@@ -1,0 +1,74 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid (reference: /root/reference, Sand3r-/Paddle).
+
+Not a port: the static-graph Program lowers to ONE jitted XLA computation
+(executor.py), dygraph records a jax.vjp tape, distribution is jax.sharding
+meshes + XLA collectives over ICI. See SURVEY.md for the design map.
+
+The `paddle_tpu.fluid` alias mirrors `paddle.fluid` so reference training
+scripts map 1:1.
+"""
+from . import core
+from .core import (CPUPlace, TPUPlace, CUDAPlace, XLAPlace, CUDAPinnedPlace,
+                   cuda_places, cpu_places, tpu_places, is_compiled_with_cuda,
+                   Scope, global_scope, scope_guard)
+from .core import unique_name
+from .core.random import seed
+from . import framework
+from .framework import (Program, Variable, default_main_program,
+                        default_startup_program, program_guard,
+                        in_dygraph_mode, manual_seed)
+from . import ops
+from . import initializer
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import layers
+from .layers.io import fluid_data as data
+from . import regularizer
+from . import clip
+from .backward import append_backward, gradients
+from . import optimizer
+from .executor import Executor
+from . import metrics
+from . import nets
+from .compiler import CompiledProgram
+from .parallel_executor import ParallelExecutor
+from . import dygraph
+from .dygraph.base import enable_dygraph, disable_dygraph, enabled
+from . import io
+from .io import (save_params, save_persistables, load_params, load_persistables,
+                 save_inference_model, load_inference_model, save_dygraph,
+                 load_dygraph)
+from . import reader
+from .reader import DataLoader
+from .data_feeder import DataFeeder
+from . import parallel
+from . import distributed
+from . import contrib
+from . import profiler
+
+# `import paddle_tpu.fluid as fluid` parity: fluid IS this module's namespace.
+import sys as _sys
+fluid = _sys.modules[__name__]
+_sys.modules[__name__ + '.fluid'] = fluid
+
+__version__ = '0.1.0'
+
+
+def install_check():
+    """fluid.install_check.run_check parity: tiny train step on the default
+    device, raises on failure."""
+    import numpy as np
+    prog = Program()
+    startup = Program()
+    with program_guard(prog, startup):
+        x = layers.data('install_check_x', [2], append_batch_size=True)
+        y = layers.fc(x, size=2)
+        loss = layers.reduce_mean(y)
+        optimizer.SGD(0.01).minimize(loss)
+    exe = Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        out = exe.run(prog, feed={'install_check_x':
+                                  np.ones((4, 2), np.float32)},
+                      fetch_list=[loss])
+    print("paddle_tpu install check passed —", out[0].shape)

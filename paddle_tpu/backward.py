@@ -1,0 +1,67 @@
+"""append_backward: mark the program for autodiff.
+
+Parity with reference python/paddle/fluid/backward.py. The reference builds
+explicit grad ops per-op via GradOpMaker; the TPU design instead inserts ONE
+backward marker op — the Executor's lowering wraps the forward segment in
+`jax.value_and_grad` over the parameter subtree, which is both simpler and
+lets XLA fuse/schedule the whole backward pass.
+"""
+from __future__ import annotations
+
+from .framework import BACKWARD_OP_TYPE, Parameter
+
+
+def _grad_name(name):
+    return name + '@GRAD'
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Returns list of (param_var, grad_var) like the reference."""
+    program = loss.block.program
+    block = program.global_block()
+    params = [p for p in program.all_parameters() if p.trainable]
+    if parameter_list:
+        wanted = {p if isinstance(p, str) else p.name for p in parameter_list}
+        params = [p for p in params if p.name in wanted]
+    if no_grad_set:
+        banned = {v if isinstance(v, str) else v.name for v in no_grad_set}
+        params = [p for p in params if p.name not in banned]
+    if not params:
+        raise ValueError("no trainable parameters to differentiate")
+
+    param_grads = []
+    for p in params:
+        g = block.create_var(name=_grad_name(p.name), shape=list(p.shape),
+                             dtype=p.dtype, stop_gradient=True)
+        param_grads.append((p, g))
+
+    block.append_op(
+        BACKWARD_OP_TYPE,
+        inputs={'Loss': loss.name},
+        outputs={'Grads': [g.name for _, g in param_grads]},
+        attrs={'loss': loss.name,
+               'params': [p.name for p, _ in param_grads],
+               'checkpoints': [c.name if hasattr(c, 'name') else c
+                               for c in (checkpoints or [])]})
+    return param_grads
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """fluid.gradients parity: symbolic grads of targets w.r.t. inputs.
+    Restricted form: single scalar target (covers ref model usage)."""
+    t = targets[0] if isinstance(targets, (list, tuple)) else targets
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    block = t.block
+    grads = []
+    for x in inputs:
+        g = block.create_var(name=_grad_name(x.name), shape=list(x.shape or []),
+                             dtype=x.dtype, stop_gradient=True)
+        grads.append(g)
+    block.append_op(
+        BACKWARD_OP_TYPE,
+        inputs={'Loss': t.name},
+        outputs={'Grads': [g.name for g in grads]},
+        attrs={'loss': t.name, 'params': [x.name for x in inputs],
+               'wrt_inputs': True, 'checkpoints': []})
+    return grads

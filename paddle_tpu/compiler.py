@@ -1,0 +1,74 @@
+"""CompiledProgram (ref: python/paddle/fluid/compiler.py).
+
+The reference's with_data_parallel clones the graph per GPU and inserts NCCL
+allreduce. TPU redesign: the program is unchanged; data parallelism = shard
+the feed batch over the mesh 'dp' axis, replicate params, and let XLA insert
+AllReduce over ICI inside the already-jitted step. build_strategy /
+exec_strategy knobs that XLA subsumes (op fusion, memory optimize) are
+accepted and ignored — that's the point of the redesign.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+class BuildStrategy:
+    """ref: framework/details/build_strategy.h knobs — accepted for compat.
+    fuse_all_reduce_ops / fuse_elewise_add_act_ops etc. are XLA's job now."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+        self.use_experimental_executor = False
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._data_sharding = None
+        self._places = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        """Shard feeds over all local devices (mesh axis 'dp')."""
+        from .parallel.mesh import get_default_mesh, make_mesh
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        mesh = get_default_mesh()
+        if mesh is None or 'dp' not in mesh.axis_names:
+            n = len(jax.devices())
+            mesh = make_mesh({'dp': n})
+        self._data_sharding = NamedSharding(mesh, PartitionSpec('dp'))
+        self._places = places
+        return self
+
+    def _compile(self, *a, **k):
+        return self

@@ -1,0 +1,2 @@
+"""contrib namespace (ref: python/paddle/fluid/contrib/)."""
+from . import mixed_precision

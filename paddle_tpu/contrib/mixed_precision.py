@@ -1,0 +1,124 @@
+"""Automatic mixed precision (ref: python/paddle/fluid/contrib/
+mixed_precision/decorator.py + fp16_lists.py).
+
+TPU-first: the fast dtype is bfloat16 (no loss scaling needed — bf16 keeps
+fp32's exponent range), but the reference's fp16 dynamic loss scaling
+machinery is kept for API parity and for fp16 compat runs. Master weights
+stay fp32; the cast list mirrors the ref's white/black lists.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework import in_dygraph_mode
+
+# ref: fp16_lists.py
+white_list = {'conv2d', 'conv3d', 'matmul', 'mul', 'conv2d_transpose'}
+black_list = {'exp', 'square', 'log', 'mean', 'sum', 'cos_sim',
+              'softmax', 'softmax_with_cross_entropy', 'sigmoid_cross_entropy_with_logits',
+              'cross_entropy', 'layer_norm', 'batch_norm', 'reduce_sum'}
+
+
+class AutoMixedPrecisionLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(white_list) | set(custom_white_list or ())
+        self.black_list = set(black_list) | set(custom_black_list or ())
+
+
+class OptimizerWithMixedPrecision:
+    """Wraps an optimizer: scales the loss, unscales grads, skips steps on
+    inf/nan (dynamic loss scaling, ref decorator.py)."""
+
+    def __init__(self, optimizer, amp_lists=None, init_loss_scaling=2.**15,
+                 incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 incr_ratio=2.0, decr_ratio=0.8, use_dynamic_loss_scaling=True,
+                 dtype='bfloat16'):
+        self._inner = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._loss_scale = float(init_loss_scaling)
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._dynamic = use_dynamic_loss_scaling
+        self._dtype = dtype
+        self._good_steps = 0
+        self._bad_steps = 0
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def get_loss_scaling(self):
+        return self._loss_scale
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list)
+        # static: bf16 scaling is a no-op numerically; scale loss for fp16
+        # parity then let the optimizer unscale via lr (scale folded in grads)
+        from ..layers.common import apply_op_layer
+        if self._dtype == 'float16' and self._loss_scale != 1.0:
+            scaled = apply_op_layer('scale', {'x': loss},
+                                    {'scale': self._loss_scale})
+            from ..backward import append_backward
+            params_grads = append_backward(scaled, parameter_list)
+            inv = 1.0 / self._loss_scale
+            params_grads = [
+                (p, apply_op_layer('scale', {'x': g}, {'scale': inv}))
+                for p, g in params_grads]
+            self._inner.apply_gradients(params_grads)
+            return None, params_grads
+        return self._inner.minimize(loss, startup_program, parameter_list,
+                                    no_grad_set)
+
+    def _dygraph_minimize(self, loss, parameter_list):
+        import numpy as np
+        params = parameter_list or self._inner._parameter_list
+        grads_finite = all(
+            bool(jnp.all(jnp.isfinite(p.grad))) for p in params
+            if p.grad is not None)
+        if not grads_finite and self._dynamic:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._loss_scale = max(self._loss_scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+            for p in params:
+                p.clear_gradient()
+            return None, []
+        self._good_steps += 1
+        if self._dynamic and self._good_steps >= self._incr_every:
+            self._loss_scale *= self._incr_ratio
+            self._good_steps = 0
+        return self._inner.minimize(loss, parameter_list=params)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.**15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8, use_dynamic_loss_scaling=True,
+             dtype='bfloat16'):
+    """fluid.contrib.mixed_precision.decorate parity."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, incr_every_n_steps,
+        decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+        use_dynamic_loss_scaling, dtype)
+
+
+def cast_model_to_bf16(layer):
+    """Cast a dygraph model's float params to bfloat16 (inference)."""
+    for p in layer.parameters():
+        if jnp.issubdtype(p.value.dtype, jnp.floating):
+            p.value = p.value.astype(jnp.bfloat16)
+    return layer
+
+
+def bf16_autocast_wrap(apply_fn):
+    """Wrap a functional apply: params stay fp32, activations compute in bf16
+    (matmul/conv inputs cast; XLA keeps accumulation fp32 on MXU)."""
+    def wrapped(params, *args, **kw):
+        cast_params = {k: (v.astype(jnp.bfloat16)
+                           if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                       for k, v in params.items()}
+        return apply_fn(cast_params, *args, **kw)
+    return wrapped

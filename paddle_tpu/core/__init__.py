@@ -1,0 +1,6 @@
+from . import dtypes, places, unique_name, scope
+from .dtypes import convert_dtype, to_jax_dtype
+from .places import (CPUPlace, TPUPlace, CUDAPlace, XLAPlace, CUDAPinnedPlace,
+                     Place, is_compiled_with_cuda, cuda_places, cpu_places,
+                     tpu_places, _get_paddle_place)
+from .scope import Scope, global_scope, scope_guard

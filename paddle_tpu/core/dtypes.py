@@ -1,0 +1,68 @@
+"""Dtype system for paddle_tpu.
+
+Parity with the reference's VarType dtypes
+(/root/reference/paddle/fluid/framework/framework.proto: VarType.Type) but
+TPU-first: bfloat16 is a first-class training dtype, float16 is a compat alias
+path, and float64 is supported-but-discouraged (TPU emulates it slowly).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype names → jnp dtypes. Mirrors the reference's
+# convert_np_dtype_to_dtype_ (python/paddle/fluid/framework.py:958).
+_NAME_TO_DTYPE = {
+    'bool': jnp.bool_,
+    'int8': jnp.int8,
+    'uint8': jnp.uint8,
+    'int16': jnp.int16,
+    'int32': jnp.int32,
+    'int64': jnp.int64,
+    'float16': jnp.float16,
+    'bfloat16': jnp.bfloat16,
+    'float32': jnp.float32,
+    'float64': jnp.float64,
+    'complex64': jnp.complex64,
+}
+
+FLOAT_DTYPES = ('float16', 'bfloat16', 'float32', 'float64')
+INT_DTYPES = ('int8', 'uint8', 'int16', 'int32', 'int64')
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (str | np.dtype | jnp dtype) to canonical string."""
+    if dtype is None:
+        return 'float32'
+    if isinstance(dtype, str):
+        name = dtype.lower()
+        if name in ('float', 'fp32'):
+            name = 'float32'
+        elif name in ('double',):
+            name = 'float64'
+        elif name in ('half', 'fp16'):
+            name = 'float16'
+        elif name in ('bf16',):
+            name = 'bfloat16'
+        elif name in ('int', 'long'):
+            name = 'int64' if name == 'long' else 'int32'
+        if name not in _NAME_TO_DTYPE:
+            raise TypeError(f"unsupported dtype: {dtype!r}")
+        return name
+    # numpy / jax dtype objects
+    name = np.dtype(dtype).name if not hasattr(dtype, 'name') else dtype.name
+    if name not in _NAME_TO_DTYPE:
+        raise TypeError(f"unsupported dtype: {dtype!r}")
+    return name
+
+
+def to_jax_dtype(dtype):
+    return _NAME_TO_DTYPE[convert_dtype(dtype)]
+
+
+def is_float(dtype):
+    return convert_dtype(dtype) in FLOAT_DTYPES
+
+
+def is_integer(dtype):
+    return convert_dtype(dtype) in INT_DTYPES

@@ -1,0 +1,38 @@
+"""PRNG key plumbing.
+
+TPU-first determinism story: one global seed → jax PRNG key tree. Static-graph
+lowering folds (step_counter, op_index) into the base key so every random op
+gets a distinct, reproducible stream; dygraph and initializers draw from a
+global splitting generator. Replaces the reference's per-op `seed` attrs and
+cuRAND states (ref: paddle/fluid/operators/dropout_op.cu seed handling).
+"""
+from __future__ import annotations
+
+import jax
+
+
+class KeyGenerator:
+    def __init__(self, seed: int = 0):
+        self.seed(seed)
+
+    def seed(self, seed: int):
+        self._base = jax.random.PRNGKey(int(seed))
+        self._counter = 0
+
+    def next_key(self):
+        self._counter += 1
+        return jax.random.fold_in(self._base, self._counter)
+
+    def base_key(self):
+        return self._base
+
+
+default_generator = KeyGenerator(0)
+
+
+def seed(s: int):
+    """Global seed entry point (ref: fluid.default_main_program().random_seed)."""
+    from .. import framework
+    framework.manual_seed(s)
+    default_generator.seed(s)
+    return default_generator

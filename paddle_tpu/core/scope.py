@@ -1,0 +1,81 @@
+"""Variable scope: runtime storage for persistable variables.
+
+Parity with the reference's framework::Scope
+(/root/reference/paddle/fluid/framework/scope.h). In the TPU design a Scope is
+a flat name → jax.Array store (a pytree leaf dict) so the whole training state
+can be passed into / donated to a jitted step function.
+"""
+from __future__ import annotations
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self._vars = {}
+        self._parent = parent
+        self._kids = []
+
+    def new_scope(self):
+        kid = Scope(parent=self)
+        self._kids.append(kid)
+        return kid
+
+    def var(self, name):
+        """Find-or-declare. Returns current value (may be None if undeclared)."""
+        if name not in self._vars and (self._parent is None or self._parent.find(name) is None):
+            self._vars[name] = None
+        return self.find(name)
+
+    def find(self, name):
+        if name in self._vars:
+            return self._vars[name]
+        if self._parent is not None:
+            return self._parent.find(name)
+        return None
+
+    def has(self, name):
+        return name in self._vars or (self._parent is not None and self._parent.has(name))
+
+    def set(self, name, value):
+        # write where the var lives, else locally
+        s = self
+        while s is not None:
+            if name in s._vars:
+                s._vars[name] = value
+                return
+            s = s._parent
+        self._vars[name] = value
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def local_names(self):
+        return list(self._vars.keys())
+
+    def all_items(self):
+        items = {} if self._parent is None else self._parent.all_items()
+        items.update(self._vars)
+        return items
+
+    def drop_kids(self):
+        self._kids.clear()
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = old

@@ -1,0 +1,36 @@
+"""DataFeeder (ref: python/paddle/fluid/data_feeder.py): converts a batch of
+python rows into the feed dict of batched numpy arrays."""
+from __future__ import annotations
+
+import numpy as np
+
+from .core.dtypes import convert_dtype
+from .framework import Variable
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_vars = feed_list
+        self.place = place
+
+    def feed(self, iterable):
+        columns = None
+        for row in iterable:
+            if columns is None:
+                columns = [[] for _ in row]
+            for c, item in zip(columns, row):
+                c.append(np.asarray(item))
+        out = {}
+        for var, col in zip(self.feed_vars, columns or []):
+            name = var.name if isinstance(var, Variable) else var
+            arr = np.stack(col)
+            if isinstance(var, Variable):
+                want = np.dtype(convert_dtype(var.dtype)
+                                .replace('bfloat16', 'float32'))
+                arr = arr.astype(want, copy=False)
+                # reshape trailing dims to the declared var shape
+                tail = [s for s in var.shape[1:]]
+                if tail and all(s > 0 for s in tail):
+                    arr = arr.reshape((arr.shape[0], *tail))
+            out[name] = arr
+        return out

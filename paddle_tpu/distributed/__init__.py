@@ -1,0 +1,9 @@
+"""paddle.distributed parity namespace (ref: python/paddle/distributed/):
+launch utilities + collective API re-exports."""
+from ..parallel import (fleet, Fleet, DistributedStrategy, make_mesh,
+                        set_default_mesh, get_default_mesh, topology)
+from ..parallel.collective import (allreduce_sum, allreduce_mean,
+                                   allreduce_max, allreduce_min, allgather,
+                                   reduce_scatter, broadcast, alltoall,
+                                   ppermute, barrier)
+from .launch import launch, init_parallel_env, get_rank, get_world_size

@@ -1,0 +1,50 @@
+"""Multi-host launch (ref: python/paddle/distributed/launch.py).
+
+The reference spawns one process per GPU and wires NCCL via env vars. On TPU
+pods each host already runs one process per slice-host; initialization is
+jax.distributed.initialize() with coordinator discovery from env (TPU metadata
+provides it automatically on Cloud TPU).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None):
+    """Initialize multi-host jax runtime. No-op on single host."""
+    if num_processes is None:
+        num_processes = int(os.environ.get('PADDLE_TRAINERS_NUM', '1'))
+    if num_processes <= 1:
+        return
+    kwargs = {}
+    if coordinator_address:
+        kwargs['coordinator_address'] = coordinator_address
+        kwargs['num_processes'] = num_processes
+        kwargs['process_id'] = process_id or int(
+            os.environ.get('PADDLE_TRAINER_ID', '0'))
+    jax.distributed.initialize(**kwargs)
+
+
+def get_rank():
+    return jax.process_index()
+
+
+def get_world_size():
+    return jax.process_count()
+
+
+def launch(training_script, args=(), nproc_per_node=None):
+    """Single-host convenience: on TPU the runtime owns all local chips in one
+    process, so `launch` execs the script directly (ref behavior of spawning
+    per-GPU workers is unnecessary)."""
+    import runpy
+    import sys
+    old_argv = sys.argv
+    sys.argv = [training_script] + list(args)
+    try:
+        runpy.run_path(training_script, run_name='__main__')
+    finally:
+        sys.argv = old_argv

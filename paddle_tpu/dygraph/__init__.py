@@ -1,0 +1,25 @@
+"""Imperative (dygraph) mode — ref: python/paddle/fluid/dygraph/."""
+from .base import guard, enable_dygraph, disable_dygraph, enabled, to_variable
+from .tape import Tensor, Parameter, no_grad, no_grad_guard, dispatch_op
+from .layers import Layer
+from .container import Sequential, LayerList, ParameterList
+from .nn import (Conv2D, Conv3D, Pool2D, Linear, BatchNorm, Embedding,
+                 GRUUnit, LayerNorm, NCE, PRelu, BilinearTensorProduct,
+                 Conv2DTranspose, Conv3DTranspose, GroupNorm, SpectralNorm,
+                 TreeConv, Dropout)
+from . import jit
+from .jit import TracedLayer, declarative
+from .parallel import DataParallel, ParallelEnv, prepare_context
+from .checkpoint import save_dygraph, load_dygraph
+from .learning_rate_scheduler import (LearningRateDecay, PiecewiseDecay,
+                                      NaturalExpDecay, ExponentialDecay,
+                                      InverseTimeDecay, PolynomialDecay,
+                                      CosineDecay, NoamDecay)
+
+
+class BackwardStrategy:
+    """ref: imperative/backward_strategy.h — sort_sum_gradient accepted for
+    parity; the tape already accumulates deterministically."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False

@@ -1,0 +1,42 @@
+"""Dygraph mode switches (ref: python/paddle/fluid/dygraph/base.py)."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from .. import framework
+from .tape import Tensor, no_grad, no_grad_guard
+
+
+class _Tracer:
+    """Marker object; framework.in_dygraph_mode() keys off its presence
+    (ref: the C++ imperative::Tracer held by framework._dygraph_tracer_)."""
+    pass
+
+
+def enabled():
+    return framework.in_dygraph_mode()
+
+
+def enable_dygraph(place=None):
+    framework._dygraph_tracer_ = _Tracer()
+
+
+def disable_dygraph():
+    framework._dygraph_tracer_ = None
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    enable_dygraph(place)
+    try:
+        yield
+    finally:
+        disable_dygraph()
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, Tensor):
+        return value
+    return Tensor(np.asarray(value), name=name, stop_gradient=True)

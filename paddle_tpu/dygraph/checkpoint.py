@@ -1,0 +1,41 @@
+"""save_dygraph / load_dygraph (ref: python/paddle/fluid/dygraph/checkpoint.py).
+
+Format: numpy .npz per state dict (portable, no pickle of arrays), plus a
+small JSON manifest. Large sharded states use io.orbax paths instead.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from .tape import Tensor
+
+
+def save_dygraph(state_dict, model_path):
+    """state_dict: name → Tensor/ndarray. Writes {model_path}.pdparams(.npz)."""
+    os.makedirs(os.path.dirname(model_path) or '.', exist_ok=True)
+    arrays = {}
+    meta = {}
+    for k, v in state_dict.items():
+        arr = np.asarray(v.value) if isinstance(v, Tensor) else np.asarray(v)
+        arrays[k] = arr
+        meta[k] = {'shape': list(arr.shape), 'dtype': str(arr.dtype)}
+    np.savez(model_path + '.pdparams.npz', **arrays)
+    with open(model_path + '.pdparams.json', 'w') as f:
+        json.dump(meta, f)
+
+
+def load_dygraph(model_path, keep_name_table=False):
+    path = model_path + '.pdparams.npz'
+    if not os.path.exists(path):
+        raise ValueError(f"no checkpoint at {model_path}")
+    data = np.load(path)
+    state = {k: data[k] for k in data.files}
+    opt_path = model_path + '.pdopt.npz'
+    opt_state = None
+    if os.path.exists(opt_path):
+        od = np.load(opt_path)
+        opt_state = {k: od[k] for k in od.files}
+    return state, opt_state

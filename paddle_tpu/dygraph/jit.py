@@ -1,0 +1,216 @@
+"""dygraph.jit: to_static / TracedLayer / fused training steps.
+
+Parity with reference python/paddle/fluid/dygraph/jit.py +
+dygraph_to_static/: where the reference translates Python AST to a static
+Program, the TPU design traces the SAME eager code with jax tracers (the tape
+runs the identical registered functionals), producing one fused XLA
+computation. `TrainStep` additionally folds grad + optimizer update into that
+single program — the production training path used by the benchmarks.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .tape import Tensor, Parameter, no_grad_guard
+from .layers import Layer
+
+
+@contextlib.contextmanager
+def _bind(tensors: dict, values: dict):
+    """Temporarily swap Tensor.value for traced values; restore after."""
+    saved = {n: t.value for n, t in tensors.items()}
+    try:
+        for n, t in tensors.items():
+            if n in values:
+                t.value = values[n]
+        yield
+    finally:
+        for n, t in tensors.items():
+            t.value = saved[n]
+
+
+def _tensorize(args):
+    return [a if isinstance(a, Tensor) else Tensor(a, stop_gradient=True)
+            for a in args]
+
+
+def _devalue(out):
+    if isinstance(out, Tensor):
+        return out.value
+    if isinstance(out, (list, tuple)):
+        return type(out)(_devalue(o) for o in out)
+    return out
+
+
+def functionalize(layer: Layer):
+    """layer → (apply_fn, params, buffers) where
+    apply_fn(params, buffers, *arg_arrays) -> (outputs, new_buffers) is pure."""
+    params = dict(layer.named_parameters())
+    buffers = dict(layer.named_buffers())
+
+    def apply_fn(param_vals, buffer_vals, *args):
+        with _bind(params, param_vals), _bind(buffers, buffer_vals):
+            with no_grad_guard():
+                out = layer(*_tensorize(args))
+            new_buffers = {n: b.value for n, b in buffers.items()}
+        return _devalue(out), new_buffers
+
+    return apply_fn, {n: p.value for n, p in params.items()}, \
+        {n: b.value for n, b in buffers.items()}
+
+
+class TracedLayer:
+    """ref: dygraph/jit.py:TracedLayer — here a jitted functional closure."""
+
+    def __init__(self, layer, apply_fn, params, buffers):
+        self._layer = layer
+        self._apply = jax.jit(apply_fn)
+        self._params = params
+        self._buffers = buffers
+
+    @staticmethod
+    def trace(layer, inputs):
+        apply_fn, params, buffers = functionalize(layer)
+        traced = TracedLayer(layer, apply_fn, params, buffers)
+        out = traced(*inputs)
+        return out, traced
+
+    def __call__(self, *args):
+        vals = [a.value if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        out, _ = self._apply(self._params, self._buffers, *vals)
+        if isinstance(out, (list, tuple)):
+            return type(out)(Tensor(o, stop_gradient=True) for o in out)
+        return Tensor(out, stop_gradient=True)
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        from ..io import _save_jit_model
+        _save_jit_model(dirname, self._layer, self._params, self._buffers)
+
+
+def declarative(fn):
+    """@declarative / to_static: jit the eager function. Parameters of any
+    Layer bound as `self` are captured fresh each call."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return fn(*args, **kwargs)
+    wrapper._is_declarative = True
+    return wrapper
+
+
+to_static = declarative
+
+
+class TrainStep:
+    """Fully-fused training step: forward + vjp + optimizer update in ONE
+    jitted XLA program with donated state (the TPU analogue of the reference
+    ParallelExecutor fast path). Use:
+
+        step = TrainStep(model, loss_fn, optimizer)
+        loss = step(x_batch, y_batch)          # numpy/jax arrays in
+    """
+
+    def __init__(self, layer: Layer, loss_fn, optimizer, data_sharding=None,
+                 remat=False, donate=True):
+        self._layer = layer
+        self._params = dict(layer.named_parameters())
+        self._buffers = dict(layer.named_buffers())
+        self._opt = optimizer
+        self._loss_fn = loss_fn
+        self._remat = remat
+        self._data_sharding = data_sharding
+        self._jitted = None
+        self._slots = None
+        self._step = 0
+
+    def _build(self):
+        layer = self._layer
+        params = self._params
+        buffers = self._buffers
+        loss_fn = self._loss_fn
+        opt = self._opt
+        slot_names = opt._slot_names
+        hypers = opt._hypers()
+        has_lr = opt._has_lr_input
+        from ..ops.registry import get_op
+        update_fn = get_op(opt._op_type).fn
+        clip = opt._grad_clip
+        base_reg = opt.regularization
+        regs = {n: (getattr(p, 'regularizer', None) or base_reg)
+                for n, p in params.items()}
+        trainable = {n for n, p in params.items() if p.trainable}
+
+        def forward(pvals, bvals, batch):
+            with _bind(params, pvals), _bind(buffers, bvals):
+                with no_grad_guard():
+                    loss = loss_fn(layer, *_tensorize(batch))
+                new_b = {n: b.value for n, b in buffers.items()}
+            lv = loss.value if isinstance(loss, Tensor) else loss
+            return jnp.sum(lv), new_b
+
+        if self._remat:
+            forward = jax.checkpoint(forward, static_argnums=())
+
+        def step(pvals, bvals, slots, lr, batch):
+            train_p = {n: pvals[n] for n in trainable}
+            frozen_p = {n: v for n, v in pvals.items() if n not in trainable}
+
+            def f(tp):
+                return forward({**frozen_p, **tp}, bvals, batch)
+
+            (loss, new_b), grads = jax.value_and_grad(f, has_aux=True)(train_p)
+            for n in grads:
+                if regs[n] is not None:
+                    grads[n] = regs[n].apply(train_p[n], grads[n])
+            if clip is not None:
+                grads = clip.apply_tree(grads)
+            new_p = dict(frozen_p)
+            new_slots = {}
+            for n in trainable:
+                args = [train_p[n], grads[n]] + \
+                    [slots[n][s] for s in slot_names]
+                if has_lr:
+                    args.append(lr)
+                res = update_fn(*args, **hypers)
+                res = res if isinstance(res, tuple) else (res,)
+                new_p[n] = res[0]
+                new_slots[n] = dict(zip(slot_names, res[1:]))
+            return new_p, new_b, new_slots, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def state(self):
+        return ({n: p.value for n, p in self._params.items()},
+                {n: b.value for n, b in self._buffers.items()})
+
+    def __call__(self, *batch):
+        if self._jitted is None:
+            self._jitted = self._build()
+            self._slots = {
+                n: {s: jnp.full(shp, fill, jnp.float32)
+                    for s, (shp, fill) in
+                    self._opt._slot_init(list(p.shape), p.dtype).items()}
+                for n, p in self._params.items() if p.trainable}
+        batch_vals = []
+        for b in batch:
+            arr = b.value if isinstance(b, Tensor) else jnp.asarray(b)
+            if self._data_sharding is not None:
+                arr = jax.device_put(arr, self._data_sharding)
+            batch_vals.append(arr)
+        pvals, bvals = self.state()
+        new_p, new_b, self._slots, loss = self._jitted(
+            pvals, bvals, self._slots, jnp.float32(self._opt._current_lr()),
+            tuple(batch_vals))
+        for n, p in self._params.items():
+            p.value = new_p[n]
+        for n, b in self._buffers.items():
+            b.value = new_b[n]
+        self._step += 1
+        if hasattr(self._opt._learning_rate, 'step'):
+            self._opt._learning_rate.step()
+        return loss

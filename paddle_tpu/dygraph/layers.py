@@ -1,0 +1,189 @@
+"""dygraph.Layer base (ref: python/paddle/fluid/dygraph/layers.py)."""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import unique_name
+from ..core.dtypes import convert_dtype, to_jax_dtype
+from ..initializer import ConstantInitializer, XavierInitializer
+from ..param_attr import ParamAttr
+from .tape import Parameter, Tensor
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype='float32'):
+        self._full_name = unique_name.generate(
+            name_scope or self.__class__.__name__.lower())
+        self._dtype = convert_dtype(dtype)
+        self._parameters = OrderedDict()
+        self._sub_layers = OrderedDict()
+        self._buffers = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    # ---- params / sublayers ----
+    def create_parameter(self, shape, attr=None, dtype='float32',
+                         is_bias=False, default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        init = attr.initializer or default_initializer or (
+            ConstantInitializer(0.0) if is_bias else XavierInitializer())
+        value = init.compute([int(s) for s in shape], convert_dtype(dtype))
+        name = attr.name or unique_name.generate(self._full_name + '.w')
+        p = Parameter(value, name=name, trainable=attr.trainable,
+                      regularizer=attr.regularizer,
+                      learning_rate=attr.learning_rate)
+        return p
+
+    def create_buffer(self, shape, dtype='float32', fill=0.0):
+        t = Tensor(jnp.full(tuple(shape), fill, to_jax_dtype(dtype)),
+                   stop_gradient=True, persistable=True)
+        return t
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor):
+        self._buffers[name] = tensor
+        return tensor
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self.__dict__.setdefault('_parameters', OrderedDict())[name] = value
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault('_sub_layers', OrderedDict())[name] = value
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ('_parameters', '_sub_layers', '_buffers'):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__} has no attribute {name!r}")
+
+    # ---- traversal ----
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def named_parameters(self, prefix=''):
+        for n, p in self._parameters.items():
+            yield (prefix + n if not prefix else prefix + '.' + n), p
+        for ln, l in self._sub_layers.items():
+            sub_prefix = ln if not prefix else prefix + '.' + ln
+            yield from l.named_parameters(sub_prefix)
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    def named_sublayers(self, prefix=''):
+        for n, l in self._sub_layers.items():
+            name = n if not prefix else prefix + '.' + n
+            yield name, l
+            yield from l.named_sublayers(name)
+
+    def buffers(self, include_sublayers=True):
+        out = list(self._buffers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.buffers())
+        return out
+
+    def named_buffers(self, prefix=''):
+        for n, b in self._buffers.items():
+            yield (prefix + '.' + n if prefix else n), b
+        for ln, l in self._sub_layers.items():
+            yield from l.named_buffers(ln if not prefix else prefix + '.' + ln)
+
+    # ---- modes ----
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # ---- state ----
+    def state_dict(self, destination=None, include_sublayers=True,
+                   prefix=''):
+        dest = destination if destination is not None else OrderedDict()
+        for n, p in self.named_parameters():
+            dest[n] = p
+        for n, b in self.named_buffers():
+            dest[n] = b
+        return dest
+
+    def set_dict(self, state, include_sublayers=True, use_structured_name=True):
+        own = self.state_dict()
+        for n, t in own.items():
+            if n in state:
+                src = state[n]
+                arr = src.value if isinstance(src, Tensor) else jnp.asarray(src)
+                t.value = arr.astype(t.value.dtype).reshape(t.value.shape)
+
+    load_dict = set_dict
+    set_state_dict = set_dict
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # ---- hooks ----
+    def register_forward_pre_hook(self, hook):
+        key = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[key] = hook
+        return _HookRemover(self._forward_pre_hooks, key)
+
+    def register_forward_post_hook(self, hook):
+        key = len(self._forward_post_hooks)
+        self._forward_post_hooks[key] = hook
+        return _HookRemover(self._forward_post_hooks, key)
+
+    # ---- call ----
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            res = hook(self, args)
+            if res is not None:
+                args = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, args, out)
+            if res is not None:
+                out = res
+        return out
+
+
+class _HookRemover:
+    def __init__(self, store, key):
+        self._store, self._key = store, key
+
+    def remove(self):
+        self._store.pop(self._key, None)

@@ -1,0 +1,112 @@
+"""Dygraph DataParallel (ref: python/paddle/fluid/dygraph/parallel.py).
+
+TPU redesign: the reference all-reduces gradients over NCCL after backward;
+here data parallelism is expressed by sharding the batch over a
+jax.sharding.Mesh axis — XLA inserts the AllReduce over ICI during the fused
+step (see parallel/mesh.py). The eager API keeps ref semantics:
+scale_loss / apply_collective_grads are identity when world size is 1.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+from .tape import Tensor
+
+
+class ParallelEnv:
+    """ref: dygraph/parallel.py:Env — rank/world topology discovery from the
+    jax runtime (slice metadata) instead of env vars."""
+
+    def __init__(self):
+        self._nranks = jax.process_count()
+        self._local_rank = jax.process_index()
+
+    @property
+    def nranks(self):
+        return self._nranks
+
+    @property
+    def local_rank(self):
+        return self._local_rank
+
+    @property
+    def rank(self):
+        return self._local_rank
+
+    @property
+    def world_size(self):
+        return self._nranks
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return f"process:{self._local_rank}"
+
+    @property
+    def trainer_endpoints(self):
+        return [f"process:{i}" for i in range(self._nranks)]
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    """Wraps a Layer for data-parallel training. With a mesh (see
+    parallel.mesh.get_default_mesh) the fused TrainStep shards batches over
+    the 'dp' axis; eagerly, grads are averaged across the mesh when one is
+    active (single-host: identity, matching ref nranks==1 behavior)."""
+
+    def __init__(self, layers, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy or ParallelEnv()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @property
+    def _nranks(self):
+        from ..parallel.mesh import get_default_mesh
+        mesh = get_default_mesh()
+        if mesh is not None and 'dp' in mesh.axis_names:
+            return mesh.shape['dp']
+        return 1
+
+    def scale_loss(self, loss):
+        n = self._nranks
+        if n <= 1:
+            return loss
+        return loss * (1.0 / n)
+
+    def apply_collective_grads(self):
+        """Average gradients across the dp mesh axis. Under the sharded jit
+        step XLA already psums grads; eager path averages explicitly."""
+        n = self._nranks
+        if n <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                p.grad = p.grad / n
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix=''):
+        return self._layers.named_parameters(prefix)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_dict(self, *a, **k):
+        return self._layers.set_dict(*a, **k)
+
+    load_dict = set_dict

@@ -1,0 +1,346 @@
+"""Dygraph autograd: Tensor (VarBase) + tape of jax.vjp nodes.
+
+Parity with the reference imperative engine
+(/root/reference/paddle/fluid/imperative/tracer.cc + gradient accumulation in
+imperative/layer.cc), redesigned for XLA: every eager op call runs the SAME
+registered jax functional the static graph uses, capturing its vjp; backward()
+walks the tape in reverse topological order. Under `jit.to_static` the tape
+records through tracers, so the whole step can still fuse into one XLA program.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import unique_name
+from ..core.dtypes import convert_dtype, to_jax_dtype
+from ..core.random import default_generator
+from ..ops.registry import get_op
+
+_grad_enabled = True
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    global _grad_enabled
+    old = _grad_enabled
+    _grad_enabled = False
+    try:
+        yield
+    finally:
+        _grad_enabled = old
+
+
+def no_grad(fn=None):
+    if fn is None:
+        return no_grad_guard()
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        with no_grad_guard():
+            return fn(*a, **k)
+    return wrapper
+
+
+class Node:
+    __slots__ = ('vjp_fn', 'inputs', 'n_outputs', 'out_avals', 'op_type')
+
+    def __init__(self, vjp_fn, inputs, n_outputs, out_avals, op_type):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs          # list[Tensor] in vjp arg order
+        self.n_outputs = n_outputs
+        self.out_avals = out_avals    # [(shape, dtype)] per output
+        self.op_type = op_type
+
+
+class Tensor:
+    """VarBase parity: eager tensor with autograd metadata."""
+
+    def __init__(self, value, name=None, stop_gradient=False,
+                 persistable=False, dtype=None):
+        if dtype is not None:
+            value = jnp.asarray(value, to_jax_dtype(dtype))
+        else:
+            value = jnp.asarray(value)
+        self.value = value
+        self.name = name or unique_name.generate('tensor')
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.grad = None
+        self._node: Optional[Node] = None
+        self._out_index = 0
+
+    # ---- info ----
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return convert_dtype(self.value.dtype)
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def item(self):
+        return self.value.item()
+
+    def __len__(self):
+        return self.value.shape[0]
+
+    def __repr__(self):
+        return f"Tensor(name={self.name}, shape={self.shape}, " \
+               f"dtype={self.dtype}, stop_gradient={self.stop_gradient})\n" \
+               f"{self.value}"
+
+    # ---- autograd ----
+    def backward(self, retain_graph=False, backward_strategy=None):
+        run_backward(self)
+
+    def gradient(self):
+        return None if self.grad is None else np.asarray(self.grad)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def detach(self):
+        t = Tensor(self.value, stop_gradient=True)
+        return t
+
+    def set_value(self, value):
+        v = value.value if isinstance(value, Tensor) else jnp.asarray(value)
+        self.value = v.astype(self.value.dtype)
+
+    def astype(self, dtype):
+        return dispatch_op('cast', {'x': self}, {'dtype': convert_dtype(dtype)})
+
+    # math dunders are attached by monkey_patch_tensor() below
+
+
+class Parameter(Tensor):
+    def __init__(self, value, name=None, trainable=True, regularizer=None,
+                 **kw):
+        super().__init__(value, name=name, stop_gradient=not trainable,
+                         persistable=True)
+        self.trainable = trainable
+        self.regularizer = regularizer
+        self.optimize_attr = {'learning_rate': kw.get('learning_rate', 1.0)}
+
+
+def to_tensor_value(x):
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def dispatch_op(op_type, inputs, attrs):
+    """Run a registered op eagerly, recording the tape. `inputs` is
+    slot → Tensor | [Tensor] | None, matching the op's positional slots."""
+    opdef = get_op(op_type)
+    flat_tensors = []   # tensors participating in vjp
+    arg_spec = []       # per-slot: ('single', idx) | ('list', [idx]) | ('const', v)
+    for slot in opdef.input_slots:
+        v = inputs.get(slot)
+        if v is None:
+            arg_spec.append(('const', None))
+        elif isinstance(v, (list, tuple)):
+            idxs = []
+            for item in v:
+                t = item if isinstance(item, Tensor) else Tensor(item, stop_gradient=True)
+                idxs.append(len(flat_tensors))
+                flat_tensors.append(t)
+            arg_spec.append(('list', idxs))
+        else:
+            t = v if isinstance(v, Tensor) else Tensor(v, stop_gradient=True)
+            arg_spec.append(('single', len(flat_tensors)))
+            flat_tensors.append(t)
+
+    attrs = dict(attrs)
+    if opdef.needs_rng and 'key' not in attrs:
+        attrs['key'] = default_generator.next_key()
+
+    def call(*vals):
+        args = []
+        for kind, ref in arg_spec:
+            if kind == 'const':
+                args.append(ref)
+            elif kind == 'single':
+                args.append(vals[ref])
+            else:
+                args.append([vals[i] for i in ref])
+        return opdef.fn(*args, **attrs)
+
+    vals = [t.value for t in flat_tensors]
+    needs_grad = _grad_enabled and any(
+        not t.stop_gradient and jnp.issubdtype(t.value.dtype, jnp.inexact)
+        for t in flat_tensors)
+
+    if not needs_grad:
+        result = call(*vals)
+        return _wrap_outputs(opdef, result, node=None)
+
+    result, vjp_fn = jax.vjp(call, *vals)
+    flat_res = _flatten_result(opdef, result)
+    node = Node(vjp_fn, flat_tensors, len(flat_res),
+                [(r.shape, r.dtype) for r in flat_res], op_type)
+    return _wrap_outputs(opdef, result, node)
+
+
+def _flatten_result(opdef, result):
+    if len(opdef.output_slots) == 1:
+        return list(result) if isinstance(result, (list, tuple)) else [result]
+    flat = []
+    for r in result:
+        flat.extend(r if isinstance(r, (list, tuple)) else [r])
+    return flat
+
+
+def _wrap_outputs(opdef, result, node):
+    def mk(val, idx):
+        t = Tensor(val, stop_gradient=(node is None))
+        t._node = node
+        t._out_index = idx
+        return t
+
+    if len(opdef.output_slots) == 1:
+        if isinstance(result, (list, tuple)):
+            return [mk(v, i) for i, v in enumerate(result)]
+        return mk(result, 0)
+    outs = []
+    idx = 0
+    for r in result:
+        if isinstance(r, (list, tuple)):
+            outs.append([mk(v, idx + j) for j, v in enumerate(r)])
+            idx += len(r)
+        else:
+            outs.append(mk(r, idx))
+            idx += 1
+    return tuple(outs)
+
+
+def run_backward(loss: Tensor):
+    """Reverse-topological tape walk (ref: imperative/engine.cc)."""
+    if loss._node is None:
+        raise RuntimeError("backward() on a tensor with no grad history")
+    topo = []
+    seen = set()
+
+    def dfs(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for t in node.inputs:
+            if t._node is not None:
+                dfs(t._node)
+        topo.append(node)
+
+    dfs(loss._node)
+
+    cotangents = {}  # id(node) → [array or None per output]
+
+    def seed_ct(node, idx, val):
+        lst = cotangents.setdefault(id(node), [None] * node.n_outputs)
+        lst[idx] = val if lst[idx] is None else lst[idx] + val
+
+    seed_ct(loss._node, loss._out_index,
+            jnp.ones(loss.shape, to_jax_dtype(loss.dtype)))
+
+    for node in reversed(topo):
+        cts = cotangents.pop(id(node), None)
+        if cts is None:
+            continue
+        full = []
+        for i, (shape, dtype) in enumerate(node.out_avals):
+            if cts[i] is not None:
+                full.append(cts[i])
+            else:
+                full.append(jnp.zeros(shape, dtype))
+        # rebuild the vjp cotangent structure (mirror of the primal output)
+        ct_struct = _rebuild_ct(node, full)
+        in_cts = node.vjp_fn(ct_struct)
+        for t, g in zip(node.inputs, in_cts):
+            if t.stop_gradient or not jnp.issubdtype(t.value.dtype, jnp.inexact):
+                continue
+            if type(g).__name__ == 'float0' or (hasattr(g, 'dtype') and
+                                                g.dtype == jax.dtypes.float0):
+                continue
+            if t._node is not None:
+                seed_ct(t._node, t._out_index, g)
+            else:
+                t.grad = g if t.grad is None else t.grad + g
+        # leaf accumulation also for tensors that have nodes but are params?
+        # params are leaves (no node), handled above.
+    # intermediate tensors keep no .grad (matches ref default)
+
+
+def _rebuild_ct(node, flat):
+    """Reshape flat cotangent list back into the op's output structure."""
+    try:
+        opdef = get_op(node.op_type)
+    except KeyError:
+        return flat[0] if node.n_outputs == 1 else tuple(flat)
+    if len(opdef.output_slots) == 1:
+        if node.n_outputs == 1:
+            return flat[0]
+        return flat  # variadic single-slot (e.g. split) → list
+    return tuple(flat)
+
+
+def monkey_patch_tensor():
+    T = Tensor
+
+    def _coerce(other):
+        return other if isinstance(other, Tensor) else Tensor(other, stop_gradient=True)
+
+    def binop(op_type, reverse=False):
+        def impl(self, other):
+            other = _coerce(other)
+            x, y = (other, self) if reverse else (self, other)
+            return dispatch_op(op_type, {'x': x, 'y': y}, {})
+        return impl
+
+    T.__add__ = binop('elementwise_add')
+    T.__radd__ = binop('elementwise_add', True)
+    T.__sub__ = binop('elementwise_sub')
+    T.__rsub__ = binop('elementwise_sub', True)
+    T.__mul__ = binop('elementwise_mul')
+    T.__rmul__ = binop('elementwise_mul', True)
+    T.__truediv__ = binop('elementwise_div')
+    T.__rtruediv__ = binop('elementwise_div', True)
+    T.__pow__ = binop('elementwise_pow')
+    T.__mod__ = binop('elementwise_mod')
+    T.__floordiv__ = binop('elementwise_floordiv')
+    T.__matmul__ = lambda self, other: dispatch_op(
+        'matmul', {'x': self, 'y': _coerce(other)}, {})
+    T.__neg__ = lambda self: dispatch_op('scale', {'x': self}, {'scale': -1.0})
+    T.__eq__ = binop('equal')
+    T.__ne__ = binop('not_equal')
+    T.__lt__ = binop('less_than')
+    T.__le__ = binop('less_equal')
+    T.__gt__ = binop('greater_than')
+    T.__ge__ = binop('greater_equal')
+    T.__hash__ = lambda self: id(self)
+
+    def _getitem(self, idx):
+        if isinstance(idx, Tensor):
+            idx = idx.value
+        if (self.stop_gradient or not _grad_enabled
+                or not jnp.issubdtype(self.value.dtype, jnp.inexact)):
+            return Tensor(self.value[idx], stop_gradient=True)
+        out, vjp_fn = jax.vjp(lambda v: v[idx], self.value)
+        node = Node(vjp_fn, [self], 1, [(out.shape, out.dtype)], '__getitem__')
+        t = Tensor(out)
+        t._node = node
+        return t
+
+    T.__getitem__ = _getitem
+
+
+monkey_patch_tensor()

@@ -1,0 +1,226 @@
+"""Executor: lowers a Program to ONE pure jax function and runs it jitted.
+
+Parity with reference python/paddle/fluid/executor.py + the C++ executor
+(/root/reference/paddle/fluid/framework/executor.cc). The TPU redesign (see
+BASELINE.json north star): instead of per-op kernel dispatch, the whole
+Program becomes `step(state, feeds, key) -> (new_state, fetches)`, compiled
+through an XLA compile cache keyed by (program version, feed shapes). Backward
+markers lower to jax.value_and_grad; optimizer ops run inside the same fused
+step; persistable writes return functionally and are stored back to the Scope.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.dtypes import to_jax_dtype
+from .core.places import _get_paddle_place
+from .core.scope import global_scope
+from .core.random import default_generator
+from .framework import (BACKWARD_OP_TYPE, Program, Variable,
+                        default_main_program)
+from .ops.registry import get_op
+
+
+class _OpRunner:
+    """Executes one IR op given a name→value resolver. Shared by the jit
+    lowering and the eager startup path."""
+
+    @staticmethod
+    def run(op, read, write, key):
+        if op.type == '__init__':
+            attrs = op.attrs
+            out = attrs['initializer'].compute(attrs['shape'], attrs['dtype'],
+                                               key=key)
+            write(op.outputs['Out'][0], out)
+            return
+        if op.type == '__constant__':
+            write(op.outputs['Out'][0], jnp.asarray(op.attrs['value']))
+            return
+        opdef = get_op(op.type)
+        args = []
+        for slot in opdef.input_slots:
+            names = op.inputs.get(slot, [])
+            if not names:
+                args.append(None)
+            elif slot in opdef.variadic:
+                args.append([read(n) for n in names])
+            else:
+                args.append(read(names[0]))
+        attrs = {k: v for k, v in op.attrs.items() if k != 'initializer'}
+        if opdef.needs_rng:
+            attrs['key'] = key
+        result = opdef.fn(*args, **attrs)
+        results = [result] if len(opdef.output_slots) == 1 else list(result)
+        for slot, res in zip(opdef.output_slots, results):
+            names = op.outputs.get(slot, [])
+            if not names:
+                continue
+            res_list = res if isinstance(res, (list, tuple)) else [res]
+            if len(names) == 1 and len(res_list) == 1:
+                write(names[0], res_list[0])
+            else:
+                for n, r in zip(names, res_list):
+                    write(n, r)
+
+
+def _lower(program: Program, feed_names, fetch_names, state_names):
+    """Build the pure step function for `program`."""
+    ops = list(program.global_block().ops)
+    bwd_idx = next((i for i, op in enumerate(ops)
+                    if op.type == BACKWARD_OP_TYPE), None)
+    state_set = frozenset(state_names)
+
+    def step(state, feeds, base_key):
+        env: Dict[str, object] = dict(feeds)
+
+        def make_read(*stores):
+            def read(name):
+                for s in stores:
+                    if name in s:
+                        return s[name]
+                raise KeyError(
+                    f"variable '{name}' has no value: not a feed, not in "
+                    f"scope (did you run the startup program?)")
+            return read
+
+        def run_seq(op_list, offset, read, write):
+            for i, op in enumerate(op_list):
+                _OpRunner.run(op, read, write,
+                              jax.random.fold_in(base_key, offset + i))
+
+        if bwd_idx is None:
+            run_seq(ops, 0, make_read(env, state), env.__setitem__)
+        else:
+            marker = ops[bwd_idx]
+            loss_name = marker.attrs['loss']
+            param_names = marker.attrs['params']
+            params = {n: state[n] for n in param_names}
+            fwd_ops = ops[:bwd_idx]
+
+            def fwd(pvals):
+                e = dict(feeds)
+                run_seq(fwd_ops, 0, make_read(e, pvals, state), e.__setitem__)
+                loss = e[loss_name]
+                return jnp.sum(loss), e
+
+            (_, env), grads = jax.value_and_grad(fwd, has_aux=True)(params)
+            for n, gname in zip(param_names, marker.outputs['Grads']):
+                env[gname] = grads[n]
+            run_seq(ops[bwd_idx + 1:], bwd_idx + 1,
+                    make_read(env, state), env.__setitem__)
+
+        # ALL state passes through (donated inputs alias unwritten outputs —
+        # otherwise the scope would keep handles to donated buffers)
+        new_state = {n: env.get(n, state[n]) for n in state_set}
+        read = make_read(env, state)
+        fetches = [read(n) for n in fetch_names]
+        return new_state, fetches
+
+    return step
+
+
+class Executor:
+    """fluid.Executor parity. `place` is accepted for compat; execution always
+    targets the default XLA backend."""
+
+    def __init__(self, place=None):
+        self.place = _get_paddle_place(place)
+        self._cache = {}
+        self._step_counter = 0
+
+    # ------------------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True, feed_var_name='feed',
+            fetch_var_name='fetch'):
+        from .compiler import CompiledProgram
+        sharding = None
+        if isinstance(program, CompiledProgram):
+            sharding = program._data_sharding
+            program = program._program
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in fetch_list]
+
+        block = program.global_block()
+        if any(op.type == '__init__' for op in block.ops):
+            self._run_startup(program, scope)
+            return []
+
+        # persistable vars = training state
+        state_names = sorted(v.name for v in program.list_vars()
+                             if v.persistable)
+        state = {}
+        for n in state_names:
+            val = scope.find(n)
+            if val is None:
+                raise RuntimeError(
+                    f"persistable var '{n}' is uninitialized; run the startup "
+                    f"program first (exe.run(fluid.default_startup_program()))")
+            state[n] = val
+
+        feed_vals = {}
+        for name, value in feed.items():
+            dtype = block.var(name).dtype if block.has_var(name) else None
+            arr = jnp.asarray(value, to_jax_dtype(dtype) if dtype else None)
+            if sharding is not None:
+                arr = jax.device_put(arr, sharding)
+            feed_vals[name] = arr
+
+        feed_sig = tuple(sorted((n, v.shape, str(v.dtype))
+                                for n, v in feed_vals.items()))
+        key = (id(program), program._version, feed_sig, tuple(fetch_names),
+               tuple(state_names))
+        fn = self._cache.get(key)
+        if fn is None:
+            step = _lower(program, list(feed_vals), fetch_names, state_names)
+            fn = jax.jit(step, donate_argnums=(0,))
+            self._cache[key] = fn
+
+        self._step_counter += 1
+        base_key = jax.random.fold_in(default_generator.base_key(),
+                                      self._step_counter)
+        new_state, fetches = fn(state, feed_vals, base_key)
+        for n, v in new_state.items():
+            scope.set(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches
+
+    # ------------------------------------------------------------------
+    def _run_startup(self, program, scope):
+        """Run an init program eagerly (once-per-training cost; not jitted)."""
+        self._step_counter += 1
+        base_key = jax.random.fold_in(default_generator.base_key(),
+                                      self._step_counter)
+        env = {}
+
+        def read(name):
+            if name in env:
+                return env[name]
+            v = scope.find(name)
+            if v is None:
+                raise KeyError(f"startup: uninitialized input '{name}'")
+            return v
+
+        for i, op in enumerate(program.global_block().ops):
+            _OpRunner.run(op, read, env.__setitem__,
+                          jax.random.fold_in(base_key, i))
+        for v in program.list_vars():
+            if v.persistable and v.name in env:
+                scope.set(v.name, env[v.name])
+
+    def close(self):
+        self._cache.clear()
+
+
+def scope_has_initialized(program, scope=None):
+    scope = scope or global_scope()
+    return all(scope.find(v.name) is not None
+               for v in program.list_vars() if v.persistable)

@@ -1,0 +1,390 @@
+"""Static-graph IR: Program / Block / Operator / Variable.
+
+Parity with reference python/paddle/fluid/framework.py (Program, Block,
+Operator, Variable, program_guard, default_main_program) — redesigned for TPU:
+the Program is a lightweight op-list IR that the Executor lowers to ONE pure
+jax function and jit-compiles (see executor.py). There is no per-op kernel
+dispatch at runtime; XLA fuses the entire step. Ops reference registered
+functional implementations (ops/registry.py) instead of C++ OpKernels.
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .core import unique_name
+from .core.dtypes import convert_dtype
+from .core.scope import global_scope
+
+# dummy size substituted for -1 dims during jax.eval_shape-based inference;
+# inferred dims equal to it are mapped back to -1 for display.
+_DYNAMIC_DIM_SENTINEL = 1999
+
+BACKWARD_OP_TYPE = '__backward__'
+
+_dygraph_tracer_ = None  # set by dygraph.base when in imperative mode
+
+
+def in_dygraph_mode():
+    return _dygraph_tracer_ is not None
+
+
+class Variable:
+    """A named tensor in a Block. Mirrors fluid.framework.Variable."""
+
+    def __init__(self, block, name, shape=None, dtype='float32',
+                 persistable=False, stop_gradient=False, is_data=False,
+                 lod_level=0, trainable=False, **kwargs):
+        self.block = block
+        self.name = name
+        self.shape = tuple(int(s) for s in shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.lod_level = lod_level
+        self.trainable = trainable
+
+    # ---- info ----
+    @property
+    def ndim(self):
+        return len(self.shape) if self.shape is not None else None
+
+    def numel(self):
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}, persistable={self.persistable})")
+
+    __str__ = __repr__
+
+    def numpy(self):
+        """Fetch the current value from the global scope (persistables only)."""
+        val = global_scope().find(self.name)
+        if val is None:
+            raise ValueError(
+                f"Variable {self.name} has no value in scope; run the startup "
+                f"program or fetch it via Executor.run.")
+        return np.asarray(val)
+
+    def set_value(self, value):
+        from .core.dtypes import to_jax_dtype
+        import jax.numpy as jnp
+        global_scope().set(self.name, jnp.asarray(value, to_jax_dtype(self.dtype)))
+
+    # math ops are monkey-patched in layers/math_op_patch.py
+
+
+class Parameter(Variable):
+    """A trainable persistable Variable. Mirrors fluid.framework.Parameter."""
+
+    def __init__(self, block, name, shape, dtype='float32', trainable=True,
+                 regularizer=None, learning_rate=1.0, do_model_average=False,
+                 **kwargs):
+        super().__init__(block, name, shape=shape, dtype=dtype,
+                         persistable=True, stop_gradient=not trainable,
+                         trainable=trainable)
+        self.regularizer = regularizer
+        self.optimize_attr = {'learning_rate': learning_rate}
+        self.do_model_average = do_model_average
+
+
+class Operator:
+    """One node of the Program IR.
+
+    Mirrors fluid.framework.Operator, but instead of an OpDesc dispatched to a
+    C++ kernel, `type` names a registered jax functional (ops/registry.py);
+    inputs/outputs are slot-name → [var names].
+    """
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+        self.block = block
+        self.type = type
+        self.inputs: Dict[str, List[str]] = {
+            k: ([v] if isinstance(v, str) else list(v))
+            for k, v in (inputs or {}).items()}
+        self.outputs: Dict[str, List[str]] = {
+            k: ([v] if isinstance(v, str) else list(v))
+            for k, v in (outputs or {}).items()}
+        self.attrs = dict(attrs or {})
+
+    def input_names(self):
+        return [n for vs in self.inputs.values() for n in vs]
+
+    def output_names(self):
+        return [n for vs in self.outputs.values() for n in vs]
+
+    def attr(self, name):
+        return self.attrs[name]
+
+    def _set_attr(self, name, val):
+        self.attrs[name] = val
+        self.block.program._bump_version()
+
+    def has_attr(self, name):
+        return name in self.attrs
+
+    def __repr__(self):
+        ins = {k: v for k, v in self.inputs.items()}
+        outs = {k: v for k, v in self.outputs.items()}
+        return f"{{{self.type}: {ins} -> {outs}}}"
+
+
+class Block:
+    """A list of ops + dict of vars. Mirrors fluid.framework.Block."""
+
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+
+    # ---- vars ----
+    def create_var(self, name=None, **kwargs):
+        if name is None:
+            name = unique_name.generate('_generated_var')
+        if name in self.vars:
+            return self.vars[name]
+        v = Variable(self, name, **kwargs)
+        self.vars[name] = v
+        return v
+
+    def create_parameter(self, name, shape, dtype, **kwargs):
+        p = Parameter(self, name, shape, dtype=dtype, **kwargs)
+        self.vars[name] = p
+        return p
+
+    def var(self, name):
+        v = self._find_var_recursive(name)
+        if v is None:
+            raise ValueError(f"var {name} not in block {self.idx}")
+        return v
+
+    def has_var(self, name):
+        return self._find_var_recursive(name) is not None
+
+    def _find_var_recursive(self, name):
+        if name in self.vars:
+            return self.vars[name]
+        if self.parent_idx >= 0:
+            return self.program.block(self.parent_idx)._find_var_recursive(name)
+        return None
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # ---- ops ----
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.append(op)
+        self.program._bump_version()
+        return op
+
+    def prepend_op(self, type, inputs=None, outputs=None, attrs=None):
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(0, op)
+        self.program._bump_version()
+        return op
+
+    def __repr__(self):
+        lines = [f"Block[{self.idx}]"]
+        for v in self.vars.values():
+            lines.append('  ' + repr(v))
+        for op in self.ops:
+            lines.append('  ' + repr(op))
+        return '\n'.join(lines)
+
+
+class Program:
+    """A sequence of blocks; the unit of compilation & execution.
+
+    Mirrors fluid.framework.Program. `_version` invalidates the Executor's XLA
+    compile cache on mutation. `clone(for_test=True)` prunes grad/optimizer ops
+    and flips `is_test` attrs, like the reference's Program.clone
+    (python/paddle/fluid/framework.py:3971).
+    """
+
+    _COUNTER = 0
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self._version = 0
+        Program._COUNTER += 1
+        self._id = Program._COUNTER
+        self._seed = None
+        self.random_seed = None
+
+    # ---- blocks ----
+    def global_block(self):
+        return self.blocks[0]
+
+    def block(self, idx):
+        return self.blocks[idx]
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def _create_block(self, parent_idx=None):
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        b = Block(self, len(self.blocks), parent_idx=parent)
+        self.blocks.append(b)
+        self.current_block_idx = b.idx
+        return b
+
+    def _rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    def _bump_version(self):
+        self._version += 1
+
+    # ---- queries ----
+    def list_vars(self):
+        for b in self.blocks:
+            yield from b.vars.values()
+
+    def all_parameters(self):
+        return [p for b in self.blocks for p in b.all_parameters()]
+
+    def num_ops(self):
+        return sum(len(b.ops) for b in self.blocks)
+
+    # ---- transforms ----
+    def clone(self, for_test=False):
+        p = Program()
+        p.blocks = []
+        for b in self.blocks:
+            nb = Block(p, b.idx, b.parent_idx)
+            for name, v in b.vars.items():
+                nv = copy.copy(v)
+                nv.block = nb
+                nb.vars[name] = nv
+            for op in b.ops:
+                if for_test and op.type == BACKWARD_OP_TYPE:
+                    break  # drop backward marker and everything after it
+                nop = Operator(nb, op.type,
+                               {k: list(v) for k, v in op.inputs.items()},
+                               {k: list(v) for k, v in op.outputs.items()},
+                               copy.deepcopy(op.attrs))
+                if for_test and 'is_test' in nop.attrs:
+                    nop.attrs['is_test'] = True
+                nb.ops.append(nop)
+            p.blocks.append(nb)
+        p.current_block_idx = 0
+        p.random_seed = self.random_seed
+        return p
+
+    def _prune(self, targets):
+        """Keep only ops needed to compute `targets` (list of Variables/names).
+
+        Used by save_inference_model (ref: python/paddle/fluid/io.py:1099).
+        """
+        target_names = {t.name if isinstance(t, Variable) else t for t in targets}
+        blk = self.global_block()
+        needed = set(target_names)
+        kept = []
+        for op in reversed(blk.ops):
+            if op.type == BACKWARD_OP_TYPE:
+                continue
+            if set(op.output_names()) & needed:
+                kept.append(op)
+                needed |= set(op.input_names())
+        kept.reverse()
+        p = self.clone()
+        nb = p.global_block()
+        keep_keys = {(op.type, tuple(sorted(op.output_names()))) for op in kept}
+        nb.ops = [op for op in nb.ops
+                  if (op.type, tuple(sorted(op.output_names()))) in keep_keys]
+        # drop vars not referenced
+        used = set()
+        for op in nb.ops:
+            used |= set(op.input_names()) | set(op.output_names())
+        used |= target_names
+        nb.vars = {k: v for k, v in nb.vars.items() if k in used or v.is_data}
+        return p
+
+    def __repr__(self):
+        return '\n'.join(repr(b) for b in self.blocks)
+
+    __str__ = __repr__
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        return repr(self)
+
+
+# ---------------------------------------------------------------------------
+# default programs & guards (ref: fluid.framework default_main_program etc.)
+# ---------------------------------------------------------------------------
+_main_program_ = Program()
+_startup_program_ = Program()
+
+
+def default_main_program():
+    return _main_program_
+
+
+def default_startup_program():
+    return _startup_program_
+
+
+def switch_main_program(program):
+    global _main_program_
+    old = _main_program_
+    _main_program_ = program
+    return old
+
+
+def switch_startup_program(program):
+    global _startup_program_
+    old = _startup_program_
+    _startup_program_ = program
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    old_main = switch_main_program(main_program)
+    old_start = None
+    if startup_program is not None:
+        old_start = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        if old_start is not None:
+            switch_startup_program(old_start)
+
+
+_global_seed = 0
+
+
+def manual_seed(seed):
+    """Set the global random seed (ref: fluid.Program.random_seed + dygraph seed)."""
+    global _global_seed
+    _global_seed = int(seed)
+
+
+def get_global_seed():
+    return _global_seed
+
+
+# ---------------------------------------------------------------------------
+# shape inference helpers (jax.eval_shape based — no per-op InferShape code)
+# ---------------------------------------------------------------------------
+
+def shape_to_concrete(shape):
+    """Replace -1 dims with the sentinel for eval_shape tracing."""
+    return tuple(_DYNAMIC_DIM_SENTINEL if s == -1 else s for s in shape)
+
+
+def shape_from_concrete(shape):
+    """Map sentinel-derived dims back to -1 for display parity."""
+    return tuple(-1 if s == _DYNAMIC_DIM_SENTINEL else s for s in shape)

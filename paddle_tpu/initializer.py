@@ -1,0 +1,172 @@
+"""Parameter initializers.
+
+Parity with reference python/paddle/fluid/initializer.py (Constant, Uniform,
+Normal, TruncatedNormal, Xavier, MSRA, Bilinear, NumpyArray). Dual-mode:
+- static graph: append a fill op to the startup program (`__call__(var, block)`)
+- direct: compute a jax array (`compute(shape, dtype, key)`) — used by dygraph
+  Layers and by the startup lowering.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.dtypes import to_jax_dtype
+from .core.random import default_generator
+
+
+class Initializer:
+    def __call__(self, var, block):
+        """Append an init op for `var` to `block` (startup program)."""
+        block.append_op('__init__', inputs={}, outputs={'Out': var.name},
+                        attrs={'initializer': self, 'shape': list(var.shape),
+                               'dtype': var.dtype})
+        return var
+
+    def compute(self, shape, dtype, key=None):
+        raise NotImplementedError
+
+    def _key(self, key):
+        return key if key is not None else default_generator.next_key()
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0, force_cpu=False):
+        self.value = value
+
+    def compute(self, shape, dtype, key=None):
+        return jnp.full(tuple(shape), self.value, to_jax_dtype(dtype))
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high = low, high
+
+    def compute(self, shape, dtype, key=None):
+        return jax.random.uniform(self._key(key), tuple(shape),
+                                  to_jax_dtype(dtype), self.low, self.high)
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale = loc, scale
+
+    def compute(self, shape, dtype, key=None):
+        return self.loc + self.scale * jax.random.normal(
+            self._key(key), tuple(shape), to_jax_dtype(dtype))
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale = loc, scale
+
+    def compute(self, shape, dtype, key=None):
+        return self.loc + self.scale * jax.random.truncated_normal(
+            self._key(key), -2.0, 2.0, tuple(shape), to_jax_dtype(dtype))
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels OIHW: fan_in = I*k, fan_out = O*k
+    receptive = math.prod(shape[2:])
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierInitializer(Initializer):
+    """Glorot (ref: initializer.py XavierInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform, self.fan_in, self.fan_out = uniform, fan_in, fan_out
+
+    def compute(self, shape, dtype, key=None):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            return jax.random.uniform(self._key(key), tuple(shape),
+                                      to_jax_dtype(dtype), -limit, limit)
+        std = math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(self._key(key), tuple(shape),
+                                       to_jax_dtype(dtype))
+
+
+class MSRAInitializer(Initializer):
+    """He/Kaiming (ref: initializer.py MSRAInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self.uniform, self.fan_in = uniform, fan_in
+
+    def compute(self, shape, dtype, key=None):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            return jax.random.uniform(self._key(key), tuple(shape),
+                                      to_jax_dtype(dtype), -limit, limit)
+        std = math.sqrt(2.0 / fi)
+        return std * jax.random.normal(self._key(key), tuple(shape),
+                                       to_jax_dtype(dtype))
+
+
+class BilinearInitializer(Initializer):
+    """Bilinear upsampling kernel init for conv_transpose (ref: initializer.py)."""
+
+    def compute(self, shape, dtype, key=None):
+        weight = np.zeros(shape, dtype='float32')
+        shape = tuple(shape)
+        f = math.ceil(shape[3] / 2)
+        c = (2 * f - 1 - f % 2) / (2 * f)
+        for i in range(np.prod(shape[2:])):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            v = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            weight[..., y, x] = v
+        return jnp.asarray(weight, to_jax_dtype(dtype))
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def compute(self, shape, dtype, key=None):
+        return jnp.asarray(self.value, to_jax_dtype(dtype)).reshape(tuple(shape))
+
+
+# reference-compatible aliases
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer
+
+
+def _global_weight_initializer():
+    return XavierInitializer()
+
+
+def _global_bias_initializer():
+    return ConstantInitializer(0.0)
+
+
+def force_init_on_cpu():
+    return False
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    yield

@@ -1,0 +1,191 @@
+"""Model save/load (ref: python/paddle/fluid/io.py): save_params,
+save_persistables, load_params, save/load_inference_model + dygraph
+save_dygraph/load_dygraph re-export. Program IR serializes to JSON (the
+reference uses protobuf ProgramDesc); params to .npz.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from .core.dtypes import to_jax_dtype
+from .core.scope import global_scope
+from .framework import (BACKWARD_OP_TYPE, Block, Operator, Parameter, Program,
+                        Variable, default_main_program)
+from .dygraph.checkpoint import save_dygraph, load_dygraph
+
+__all__ = ['save_params', 'save_persistables', 'load_params',
+           'load_persistables', 'save_inference_model', 'load_inference_model',
+           'save_dygraph', 'load_dygraph', 'save_vars', 'load_vars']
+
+
+def _collect(program, predicate, scope):
+    out = {}
+    for v in program.list_vars():
+        if predicate(v):
+            val = scope.find(v.name)
+            if val is not None:
+                out[v.name] = np.asarray(val)
+    return out
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    program = main_program or default_main_program()
+    scope = global_scope()
+    if vars is not None:
+        data = {v.name if isinstance(v, Variable) else v:
+                np.asarray(scope.find(v.name if isinstance(v, Variable) else v))
+                for v in vars}
+    else:
+        data = _collect(program, predicate, scope)
+    os.makedirs(dirname, exist_ok=True)
+    np.savez(os.path.join(dirname, filename or 'params.npz'), **data)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program,
+              predicate=lambda v: isinstance(v, Parameter), filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program,
+              predicate=lambda v: v.persistable and not v.is_data,
+              filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    program = main_program or default_main_program()
+    scope = global_scope()
+    path = os.path.join(dirname, filename or 'params.npz')
+    data = np.load(path)
+    names = set(data.files)
+    for v in program.list_vars():
+        want = (vars is not None and any(
+            (x.name if isinstance(x, Variable) else x) == v.name for x in vars)) \
+            or (predicate is not None and predicate(v))
+        if want and v.name in names:
+            scope.set(v.name, jnp.asarray(data[v.name],
+                                          to_jax_dtype(v.dtype)))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program,
+              predicate=lambda v: isinstance(v, Parameter), filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program,
+              predicate=lambda v: v.persistable and not v.is_data,
+              filename=filename)
+
+
+# ---------------------------------------------------------------------------
+# Program IR serialization (JSON; ref uses protobuf ProgramDesc)
+# ---------------------------------------------------------------------------
+
+def _program_to_dict(program):
+    blocks = []
+    for b in program.blocks:
+        vars_ = []
+        for v in b.vars.values():
+            vars_.append({
+                'name': v.name, 'shape': list(v.shape) if v.shape else None,
+                'dtype': v.dtype, 'persistable': v.persistable,
+                'is_data': v.is_data, 'stop_gradient': v.stop_gradient,
+                'is_parameter': isinstance(v, Parameter),
+                'trainable': v.trainable, 'lod_level': v.lod_level})
+        ops = []
+        for op in b.ops:
+            attrs = {}
+            skipped = False
+            for k, val in op.attrs.items():
+                if k == 'initializer' or isinstance(val, np.ndarray):
+                    skipped = True
+                    continue
+                attrs[k] = val
+            entry = {'type': op.type, 'inputs': op.inputs,
+                     'outputs': op.outputs, 'attrs': attrs}
+            if skipped and op.type == '__constant__':
+                entry['constant_value'] = np.asarray(
+                    op.attrs['value']).tolist()
+                entry['constant_dtype'] = str(
+                    np.asarray(op.attrs['value']).dtype)
+            ops.append(entry)
+        blocks.append({'idx': b.idx, 'parent_idx': b.parent_idx,
+                       'vars': vars_, 'ops': ops})
+    return {'blocks': blocks, 'version': 1}
+
+
+def _program_from_dict(d):
+    p = Program()
+    p.blocks = []
+    for bd in d['blocks']:
+        b = Block(p, bd['idx'], bd['parent_idx'])
+        for vd in bd['vars']:
+            if vd.pop('is_parameter', False):
+                b.create_parameter(vd['name'], vd['shape'], vd['dtype'],
+                                   trainable=vd.get('trainable', True))
+            else:
+                b.create_var(**vd)
+        for od in bd['ops']:
+            attrs = od['attrs']
+            if 'constant_value' in od:
+                attrs['value'] = np.asarray(od['constant_value'],
+                                            od['constant_dtype'])
+            op = Operator(b, od['type'], od['inputs'], od['outputs'], attrs)
+            b.ops.append(op)
+        p.blocks.append(b)
+    p.current_block_idx = 0
+    return p
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    """ref: io.py:save_inference_model — prunes to the inference slice."""
+    program = main_program or default_main_program()
+    inference_program = program.clone(for_test=True)
+    inference_program = inference_program._prune(target_vars)
+    os.makedirs(dirname, exist_ok=True)
+    meta = _program_to_dict(inference_program)
+    meta['feed_names'] = list(feeded_var_names)
+    meta['fetch_names'] = [t.name if isinstance(t, Variable) else t
+                           for t in target_vars]
+    with open(os.path.join(dirname, model_filename or '__model__.json'),
+              'w') as f:
+        json.dump(meta, f)
+    if not program_only:
+        save_persistables(executor, dirname, inference_program,
+                          params_filename or 'params.npz')
+    return meta['fetch_names']
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or '__model__.json')) as f:
+        meta = json.load(f)
+    program = _program_from_dict(meta)
+    scope = global_scope()
+    path = os.path.join(dirname, params_filename or 'params.npz')
+    if os.path.exists(path):
+        data = np.load(path)
+        for v in program.list_vars():
+            if v.persistable and v.name in data.files:
+                scope.set(v.name, jnp.asarray(data[v.name],
+                                              to_jax_dtype(v.dtype)))
+    fetch_vars = [program.global_block().var(n) for n in meta['fetch_names']]
+    return program, meta['feed_names'], fetch_vars
+
+
+def _save_jit_model(dirname, layer, params, buffers):
+    os.makedirs(dirname, exist_ok=True)
+    np.savez(os.path.join(dirname, 'jit_params.npz'),
+             **{k: np.asarray(v) for k, v in params.items()})
+    np.savez(os.path.join(dirname, 'jit_buffers.npz'),
+             **{k: np.asarray(v) for k, v in buffers.items()})

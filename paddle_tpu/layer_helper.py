@@ -1,0 +1,163 @@
+"""LayerHelper: shared machinery for static-graph layer functions.
+
+Parity with reference python/paddle/fluid/layer_helper.py: creates parameters
+(+ their init ops in the startup program), temp output variables with shapes
+inferred via jax.eval_shape over the op functional, and appends ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core import unique_name
+from .core.dtypes import convert_dtype, to_jax_dtype
+from .framework import (Variable, default_main_program, default_startup_program,
+                        shape_to_concrete, shape_from_concrete)
+from .initializer import (ConstantInitializer, XavierInitializer)
+from .param_attr import ParamAttr
+from .ops.registry import get_op
+
+
+class LayerHelper:
+    def __init__(self, layer_type, **kwargs):
+        self.kwargs = kwargs
+        self.layer_type = layer_type
+        name = kwargs.get('name')
+        self.name = name if name is not None else unique_name.generate(layer_type)
+
+    @property
+    def main_program(self):
+        return default_main_program()
+
+    @property
+    def startup_program(self):
+        return default_startup_program()
+
+    def input(self, name='input'):
+        return self.kwargs[name]
+
+    @property
+    def param_attr(self):
+        return ParamAttr._to_attr(self.kwargs.get('param_attr'))
+
+    @property
+    def bias_attr(self):
+        return ParamAttr._to_attr(self.kwargs.get('bias_attr'))
+
+    # ---- variables ----
+    def create_parameter(self, attr, shape, dtype='float32', is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        if attr.name is None:
+            attr.name = unique_name.generate('.'.join([self.name, 'w' if not is_bias else 'b']))
+        init = attr.initializer or default_initializer or (
+            ConstantInitializer(0.0) if is_bias else XavierInitializer())
+        block = self.main_program.global_block()
+        if block.has_var(attr.name):
+            return block.var(attr.name)
+        p = block.create_parameter(
+            attr.name, [int(s) for s in shape], convert_dtype(dtype),
+            trainable=attr.trainable, regularizer=attr.regularizer,
+            learning_rate=attr.learning_rate,
+            do_model_average=attr.do_model_average)
+        # mirror into startup program with its init op
+        sblock = self.startup_program.global_block()
+        sp = sblock.create_parameter(
+            attr.name, [int(s) for s in shape], convert_dtype(dtype),
+            trainable=attr.trainable)
+        init(sp, sblock)
+        return p
+
+    def create_variable_for_type_inference(self, dtype='float32', name=None):
+        return self.main_program.current_block().create_var(
+            name=name or unique_name.generate('.'.join([self.name, 'tmp'])),
+            dtype=convert_dtype(dtype), shape=None)
+
+    create_tmp_variable = create_variable_for_type_inference
+
+    def create_global_variable(self, shape, dtype='float32', persistable=True,
+                               name=None, stop_gradient=True):
+        return self.main_program.global_block().create_var(
+            name=name or unique_name.generate('.'.join([self.name, 'global'])),
+            shape=[int(s) for s in shape], dtype=convert_dtype(dtype),
+            persistable=persistable, stop_gradient=stop_gradient)
+
+    # ---- ops ----
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        op = self.main_program.current_block().append_op(
+            type=type, inputs=inputs, outputs=outputs, attrs=attrs)
+        self._infer_shapes(op)
+        return op
+
+    def _infer_shapes(self, op):
+        """Fill in missing output var shapes via jax.eval_shape on the op fn."""
+        try:
+            opdef = get_op(op.type)
+        except KeyError:
+            return
+        block = op.block
+
+        def spec_of(name):
+            v = block.var(name)
+            if v.shape is None:
+                return None
+            return jax.ShapeDtypeStruct(shape_to_concrete(v.shape),
+                                        to_jax_dtype(v.dtype))
+
+        args = []
+        for slot in opdef.input_slots:
+            names = op.inputs.get(slot, [])
+            if not names:
+                args.append(None)
+            elif slot in opdef.variadic:
+                specs = [spec_of(n) for n in names]
+                if any(s is None for s in specs):
+                    return
+                args.append(specs)
+            else:
+                s = spec_of(names[0])
+                if s is None:
+                    return
+                args.append(s)
+        attrs = dict(op.attrs)
+        attrs.pop('initializer', None)
+        try:
+            if opdef.needs_rng:
+                key_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)
+                out = jax.eval_shape(
+                    lambda key, *a: opdef.fn(*a, key=key, **attrs), key_spec, *args)
+            else:
+                out = jax.eval_shape(lambda *a: opdef.fn(*a, **attrs), *args)
+        except Exception:
+            return
+        outs = [out] if len(opdef.output_slots) == 1 else list(out)
+        flat_out_names = []
+        for slot in opdef.output_slots:
+            flat_out_names.append(op.outputs.get(slot, []))
+        # match: one result per output slot; variadic slot gets a list result
+        for slot_names, res in zip(flat_out_names, outs):
+            res_list = res if isinstance(res, (list, tuple)) else [res]
+            for n, r in zip(slot_names, res_list):
+                v = block.var(n)
+                if v.shape is None:
+                    v.shape = shape_from_concrete(r.shape)
+                    v.dtype = convert_dtype(r.dtype)
+
+    def append_activation(self, out):
+        act = self.kwargs.get('act')
+        if act is None:
+            return out
+        tmp = self.create_variable_for_type_inference(out.dtype)
+        self.append_op(type=act, inputs={'x': out.name}, outputs={'Out': tmp.name})
+        return tmp
+
+    def append_bias_op(self, input_var, bias, axis=-1):
+        if bias is None:
+            return input_var
+        tmp = self.create_variable_for_type_inference(input_var.dtype)
+        self.append_op(type='elementwise_add',
+                       inputs={'x': input_var.name, 'y': bias.name},
+                       outputs={'Out': tmp.name}, attrs={'axis': axis})
+        return tmp

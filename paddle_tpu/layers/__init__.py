@@ -1,0 +1,27 @@
+"""fluid.layers parity namespace."""
+from . import common
+from .nn import *  # noqa
+from .tensor import *  # noqa
+from .loss import *  # noqa
+from .io import data
+from . import nn, tensor, loss, io
+from .math_op_patch import monkey_patch_variable
+
+monkey_patch_variable()
+
+# accuracy / auc live in layers namespace in the reference too
+from .common import apply_op_layer as _apply
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    out = _apply('accuracy', {'pred': input, 'label': label}, {'k': k})
+    return out[0]
+
+
+def auc(input, label, curve='ROC', num_thresholds=200, topk=1,
+        slide_steps=1):
+    """Static AUC: returns batch AUC via rank statistic (stateful accumulators
+    live in metrics.Auc for the full parity path)."""
+    out = _apply('auc', {'pred': input, 'label': label},
+                 {'num_thresholds': num_thresholds})
+    return out, [out]

@@ -1,0 +1,72 @@
+"""Shared helpers for layer functions (ref: python/paddle/fluid/layers/
+layer_function_generator.py) — generate a static-graph layer function straight
+from a registered op."""
+from __future__ import annotations
+
+from ..framework import Variable, in_dygraph_mode
+from ..layer_helper import LayerHelper
+from ..ops.registry import get_op
+
+
+def _var_name(x):
+    return x.name if isinstance(x, Variable) else x
+
+
+def apply_op_layer(op_type, inputs, attrs=None, name=None, n_outputs=None,
+                   dtype=None):
+    """Append `op_type` to the current program; returns output Variable(s).
+
+    inputs: dict slot → Variable | [Variables]. In dygraph mode, dispatches
+    eagerly through the tape instead (one code path for both modes, like the
+    reference's `in_dygraph_mode()` branches in each layer).
+    """
+    if in_dygraph_mode():
+        from ..dygraph.tape import dispatch_op
+        return dispatch_op(op_type, inputs, attrs or {})
+    opdef = get_op(op_type)
+    helper = LayerHelper(op_type, name=name)
+    in_names = {}
+    first_dtype = dtype
+    for slot, v in inputs.items():
+        if v is None:
+            continue
+        if isinstance(v, (list, tuple)):
+            in_names[slot] = [_var_name(x) for x in v]
+            if first_dtype is None and v and isinstance(v[0], Variable):
+                first_dtype = v[0].dtype
+        else:
+            in_names[slot] = _var_name(v)
+            if first_dtype is None and isinstance(v, Variable):
+                first_dtype = v.dtype
+    outs = {}
+    out_vars = []
+    slots = opdef.output_slots
+    for slot in slots:
+        k = n_outputs.get(slot, 1) if isinstance(n_outputs, dict) else 1
+        vs = [helper.create_variable_for_type_inference(first_dtype or 'float32')
+              for _ in range(k)]
+        outs[slot] = [v.name for v in vs]
+        out_vars.append(vs if k > 1 else vs[0])
+    helper.append_op(type=op_type, inputs=in_names, outputs=outs,
+                     attrs=attrs or {})
+    return out_vars[0] if len(out_vars) == 1 else tuple(out_vars)
+
+
+def generate_layer_fn(op_type, in_slots=None, doc=''):
+    """Make a `fn(x, ..., name=None, **attrs) -> Variable` layer from an op."""
+    opdef = get_op(op_type)
+    slots = in_slots or opdef.input_slots
+
+    def layer(*args, name=None, **kwargs):
+        inputs = {}
+        for slot, v in zip(slots, args):
+            inputs[slot] = v
+        for slot in slots[len(args):]:
+            if slot in kwargs:
+                inputs[slot] = kwargs.pop(slot)
+        return apply_op_layer(op_type, inputs, kwargs, name=name)
+
+    layer.__name__ = op_type
+    layer.__doc__ = doc or f"Auto-generated layer for op `{op_type}` " \
+                           f"(TPU-native jax functional)."
+    return layer

@@ -1,0 +1,27 @@
+"""Data-layer declarations (ref: python/paddle/fluid/layers/io.py:data and
+python/paddle/fluid/data.py:fluid.data)."""
+from __future__ import annotations
+
+from ..core.dtypes import convert_dtype
+from ..framework import default_main_program, default_startup_program
+
+__all__ = ['data']
+
+
+def data(name, shape, dtype='float32', lod_level=0, append_batch_size=True,
+         type=None, stop_gradient=True):
+    """fluid.layers.data parity: prepends a -1 batch dim unless told not to."""
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    for prog in (default_main_program(),):
+        v = prog.global_block().create_var(
+            name=name, shape=shape, dtype=convert_dtype(dtype),
+            is_data=True, stop_gradient=stop_gradient, lod_level=lod_level)
+    return v
+
+
+def fluid_data(name, shape, dtype='float32', lod_level=0):
+    """fluid.data parity: shape used as-is (may contain None/-1)."""
+    shape = [-1 if s is None else s for s in shape]
+    return data(name, shape, dtype, lod_level, append_batch_size=False)

@@ -1,0 +1,157 @@
+"""fluid.layers loss functions (ref: python/paddle/fluid/layers/loss.py)."""
+from __future__ import annotations
+
+from .common import apply_op_layer, generate_layer_fn
+
+__all__ = ['cross_entropy', 'square_error_cost', 'softmax_with_cross_entropy',
+           'sigmoid_cross_entropy_with_logits', 'smooth_l1', 'huber_loss',
+           'kldiv_loss', 'bpr_loss', 'rank_loss', 'margin_rank_loss',
+           'log_loss', 'mse_loss', 'npair_loss', 'dice_loss', 'center_loss',
+           'teacher_student_sigmoid_loss', 'sampled_softmax_with_cross_entropy',
+           'hsigmoid', 'edit_distance', 'warpctc']
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    return apply_op_layer('cross_entropy', {'x': input, 'label': label},
+                          {'soft_label': soft_label,
+                           'ignore_index': ignore_index})
+
+
+def square_error_cost(input, label):
+    return apply_op_layer('square_error_cost', {'x': input, 'label': label})
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss, sm = apply_op_layer('softmax_with_cross_entropy',
+                              {'logits': logits, 'label': label},
+                              {'soft_label': soft_label,
+                               'ignore_index': ignore_index, 'axis': axis})
+    return (loss, sm) if return_softmax else loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None,
+                                      normalize=False):
+    return apply_op_layer('sigmoid_cross_entropy_with_logits',
+                          {'x': x, 'label': label},
+                          {'ignore_index': ignore_index,
+                           'normalize': normalize}, name=name)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    return apply_op_layer('smooth_l1_loss',
+                          {'x': x, 'y': y, 'inside_weight': inside_weight,
+                           'outside_weight': outside_weight},
+                          {'sigma': sigma if sigma is not None else 1.0})
+
+
+huber_loss = generate_layer_fn('huber_loss')
+kldiv_loss = generate_layer_fn('kldiv_loss')
+bpr_loss = generate_layer_fn('bpr_loss')
+rank_loss = generate_layer_fn('rank_loss')
+margin_rank_loss = generate_layer_fn('margin_rank_loss')
+log_loss = generate_layer_fn('log_loss')
+teacher_student_sigmoid_loss = generate_layer_fn('teacher_student_sigmoid_loss')
+
+
+def mse_loss(input, label):
+    sq = apply_op_layer('square_error_cost', {'x': input, 'label': label})
+    return apply_op_layer('reduce_mean', {'x': sq})
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """ref: layers/loss.py:npair_loss — composed from existing layers."""
+    from . import nn
+    a2 = apply_op_layer('reduce_sum', {'x': apply_op_layer(
+        'elementwise_mul', {'x': anchor, 'y': anchor})})
+    p2 = apply_op_layer('reduce_sum', {'x': apply_op_layer(
+        'elementwise_mul', {'x': positive, 'y': positive})})
+    l2 = apply_op_layer('scale', {'x': apply_op_layer(
+        'elementwise_add', {'x': a2, 'y': p2})}, {'scale': l2_reg * 0.25})
+    sim = nn.matmul(anchor, positive, transpose_y=True)
+    lbl = apply_op_layer('cast', {'x': labels}, {'dtype': 'float32'})
+    import numpy as np
+    # soft labels: equality matrix normalized per row
+    eq = apply_op_layer('equal', {'x': apply_op_layer('unsqueeze', {'x': lbl}, {'axes': [1]}),
+                                  'y': apply_op_layer('unsqueeze', {'x': lbl}, {'axes': [0]})})
+    eqf = apply_op_layer('cast', {'x': eq}, {'dtype': 'float32'})
+    row = apply_op_layer('reduce_sum', {'x': eqf}, {'dim': [1], 'keep_dim': True})
+    soft = apply_op_layer('elementwise_div', {'x': eqf, 'y': row})
+    ce = apply_op_layer('softmax_with_cross_entropy',
+                        {'logits': sim, 'label': soft}, {'soft_label': True})[0]
+    loss = apply_op_layer('reduce_mean', {'x': ce})
+    return apply_op_layer('elementwise_add', {'x': loss, 'y': l2})
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    return apply_op_layer('dice_loss', {'x': input, 'label': label},
+                          {'epsilon': epsilon})
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    from ..layer_helper import LayerHelper
+    from ..initializer import ConstantInitializer
+    helper = LayerHelper('center_loss', param_attr=param_attr)
+    d = input.shape[-1]
+    centers = helper.create_parameter(
+        helper.param_attr, [num_classes, d], input.dtype,
+        default_initializer=ConstantInitializer(0.0))
+    centers.stop_gradient = True
+    centers.trainable = False
+    from .tensor import fill_constant
+    rate = alpha if hasattr(alpha, 'name') else fill_constant([1], 'float32', alpha)
+    loss, _, _ = apply_op_layer(
+        'center_loss',
+        {'x': input, 'label': label, 'centers': centers, 'update_rate': rate},
+        {'cluster_num': num_classes, 'need_update': update_center})
+    return loss
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples, **kw):
+    """TPU formulation: full softmax is MXU-cheap; sampling adds no win at the
+    ref's class counts, so this lowers to softmax_with_cross_entropy (same
+    estimator in expectation; ref: layers/loss.py:1204)."""
+    return softmax_with_cross_entropy(logits, label)
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Hierarchical sigmoid (ref: layers/loss.py:hsigmoid). Default complete-
+    binary-tree coding, dense TPU formulation (ops/extra_ops.py)."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper('hsigmoid', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr, [num_classes, d],
+                                input.dtype)
+    b = helper.create_parameter(helper.bias_attr, [num_classes], input.dtype,
+                                is_bias=True)
+    return apply_op_layer('hsigmoid',
+                          {'x': input, 'label': label, 'weight': w, 'bias': b},
+                          {'num_classes': num_classes})
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance on padded id sequences (ref: edit_distance_op.cc),
+    lax.scan DP over columns — static shapes, TPU-safe (ops/extra_ops.py)."""
+    out, seq_num = apply_op_layer(
+        'edit_distance',
+        {'x': input, 'label': label, 'x_len': input_length,
+         'label_len': label_length},
+        {'normalized': normalized})
+    return out, seq_num
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """CTC loss (ref: warpctc_op.cc) — native jax log-space forward algorithm
+    over lax.scan, ops/extra_ops.py (replaces the warp-ctc CUDA library)."""
+    return apply_op_layer(
+        'warpctc',
+        {'logits': input, 'label': label, 'logit_len': input_length,
+         'label_len': label_length},
+        {'blank': blank, 'norm_by_times': norm_by_times})

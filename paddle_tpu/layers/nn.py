@@ -1,0 +1,609 @@
+"""fluid.layers.nn parity (ref: python/paddle/fluid/layers/nn.py, 146 fns).
+
+Parameter-bearing layers (fc, conv2d, batch_norm, …) create Parameters via
+LayerHelper (init ops land in the startup program); everything else is a thin
+wrapper over the registered jax functionals via apply_op_layer, so the same
+code path serves static graph AND dygraph.
+"""
+from __future__ import annotations
+
+from ..core.dtypes import convert_dtype
+from ..framework import Variable, in_dygraph_mode
+from ..initializer import ConstantInitializer, NormalInitializer, XavierInitializer
+from ..layer_helper import LayerHelper
+from .common import apply_op_layer, generate_layer_fn
+
+__all__ = []  # filled at bottom
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """ref: layers/nn.py:fc — implemented as mul(+concat) + bias + act."""
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    helper = LayerHelper('fc', param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    mul_results = []
+    import math
+    for x in inputs:
+        in_feat = math.prod(x.shape[num_flatten_dims:])
+        w = helper.create_parameter(helper.param_attr, [in_feat, size], x.dtype)
+        mul_results.append(apply_op_layer(
+            'mul', {'x': x, 'y': w},
+            {'x_num_col_dims': num_flatten_dims, 'y_num_col_dims': 1}))
+    out = mul_results[0] if len(mul_results) == 1 else \
+        apply_op_layer('sum', {'xs': mul_results})
+    b = helper.create_parameter(helper.bias_attr, [size], 'float32', is_bias=True)
+    if b is not None:
+        out = apply_op_layer('elementwise_add', {'x': out, 'y': b},
+                             {'axis': num_flatten_dims})
+    if act:
+        out = apply_op_layer(act, {'x': out})
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype='float32'):
+    """ref: layers/nn.py:embedding."""
+    helper = LayerHelper('embedding', param_attr=param_attr)
+    w = helper.create_parameter(helper.param_attr, list(size), dtype,
+                                default_initializer=XavierInitializer())
+    pad = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    return apply_op_layer('lookup_table', {'w': w, 'ids': input},
+                          {'padding_idx': pad, 'is_sparse': is_sparse,
+                           'is_distributed': is_distributed})
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format='NCHW'):
+    """ref: layers/nn.py:conv2d (use_cudnn accepted for compat; XLA decides)."""
+    helper = LayerHelper('conv2d', param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    c_in = input.shape[1] if data_format == 'NCHW' else input.shape[-1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    import math
+    std = math.sqrt(2.0 / (fs[0] * fs[1] * c_in))
+    w = helper.create_parameter(
+        helper.param_attr, [num_filters, c_in // groups, fs[0], fs[1]],
+        input.dtype, default_initializer=NormalInitializer(0.0, std))
+    if data_format == 'NHWC':
+        # weights stay OIHW in the program; functional transposes to HWIO
+        pass
+    out = apply_op_layer('conv2d', {'x': input, 'weight': w},
+                         {'stride': stride, 'padding': padding,
+                          'dilation': dilation, 'groups': groups,
+                          'data_format': data_format})
+    b = helper.create_parameter(helper.bias_attr, [num_filters], 'float32',
+                                is_bias=True)
+    if b is not None:
+        axis = 1 if data_format == 'NCHW' else 3
+        out = apply_op_layer('elementwise_add', {'x': out, 'y': b}, {'axis': axis})
+    if act:
+        out = apply_op_layer(act, {'x': out})
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format='NCDHW'):
+    helper = LayerHelper('conv3d', param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    c_in = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    w = helper.create_parameter(
+        helper.param_attr, [num_filters, c_in // groups, *fs], input.dtype)
+    out = apply_op_layer('conv3d', {'x': input, 'weight': w},
+                         {'stride': stride, 'padding': padding,
+                          'dilation': dilation, 'groups': groups})
+    b = helper.create_parameter(helper.bias_attr, [num_filters], 'float32',
+                                is_bias=True)
+    if b is not None:
+        out = apply_op_layer('elementwise_add', {'x': out, 'y': b}, {'axis': 1})
+    if act:
+        out = apply_op_layer(act, {'x': out})
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper('conv2d_transpose', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    c_in = input.shape[1]
+    if filter_size is None:
+        raise ValueError("filter_size required (output_size-only form: "
+                         "provide filter_size for the TPU build)")
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = helper.create_parameter(
+        helper.param_attr, [c_in, num_filters // groups, fs[0], fs[1]],
+        input.dtype)
+    out = apply_op_layer('conv2d_transpose', {'x': input, 'weight': w},
+                         {'stride': stride, 'padding': padding,
+                          'dilation': dilation, 'groups': groups})
+    b = helper.create_parameter(helper.bias_attr, [num_filters], 'float32',
+                                is_bias=True)
+    if b is not None:
+        out = apply_op_layer('elementwise_add', {'x': out, 'y': b}, {'axis': 1})
+    if act:
+        out = apply_op_layer(act, {'x': out})
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper('conv3d_transpose', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    c_in = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    w = helper.create_parameter(
+        helper.param_attr, [c_in, num_filters // groups, *fs], input.dtype)
+    out = apply_op_layer('conv3d_transpose', {'x': input, 'weight': w},
+                         {'stride': stride, 'padding': padding,
+                          'dilation': dilation, 'groups': groups})
+    b = helper.create_parameter(helper.bias_attr, [num_filters], 'float32',
+                                is_bias=True)
+    if b is not None:
+        out = apply_op_layer('elementwise_add', {'x': out, 'y': b}, {'axis': 1})
+    if act:
+        out = apply_op_layer(act, {'x': out})
+    return out
+
+
+def pool2d(input, pool_size=-1, pool_type='max', pool_stride=1, pool_padding=0,
+           global_pooling=False, use_cudnn=True, ceil_mode=False, name=None,
+           exclusive=True, data_format='NCHW'):
+    return apply_op_layer('pool2d', {'x': input},
+                          {'pool_size': pool_size, 'pool_type': pool_type,
+                           'pool_stride': pool_stride,
+                           'pool_padding': pool_padding,
+                           'global_pooling': global_pooling,
+                           'ceil_mode': ceil_mode, 'exclusive': exclusive,
+                           'data_format': data_format}, name=name)
+
+
+def pool3d(input, pool_size=-1, pool_type='max', pool_stride=1, pool_padding=0,
+           global_pooling=False, use_cudnn=True, ceil_mode=False, name=None,
+           exclusive=True, data_format='NCDHW'):
+    return apply_op_layer('pool3d', {'x': input},
+                          {'pool_size': pool_size, 'pool_type': pool_type,
+                           'pool_stride': pool_stride,
+                           'pool_padding': pool_padding,
+                           'global_pooling': global_pooling,
+                           'ceil_mode': ceil_mode, 'exclusive': exclusive,
+                           'data_format': data_format}, name=name)
+
+
+def adaptive_pool2d(input, pool_size, pool_type='max', require_index=False,
+                    name=None):
+    return apply_op_layer('adaptive_pool2d', {'x': input},
+                          {'pool_size': pool_size, 'pool_type': pool_type},
+                          name=name)
+
+
+def adaptive_pool3d(input, pool_size, pool_type='max', require_index=False,
+                    name=None):
+    return apply_op_layer('adaptive_pool3d', {'x': input},
+                          {'pool_size': pool_size, 'pool_type': pool_type},
+                          name=name)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout='NCHW',
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """ref: layers/nn.py:batch_norm. Running stats are persistable vars whose
+    MeanOut/VarianceOut aliases make the jitted step update them functionally."""
+    helper = LayerHelper('batch_norm', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    c = input.shape[1] if data_layout == 'NCHW' else input.shape[-1]
+    dtype = 'float32'
+    scale = helper.create_parameter(
+        helper.param_attr, [c], dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(helper.bias_attr, [c], dtype, is_bias=True)
+    from ..core import unique_name
+    mean_name = moving_mean_name or unique_name.generate(helper.name + '.mean')
+    var_name = moving_variance_name or unique_name.generate(helper.name + '.variance')
+
+    def stat_var(nm, init_val):
+        v = helper.main_program.global_block().create_var(
+            name=nm, shape=[c], dtype=dtype, persistable=True,
+            stop_gradient=True)
+        sb = helper.startup_program.global_block()
+        sv = sb.create_var(name=nm, shape=[c], dtype=dtype, persistable=True,
+                           stop_gradient=True)
+        ConstantInitializer(init_val)(sv, sb)
+        return v
+
+    mean = stat_var(mean_name, 0.0)
+    variance = stat_var(var_name, 1.0)
+    if in_dygraph_mode():
+        raise RuntimeError("use dygraph.BatchNorm in imperative mode")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='batch_norm',
+        inputs={'x': input.name, 'scale': scale.name, 'bias': bias.name,
+                'mean': mean.name, 'variance': variance.name},
+        outputs={'Y': out.name, 'MeanOut': mean.name,
+                 'VarianceOut': var_name},
+        attrs={'momentum': momentum, 'epsilon': epsilon, 'is_test': is_test,
+               'use_global_stats': use_global_stats,
+               'data_layout': data_layout})
+    if act:
+        out = apply_op_layer(act, {'x': out})
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper('layer_norm', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    import math
+    nshape = [math.prod(input.shape[begin_norm_axis:])]
+    s = helper.create_parameter(
+        helper.param_attr, nshape, input.dtype,
+        default_initializer=ConstantInitializer(1.0)) if scale else None
+    b = helper.create_parameter(helper.bias_attr, nshape, input.dtype,
+                                is_bias=True) if shift else None
+    out = apply_op_layer('layer_norm', {'x': input, 'scale': s, 'bias': b},
+                         {'begin_norm_axis': begin_norm_axis,
+                          'epsilon': epsilon})
+    if act:
+        out = apply_op_layer(act, {'x': out})
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper('instance_norm', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    c = input.shape[1]
+    s = helper.create_parameter(helper.param_attr, [c], input.dtype,
+                                default_initializer=ConstantInitializer(1.0))
+    b = helper.create_parameter(helper.bias_attr, [c], input.dtype,
+                                is_bias=True)
+    return apply_op_layer('instance_norm', {'x': input, 'scale': s, 'bias': b},
+                          {'epsilon': epsilon})
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout='NCHW', name=None):
+    helper = LayerHelper('group_norm', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    c = input.shape[1]
+    s = helper.create_parameter(helper.param_attr, [c], input.dtype,
+                                default_initializer=ConstantInitializer(1.0))
+    b = helper.create_parameter(helper.bias_attr, [c], input.dtype,
+                                is_bias=True)
+    out = apply_op_layer('group_norm', {'x': input, 'scale': s, 'bias': b},
+                         {'groups': groups, 'epsilon': epsilon,
+                          'data_layout': data_layout})
+    if act:
+        out = apply_op_layer(act, {'x': out})
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """ref: layers/nn.py:spectral_norm — power iteration inlined in the graph
+    (u/v vectors are persistable state in the ref; here re-estimated per step,
+    which matches power_iters semantics under jit)."""
+    return apply_op_layer('spectral_norm', {'w': weight},
+                          {'dim': dim, 'power_iters': power_iters, 'eps': eps},
+                          name=name)
+
+
+def data_norm(input, act=None, epsilon=1e-4, param_attr=None, name=None,
+              data_layout='NCHW', in_place=False, do_model_average_for_mean_and_var=True):
+    helper = LayerHelper('data_norm', name=name)
+    c = input.shape[-1]
+    from ..core import unique_name
+
+    def stat(nm, val):
+        full = unique_name.generate(helper.name + '.' + nm)
+        v = helper.main_program.global_block().create_var(
+            name=full, shape=[c] if nm != 'batch_size' else [c], dtype='float32',
+            persistable=True, stop_gradient=True)
+        sb = helper.startup_program.global_block()
+        sv = sb.create_var(name=full, shape=[c], dtype='float32',
+                           persistable=True, stop_gradient=True)
+        ConstantInitializer(val)(sv, sb)
+        return v
+
+    bsize = stat('batch_size', 1e4)
+    bsum = stat('batch_sum', 0.0)
+    bsq = stat('batch_square_sum', 1e4)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='data_norm',
+        inputs={'x': input.name, 'batch_size': bsize.name,
+                'batch_sum': bsum.name, 'batch_square_sum': bsq.name},
+        outputs={'Y': out.name, 'BatchSizeOut': bsize.name,
+                 'BatchSumOut': bsum.name, 'BatchSquareSumOut': bsq.name},
+        attrs={'epsilon': epsilon})
+    if act:
+        out = apply_op_layer(act, {'x': out})
+    return out
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation='downgrade_in_infer'):
+    return apply_op_layer('dropout', {'x': x},
+                          {'dropout_prob': dropout_prob, 'is_test': is_test,
+                           'dropout_implementation': dropout_implementation},
+                          name=name)
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    return apply_op_layer('softmax', {'x': input}, {'axis': axis}, name=name)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    return apply_op_layer('matmul', {'x': x, 'y': y},
+                          {'transpose_x': transpose_x,
+                           'transpose_y': transpose_y, 'alpha': alpha},
+                          name=name)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    return apply_op_layer('mul', {'x': x, 'y': y},
+                          {'x_num_col_dims': x_num_col_dims,
+                           'y_num_col_dims': y_num_col_dims}, name=name)
+
+
+def topk(input, k, name=None):
+    return apply_op_layer('top_k', {'x': input}, {'k': k}, name=name)
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return apply_op_layer('one_hot', {'x': input},
+                          {'depth': depth,
+                           'allow_out_of_range': allow_out_of_range})
+
+
+def prelu(x, mode, param_attr=None, name=None):
+    helper = LayerHelper('prelu', param_attr=param_attr, name=name)
+    if mode == 'all':
+        shape = [1]
+    elif mode == 'channel':
+        shape = [x.shape[1]]
+    else:
+        import math
+        shape = [math.prod(x.shape[1:])]
+    alpha = helper.create_parameter(
+        helper.param_attr, shape, x.dtype,
+        default_initializer=ConstantInitializer(0.25))
+    return apply_op_layer('prelu', {'x': x, 'alpha': alpha}, {'mode': mode})
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler='uniform',
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation (ref: layers/nn.py:nce). TPU formulation:
+    samples drawn inside the jitted step via the op's PRNG key."""
+    helper = LayerHelper('nce', param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr, [num_total_classes, dim],
+                                input.dtype)
+    b = helper.create_parameter(helper.bias_attr, [num_total_classes],
+                                input.dtype, is_bias=True)
+    return apply_op_layer('nce',
+                          {'x': input, 'label': label, 'weight': w, 'bias': b},
+                          {'num_total_classes': num_total_classes,
+                           'num_neg_samples': num_neg_samples or 10})
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return apply_op_layer('l2_normalize', {'x': x},
+                          {'axis': axis, 'epsilon': epsilon}, name=name)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    return apply_op_layer('im2sequence', {'x': input},
+                          {'filter_size': filter_size, 'stride': stride,
+                           'padding': padding}, name=name)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper('row_conv', param_attr=param_attr, act=act)
+    d = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                [future_context_size + 1, d], input.dtype)
+    out = apply_op_layer('row_conv', {'x': input, 'w': w})
+    if act:
+        out = apply_op_layer(act, {'x': out})
+    return out
+
+
+def multiplex(inputs, index):
+    return apply_op_layer('multiplex', {'index': index, 'xs': list(inputs)})
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    return apply_op_layer('smooth_l1_loss',
+                          {'x': x, 'y': y, 'inside_weight': inside_weight,
+                           'outside_weight': outside_weight},
+                          {'sigma': sigma if sigma is not None else 1.0})
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """ref: layers/nn.py:autoincreased_step_counter — a persistable int64
+    counter bumped by an increment op each step (drives LR schedules)."""
+    helper = LayerHelper('global_step_counter')
+    name = counter_name or '@STEP_COUNTER@'
+    block = helper.main_program.global_block()
+    if block.has_var(name):
+        return block.var(name)
+    counter = block.create_var(name=name, shape=[1], dtype='int64',
+                               persistable=True, stop_gradient=True)
+    sb = helper.startup_program.global_block()
+    sv = sb.create_var(name=name, shape=[1], dtype='int64', persistable=True,
+                       stop_gradient=True)
+    ConstantInitializer(begin - step)(sv, sb)
+    helper.main_program.global_block().prepend_op(
+        type='increment', inputs={'x': name}, outputs={'Out': name},
+        attrs={'value': float(step)})
+    return counter
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    helper = LayerHelper('bilinear_tensor_product', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    w = helper.create_parameter(helper.param_attr,
+                                [size, x.shape[-1], y.shape[-1]], x.dtype)
+    b = helper.create_parameter(helper.bias_attr, [size], x.dtype, is_bias=True)
+    out = apply_op_layer('bilinear_tensor_product',
+                         {'x': x, 'y': y, 'weight': w, 'bias': b})
+    if act:
+        out = apply_op_layer(act, {'x': out})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# thin generated wrappers (attr names match the reference layer signatures)
+# ---------------------------------------------------------------------------
+
+def _gen(op_type, *, fname=None, slots=None):
+    fn = generate_layer_fn(op_type, in_slots=slots)
+    fn.__name__ = fname or op_type
+    globals()[fn.__name__] = fn
+    __all__.append(fn.__name__)
+    return fn
+
+
+for _op in ['relu', 'relu6', 'leaky_relu', 'elu', 'selu', 'brelu', 'soft_relu',
+            'stanh', 'hard_sigmoid', 'hard_swish', 'swish', 'maxout', 'pow',
+            'gelu', 'erf', 'log', 'sign', 'mean',
+            'reduce_sum', 'reduce_mean', 'reduce_max', 'reduce_min',
+            'reduce_prod', 'reduce_all', 'reduce_any', 'logsumexp',
+            'elementwise_add', 'elementwise_sub', 'elementwise_mul',
+            'elementwise_div', 'elementwise_max', 'elementwise_min',
+            'elementwise_pow', 'elementwise_mod', 'elementwise_floordiv',
+            'scale', 'clip', 'clip_by_norm', 'cos_sim',
+            'transpose', 'squeeze', 'unsqueeze', 'reshape', 'flatten',
+            'gather', 'gather_nd', 'scatter', 'scatter_nd_add',
+            'expand', 'expand_as', 'pad', 'pad2d', 'pad_constant_like',
+            'label_smooth', 'shard_index', 'where',
+            'space_to_depth', 'shuffle_channel', 'temporal_shift',
+            'grid_sampler', 'affine_channel', 'pixel_shuffle', 'unfold',
+            'add_position_encoding', 'log_loss', 'unstack',
+            'uniform_random', 'gaussian_random',
+            'uniform_random_batch_size_like', 'gaussian_random_batch_size_like',
+            'sampling_id', 'random_crop',
+            'logical_and', 'logical_or', 'logical_xor', 'logical_not',
+            'has_inf', 'has_nan', 'isfinite', 'mean_iou']:
+    _gen(_op)
+
+_gen('slice', fname='slice')
+_gen('strided_slice', fname='strided_slice')
+_gen('fsp', fname='fsp_matrix')
+_gen('arg_min', fname='argmin')
+_gen('arg_max', fname='argmax')
+_gen('argsort', fname='argsort')
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    n = num_or_sections if isinstance(num_or_sections, int) \
+        else len(num_or_sections)
+    helper_out = apply_op_layer('split', {'x': input},
+                                {'num_or_sections': num_or_sections,
+                                 'dim': dim}, name=name,
+                                n_outputs={'Out': n})
+    return helper_out if isinstance(helper_out, list) else helper_out
+
+
+def stack(x, axis=0):
+    return apply_op_layer('stack', {'xs': list(x)}, {'axis': axis})
+
+
+def concat(input, axis=0, name=None):
+    return apply_op_layer('concat', {'xs': list(input)}, {'axis': axis},
+                          name=name)
+
+
+def affine_grid(theta, out_shape, name=None):
+    return apply_op_layer('affine_grid', {'theta': theta},
+                          {'out_shape': list(out_shape)}, name=name)
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample='BILINEAR', actual_shape=None, align_corners=True,
+                 align_mode=1, data_format='NCHW'):
+    if out_shape is None:
+        h = int(input.shape[2] * scale)
+        w = int(input.shape[3] * scale)
+        out_shape = [h, w]
+    method = resample.lower()
+    return apply_op_layer('interpolate', {'x': input},
+                          {'out_shape': list(out_shape), 'method': method,
+                           'align_corners': align_corners,
+                           'align_mode': align_mode,
+                           'data_format': data_format}, name=name)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format='NCHW'):
+    return image_resize(input, out_shape, scale, name, 'BILINEAR',
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True, data_format='NCHW'):
+    return image_resize(input, out_shape, scale, name, 'NEAREST',
+                        actual_shape, align_corners, 1, data_format)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format='NCDHW'):
+    return image_resize(input, out_shape, scale, name, 'BILINEAR',
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def image_resize_short(input, out_short_len, resample='BILINEAR'):
+    h, w = input.shape[2], input.shape[3]
+    short = min(h, w)
+    scale = out_short_len / short
+    return image_resize(input, [int(h * scale), int(w * scale)],
+                        resample=resample)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    return apply_op_layer('crop_tensor', {'x': x},
+                          {'shape': list(shape), 'offsets': offsets},
+                          name=name)
+
+
+crop_tensor = crop
+
+
+def unique(x, dtype='int32'):
+    out = apply_op_layer('unique_with_counts', {'x': x}, {'dtype': dtype})
+    return out[0], out[1]
+
+
+def unique_with_counts(x, dtype='int32'):
+    return apply_op_layer('unique_with_counts', {'x': x}, {'dtype': dtype})
+
+
+__all__ += ['fc', 'embedding', 'conv2d', 'conv3d', 'conv2d_transpose',
+            'conv3d_transpose', 'pool2d', 'pool3d', 'adaptive_pool2d',
+            'adaptive_pool3d', 'batch_norm', 'layer_norm', 'instance_norm',
+            'group_norm', 'spectral_norm', 'data_norm', 'dropout', 'softmax',
+            'matmul', 'mul', 'topk', 'one_hot', 'prelu', 'nce', 'l2_normalize',
+            'im2sequence', 'row_conv', 'multiplex', 'smooth_l1',
+            'autoincreased_step_counter', 'bilinear_tensor_product', 'split',
+            'stack', 'concat', 'affine_grid', 'image_resize', 'resize_bilinear',
+            'resize_nearest', 'resize_trilinear', 'image_resize_short', 'crop',
+            'crop_tensor', 'unique', 'unique_with_counts']

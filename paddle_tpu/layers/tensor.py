@@ -1,0 +1,168 @@
+"""fluid.layers.tensor parity (ref: python/paddle/fluid/layers/tensor.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+from ..framework import Variable, in_dygraph_mode
+from ..initializer import ConstantInitializer, NumpyArrayInitializer
+from ..layer_helper import LayerHelper
+from .common import apply_op_layer, generate_layer_fn
+
+__all__ = ['create_tensor', 'create_parameter', 'create_global_var', 'cast',
+           'concat', 'sums', 'assign', 'fill_constant',
+           'fill_constant_batch_size_like', 'argmin', 'argmax', 'argsort',
+           'ones', 'zeros', 'reverse', 'has_inf', 'has_nan', 'isfinite',
+           'range', 'linspace', 'zeros_like', 'ones_like', 'diag', 'eye',
+           'tensor_array_to_tensor']
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper('create_tensor', name=name)
+    return helper.main_program.current_block().create_var(
+        name=name, dtype=convert_dtype(dtype), persistable=persistable)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..param_attr import ParamAttr
+    helper = LayerHelper('create_parameter', name=name)
+    attr = ParamAttr._to_attr(attr)
+    if name is not None and attr.name is None:
+        attr.name = name
+    return helper.create_parameter(attr, shape, dtype, is_bias,
+                                   default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper('global_var', name=name)
+    v = helper.create_global_variable(shape, dtype, persistable=persistable,
+                                      name=name)
+    sb = helper.startup_program.global_block()
+    sv = sb.create_var(name=v.name, shape=list(shape),
+                       dtype=convert_dtype(dtype), persistable=persistable,
+                       stop_gradient=True)
+    ConstantInitializer(float(value))(sv, sb)
+    return v
+
+
+def cast(x, dtype):
+    return apply_op_layer('cast', {'x': x}, {'dtype': convert_dtype(dtype)},
+                          dtype=convert_dtype(dtype))
+
+
+def concat(input, axis=0, name=None):
+    return apply_op_layer('concat', {'xs': list(input)}, {'axis': axis},
+                          name=name)
+
+
+def sums(input, out=None):
+    return apply_op_layer('sum', {'xs': list(input)})
+
+
+def assign(input, output=None):
+    if isinstance(input, (np.ndarray, list, tuple, float, int)):
+        arr = np.asarray(input)
+        return fill_constant_array(arr)
+    out = apply_op_layer('assign', {'x': input})
+    return out
+
+
+def fill_constant_array(arr):
+    """Materialize a numpy constant into the graph."""
+    helper = LayerHelper('constant')
+    out = helper.create_variable_for_type_inference(str(arr.dtype))
+    helper.append_op(type='__constant__', inputs={},
+                     outputs={'Out': out.name},
+                     attrs={'value': np.asarray(arr)})
+    out.shape = tuple(arr.shape)
+    out.dtype = convert_dtype(str(arr.dtype))
+    return out
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    return apply_op_layer('fill_constant', {},
+                          {'shape': list(shape), 'value': float(value)
+                           if convert_dtype(dtype).startswith('float') else value,
+                           'dtype': convert_dtype(dtype)},
+                          dtype=convert_dtype(dtype))
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    return apply_op_layer('fill_constant_batch_size_like', {'ref': input},
+                          {'shape': list(shape), 'value': value,
+                           'dtype': convert_dtype(dtype),
+                           'input_dim_idx': input_dim_idx,
+                           'output_dim_idx': output_dim_idx})
+
+
+argmin = generate_layer_fn('arg_min')
+argmax = generate_layer_fn('arg_max')
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    return apply_op_layer('argsort', {'x': input},
+                          {'axis': axis, 'descending': descending}, name=name)
+
+
+def ones(shape, dtype='float32', force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros(shape, dtype='float32', force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def reverse(x, axis):
+    return apply_op_layer('reverse', {'x': x}, {'axis': axis})
+
+
+has_inf = generate_layer_fn('has_inf')
+has_nan = generate_layer_fn('has_nan')
+isfinite = generate_layer_fn('isfinite')
+
+
+def range(start, end, step, dtype):
+    return apply_op_layer('range', {},
+                          {'start': start, 'end': end, 'step': step,
+                           'dtype': convert_dtype(dtype)},
+                          dtype=convert_dtype(dtype))
+
+
+def linspace(start, stop, num, dtype):
+    return apply_op_layer('linspace', {},
+                          {'start': start, 'stop': stop, 'num': num,
+                           'dtype': convert_dtype(dtype)},
+                          dtype=convert_dtype(dtype))
+
+
+def zeros_like(x, out=None):
+    return apply_op_layer('fill_zeros_like', {'x': x})
+
+
+def ones_like(x, out=None):
+    return apply_op_layer('fill_any_like', {'x': x}, {'value': 1.0})
+
+
+def diag(diagonal):
+    return apply_op_layer('diag', {'x': diagonal})
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype='float32'):
+    out = apply_op_layer('eye', {},
+                         {'num_rows': num_rows, 'num_columns': num_columns,
+                          'dtype': convert_dtype(dtype)},
+                         dtype=convert_dtype(dtype))
+    if batch_shape:
+        for _ in batch_shape:
+            out = apply_op_layer('unsqueeze', {'x': out}, {'axes': [0]})
+        out = apply_op_layer('expand', {'x': out},
+                             {'expand_times': list(batch_shape) + [1, 1]})
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None):
+    out = apply_op_layer('stack', {'xs': list(input)}, {'axis': axis})
+    return out, None

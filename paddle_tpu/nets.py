@@ -1,0 +1,102 @@
+"""Composite networks (ref: python/paddle/fluid/nets.py)."""
+from __future__ import annotations
+
+from . import layers
+
+__all__ = ['simple_img_conv_pool', 'img_conv_group', 'sequence_conv_pool',
+           'glu', 'scaled_dot_product_attention']
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type='max',
+                         global_pooling=False, conv_stride=1, conv_padding=0,
+                         conv_dilation=1, conv_groups=1, param_attr=None,
+                         bias_attr=None, act=None, use_cudnn=True):
+    conv_out = layers.conv2d(input, num_filters=num_filters,
+                             filter_size=filter_size, stride=conv_stride,
+                             padding=conv_padding, dilation=conv_dilation,
+                             groups=conv_groups, param_attr=param_attr,
+                             bias_attr=bias_attr, act=act)
+    return layers.pool2d(conv_out, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride, pool_padding=pool_padding,
+                         global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type='max', use_cudnn=True):
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def _expand(v):
+        return v if isinstance(v, (list, tuple)) else [v] * len(conv_num_filter)
+
+    conv_padding = _expand(conv_padding)
+    conv_filter_size = _expand(conv_filter_size)
+    param_attr = _expand(param_attr)
+    conv_with_batchnorm = _expand(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = _expand(conv_batchnorm_drop_rate)
+
+    for i in range(len(conv_num_filter)):
+        local_conv_act = conv_act
+        if conv_with_batchnorm[i]:
+            local_conv_act = None
+        tmp = layers.conv2d(tmp, num_filters=conv_num_filter[i],
+                            filter_size=conv_filter_size[i],
+                            padding=conv_padding[i],
+                            param_attr=param_attr[i], act=local_conv_act)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(tmp, act=conv_act)
+            if conv_batchnorm_drop_rate[i]:
+                tmp = layers.dropout(tmp, conv_batchnorm_drop_rate[i])
+    return layers.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act='sigmoid', pool_type='max', bias_attr=None):
+    from .layers import sequence as seq_layers
+    conv_out = seq_layers.sequence_conv(input, num_filters=num_filters,
+                                        filter_size=filter_size,
+                                        param_attr=param_attr, act=act,
+                                        bias_attr=bias_attr)
+    return seq_layers.sequence_pool(conv_out, pool_type=pool_type)
+
+
+def glu(input, dim=-1):
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.common.apply_op_layer(
+        'sigmoid', {'x': b}))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """ref: nets.py:scaled_dot_product_attention. Multi-head attention built
+    on matmul+softmax — XLA fuses this into an MXU-friendly schedule."""
+    d = queries.shape[-1]
+
+    def _split_heads(x):
+        if num_heads == 1:
+            return x
+        b, t, dd = x.shape
+        x = layers.reshape(x, shape=[b if b > 0 else -1, t, num_heads,
+                                     dd // num_heads])
+        return layers.transpose(x, perm=[0, 2, 1, 3])
+
+    q = _split_heads(queries)
+    k = _split_heads(keys)
+    v = _split_heads(values)
+    scaled = layers.scale(q, scale=(d // num_heads) ** -0.5)
+    logits = layers.matmul(scaled, k, transpose_y=True)
+    weights = layers.softmax(logits)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate,
+                                 dropout_implementation='upscale_in_train')
+    ctx = layers.matmul(weights, v)
+    if num_heads > 1:
+        b = ctx.shape[0]
+        ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+        ctx = layers.reshape(ctx, shape=[b if b > 0 else -1, ctx.shape[1],
+                                         num_heads * (d // num_heads)])
+    return ctx

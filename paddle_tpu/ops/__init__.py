@@ -1,0 +1,12 @@
+"""Functional op library. Importing this package registers all ops.
+
+Modules double as a direct functional API (used by dygraph layers), e.g.
+`from paddle_tpu.ops import nn_ops as F; F.conv2d(x, w, stride=1)`.
+"""
+from . import registry
+from .registry import register_op, get_op, has_op, all_ops, custom_op
+from . import (math_ops, tensor_ops, nn_ops, loss_ops, random_ops,
+               optimizer_ops, extra_ops)
+
+# registered lazily by later modules: sequence_ops, rnn_ops, detection_ops,
+# collective_ops — imported in paddle_tpu/__init__.py once they exist.

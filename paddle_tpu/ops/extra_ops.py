@@ -1,0 +1,357 @@
+"""Ops that the reference implements inside layer functions or aux kernels:
+spectral_norm, nce, hsigmoid, dice_loss, edit_distance, warpctc, gru_unit,
+tree_conv, auc. Registered here so both static layers and dygraph share them.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+@register_op('spectral_norm')
+def spectral_norm(w, *, dim=0, power_iters=1, eps=1e-12):
+    """ref: paddle/fluid/operators/spectral_norm_op.cc — power iteration."""
+    w = jnp.asarray(w)
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    u = jnp.ones((wm.shape[0],), w.dtype)
+    v = jnp.ones((wm.shape[1],), w.dtype)
+    for _ in range(max(power_iters, 1)):
+        v = wm.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wm @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ wm @ v
+    return w / sigma
+
+
+@register_op('nce', needs_rng=True)
+def nce(x, label, weight, bias, *, num_total_classes, num_neg_samples=10,
+        key=None):
+    """Noise-contrastive estimation (ref: paddle/fluid/operators/nce_op.cc),
+    uniform negative sampling inside the jitted step."""
+    x = jnp.asarray(x)
+    label = jnp.asarray(label).reshape(-1)
+    w = jnp.asarray(weight)
+    b = jnp.asarray(bias)
+    neg = jax.random.randint(key, (num_neg_samples,), 0, num_total_classes)
+    pos_logit = jnp.sum(x * w[label], -1) + b[label]
+    neg_logit = x @ w[neg].T + b[neg]
+    pos_loss = -jax.nn.log_sigmoid(pos_logit)
+    neg_loss = -jnp.sum(jax.nn.log_sigmoid(-neg_logit), -1)
+    return (pos_loss + neg_loss)[:, None]
+
+
+@register_op('hsigmoid')
+def hsigmoid(x, label, weight, bias, *, num_classes):
+    """Hierarchical sigmoid over a complete binary tree
+    (ref: paddle/fluid/operators/hierarchical_sigmoid_op.cc)."""
+    x = jnp.asarray(x)
+    label = jnp.asarray(label).reshape(-1)
+    w = jnp.asarray(weight)
+    b = jnp.asarray(bias)
+    code_len = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+    ids = label + num_classes
+    losses = jnp.zeros((x.shape[0],), x.dtype)
+    for _ in range(code_len):
+        parent = ids // 2
+        is_right = (ids % 2).astype(x.dtype)
+        valid = (parent >= 1) & (parent < num_classes)
+        node = jnp.clip(parent - 1, 0, num_classes - 1)
+        logit = jnp.sum(x * w[node], -1) + b[node]
+        ce = jnp.maximum(logit, 0) - logit * is_right + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        losses = losses + jnp.where(valid, ce, 0.0)
+        ids = parent
+    return losses[:, None]
+
+
+@register_op('dice_loss')
+def dice_loss(x, label, *, epsilon=1e-5):
+    x = jnp.asarray(x)
+    label = jnp.asarray(label)
+    if label.shape[-1] == 1:
+        label = jax.nn.one_hot(label[..., 0], x.shape[-1])
+    label = label.astype(x.dtype)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = 2 * jnp.sum(x * label, reduce_dims)
+    union = jnp.sum(x, reduce_dims) + jnp.sum(label, reduce_dims)
+    return jnp.mean(1 - (inter + epsilon) / (union + epsilon))
+
+
+@register_op('edit_distance', outputs=['Out', 'SequenceNum'])
+def edit_distance(x, label, x_len=None, label_len=None, *, normalized=True):
+    """Levenshtein DP via lax.scan, static shapes
+    (ref: paddle/fluid/operators/edit_distance_op.cc)."""
+    x = jnp.asarray(x)
+    label = jnp.asarray(label)
+    b, n = x.shape
+    m = label.shape[1]
+    xl = jnp.asarray(x_len).reshape(-1) if x_len is not None else jnp.full((b,), n)
+    ll = jnp.asarray(label_len).reshape(-1) if label_len is not None \
+        else jnp.full((b,), m)
+
+    def per_row(xr, lr, nx, nl):
+        # DP over full padded matrix with masking on lengths
+        row0 = jnp.arange(m + 1, dtype=jnp.float32)
+
+        def step(prev, i):
+            def inner(left, j):
+                up = prev[j + 1]
+                diag = prev[j]
+                cost = jnp.where(xr[i] == lr[j], 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(left + 1, up + 1), diag + cost)
+                return val, val
+            first = prev[0] + 1
+            _, rest = lax.scan(inner, first, jnp.arange(m))
+            row = jnp.concatenate([first[None], rest])
+            row = jnp.where(i < nx, row, prev)
+            return row, None
+
+        final, _ = lax.scan(step, row0, jnp.arange(n))
+        return final[nl]
+
+    d = jax.vmap(per_row)(x, label, xl, ll).astype(jnp.float32)
+    if normalized:
+        d = d / jnp.maximum(ll.astype(jnp.float32), 1.0)
+    return d[:, None], jnp.asarray([b], jnp.int64)
+
+
+@register_op('warpctc')
+def warpctc(logits, label, logit_len=None, label_len=None, *, blank=0,
+            norm_by_times=False):
+    """CTC loss, log-space forward algorithm over lax.scan — the TPU-native
+    replacement for the warp-ctc CUDA dependency
+    (ref: paddle/fluid/operators/warpctc_op.cc)."""
+    logits = jnp.asarray(logits)
+    label = jnp.asarray(label)
+    if logits.ndim == 3 and logits.shape[0] != label.shape[0]:
+        logits = jnp.swapaxes(logits, 0, 1)  # (T,B,C) → (B,T,C)
+    b, t, c = logits.shape
+    l = label.shape[1]
+    logp = jax.nn.log_softmax(logits, -1)
+    tl = jnp.asarray(logit_len).reshape(-1) if logit_len is not None \
+        else jnp.full((b,), t)
+    ll = jnp.asarray(label_len).reshape(-1) if label_len is not None \
+        else jnp.full((b,), l)
+    ext = jnp.full((b, 2 * l + 1), blank)
+    ext = ext.at[:, 1::2].set(label)
+    neg_inf = -1e30
+
+    def per_seq(lp, e, nt, nl):
+        s = 2 * nl + 1
+        alpha0 = jnp.full((2 * l + 1,), neg_inf)
+        alpha0 = alpha0.at[0].set(lp[0, blank])
+        alpha0 = alpha0.at[1].set(jnp.where(nl > 0, lp[0, e[1]], neg_inf))
+
+        def step(alpha, ti):
+            prev1 = jnp.concatenate([jnp.array([neg_inf]), alpha[:-1]])
+            prev2 = jnp.concatenate([jnp.array([neg_inf, neg_inf]), alpha[:-2]])
+            idx = jnp.arange(2 * l + 1)
+            same = jnp.concatenate([jnp.array([True, True]), e[2:] == e[:-2]])
+            allow2 = (idx % 2 == 1) & (~same)
+            cand = jnp.logaddexp(alpha, prev1)
+            cand = jnp.where(allow2, jnp.logaddexp(cand, prev2), cand)
+            new = cand + lp[ti, e]
+            new = jnp.where(ti < nt, new, alpha)
+            return new, None
+
+        alphaT, _ = lax.scan(step, alpha0, jnp.arange(1, t))
+        ll_prob = jnp.logaddexp(alphaT[s - 1], alphaT[s - 2])
+        loss = -ll_prob
+        if norm_by_times:
+            loss = loss / jnp.maximum(nt, 1)
+        return loss
+
+    return jax.vmap(per_seq)(logp, ext, tl, ll)[:, None]
+
+
+@register_op('ctc_greedy_decoder', outputs=['Out', 'OutLen'])
+def ctc_greedy_decoder(x, *, blank):
+    """ref: paddle/fluid/operators/ctc_align_op.cc — argmax, merge repeats,
+    drop blanks; output padded with -1."""
+    x = jnp.asarray(x)  # (B, T, C) probs
+    ids = jnp.argmax(x, -1)  # B, T
+    prev = jnp.concatenate([jnp.full_like(ids[:, :1], -1), ids[:, :-1]], 1)
+    keep = (ids != blank) & (ids != prev)
+    b, t = ids.shape
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    gathered = jnp.take_along_axis(ids, order, 1)
+    counts = jnp.sum(keep, 1)
+    pos = jnp.arange(t)[None, :]
+    out = jnp.where(pos < counts[:, None], gathered, -1)
+    return out, counts
+
+
+@register_op('gru_unit', outputs=['Hidden', 'ResetHidden', 'Gate'])
+def gru_unit(x, hidden, weight, bias=None, *, origin_mode=False):
+    """ref: paddle/fluid/operators/gru_unit_op.cc. x: (B, 3D) projected input."""
+    x = jnp.asarray(x)
+    h = jnp.asarray(hidden)
+    w = jnp.asarray(weight)
+    d = h.shape[-1]
+    g = x + (jnp.asarray(bias) if bias is not None else 0.0)
+    wu_r = w[:, :2 * d]
+    wc = w[:, 2 * d:]
+    ur = jax.nn.sigmoid(g[:, :2 * d] + h @ wu_r)
+    u, r = ur[:, :d], ur[:, d:]
+    rh = r * h
+    c = jnp.tanh(g[:, 2 * d:] + rh @ wc)
+    if origin_mode:
+        new_h = u * h + (1 - u) * c
+    else:
+        new_h = (1 - u) * h + u * c
+    return new_h, rh, jnp.concatenate([ur, c], -1)
+
+
+@register_op('lstm_unit', outputs=['H', 'C'])
+def lstm_unit(x, cell, *, forget_bias=0.0):
+    """ref: paddle/fluid/operators/lstm_unit_op.cc. x: (B, 4D) gates."""
+    x = jnp.asarray(x)
+    c_prev = jnp.asarray(cell)
+    d = c_prev.shape[-1]
+    i, f, c_hat, o = jnp.split(x, 4, axis=-1)
+    new_c = c_prev * jax.nn.sigmoid(f + forget_bias) + \
+        jax.nn.sigmoid(i) * jnp.tanh(c_hat)
+    new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
+    return new_h, new_c
+
+
+@register_op('tree_conv')
+def tree_conv(nodes, edges, weight, *, max_depth=8):
+    """Tree-based convolution (ref: paddle/fluid/operators/tree_conv_op.cc),
+    dense positional-role formulation for static shapes."""
+    nodes = jnp.asarray(nodes)
+    w = jnp.asarray(weight)  # F,3,O,K
+    agg_self = jnp.einsum('bnf,fok->bnok', nodes, w[:, 0])
+    agg_l = jnp.einsum('bnf,fok->bnok', nodes, w[:, 1])
+    agg_r = jnp.einsum('bnf,fok->bnok', nodes, w[:, 2])
+    return jnp.tanh(agg_self + 0.5 * (agg_l + agg_r))
+
+
+@register_op('auc')
+def auc(pred, label, *, num_thresholds=200):
+    """Batch ROC-AUC by rank statistic (ref: paddle/fluid/operators/metrics/
+    auc_op.cc keeps global accumulators; metrics.Auc does that on top)."""
+    p = jnp.asarray(pred)
+    p = p[:, 1] if p.ndim == 2 and p.shape[1] == 2 else p.reshape(-1)
+    y = jnp.asarray(label).reshape(-1).astype(jnp.float32)
+    order = jnp.argsort(p)
+    n = p.shape[0]
+    ranks = jnp.zeros((n,)).at[order].set(jnp.arange(1, n + 1, dtype=jnp.float32))
+    pos = jnp.sum(y)
+    neg = n - pos
+    sum_ranks_pos = jnp.sum(jnp.where(y > 0, ranks, 0.0))
+    return (sum_ranks_pos - pos * (pos + 1) / 2) / jnp.maximum(pos * neg, 1.0)
+
+
+@register_op('linear_chain_crf', outputs=['LogLikelihood', 'Alpha',
+                                          'EmissionExps', 'TransitionExps'])
+def linear_chain_crf(emission, transition, label, length=None):
+    """ref: paddle/fluid/operators/linear_chain_crf_op.cc. Batched dense form:
+    emission (B,T,N), transition (N+2,N) with rows 0/1 = start/stop weights."""
+    em = jnp.asarray(emission)
+    tr = jnp.asarray(transition)
+    lb = jnp.asarray(label)
+    if lb.ndim == 3 and lb.shape[-1] == 1:
+        lb = lb[..., 0]
+    b, t, n = em.shape
+    start, stop, trans = tr[0], tr[1], tr[2:]
+    ln = jnp.asarray(length).reshape(-1) if length is not None \
+        else jnp.full((b,), t)
+
+    def per_seq(e, y, nt):
+        a0 = start + e[0]
+
+        def step(alpha, ti):
+            nxt = jax.scipy.special.logsumexp(
+                alpha[:, None] + trans, axis=0) + e[ti]
+            nxt = jnp.where(ti < nt, nxt, alpha)
+            return nxt, None
+        alphaT, _ = lax.scan(step, a0, jnp.arange(1, t))
+        logz = jax.scipy.special.logsumexp(alphaT + stop)
+        # score of gold path
+        idx = jnp.arange(t)
+        em_score = jnp.sum(jnp.where(idx < nt,
+                                     jnp.take_along_axis(e, y[:, None], 1)[:, 0],
+                                     0.0))
+        pair_valid = (idx[1:] < nt)
+        tr_score = jnp.sum(jnp.where(pair_valid, trans[y[:-1], y[1:]], 0.0))
+        last = jnp.clip(nt - 1, 0, t - 1)
+        gold = em_score + tr_score + start[y[0]] + stop[y[last]]
+        return -(gold - logz)
+
+    nll = jax.vmap(per_seq)(em, lb, ln)
+    return nll[:, None], em, jnp.exp(em), jnp.exp(tr)
+
+
+@register_op('crf_decoding')
+def crf_decoding(emission, transition, length=None):
+    """Viterbi decode (ref: paddle/fluid/operators/crf_decoding_op.cc)."""
+    em = jnp.asarray(emission)
+    tr = jnp.asarray(transition)
+    b, t, n = em.shape
+    start, stop, trans = tr[0], tr[1], tr[2:]
+    ln = jnp.asarray(length).reshape(-1) if length is not None \
+        else jnp.full((b,), t)
+
+    def per_seq(e, nt):
+        a0 = start + e[0]
+
+        def fwd(alpha, ti):
+            scores = alpha[:, None] + trans
+            best = jnp.max(scores, axis=0) + e[ti]
+            bp = jnp.argmax(scores, axis=0)
+            new = jnp.where(ti < nt, best, alpha)
+            return new, bp
+
+        alphaT, bps = lax.scan(fwd, a0, jnp.arange(1, t))
+        lastn = jnp.argmax(alphaT + stop)
+
+        def bwd(nxt, ti):
+            cur = bps[ti][nxt]
+            keep = ti + 1 < nt
+            cur = jnp.where(keep, cur, nxt)
+            return cur, cur
+
+        _, path_rev = lax.scan(bwd, lastn, jnp.arange(t - 2, -1, -1))
+        path = jnp.concatenate([path_rev[::-1], lastn[None]])
+        return path
+
+    return jax.vmap(per_seq)(em, ln).astype(jnp.int64)
+
+
+@register_op('chunk_eval', outputs=['Precision', 'Recall', 'F1',
+                                    'NumInferChunks', 'NumLabelChunks',
+                                    'NumCorrectChunks'])
+def chunk_eval(inference, label, *, num_chunk_types, chunk_scheme='IOB',
+               excluded_chunk_types=None):
+    """ref: paddle/fluid/operators/chunk_eval_op.cc — IOB span F1 on padded
+    id sequences. Tag encoding: tag = type * tag_num + {B:0, I:1}."""
+    inf = jnp.asarray(inference).reshape(jnp.asarray(inference).shape[0], -1)
+    lab = jnp.asarray(label).reshape(inf.shape)
+    tag_num = 2 if chunk_scheme == 'IOB' else 4
+
+    def starts(seq):
+        typ = seq // tag_num
+        pos = seq % tag_num
+        prev = jnp.concatenate([jnp.full_like(seq[:, :1], -1), seq[:, :-1]], 1)
+        ptyp = prev // tag_num
+        is_b = (pos == 0)
+        cont_break = (typ != ptyp)
+        return is_b | cont_break
+
+    inf_start = starts(inf)
+    lab_start = starts(lab)
+    num_inf = jnp.sum(inf_start)
+    num_lab = jnp.sum(lab_start)
+    correct = jnp.sum(inf_start & lab_start & (inf == lab))
+    prec = correct / jnp.maximum(num_inf, 1)
+    rec = correct / jnp.maximum(num_lab, 1)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-9)
+    return (prec.astype(jnp.float32), rec.astype(jnp.float32),
+            f1.astype(jnp.float32), num_inf.astype(jnp.int64),
+            num_lab.astype(jnp.int64), correct.astype(jnp.int64))

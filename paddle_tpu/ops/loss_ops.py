@@ -1,0 +1,209 @@
+"""Loss ops.
+
+Parity targets: reference paddle/fluid/operators/{cross_entropy,softmax_with_
+cross_entropy,sigmoid_cross_entropy_with_logits,squared_l2_distance,smooth_l1,
+huber_loss,kldiv_loss,bpr_loss,rank_loss,margin_rank_loss,log_loss,
+center_loss,accuracy}_op.* — numerically-stable jax formulations.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _squeeze_label(label):
+    label = jnp.asarray(label)
+    if label.ndim >= 2 and label.shape[-1] == 1:
+        return label[..., 0]
+    return label
+
+
+@register_op('cross_entropy')
+def cross_entropy(x, label, *, soft_label=False, ignore_index=-100):
+    """x are probabilities (post-softmax), matching the ref op."""
+    x = jnp.asarray(x)
+    eps = 1e-8
+    if soft_label:
+        return -jnp.sum(jnp.asarray(label) * jnp.log(x + eps), -1, keepdims=True)
+    label = _squeeze_label(label)
+    picked = jnp.take_along_axis(x, jnp.clip(label, 0, x.shape[-1] - 1)[..., None].astype(jnp.int32), -1)
+    loss = -jnp.log(picked + eps)
+    if ignore_index >= 0:
+        loss = jnp.where((label == ignore_index)[..., None], 0.0, loss)
+    return loss
+
+
+@register_op('softmax_with_cross_entropy', outputs=['Loss', 'Softmax'])
+def softmax_with_cross_entropy(logits, label, *, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False, numeric_stable_mode=True):
+    logits = jnp.asarray(logits)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    sm = jnp.exp(logp)
+    if soft_label:
+        loss = -jnp.sum(jnp.asarray(label) * logp, axis=axis, keepdims=True)
+    else:
+        label = _squeeze_label(label)
+        li = jnp.clip(label, 0, logits.shape[axis] - 1).astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, li[..., None], axis=axis)
+        loss = -picked
+        if ignore_index >= 0:
+            loss = jnp.where((label == ignore_index)[..., None], 0.0, loss)
+    return loss, sm
+
+
+@register_op('sigmoid_cross_entropy_with_logits')
+def sigmoid_cross_entropy_with_logits(x, label, *, ignore_index=-100,
+                                      normalize=False):
+    x = jnp.asarray(x)
+    label = jnp.asarray(label).astype(x.dtype)
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = (label != ignore_index)
+    loss = jnp.where(mask, loss, 0.0)
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(mask), 1)
+    return loss
+
+
+@register_op('square_error_cost')
+def square_error_cost(x, label):
+    d = jnp.asarray(x) - jnp.asarray(label)
+    return jnp.square(d)
+
+
+@register_op('smooth_l1_loss')
+def smooth_l1_loss(x, y, inside_weight=None, outside_weight=None, *, sigma=1.0):
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    d = x - y
+    if inside_weight is not None:
+        d = d * jnp.asarray(inside_weight)
+    s2 = sigma * sigma
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * d * d, ad - 0.5 / s2)
+    if outside_weight is not None:
+        loss = loss * jnp.asarray(outside_weight)
+    return jnp.sum(loss.reshape(loss.shape[0], -1), -1, keepdims=True)
+
+
+@register_op('huber_loss')
+def huber_loss(x, label, *, delta=1.0):
+    d = jnp.asarray(label) - jnp.asarray(x)
+    ad = jnp.abs(d)
+    return jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+
+
+@register_op('kldiv_loss')
+def kldiv_loss(x, target, *, reduction='mean'):
+    """x is log-prob input, matching ref kldiv_loss_op.cc."""
+    x = jnp.asarray(x)
+    t = jnp.asarray(target)
+    loss = jnp.where(t > 0, t * (jnp.log(jnp.maximum(t, 1e-12)) - x), 0.0)
+    if reduction == 'mean':
+        return jnp.mean(loss)
+    if reduction == 'sum':
+        return jnp.sum(loss)
+    if reduction == 'batchmean':
+        return jnp.sum(loss) / x.shape[0]
+    return loss
+
+
+@register_op('bpr_loss')
+def bpr_loss(x, label):
+    """Bayesian personalized ranking (ref: bpr_loss_op.cc)."""
+    x = jnp.asarray(x)
+    label = _squeeze_label(label).astype(jnp.int32)
+    n, c = x.shape
+    pos = jnp.take_along_axis(x, label[:, None], -1)
+    diff = pos - x
+    loss = -jnp.log(jax.nn.sigmoid(diff) + 1e-8)
+    mask = jnp.arange(c)[None, :] != label[:, None]
+    return (jnp.sum(jnp.where(mask, loss, 0.0), -1, keepdims=True) / (c - 1))
+
+
+@register_op('rank_loss')
+def rank_loss(label, left, right):
+    label = jnp.asarray(label)
+    d = jnp.asarray(left) - jnp.asarray(right)
+    return jnp.log1p(jnp.exp(d)) - label * d
+
+
+@register_op('margin_rank_loss')
+def margin_rank_loss(label, left, right, *, margin=0.1):
+    label = jnp.asarray(label)
+    out = margin - label * (jnp.asarray(left) - jnp.asarray(right))
+    return jnp.maximum(out, 0.0)
+
+
+@register_op('log_loss')
+def log_loss(x, label, *, epsilon=1e-4):
+    x = jnp.asarray(x)
+    label = jnp.asarray(label)
+    return -label * jnp.log(x + epsilon) - (1 - label) * jnp.log(1 - x + epsilon)
+
+
+@register_op('center_loss', outputs=['Loss', 'SampleCenterDiff', 'CentersOut'])
+def center_loss(x, label, centers, update_rate, *, cluster_num, need_update=True):
+    """ref: center_loss_op.cc."""
+    x = jnp.asarray(x)
+    label = _squeeze_label(label).astype(jnp.int32)
+    centers = jnp.asarray(centers)
+    c = centers[label]
+    diff = x - c
+    loss = 0.5 * jnp.sum(jnp.square(diff), -1, keepdims=True)
+    if need_update:
+        alpha = jnp.asarray(update_rate).reshape(())
+        counts = jnp.zeros((cluster_num,), x.dtype).at[label].add(1.0) + 1.0
+        delta = jnp.zeros_like(centers).at[label].add(diff)
+        new_centers = centers + alpha * delta / counts[:, None]
+        new_centers = lax.stop_gradient(new_centers)
+    else:
+        new_centers = centers
+    return loss, diff, new_centers
+
+
+@register_op('teacher_student_sigmoid_loss')
+def teacher_student_sigmoid_loss(x, label, *, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """ref: teacher_student_sigmoid_loss_op.cc (CTR distillation)."""
+    x = jnp.asarray(x)[:, 0]
+    label = jnp.asarray(label).reshape(-1)
+    # teacher part: label < -1 or > 1 encodes soft score z = |label| - 1 … the
+    # ref treats label in {0,1} as hard, otherwise soft score.
+    hard = (label >= 0.0) & (label <= 1.0)
+    ce_hard = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    z = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+    soft = jnp.abs(label) - 1.0
+    ce_soft = jnp.maximum(z, 0) - z * soft + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    return jnp.where(hard, ce_hard, ce_soft)[:, None]
+
+
+@register_op('accuracy', outputs=['Out', 'Correct', 'Total'])
+def accuracy(pred, label, *, k=1):
+    """ref: paddle/fluid/operators/metrics/accuracy_op.cc. pred: probs/logits."""
+    pred = jnp.asarray(pred)
+    label = _squeeze_label(label).astype(jnp.int32)
+    _, top = lax.top_k(pred, k)
+    correct = jnp.any(top == label[:, None], -1)
+    total = jnp.asarray(pred.shape[0], jnp.int64)
+    ncorrect = jnp.sum(correct).astype(jnp.int64)
+    return (ncorrect.astype(jnp.float32) / total.astype(jnp.float32),
+            ncorrect, total)
+
+
+@register_op('mean_iou', outputs=['Out', 'Wrong', 'Correct'])
+def mean_iou(pred, label, *, num_classes):
+    pred = jnp.asarray(pred).reshape(-1).astype(jnp.int32)
+    label = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    inter = jnp.zeros((num_classes,), jnp.float32).at[pred].add(
+        (pred == label).astype(jnp.float32))
+    parea = jnp.zeros((num_classes,), jnp.float32).at[pred].add(1.0)
+    larea = jnp.zeros((num_classes,), jnp.float32).at[label].add(1.0)
+    union = parea + larea - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1e-9), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    return miou, (parea - inter).astype(jnp.int32), inter.astype(jnp.int32)

@@ -1,0 +1,359 @@
+"""Elementwise math, matmul, reductions, comparisons, logicals.
+
+Parity targets: /root/reference/paddle/fluid/operators/elementwise/*,
+matmul_op.cc, mul_op.cc, reduce_ops/*, controlflow/compare_op.cc, scale_op.cc.
+All are thin jax functionals — XLA fuses them; gradients via jax.vjp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _align_y(x, y, axis=-1):
+    """Paddle elementwise broadcast: align y at `axis` of x (ref:
+    paddle/fluid/operators/elementwise/elementwise_op_function.h)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if y.ndim == 0 or x.shape == y.shape or y.ndim >= x.ndim:
+        return y
+    if axis == -1 or axis is None:
+        axis = x.ndim - y.ndim
+    trailing = x.ndim - axis - y.ndim
+    if trailing > 0:
+        y = y.reshape(y.shape + (1,) * trailing)
+    return y
+
+
+def _ew(name, fn):
+    @register_op(name)
+    def op(x, y, *, axis=-1):
+        return fn(jnp.asarray(x), _align_y(x, y, axis))
+    op.__name__ = name
+    return op
+
+
+elementwise_add = _ew('elementwise_add', jnp.add)
+elementwise_sub = _ew('elementwise_sub', jnp.subtract)
+elementwise_mul = _ew('elementwise_mul', jnp.multiply)
+elementwise_div = _ew('elementwise_div', jnp.divide)
+elementwise_max = _ew('elementwise_max', jnp.maximum)
+elementwise_min = _ew('elementwise_min', jnp.minimum)
+elementwise_pow = _ew('elementwise_pow', jnp.power)
+elementwise_mod = _ew('elementwise_mod', jnp.mod)
+elementwise_floordiv = _ew('elementwise_floordiv', jnp.floor_divide)
+
+
+@register_op('scale')
+def scale(x, *, scale=1.0, bias=0.0, bias_after_scale=True):
+    x = jnp.asarray(x)
+    s = jnp.asarray(scale, x.dtype)
+    b = jnp.asarray(bias, x.dtype)
+    return x * s + b if bias_after_scale else (x + b) * s
+
+
+@register_op('matmul')
+def matmul(x, y, *, transpose_x=False, transpose_y=False, alpha=1.0):
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    return out
+
+
+@register_op('mul')
+def mul(x, y, *, x_num_col_dims=1, y_num_col_dims=1):
+    """Flatten-to-2D matmul (ref: paddle/fluid/operators/mul_op.cc)."""
+    import math
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    xs, ys = x.shape, y.shape
+    xm = x.reshape((math.prod(xs[:x_num_col_dims]), -1))
+    ym = y.reshape((math.prod(ys[:y_num_col_dims]), -1))
+    out = xm @ ym
+    out_shape = xs[:x_num_col_dims] + ys[y_num_col_dims:]
+    return out.reshape(out_shape)
+
+
+@register_op('sum', variadic=['xs'])
+def sum_op(xs):
+    """Add N tensors (ref: paddle/fluid/operators/sum_op.cc)."""
+    if not isinstance(xs, (list, tuple)):
+        return jnp.asarray(xs)
+    out = jnp.asarray(xs[0])
+    for x in xs[1:]:
+        out = out + jnp.asarray(x)
+    return out
+
+
+@register_op('clip')
+def clip(x, *, min, max):
+    return jnp.clip(jnp.asarray(x), min, max)
+
+
+@register_op('clip_by_norm')
+def clip_by_norm(x, *, max_norm):
+    x = jnp.asarray(x)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.where(norm > max_norm, x * (max_norm / norm), x)
+
+
+# ---------------------------------------------------------------------------
+# unary math / activations (ref: paddle/fluid/operators/activation_op.cc)
+# ---------------------------------------------------------------------------
+
+def _unary(name, fn):
+    @register_op(name)
+    def op(x):
+        return fn(jnp.asarray(x))
+    op.__name__ = name
+    return op
+
+
+sigmoid = _unary('sigmoid', jax.nn.sigmoid)
+logsigmoid = _unary('logsigmoid', jax.nn.log_sigmoid)
+exp = _unary('exp', jnp.exp)
+tanh = _unary('tanh', jnp.tanh)
+atan = _unary('atan', jnp.arctan)
+tanh_shrink = _unary('tanh_shrink', lambda x: x - jnp.tanh(x))
+sqrt = _unary('sqrt', jnp.sqrt)
+rsqrt = _unary('rsqrt', lax.rsqrt)
+abs_ = _unary('abs', jnp.abs)
+ceil = _unary('ceil', jnp.ceil)
+floor = _unary('floor', jnp.floor)
+cos = _unary('cos', jnp.cos)
+sin = _unary('sin', jnp.sin)
+acos = _unary('acos', jnp.arccos)
+asin = _unary('asin', jnp.arcsin)
+cosh = _unary('cosh', jnp.cosh)
+sinh = _unary('sinh', jnp.sinh)
+round_ = _unary('round', jnp.round)
+reciprocal = _unary('reciprocal', lambda x: 1.0 / x)
+log_ = _unary('log', jnp.log)
+square = _unary('square', jnp.square)
+softplus = _unary('softplus', jax.nn.softplus)
+softsign = _unary('softsign', jax.nn.soft_sign)
+relu = _unary('relu', jax.nn.relu)
+sign = _unary('sign', jnp.sign)
+erf = _unary('erf', lax.erf)
+
+
+@register_op('gelu')
+def gelu(x, *, approximate=False):
+    return jax.nn.gelu(jnp.asarray(x), approximate=approximate)
+
+
+@register_op('leaky_relu')
+def leaky_relu(x, *, alpha=0.02):
+    x = jnp.asarray(x)
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@register_op('relu6')
+def relu6(x, *, threshold=6.0):
+    return jnp.clip(jnp.asarray(x), 0.0, threshold)
+
+
+@register_op('elu')
+def elu(x, *, alpha=1.0):
+    x = jnp.asarray(x)
+    return jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+@register_op('selu')
+def selu(x, *, scale=1.0507009873554805, alpha=1.6732632423543772):
+    x = jnp.asarray(x)
+    return scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+@register_op('prelu')
+def prelu(x, alpha, *, mode='all'):
+    x = jnp.asarray(x)
+    a = jnp.asarray(alpha)
+    if mode == 'channel' and a.size > 1:
+        a = a.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == 'all':
+        a = a.reshape(())if a.size == 1 else a
+    return jnp.where(x >= 0, x, a * x)
+
+
+@register_op('brelu')
+def brelu(x, *, t_min=0.0, t_max=24.0):
+    return jnp.clip(jnp.asarray(x), t_min, t_max)
+
+
+@register_op('soft_relu')
+def soft_relu(x, *, threshold=40.0):
+    x = jnp.clip(jnp.asarray(x), -threshold, threshold)
+    return jnp.log1p(jnp.exp(x))
+
+
+@register_op('stanh')
+def stanh(x, *, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * jnp.asarray(x))
+
+
+@register_op('hard_sigmoid')
+def hard_sigmoid(x, *, slope=0.2, offset=0.5):
+    return jnp.clip(slope * jnp.asarray(x) + offset, 0.0, 1.0)
+
+
+@register_op('hard_swish')
+def hard_swish(x, *, threshold=6.0, scale=6.0, offset=3.0):
+    x = jnp.asarray(x)
+    return x * jnp.clip(x + offset, 0.0, threshold) / scale
+
+
+@register_op('swish')
+def swish(x, *, beta=1.0):
+    x = jnp.asarray(x)
+    return x * jax.nn.sigmoid(beta * x)
+
+
+@register_op('hard_shrink')
+def hard_shrink(x, *, threshold=0.5):
+    x = jnp.asarray(x)
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@register_op('softshrink')
+def softshrink(x, *, lambda_=0.5):
+    x = jnp.asarray(x)
+    return jnp.where(x > lambda_, x - lambda_, jnp.where(x < -lambda_, x + lambda_, 0.0))
+
+
+@register_op('thresholded_relu')
+def thresholded_relu(x, *, threshold=1.0):
+    x = jnp.asarray(x)
+    return jnp.where(x > threshold, x, 0.0)
+
+
+@register_op('maxout')
+def maxout(x, *, groups, axis=1):
+    x = jnp.asarray(x)
+    c = x.shape[axis]
+    assert c % groups == 0
+    shape = list(x.shape)
+    shape[axis:axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+@register_op('pow')
+def pow_op(x, *, factor=1.0):
+    return jnp.power(jnp.asarray(x), factor)
+
+
+@register_op('mean')
+def mean(x):
+    return jnp.mean(jnp.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# reductions (ref: paddle/fluid/operators/reduce_ops/*)
+# ---------------------------------------------------------------------------
+
+def _norm_dim(dim, ndim):
+    if dim is None:
+        return None
+    dims = [dim] if isinstance(dim, int) else list(dim)
+    return tuple(d % ndim for d in dims)
+
+
+def _reduce(name, fn):
+    @register_op(name)
+    def op(x, *, dim=None, keep_dim=False, reduce_all=False):
+        x = jnp.asarray(x)
+        axis = None if reduce_all or dim is None else _norm_dim(dim, x.ndim)
+        return fn(x, axis=axis, keepdims=keep_dim)
+    op.__name__ = name
+    return op
+
+
+reduce_sum = _reduce('reduce_sum', jnp.sum)
+reduce_mean = _reduce('reduce_mean', jnp.mean)
+reduce_max = _reduce('reduce_max', jnp.max)
+reduce_min = _reduce('reduce_min', jnp.min)
+reduce_prod = _reduce('reduce_prod', jnp.prod)
+reduce_all = _reduce('reduce_all', jnp.all)
+reduce_any = _reduce('reduce_any', jnp.any)
+
+
+@register_op('logsumexp')
+def logsumexp(x, *, dim=None, keep_dim=False):
+    x = jnp.asarray(x)
+    return jax.scipy.special.logsumexp(x, axis=_norm_dim(dim, x.ndim), keepdims=keep_dim)
+
+
+# ---------------------------------------------------------------------------
+# comparisons / logicals (ref: paddle/fluid/operators/controlflow/compare_op.cc)
+# ---------------------------------------------------------------------------
+
+def _cmp(name, fn):
+    @register_op(name)
+    def op(x, y):
+        return fn(jnp.asarray(x), jnp.asarray(y))
+    op.__name__ = name
+    return op
+
+
+equal = _cmp('equal', jnp.equal)
+not_equal = _cmp('not_equal', jnp.not_equal)
+less_than = _cmp('less_than', jnp.less)
+less_equal = _cmp('less_equal', jnp.less_equal)
+greater_than = _cmp('greater_than', jnp.greater)
+greater_equal = _cmp('greater_equal', jnp.greater_equal)
+logical_and = _cmp('logical_and', jnp.logical_and)
+logical_or = _cmp('logical_or', jnp.logical_or)
+logical_xor = _cmp('logical_xor', jnp.logical_xor)
+logical_not = _unary('logical_not', jnp.logical_not)
+
+
+@register_op('isfinite')
+def isfinite(x):
+    return jnp.all(jnp.isfinite(jnp.asarray(x)))
+
+
+@register_op('has_inf')
+def has_inf(x):
+    return jnp.any(jnp.isinf(jnp.asarray(x)))
+
+
+@register_op('has_nan')
+def has_nan(x):
+    return jnp.any(jnp.isnan(jnp.asarray(x)))
+
+
+@register_op('cos_sim')
+def cos_sim(x, y):
+    """Row-wise cosine similarity (ref: paddle/fluid/operators/cos_sim_op.cc)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    xn = jnp.sqrt(jnp.sum(x * x, -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, -1, keepdims=True))
+    return jnp.sum(x * y, -1, keepdims=True) / (xn * yn)
+
+
+@register_op('kron')
+def kron(x, y):
+    return jnp.kron(jnp.asarray(x), jnp.asarray(y))
+
+
+@register_op('dot')
+def dot(x, y):
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    return jnp.sum(x * y, axis=-1, keepdims=True)
+
+
+@register_op('increment')
+def increment(x, *, value=1.0):
+    x = jnp.asarray(x)
+    return x + jnp.asarray(value, x.dtype)

@@ -1,0 +1,561 @@
+"""Neural-network ops: conv, pool, normalization, embedding, dropout, resize.
+
+Parity targets: reference paddle/fluid/operators/{conv,pool,batch_norm,
+layer_norm,group_norm,instance_norm,data_norm,dropout,lookup_table,softmax,
+lrn,interpolate,grid_sampler,affine_grid,pixel_shuffle,unfold,im2sequence,
+row_conv,bilinear_tensor_product}_op.* — implemented as jax functionals on
+lax.conv_general_dilated / reduce_window so XLA tiles them onto the MXU.
+Layouts: Paddle default NCHW is honored; NHWC supported via data_format attr
+(preferred on TPU).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from ..core.dtypes import to_jax_dtype
+
+
+def _pair(v, n=2):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+def _conv_dims(data_format, nd):
+    if nd == 2:
+        return ('NCHW', 'OIHW', 'NCHW') if data_format == 'NCHW' else ('NHWC', 'HWIO', 'NHWC')
+    return ('NCDHW', 'OIDHW', 'NCDHW') if data_format == 'NCDHW' else ('NDHWC', 'DHWIO', 'NDHWC')
+
+
+@register_op('conv2d')
+def conv2d(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCHW'):
+    """ref: paddle/fluid/operators/conv_op.cc (weights always OIHW)."""
+    x = jnp.asarray(x)
+    w = jnp.asarray(weight)
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()  # 'SAME' | 'VALID'
+    else:
+        p = _pair(padding)
+        pad = [(p[0], p[0]), (p[1], p[1])] if len(p) == 2 else \
+            [(p[0], p[1]), (p[2], p[3])]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dims(data_format, 2))
+    return lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=x.dtype if x.dtype == jnp.float32 else None)
+
+
+@register_op('conv3d')
+def conv3d(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCDHW'):
+    x = jnp.asarray(x)
+    w = jnp.asarray(weight)
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    p = _pair(padding, 3)
+    pad = [(pi, pi) for pi in p] if not isinstance(padding, str) else padding.upper()
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dims(data_format, 3))
+    return lax.conv_general_dilated(x, w, stride, pad, rhs_dilation=dilation,
+                                    dimension_numbers=dn, feature_group_count=groups)
+
+
+@register_op('conv2d_transpose')
+def conv2d_transpose(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
+                     output_size=None, data_format='NCHW'):
+    """ref: paddle/fluid/operators/conv_transpose_op.cc. Weight layout IOHW."""
+    x = jnp.asarray(x)
+    w = jnp.asarray(weight)
+    stride = _pair(stride)
+    p = _pair(padding)
+    # grad-of-conv formulation: lhs_dilation = stride
+    k = (w.shape[2], w.shape[3])
+    pad = [(dilation * (k[0] - 1) - p[0], dilation * (k[0] - 1) - p[0]),
+           (dilation * (k[1] - 1) - p[1], dilation * (k[1] - 1) - p[1])]
+    if data_format == 'NCHW':
+        dims = ('NCHW', 'OIHW', 'NCHW')
+    else:
+        dims = ('NHWC', 'HWIO', 'NHWC')
+    if groups > 1:
+        ci = w.shape[0]
+        w = w.reshape(groups, ci // groups, *w.shape[1:]).transpose(0, 2, 1, 3, 4) \
+            .reshape(-1, ci // groups, *w.shape[2:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)  # IOHW -> OIHW
+    w = jnp.flip(w, axis=(-2, -1))
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, dims)
+    return lax.conv_general_dilated(x, w, window_strides=(1, 1), padding=pad,
+                                    lhs_dilation=stride, rhs_dilation=_pair(dilation),
+                                    dimension_numbers=dn, feature_group_count=groups)
+
+
+@register_op('conv3d_transpose')
+def conv3d_transpose(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
+                     data_format='NCDHW'):
+    x = jnp.asarray(x)
+    w = jnp.asarray(weight)
+    stride = _pair(stride, 3)
+    p = _pair(padding, 3)
+    d = _pair(dilation, 3)
+    k = w.shape[2:]
+    pad = [(d[i] * (k[i] - 1) - p[i],) * 2 for i in range(3)]
+    w = jnp.swapaxes(w, 0, 1)
+    w = jnp.flip(w, axis=(-3, -2, -1))
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ('NCDHW', 'OIDHW', 'NCDHW'))
+    return lax.conv_general_dilated(x, w, (1, 1, 1), pad, lhs_dilation=stride,
+                                    rhs_dilation=d, dimension_numbers=dn,
+                                    feature_group_count=groups)
+
+
+def _pool(x, ksize, stride, padding, pool_type, nd, ceil_mode=False,
+          exclusive=True, data_format='NCHW', global_pool=False):
+    x = jnp.asarray(x)
+    spatial = tuple(range(2, 2 + nd)) if data_format.startswith('NC') else tuple(range(1, 1 + nd))
+    if global_pool:
+        ksize = [x.shape[a] for a in spatial]
+        stride = ksize
+        padding = [0] * nd
+    ksize = _pair(ksize, nd)
+    stride = _pair(stride if stride is not None else ksize, nd)
+    p = _pair(padding, nd)
+    window = [1] * x.ndim
+    strides = [1] * x.ndim
+    pads = [(0, 0)] * x.ndim
+    for i, a in enumerate(spatial):
+        window[a] = ksize[i]
+        strides[a] = stride[i]
+        extra = 0
+        if ceil_mode:
+            size = x.shape[a]
+            rem = (size + 2 * p[i] - ksize[i]) % stride[i]
+            extra = (stride[i] - rem) % stride[i] if rem else 0
+        pads[a] = (p[i], p[i] + extra)
+    if pool_type == 'max':
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
+                                 window, strides, pads)
+    # avg
+    ones = jnp.ones_like(x)
+    s = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add, window, strides, pads)
+    if exclusive:
+        cnt = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add, window, strides, pads)
+    else:
+        cnt = jnp.asarray(math.prod(ksize), x.dtype)
+    return s / cnt
+
+
+@register_op('pool2d')
+def pool2d(x, *, pool_size=-1, pool_type='max', pool_stride=1, pool_padding=0,
+           global_pooling=False, ceil_mode=False, exclusive=True,
+           data_format='NCHW'):
+    """ref: paddle/fluid/operators/pool_op.cc."""
+    return _pool(x, pool_size, pool_stride, pool_padding, pool_type, 2,
+                 ceil_mode, exclusive, data_format, global_pooling)
+
+
+@register_op('pool3d')
+def pool3d(x, *, pool_size=-1, pool_type='max', pool_stride=1, pool_padding=0,
+           global_pooling=False, ceil_mode=False, exclusive=True,
+           data_format='NCDHW'):
+    return _pool(x, pool_size, pool_stride, pool_padding, pool_type, 3,
+                 ceil_mode, exclusive, data_format, global_pooling)
+
+
+@register_op('adaptive_pool2d')
+def adaptive_pool2d(x, *, pool_size, pool_type='max'):
+    """ref: adaptive pooling in paddle/fluid/operators/pool_op.cc (adaptive=True).
+    Requires divisible spatial dims (true for all ref model configs)."""
+    x = jnp.asarray(x)
+    n, c, h, w = x.shape
+    oh, ow = _pair(pool_size)
+    x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    if pool_type == 'max':
+        return jnp.max(x, axis=(3, 5))
+    return jnp.mean(x, axis=(3, 5))
+
+
+@register_op('adaptive_pool3d')
+def adaptive_pool3d(x, *, pool_size, pool_type='max'):
+    x = jnp.asarray(x)
+    n, c, d, h, w = x.shape
+    od, oh, ow = _pair(pool_size, 3)
+    x = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+    if pool_type == 'max':
+        return jnp.max(x, axis=(3, 5, 7))
+    return jnp.mean(x, axis=(3, 5, 7))
+
+
+@register_op('softmax')
+def softmax(x, *, axis=-1):
+    return jax.nn.softmax(jnp.asarray(x), axis=axis)
+
+
+@register_op('log_softmax')
+def log_softmax(x, *, axis=-1):
+    return jax.nn.log_softmax(jnp.asarray(x), axis=axis)
+
+
+@register_op('batch_norm', outputs=['Y', 'MeanOut', 'VarianceOut'])
+def batch_norm(x, scale, bias, mean, variance, *, momentum=0.9, epsilon=1e-5,
+               is_test=False, use_global_stats=False, data_layout='NCHW'):
+    """ref: paddle/fluid/operators/batch_norm_op.cc. Returns (y, new_running_
+    mean, new_running_var); the graph aliases MeanOut/VarianceOut onto the
+    input stat vars so the lowered step updates state functionally."""
+    x = jnp.asarray(x)
+    scale = jnp.asarray(scale)
+    bias = jnp.asarray(bias)
+    mean = jnp.asarray(mean)
+    variance = jnp.asarray(variance)
+    if data_layout == 'NCHW' and x.ndim > 2:
+        axes = (0,) + tuple(range(2, x.ndim))
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        axes = tuple(range(x.ndim - 1))
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    if is_test or use_global_stats:
+        m, v = mean, variance
+        new_mean, new_var = mean, variance
+    else:
+        xf = x.astype(jnp.float32)
+        m = jnp.mean(xf, axes)
+        v = jnp.var(xf, axes)
+        new_mean = momentum * mean + (1 - momentum) * m.astype(mean.dtype)
+        new_var = momentum * variance + (1 - momentum) * v.astype(variance.dtype)
+        new_mean = lax.stop_gradient(new_mean)
+        new_var = lax.stop_gradient(new_var)
+    inv = lax.rsqrt(v.astype(jnp.float32) + epsilon).astype(x.dtype)
+    y = (x - m.astype(x.dtype).reshape(shape)) * inv.reshape(shape) \
+        * scale.reshape(shape) + bias.reshape(shape)
+    return y, new_mean, new_var
+
+
+@register_op('layer_norm')
+def layer_norm(x, scale=None, bias=None, *, begin_norm_axis=1, epsilon=1e-5):
+    """ref: paddle/fluid/operators/layer_norm_op.cc."""
+    x = jnp.asarray(x)
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axes, keepdims=True)
+    v = jnp.var(xf, axes, keepdims=True)
+    y = ((xf - m) * lax.rsqrt(v + epsilon)).astype(x.dtype)
+    norm_shape = x.shape[begin_norm_axis:]
+    if scale is not None:
+        y = y * jnp.asarray(scale).reshape(norm_shape)
+    if bias is not None:
+        y = y + jnp.asarray(bias).reshape(norm_shape)
+    return y
+
+
+@register_op('instance_norm')
+def instance_norm(x, scale=None, bias=None, *, epsilon=1e-5):
+    """ref: paddle/fluid/operators/instance_norm_op.cc (NCHW)."""
+    x = jnp.asarray(x)
+    axes = tuple(range(2, x.ndim))
+    m = jnp.mean(x, axes, keepdims=True)
+    v = jnp.var(x, axes, keepdims=True)
+    y = (x - m) * lax.rsqrt(v + epsilon)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * jnp.asarray(scale).reshape(shape)
+    if bias is not None:
+        y = y + jnp.asarray(bias).reshape(shape)
+    return y
+
+
+@register_op('group_norm')
+def group_norm(x, scale=None, bias=None, *, groups, epsilon=1e-5,
+               data_layout='NCHW'):
+    """ref: paddle/fluid/operators/group_norm_op.cc."""
+    x = jnp.asarray(x)
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xg = x.reshape(n, groups, c // groups, *spatial)
+    axes = tuple(range(2, xg.ndim))
+    m = jnp.mean(xg, axes, keepdims=True)
+    v = jnp.var(xg, axes, keepdims=True)
+    y = ((xg - m) * lax.rsqrt(v + epsilon)).reshape(x.shape)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * jnp.asarray(scale).reshape(shape)
+    if bias is not None:
+        y = y + jnp.asarray(bias).reshape(shape)
+    return y
+
+
+@register_op('data_norm', outputs=['Y', 'BatchSizeOut', 'BatchSumOut', 'BatchSquareSumOut'])
+def data_norm(x, batch_size, batch_sum, batch_square_sum, *, epsilon=1e-4,
+              is_test=False):
+    """ref: paddle/fluid/operators/data_norm_op.cc (CTR models)."""
+    x = jnp.asarray(x)
+    bsize = jnp.asarray(batch_size)
+    bsum = jnp.asarray(batch_sum)
+    bsq = jnp.asarray(batch_square_sum)
+    mean = bsum / bsize
+    scale = jnp.sqrt(bsize / (bsq - bsum * bsum / bsize + epsilon))
+    y = (x - mean) * scale
+    if is_test:
+        return y, bsize, bsum, bsq
+    n = jnp.asarray(x.shape[0], bsize.dtype)
+    nb = lax.stop_gradient(bsize + n)
+    ns = lax.stop_gradient(bsum + jnp.sum(x, 0))
+    nq = lax.stop_gradient(bsq + jnp.sum(jnp.square(x), 0))
+    return y, nb, ns, nq
+
+
+@register_op('dropout', needs_rng=True)
+def dropout(x, *, dropout_prob=0.5, is_test=False,
+            dropout_implementation='downgrade_in_infer', key=None):
+    """ref: paddle/fluid/operators/dropout_op.cc. Both paddle semantics:
+    downgrade_in_infer (scale at infer) and upscale_in_train."""
+    x = jnp.asarray(x)
+    if is_test:
+        if dropout_implementation == 'downgrade_in_infer':
+            return x * (1.0 - dropout_prob)
+        return x
+    if dropout_prob == 0.0:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - dropout_prob, x.shape)
+    if dropout_implementation == 'upscale_in_train':
+        return jnp.where(keep, x / (1.0 - dropout_prob), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+@register_op('lookup_table')
+def lookup_table(w, ids, *, padding_idx=-1, is_sparse=False, is_distributed=False):
+    """Embedding lookup (ref: paddle/fluid/operators/lookup_table_op.cc).
+    is_sparse accepted for API parity; on TPU dense gather + XLA handles it."""
+    w = jnp.asarray(w)
+    ids = jnp.asarray(ids)
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    if squeeze_last:
+        ids = ids[..., 0]
+    out = jnp.take(w, jnp.clip(ids, 0, w.shape[0] - 1), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return out
+
+
+@register_op('lrn')
+def lrn(x, *, n=5, k=1.0, alpha=1e-4, beta=0.75):
+    """ref: paddle/fluid/operators/lrn_op.cc (NCHW)."""
+    x = jnp.asarray(x)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    window = [1, n, 1, 1]
+    s = lax.reduce_window(pad, jnp.asarray(0, x.dtype), lax.add, window,
+                          [1, 1, 1, 1], [(0, 0)] * 4)
+    return x / jnp.power(k + alpha * s, beta)
+
+
+@register_op('interpolate')
+def interpolate(x, *, out_shape, method='bilinear', align_corners=True,
+                align_mode=1, data_format='NCHW'):
+    """ref: paddle/fluid/operators/interpolate_op.cc (bilinear/nearest/trilinear)."""
+    x = jnp.asarray(x)
+    if data_format == 'NCHW' or data_format == 'NCDHW':
+        spatial_start = 2
+    else:
+        spatial_start = 1
+    in_sp = x.shape[spatial_start:spatial_start + len(out_shape)]
+    out_sp = tuple(int(s) for s in out_shape)
+
+    def src_idx(out_len, in_len):
+        i = jnp.arange(out_len, dtype=jnp.float32)
+        if method == 'nearest':
+            if align_corners:
+                return jnp.round(i * (in_len - 1) / max(out_len - 1, 1))
+            return jnp.floor(i * in_len / out_len)
+        if align_corners:
+            return i * (in_len - 1) / max(out_len - 1, 1)
+        if align_mode == 0:
+            return jnp.clip((i + 0.5) * in_len / out_len - 0.5, 0, in_len - 1)
+        return jnp.clip(i * in_len / out_len, 0, in_len - 1)
+
+    if method == 'nearest':
+        out = x
+        for d, (ol, il) in enumerate(zip(out_sp, in_sp)):
+            idx = src_idx(ol, il).astype(jnp.int32)
+            out = jnp.take(out, idx, axis=spatial_start + d)
+        return out
+    # (bi/tri)linear: separable 1-D lerps
+    out = x.astype(jnp.float32)
+    for d, (ol, il) in enumerate(zip(out_sp, in_sp)):
+        axis = spatial_start + d
+        si = src_idx(ol, il)
+        lo = jnp.floor(si).astype(jnp.int32)
+        hi = jnp.clip(lo + 1, 0, il - 1)
+        w = (si - lo).astype(out.dtype)
+        a = jnp.take(out, lo, axis=axis)
+        b = jnp.take(out, hi, axis=axis)
+        shape = [1] * out.ndim
+        shape[axis] = ol
+        w = w.reshape(shape)
+        out = a * (1 - w) + b * w
+    return out.astype(x.dtype)
+
+
+@register_op('pixel_shuffle')
+def pixel_shuffle(x, *, upscale_factor):
+    x = jnp.asarray(x)
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+@register_op('unfold')
+def unfold(x, *, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (ref: paddle/fluid/operators/unfold_op.cc)."""
+    x = jnp.asarray(x)
+    n, c, h, w = x.shape
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    xp = jnp.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(lax.slice(
+                xp, (0, 0, i * dh, j * dw),
+                (n, c, i * dh + (oh - 1) * sh + 1, j * dw + (ow - 1) * sw + 1),
+                (1, 1, sh, sw)))
+    col = jnp.stack(patches, 2)  # n, c, kh*kw, oh, ow
+    return col.reshape(n, c * kh * kw, oh * ow)
+
+
+@register_op('im2sequence')
+def im2sequence(x, *, filter_size, stride=1, padding=0):
+    """ref: paddle/fluid/operators/im2sequence_op.cc (OCR feature slicing)."""
+    x = jnp.asarray(x)
+    n, c, h, w = x.shape
+    kh, kw = _pair(filter_size)
+    out = unfold(x, kernel_sizes=filter_size, strides=stride, paddings=padding)
+    # (n, c*kh*kw, L) -> (n*L, c*kh*kw)
+    return out.transpose(0, 2, 1).reshape(-1, c * kh * kw)
+
+
+@register_op('row_conv')
+def row_conv(x, w):
+    """Lookahead row convolution (ref: paddle/fluid/operators/row_conv_op.cc),
+    batched dense formulation: x (B, T, D), w (future_context+1, D)."""
+    x = jnp.asarray(x)
+    w = jnp.asarray(w)
+    ctx = w.shape[0]
+    out = jnp.zeros_like(x)
+    for i in range(ctx):
+        shifted = jnp.pad(x, [(0, 0), (0, i), (0, 0)])[:, i:, :]
+        out = out + shifted * w[i]
+    return out
+
+
+@register_op('bilinear_tensor_product')
+def bilinear_tensor_product(x, y, weight, bias=None):
+    """ref: paddle/fluid/operators/bilinear_tensor_product_op.cc.
+    out[b,k] = x[b]ᵀ W[k] y[b] + bias[k]."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    w = jnp.asarray(weight)
+    out = jnp.einsum('bi,kij,bj->bk', x, w, y)
+    if bias is not None:
+        out = out + jnp.asarray(bias)
+    return out
+
+
+@register_op('fsp')
+def fsp(x, y):
+    """Flow-of-solution-procedure matrix for distillation
+    (ref: paddle/fluid/operators/fsp_op.cc)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    n, c1 = x.shape[0], x.shape[1]
+    c2 = y.shape[1]
+    hw = x.shape[2] * x.shape[3]
+    xm = x.reshape(n, c1, hw)
+    ym = y.reshape(n, c2, hw)
+    return jnp.einsum('nch,ndh->ncd', xm, ym) / hw
+
+
+@register_op('add_position_encoding')
+def add_position_encoding(x, *, alpha=1.0, beta=1.0):
+    """ref: paddle/fluid/operators/add_position_encoding_op.cc."""
+    x = jnp.asarray(x)
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2 * i / d)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=1)
+    return alpha * x + beta * pe[None, :, :].astype(x.dtype)
+
+
+@register_op('grid_sampler')
+def grid_sampler(x, grid):
+    """Bilinear grid sample (ref: paddle/fluid/operators/grid_sampler_op.cc).
+    x: NCHW, grid: NHW2 in [-1, 1]."""
+    x = jnp.asarray(x)
+    grid = jnp.asarray(grid)
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+
+    def sample(yy, xx):
+        yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        batch = jnp.arange(n)[:, None, None]
+        v = x[batch, :, yi, xi]  # n, gh, gw, c
+        inb = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1))
+        return jnp.where(inb[..., None], v, 0.0)
+
+    wx = (gx - x0)[..., None]
+    wy = (gy - y0)[..., None]
+    v00 = sample(y0, x0)
+    v01 = sample(y0, x0 + 1)
+    v10 = sample(y0 + 1, x0)
+    v11 = sample(y0 + 1, x0 + 1)
+    out = (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+           + v10 * (1 - wx) * wy + v11 * wx * wy)
+    return out.transpose(0, 3, 1, 2)
+
+
+@register_op('affine_grid')
+def affine_grid(theta, *, out_shape):
+    """ref: paddle/fluid/operators/affine_grid_op.cc. theta: (N,2,3)."""
+    theta = jnp.asarray(theta)
+    n, _, h, w = out_shape
+    ys = jnp.linspace(-1, 1, h)
+    xs = jnp.linspace(-1, 1, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)  # h,w,3
+    return jnp.einsum('hwk,njk->nhwj', base.astype(theta.dtype), theta)
+
+
+@register_op('affine_channel')
+def affine_channel(x, scale, bias, *, data_layout='NCHW'):
+    x = jnp.asarray(x)
+    shape = (1, -1, 1, 1) if data_layout == 'NCHW' else (1, 1, 1, -1)
+    return x * jnp.asarray(scale).reshape(shape) + jnp.asarray(bias).reshape(shape)
+
+
+@register_op('l2_normalize')
+def l2_normalize(x, *, axis=-1, epsilon=1e-12):
+    x = jnp.asarray(x)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    return x / jnp.maximum(norm, epsilon)
+
+
+@register_op('norm', outputs=['Out', 'Norm'])
+def norm(x, *, axis=-1, epsilon=1e-10):
+    x = jnp.asarray(x)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + epsilon)
+    return x / n, n

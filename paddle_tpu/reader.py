@@ -1,0 +1,238 @@
+"""DataLoader + reader decorators (ref: python/paddle/fluid/reader.py and
+python/paddle/reader/decorator.py).
+
+TPU design: a background thread pipelines host batching and `jax.device_put`
+into a depth-k ring so host→HBM DMA overlaps device compute (the analogue of
+the reference's BufferedReader + CUDAPinnedPlace staging,
+paddle/fluid/operators/reader/buffered_reader.cc).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as pyrandom
+import threading
+
+import numpy as np
+import jax
+
+__all__ = ['DataLoader', 'batch', 'shuffle', 'buffered', 'map_readers',
+           'xmap_readers', 'chain', 'compose', 'firstn', 'cache',
+           'multiprocess_reader']
+
+
+# ---------------------------------------------------------------------------
+# reader decorators (paddle.reader.* parity)
+# ---------------------------------------------------------------------------
+
+def batch(reader, batch_size, drop_last=False):
+    def r():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return r
+
+
+def shuffle(reader, buf_size):
+    def r():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                pyrandom.shuffle(buf)
+                yield from buf
+                buf = []
+        pyrandom.shuffle(buf)
+        yield from buf
+    return r
+
+
+def buffered(reader, size):
+    def r():
+        q = queue.Queue(maxsize=size)
+        end = object()
+
+        def fill():
+            for item in reader():
+                q.put(item)
+            q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            yield item
+    return r
+
+
+def map_readers(func, *readers):
+    def r():
+        its = [rd() for rd in readers]
+        for items in zip(*its):
+            yield func(*items)
+    return r
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Thread-pool mapped reader (ref uses processes; threads suffice since
+    the heavy lifting is numpy releasing the GIL)."""
+    def r():
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(process_num) as pool:
+            window = []
+            for item in reader():
+                window.append(pool.submit(mapper, item))
+                if len(window) >= buffer_size:
+                    yield window.pop(0).result()
+            for f in window:
+                yield f.result()
+    return r
+
+
+def chain(*readers):
+    def r():
+        for rd in readers:
+            yield from rd()
+    return r
+
+
+def compose(*readers, check_alignment=True):
+    def r():
+        for items in zip(*[rd() for rd in readers]):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+    return r
+
+
+def firstn(reader, n):
+    def r():
+        return itertools.islice(reader(), n)
+    return r
+
+
+def cache(reader):
+    data = []
+
+    def r():
+        if not data:
+            data.extend(reader())
+        return iter(data)
+    return r
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Parity shim: fans readers out over threads (process isolation is not
+    needed without the GIL-bound C++ feed path)."""
+    return buffered(chain(*readers), queue_size)
+
+
+# ---------------------------------------------------------------------------
+# DataLoader
+# ---------------------------------------------------------------------------
+
+class _GeneratorLoader:
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False, use_multiprocess=False,
+                 drop_last=True):
+        self._feed_list = feed_list or []
+        self._capacity = capacity
+        self._iterable = iterable
+        self._return_list = return_list
+        self._batch_reader = None
+        self._places = None
+        self._feeder = None
+        self._drop_last = drop_last
+
+    # -- configuration (ref API) --
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        self.set_sample_list_generator(batch(reader, batch_size, drop_last),
+                                       places)
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        from .data_feeder import DataFeeder
+        feeder = DataFeeder(self._feed_list)
+
+        def batch_reader():
+            for rows in reader():
+                yield feeder.feed(rows)
+        self._batch_reader = batch_reader
+        self._places = places
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        def batch_reader():
+            for arrs in reader():
+                if isinstance(arrs, dict):
+                    yield arrs
+                else:
+                    yield {
+                        (v.name if hasattr(v, 'name') else f'feed_{i}'): a
+                        for i, (v, a) in enumerate(
+                            zip(self._feed_list, arrs))}
+        self._batch_reader = batch_reader
+        self._places = places
+        return self
+
+    # -- iteration: background prefetch of device arrays --
+    def __iter__(self):
+        q = queue.Queue(maxsize=self._capacity)
+        end = object()
+
+        def producer():
+            try:
+                for feed in self._batch_reader():
+                    staged = {k: jax.device_put(np.ascontiguousarray(v))
+                              for k, v in feed.items()}
+                    q.put(staged)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            if self._return_list:
+                yield [item[k] for k in item]
+            else:
+                yield item
+
+    def __call__(self):
+        return iter(self)
+
+    def start(self):
+        pass
+
+    def reset(self):
+        pass
+
+
+class DataLoader:
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False, drop_last=True):
+        return _GeneratorLoader(feed_list, capacity, use_double_buffer,
+                                iterable, return_list, use_multiprocess,
+                                drop_last)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        loader = _GeneratorLoader()
+        loader.set_batch_generator(lambda: iter(dataset))
+        return loader

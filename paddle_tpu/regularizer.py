@@ -1,0 +1,56 @@
+"""Weight-decay regularizers (ref: python/paddle/fluid/regularizer.py)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def append_regularization_op(self, param, grad):
+        """Return new grad Variable = grad + penalty'(param) (static mode)."""
+        raise NotImplementedError
+
+    def apply(self, p, g):
+        """Functional form for dygraph/jit paths."""
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = float(regularization_coeff)
+
+    def append_regularization_op(self, param, grad):
+        from .layers.common import apply_op_layer
+        decay = apply_op_layer('scale', {'x': param}, {'scale': self.coeff})
+        return apply_op_layer('elementwise_add', {'x': grad, 'y': decay})
+
+    def apply(self, p, g):
+        return g + self.coeff * p
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = float(regularization_coeff)
+
+    def append_regularization_op(self, param, grad):
+        from .layers.common import apply_op_layer
+        s = apply_op_layer('sign', {'x': param})
+        decay = apply_op_layer('scale', {'x': s}, {'scale': self.coeff})
+        return apply_op_layer('elementwise_add', {'x': grad, 'y': decay})
+
+    def apply(self, p, g):
+        import jax.numpy as jnp
+        return g + self.coeff * jnp.sign(p)
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer
+
+
+def append_regularization_ops(params_grads, regularization=None):
+    """ref: regularizer.py:append_regularization_ops — param-level regularizer
+    wins over the optimizer-level one."""
+    out = []
+    for p, g in params_grads:
+        reg = getattr(p, 'regularizer', None) or regularization
+        if reg is not None and getattr(p, 'trainable', True):
+            g = reg.append_regularization_op(p, g)
+        out.append((p, g))
+    return out

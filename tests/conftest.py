@@ -1,0 +1,33 @@
+"""Test config: force CPU backend with 8 virtual devices so mesh/distributed
+tests run without TPU hardware (SURVEY §4)."""
+import os
+
+os.environ['JAX_PLATFORMS'] = 'cpu'  # force: the session env exports 'axon'
+flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Isolate each test: fresh default programs, scope, and name counter."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.scope import Scope
+    import paddle_tpu.core.scope as scope_mod
+    old_main = fluid.framework._main_program_
+    old_start = fluid.framework._startup_program_
+    old_scope = scope_mod._global_scope
+    old_gen = unique_name.generator
+    fluid.framework._main_program_ = fluid.Program()
+    fluid.framework._startup_program_ = fluid.Program()
+    scope_mod._global_scope = Scope()
+    unique_name.generator = unique_name.UniqueNameGenerator()
+    yield
+    fluid.framework._main_program_ = old_main
+    fluid.framework._startup_program_ = old_start
+    scope_mod._global_scope = old_scope
+    unique_name.generator = old_gen

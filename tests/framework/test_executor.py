@@ -1,0 +1,97 @@
+"""Executor/Program semantics (SURVEY §4: executor feed/fetch, startup init,
+scope isolation, compile-cache behavior)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_feed_fetch_roundtrip():
+    x = layers.data('x', [4], dtype='float32')
+    y = layers.scale(x, scale=2.0)
+    exe = fluid.Executor()
+    xv = np.arange(8, dtype=np.float32).reshape(2, 4)
+    out, = exe.run(feed={'x': xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, xv * 2.0, rtol=1e-6)
+
+
+def test_startup_initializes_params():
+    x = layers.data('x', [3])
+    y = layers.fc(x, size=5)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    w = [p for p in fluid.default_main_program().all_parameters()]
+    assert len(w) == 2  # weight + bias
+    for p in w:
+        assert fluid.global_scope().find(p.name) is not None
+
+
+def test_train_loop_reduces_loss():
+    np.random.seed(0)
+    x = layers.data('x', [10])
+    label = layers.data('y', [1])
+    pred = layers.fc(x, size=1)
+    loss = layers.reduce_mean(layers.square_error_cost(pred, label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    w_true = np.random.randn(10, 1).astype(np.float32)
+    losses = []
+    for i in range(50):
+        xv = np.random.randn(32, 10).astype(np.float32)
+        yv = xv @ w_true
+        l, = exe.run(feed={'x': xv, 'y': yv}, fetch_list=[loss])
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_compile_cache_reuse():
+    x = layers.data('x', [4])
+    y = layers.scale(x, scale=3.0)
+    exe = fluid.Executor()
+    xv = np.ones((2, 4), np.float32)
+    exe.run(feed={'x': xv}, fetch_list=[y])
+    assert len(exe._cache) == 1
+    exe.run(feed={'x': xv}, fetch_list=[y])
+    assert len(exe._cache) == 1  # same shapes → cache hit
+    exe.run(feed={'x': np.ones((5, 4), np.float32)}, fetch_list=[y])
+    assert len(exe._cache) == 2  # new batch size → new entry
+
+
+def test_program_guard_isolation():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', [2])
+        y = layers.scale(x, scale=1.0)
+    assert len(main.global_block().ops) == 1
+    assert len(fluid.default_main_program().global_block().ops) == 0
+
+
+def test_clone_for_test_drops_backward():
+    x = layers.data('x', [4])
+    pred = layers.fc(x, size=2)
+    loss = layers.reduce_mean(pred)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    test_prog = prog.clone(for_test=True)
+    types = [op.type for op in test_prog.global_block().ops]
+    assert '__backward__' not in types
+    assert 'sgd' not in types
+
+
+def test_batch_norm_updates_running_stats():
+    x = layers.data('x', [4, 8, 8])
+    y = layers.batch_norm(x)
+    loss = layers.reduce_mean(y)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    mean_name = [v.name for v in fluid.default_main_program().list_vars()
+                 if '.mean' in v.name][0]
+    before = np.asarray(fluid.global_scope().find(mean_name)).copy()
+    xv = 5.0 + np.random.randn(16, 4, 8, 8).astype(np.float32)
+    exe.run(feed={'x': xv}, fetch_list=[loss])
+    after = np.asarray(fluid.global_scope().find(mean_name))
+    assert not np.allclose(before, after)
+    assert np.all(after > 0.1)  # moved toward batch mean ≈ 5
