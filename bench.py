@@ -1,0 +1,77 @@
+"""Benchmark driver: ResNet-50 fwd+bwd+update images/sec/chip (bf16 compute).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline (BASELINE.json north star): CUDA V100 ResNet-50 ≈ 383 img/s fp32
+(PaddlePaddle's published reference-class number for the 1.x benchmark suite).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+V100_BASELINE_IMG_S = 383.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import dygraph
+    from paddle_tpu.models import ResNet50
+    from paddle_tpu.dygraph.jit import TrainStep
+    from paddle_tpu.dygraph.tape import dispatch_op
+
+    on_tpu = jax.default_backend() != 'cpu'
+    batch = 256 if on_tpu else 8
+    img = 224 if on_tpu else 32
+    iters = 20 if on_tpu else 3
+
+    with dygraph.guard():
+        model = ResNet50(class_dim=1000)
+        if on_tpu:
+            # bf16 compute, fp32 master weights live in the optimizer update
+            for p in model.parameters():
+                if jnp.issubdtype(p.value.dtype, jnp.floating):
+                    p.value = p.value.astype(jnp.bfloat16)
+        opt = fluid.optimizer.Momentum(0.1, momentum=0.9,
+                                       parameter_list=model.parameters())
+
+        def loss_fn(m, x, y):
+            logits = m(x)
+            logits = dispatch_op('cast', {'x': logits}, {'dtype': 'float32'})
+            l, _ = dispatch_op('softmax_with_cross_entropy',
+                               {'logits': logits, 'label': y}, {})
+            return dispatch_op('reduce_mean', {'x': l}, {})
+
+        step = TrainStep(model, loss_fn, opt)
+        dtype = np.float32
+        x = np.random.randn(batch, 3, img, img).astype(dtype)
+        y = np.random.randint(0, 1000, (batch, 1)).astype(np.int64)
+        if on_tpu:
+            x = jnp.asarray(x, jnp.bfloat16)
+
+        # warmup/compile
+        l = step(x, y)
+        jax.block_until_ready(l)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            l = step(x, y)
+        jax.block_until_ready(l)
+        dt = time.perf_counter() - t0
+        img_per_sec = batch * iters / dt
+
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec / V100_BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

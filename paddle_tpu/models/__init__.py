@@ -1,0 +1,5 @@
+"""Model zoo (SURVEY §2.10)."""
+from .lenet import LeNet, build_static_lenet
+from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet101,
+                     ResNet152)
+from .bert import (BertConfig, BertModel, BertForPretraining, pretrain_loss)

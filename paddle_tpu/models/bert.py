@@ -1,0 +1,212 @@
+"""BERT (base/large) pretraining — the reference's flagship NLP benchmark
+(BASELINE.json: BERT-base seq/s; ref model: LARK/PaddleLARK BERT as driven by
+the ref's Fleet collective configs).
+
+TPU design: pure Layer composition over batched matmuls (MXU-shaped:
+[B*S, H] GEMMs), fused under dygraph.jit.TrainStep; attention is the
+softmax(QK^T/√d)V composition that XLA fuses; sequence parallelism hooks live
+in parallel/ring_attention.py.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..dygraph import Layer, Linear, LayerNorm, Embedding, Dropout, LayerList
+from ..dygraph.tape import Tensor, dispatch_op
+from ..initializer import TruncatedNormalInitializer
+from ..param_attr import ParamAttr
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act='gelu',
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def large():
+        return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                          num_attention_heads=16, intermediate_size=4096)
+
+    @staticmethod
+    def tiny():
+        """For tests / dryruns."""
+        return BertConfig(vocab_size=1024, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=128, max_position_embeddings=128)
+
+
+def _init(cfg):
+    return ParamAttr(initializer=TruncatedNormalInitializer(
+        0.0, cfg.initializer_range))
+
+
+class MultiHeadAttention(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.q = Linear(h, h, param_attr=_init(cfg))
+        self.k = Linear(h, h, param_attr=_init(cfg))
+        self.v = Linear(h, h, param_attr=_init(cfg))
+        self.out = Linear(h, h, param_attr=_init(cfg))
+        self.drop = Dropout(cfg.attention_probs_dropout_prob,
+                            dropout_implementation='upscale_in_train')
+        self.n_heads = cfg.num_attention_heads
+        self.d_head = h // cfg.num_attention_heads
+
+    def forward(self, x, attn_bias=None):
+        b, s, h = x.shape
+
+        def heads(t):
+            t = dispatch_op('reshape', {'x': t},
+                            {'shape': [b, s, self.n_heads, self.d_head]})
+            return dispatch_op('transpose', {'x': t}, {'perm': [0, 2, 1, 3]})
+
+        q = heads(self.q(x))
+        k = heads(self.k(x))
+        v = heads(self.v(x))
+        scores = dispatch_op('matmul', {'x': q, 'y': k},
+                             {'transpose_y': True,
+                              'alpha': 1.0 / math.sqrt(self.d_head)})
+        if attn_bias is not None:
+            scores = scores + attn_bias
+        probs = dispatch_op('softmax', {'x': scores}, {})
+        probs = self.drop(probs)
+        ctx = dispatch_op('matmul', {'x': probs, 'y': v}, {})
+        ctx = dispatch_op('transpose', {'x': ctx}, {'perm': [0, 2, 1, 3]})
+        ctx = dispatch_op('reshape', {'x': ctx}, {'shape': [b, s, h]})
+        return self.out(ctx)
+
+
+class TransformerLayer(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.attn = MultiHeadAttention(cfg)
+        self.attn_ln = LayerNorm(h)
+        self.ffn1 = Linear(h, cfg.intermediate_size, param_attr=_init(cfg),
+                           act=cfg.hidden_act)
+        self.ffn2 = Linear(cfg.intermediate_size, h, param_attr=_init(cfg))
+        self.ffn_ln = LayerNorm(h)
+        self.drop = Dropout(cfg.hidden_dropout_prob,
+                            dropout_implementation='upscale_in_train')
+
+    def forward(self, x, attn_bias=None):
+        a = self.attn(x, attn_bias)
+        x = self.attn_ln(x + self.drop(a))
+        f = self.ffn2(self.ffn1(x))
+        return self.ffn_ln(x + self.drop(f))
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.word_emb = Embedding([cfg.vocab_size, cfg.hidden_size],
+                                  param_attr=_init(cfg))
+        self.pos_emb = Embedding([cfg.max_position_embeddings,
+                                  cfg.hidden_size], param_attr=_init(cfg))
+        self.type_emb = Embedding([cfg.type_vocab_size, cfg.hidden_size],
+                                  param_attr=_init(cfg))
+        self.emb_ln = LayerNorm(cfg.hidden_size)
+        self.emb_drop = Dropout(cfg.hidden_dropout_prob,
+                                dropout_implementation='upscale_in_train')
+        self.encoder = LayerList([TransformerLayer(cfg)
+                                  for _ in range(cfg.num_hidden_layers)])
+        self.pooler = Linear(cfg.hidden_size, cfg.hidden_size,
+                             param_attr=_init(cfg), act='tanh')
+
+    def forward(self, input_ids, token_type_ids, attention_mask=None):
+        b, s = input_ids.shape
+        pos_ids = Tensor(np.arange(s, dtype=np.int64)[None, :].repeat(b, 0),
+                         stop_gradient=True)
+        emb = self.word_emb(input_ids) + self.pos_emb(pos_ids) + \
+            self.type_emb(token_type_ids)
+        x = self.emb_drop(self.emb_ln(emb))
+        attn_bias = None
+        if attention_mask is not None:
+            # (B,S) 1/0 → additive bias (B,1,1,S)
+            m = dispatch_op('cast', {'x': attention_mask},
+                            {'dtype': 'float32'})
+            m = dispatch_op('reshape', {'x': m}, {'shape': [b, 1, 1, s]})
+            # additive bias: 0 where attended, -1e4 where masked
+            attn_bias = dispatch_op('scale', {'x': m},
+                                    {'scale': 10000.0, 'bias': -10000.0})
+        for layer in self.encoder:
+            x = layer(x, attn_bias)
+        first_tok = x[:, 0]
+        pooled = self.pooler(first_tok)
+        return x, pooled
+
+
+class BertPretrainHeads(Layer):
+    def __init__(self, cfg: BertConfig, word_emb_param=None):
+        super().__init__()
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size,
+                                param_attr=_init(cfg), act=cfg.hidden_act)
+        self.transform_ln = LayerNorm(cfg.hidden_size)
+        self.decoder = Linear(cfg.hidden_size, cfg.vocab_size,
+                              param_attr=_init(cfg))
+        self.nsp = Linear(cfg.hidden_size, 2, param_attr=_init(cfg))
+
+    def forward(self, seq_out, pooled):
+        h = self.transform_ln(self.transform(seq_out))
+        mlm_logits = self.decoder(h)
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+class BertForPretraining(Layer):
+    def __init__(self, cfg: BertConfig = None):
+        super().__init__()
+        self.cfg = cfg or BertConfig.base()
+        self.bert = BertModel(self.cfg)
+        self.heads = BertPretrainHeads(self.cfg)
+
+    def forward(self, input_ids, token_type_ids, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.heads(seq, pooled)
+
+
+def pretrain_loss(model, input_ids, token_type_ids, mlm_labels, nsp_labels):
+    """MLM + NSP loss; mlm_labels uses -1 for unmasked positions."""
+    mlm_logits, nsp_logits = model(input_ids, token_type_ids)
+    b, s, v = mlm_logits.shape
+    flat_logits = dispatch_op('reshape', {'x': mlm_logits},
+                              {'shape': [b * s, v]})
+    flat_labels = dispatch_op('reshape', {'x': mlm_labels},
+                              {'shape': [b * s, 1]})
+    mlm_raw, _ = dispatch_op('softmax_with_cross_entropy',
+                             {'logits': flat_logits, 'label': flat_labels},
+                             {'ignore_index': -1})
+    mask = dispatch_op('cast', {'x': dispatch_op(
+        'greater_equal', {'x': flat_labels,
+                          'y': Tensor(np.zeros((1, 1), np.int64),
+                                      stop_gradient=True)}, {})},
+        {'dtype': 'float32'})
+    denom = dispatch_op('reduce_sum', {'x': mask}, {})
+    mlm_loss = dispatch_op('reduce_sum', {'x': mlm_raw * mask}, {}) / \
+        (denom + 1e-6)
+    nsp_raw, _ = dispatch_op('softmax_with_cross_entropy',
+                             {'logits': nsp_logits, 'label': nsp_labels}, {})
+    nsp_loss = dispatch_op('reduce_mean', {'x': nsp_raw}, {})
+    return mlm_loss + nsp_loss

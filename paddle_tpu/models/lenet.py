@@ -1,0 +1,44 @@
+"""MNIST LeNet — the e2e smoke model (ref: the reference's book chapter
+recognize_digits + python/paddle/fluid/tests/unittests/test_mnist* models).
+Provided in both modes: build_static_lenet() for Program/Executor and the
+dygraph LeNet Layer.
+"""
+from __future__ import annotations
+
+from .. import layers, nets
+from ..dygraph import Layer, Linear, Conv2D, Pool2D
+from ..dygraph.tape import dispatch_op
+
+
+def build_static_lenet(img, label):
+    """img: data var (N,1,28,28); label: (N,1) int64. Returns (loss, acc,
+    prediction)."""
+    conv1 = nets.simple_img_conv_pool(img, num_filters=20, filter_size=5,
+                                      pool_size=2, pool_stride=2, act='relu')
+    conv2 = nets.simple_img_conv_pool(conv1, num_filters=50, filter_size=5,
+                                      pool_size=2, pool_stride=2, act='relu')
+    fc = layers.fc(conv2, size=500, act='relu')
+    logits = layers.fc(fc, size=10)
+    loss = layers.reduce_mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return loss, acc, logits
+
+
+class LeNet(Layer):
+    """Dygraph LeNet."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = Conv2D(1, 20, 5, act='relu')
+        self.pool1 = Pool2D(2, 'max', 2)
+        self.conv2 = Conv2D(20, 50, 5, act='relu')
+        self.pool2 = Pool2D(2, 'max', 2)
+        self.fc1 = Linear(50 * 4 * 4, 500, act='relu')
+        self.fc2 = Linear(500, 10)
+
+    def forward(self, x):
+        x = self.pool1(self.conv1(x))
+        x = self.pool2(self.conv2(x))
+        x = dispatch_op('reshape', {'x': x}, {'shape': [0, 50 * 4 * 4]})
+        return self.fc2(self.fc1(x))

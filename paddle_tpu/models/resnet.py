@@ -1,0 +1,129 @@
+"""ResNet-18/34/50/101/152 for ImageNet — the reference's flagship vision
+benchmark (BASELINE.json: ResNet-50 images/sec/chip; model definition parity:
+PaddlePaddle/models image_classification/models/resnet.py as exercised by the
+ref's test_resnet unittests).
+
+TPU notes: bottleneck convs run in NCHW for API parity; under jit XLA
+re-lays-out for the MXU. bf16 activations via models.bf16 wrapper in bench.
+"""
+from __future__ import annotations
+
+from ..dygraph import Layer, Conv2D, Pool2D, BatchNorm, Linear
+from ..dygraph.tape import dispatch_op
+from ..param_attr import ParamAttr
+from ..initializer import UniformInitializer
+import math
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 groups=1, act=None):
+        super().__init__()
+        self._conv = Conv2D(num_channels, num_filters, filter_size,
+                            stride=stride, padding=(filter_size - 1) // 2,
+                            groups=groups, bias_attr=False)
+        self._bn = BatchNorm(num_filters, act=None)
+        self._act = act
+
+    def forward(self, x):
+        y = self._bn(self._conv(x))
+        if self._act:
+            y = dispatch_op(self._act, {'x': y}, {})
+        return y
+
+
+class BottleneckBlock(Layer):
+    def __init__(self, num_channels, num_filters, stride, shortcut=True):
+        super().__init__()
+        self.conv0 = ConvBNLayer(num_channels, num_filters, 1, act='relu')
+        self.conv1 = ConvBNLayer(num_filters, num_filters, 3, stride=stride,
+                                 act='relu')
+        self.conv2 = ConvBNLayer(num_filters, num_filters * 4, 1, act=None)
+        if not shortcut:
+            self.short = ConvBNLayer(num_channels, num_filters * 4, 1,
+                                     stride=stride, act=None)
+        self.shortcut = shortcut
+
+    def forward(self, x):
+        y = self.conv2(self.conv1(self.conv0(x)))
+        short = x if self.shortcut else self.short(x)
+        return dispatch_op('relu', {'x': short + y}, {})
+
+
+class BasicBlock(Layer):
+    def __init__(self, num_channels, num_filters, stride, shortcut=True):
+        super().__init__()
+        self.conv0 = ConvBNLayer(num_channels, num_filters, 3, stride=stride,
+                                 act='relu')
+        self.conv1 = ConvBNLayer(num_filters, num_filters, 3, act=None)
+        if not shortcut:
+            self.short = ConvBNLayer(num_channels, num_filters, 1,
+                                     stride=stride, act=None)
+        self.shortcut = shortcut
+
+    def forward(self, x):
+        y = self.conv1(self.conv0(x))
+        short = x if self.shortcut else self.short(x)
+        return dispatch_op('relu', {'x': short + y}, {})
+
+
+_DEPTH_CFG = {
+    18: ([2, 2, 2, 2], BasicBlock, 1),
+    34: ([3, 4, 6, 3], BasicBlock, 1),
+    50: ([3, 4, 6, 3], BottleneckBlock, 4),
+    101: ([3, 4, 23, 3], BottleneckBlock, 4),
+    152: ([3, 8, 36, 3], BottleneckBlock, 4),
+}
+
+
+class ResNet(Layer):
+    def __init__(self, layers_depth=50, class_dim=1000):
+        super().__init__()
+        depth, block_cls, expansion = _DEPTH_CFG[layers_depth]
+        num_filters = [64, 128, 256, 512]
+        self.conv = ConvBNLayer(3, 64, 7, stride=2, act='relu')
+        self.pool = Pool2D(3, 'max', 2, 1)
+        from ..dygraph import LayerList
+        self.blocks = LayerList()
+        num_channels = 64
+        for i, n in enumerate(depth):
+            for b in range(n):
+                shortcut = not (b == 0)
+                stride = 2 if b == 0 and i != 0 else 1
+                blk = block_cls(num_channels, num_filters[i], stride, shortcut)
+                num_channels = num_filters[i] * expansion
+                self.blocks.append(blk)
+        self.global_pool = Pool2D(pool_type='avg', global_pooling=True)
+        stdv = 1.0 / math.sqrt(num_channels)
+        self.out = Linear(
+            num_channels, class_dim,
+            param_attr=ParamAttr(initializer=UniformInitializer(-stdv, stdv)))
+        self._feat_dim = num_channels
+
+    def forward(self, x):
+        y = self.pool(self.conv(x))
+        for blk in self.blocks:
+            y = blk(y)
+        y = self.global_pool(y)
+        y = dispatch_op('reshape', {'x': y}, {'shape': [0, self._feat_dim]})
+        return self.out(y)
+
+
+def ResNet50(class_dim=1000):
+    return ResNet(50, class_dim)
+
+
+def ResNet18(class_dim=1000):
+    return ResNet(18, class_dim)
+
+
+def ResNet34(class_dim=1000):
+    return ResNet(34, class_dim)
+
+
+def ResNet101(class_dim=1000):
+    return ResNet(101, class_dim)
+
+
+def ResNet152(class_dim=1000):
+    return ResNet(152, class_dim)

@@ -135,17 +135,21 @@ def _pool(x, ksize, stride, padding, pool_type, nd, ceil_mode=False,
             rem = (size + 2 * p[i] - ksize[i]) % stride[i]
             extra = (stride[i] - rem) % stride[i] if rem else 0
         pads[a] = (p[i], p[i] + extra)
+    import numpy as np
     if pool_type == 'max':
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
-                                 window, strides, pads)
+        # init must stay a concrete literal: a traced constant breaks the
+        # select-and-scatter grad rule under jit-of-grad
+        init = np.array(-np.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                        else np.iinfo(x.dtype).min, x.dtype)
+        return lax.reduce_window(x, init, lax.max, window, strides, pads)
     # avg
     ones = jnp.ones_like(x)
-    s = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add, window, strides, pads)
+    zero = np.array(0, x.dtype)
+    s = lax.reduce_window(x, zero, lax.add, window, strides, pads)
     if exclusive:
-        cnt = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add, window, strides, pads)
+        cnt = lax.reduce_window(ones, zero, lax.add, window, strides, pads)
     else:
-        cnt = jnp.asarray(math.prod(ksize), x.dtype)
+        cnt = np.array(math.prod(ksize), x.dtype)
     return s / cnt
 
 
@@ -348,7 +352,8 @@ def lrn(x, *, n=5, k=1.0, alpha=1e-4, beta=0.75):
     half = n // 2
     pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
     window = [1, n, 1, 1]
-    s = lax.reduce_window(pad, jnp.asarray(0, x.dtype), lax.add, window,
+    import numpy as np
+    s = lax.reduce_window(pad, np.array(0, x.dtype), lax.add, window,
                           [1, 1, 1, 1], [(0, 0)] * 4)
     return x / jnp.power(k + alpha * s, beta)
 
