@@ -33,11 +33,6 @@ def main():
 
     with dygraph.guard():
         model = ResNet50(class_dim=1000)
-        if on_tpu:
-            # bf16 compute, fp32 master weights live in the optimizer update
-            for p in model.parameters():
-                if jnp.issubdtype(p.value.dtype, jnp.floating):
-                    p.value = p.value.astype(jnp.bfloat16)
         opt = fluid.optimizer.Momentum(0.1, momentum=0.9,
                                        parameter_list=model.parameters())
 
@@ -48,20 +43,25 @@ def main():
                                {'logits': logits, 'label': y}, {})
             return dispatch_op('reduce_mean', {'x': l}, {})
 
-        step = TrainStep(model, loss_fn, opt)
+        # bf16 compute with fp32 master weights (AMP) on TPU; param dtypes
+        # stay fp32 across steps so the fused step compiles exactly once
+        step = TrainStep(model, loss_fn, opt,
+                         amp_dtype=jnp.bfloat16 if on_tpu else None)
         dtype = np.float32
         x = np.random.randn(batch, 3, img, img).astype(dtype)
         y = np.random.randint(0, 1000, (batch, 1)).astype(np.int64)
         if on_tpu:
             x = jnp.asarray(x, jnp.bfloat16)
 
-        # warmup/compile
+        # warmup/compile; float() forces a device→host transfer, which is
+        # the only reliable barrier on the axon remote backend
+        # (block_until_ready returns before remote execution finishes)
         l = step(x, y)
-        jax.block_until_ready(l)
+        float(l)
         t0 = time.perf_counter()
         for _ in range(iters):
             l = step(x, y)
-        jax.block_until_ready(l)
+        float(l)
         dt = time.perf_counter() - t0
         img_per_sec = batch * iters / dt
 

@@ -116,7 +116,7 @@ class TrainStep:
     """
 
     def __init__(self, layer: Layer, loss_fn, optimizer, data_sharding=None,
-                 remat=False, donate=True):
+                 remat=False, donate=True, amp_dtype=None):
         self._layer = layer
         self._params = dict(layer.named_parameters())
         self._buffers = dict(layer.named_buffers())
@@ -124,6 +124,10 @@ class TrainStep:
         self._loss_fn = loss_fn
         self._remat = remat
         self._data_sharding = data_sharding
+        # amp_dtype (e.g. jnp.bfloat16): params stay fp32 master weights;
+        # the forward sees a low-precision cast, grads/updates are fp32 —
+        # param dtypes are stable across steps so the step compiles once.
+        self._amp_dtype = amp_dtype
         self._jitted = None
         self._slots = None
         self._step = 0
@@ -145,7 +149,13 @@ class TrainStep:
                 for n, p in params.items()}
         trainable = {n for n, p in params.items() if p.trainable}
 
+        amp_dtype = self._amp_dtype
+
         def forward(pvals, bvals, batch):
+            if amp_dtype is not None:
+                pvals = {n: (v.astype(amp_dtype)
+                             if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                         for n, v in pvals.items()}
             with _bind(params, pvals), _bind(buffers, bvals):
                 with no_grad_guard():
                     loss = loss_fn(layer, *_tensorize(batch))

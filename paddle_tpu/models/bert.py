@@ -159,18 +159,28 @@ class BertModel(Layer):
 
 
 class BertPretrainHeads(Layer):
-    def __init__(self, cfg: BertConfig, word_emb_param=None):
+    """MLM + NSP heads. The MLM decoder is TIED to the word-embedding matrix
+    (as in the reference BERT: mask_lm_out_fc reuses word_embedding with
+    transpose), so it owns only the decoder bias — the embedding weight is
+    passed in at forward time and its gradient flows to the shared
+    parameter."""
+
+    def __init__(self, cfg: BertConfig):
         super().__init__()
         self.transform = Linear(cfg.hidden_size, cfg.hidden_size,
                                 param_attr=_init(cfg), act=cfg.hidden_act)
         self.transform_ln = LayerNorm(cfg.hidden_size)
-        self.decoder = Linear(cfg.hidden_size, cfg.vocab_size,
-                              param_attr=_init(cfg))
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], None, 'float32', is_bias=True)
         self.nsp = Linear(cfg.hidden_size, 2, param_attr=_init(cfg))
 
-    def forward(self, seq_out, pooled):
+    def forward(self, seq_out, pooled, word_emb_weight):
         h = self.transform_ln(self.transform(seq_out))
-        mlm_logits = self.decoder(h)
+        mlm_logits = dispatch_op('matmul', {'x': h, 'y': word_emb_weight},
+                                 {'transpose_y': True})
+        mlm_logits = dispatch_op('elementwise_add',
+                                 {'x': mlm_logits, 'y': self.decoder_bias},
+                                 {'axis': -1})
         nsp_logits = self.nsp(pooled)
         return mlm_logits, nsp_logits
 
@@ -184,7 +194,7 @@ class BertForPretraining(Layer):
 
     def forward(self, input_ids, token_type_ids, attention_mask=None):
         seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
-        return self.heads(seq, pooled)
+        return self.heads(seq, pooled, self.bert.word_emb.weight)
 
 
 def pretrain_loss(model, input_ids, token_type_ids, mlm_labels, nsp_labels):

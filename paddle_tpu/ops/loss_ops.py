@@ -31,8 +31,8 @@ def cross_entropy(x, label, *, soft_label=False, ignore_index=-100):
     label = _squeeze_label(label)
     picked = jnp.take_along_axis(x, jnp.clip(label, 0, x.shape[-1] - 1)[..., None].astype(jnp.int32), -1)
     loss = -jnp.log(picked + eps)
-    if ignore_index >= 0:
-        loss = jnp.where((label == ignore_index)[..., None], 0.0, loss)
+    # negative sentinels (-1/-100) are valid ignore_index values
+    loss = jnp.where((label == ignore_index)[..., None], 0.0, loss)
     return loss
 
 
@@ -46,12 +46,17 @@ def softmax_with_cross_entropy(logits, label, *, soft_label=False,
     if soft_label:
         loss = -jnp.sum(jnp.asarray(label) * logp, axis=axis, keepdims=True)
     else:
-        label = _squeeze_label(label)
+        label = jnp.asarray(label)
+        if label.ndim == logits.ndim and label.shape[axis] == 1:
+            label = jnp.squeeze(label, axis)
         li = jnp.clip(label, 0, logits.shape[axis] - 1).astype(jnp.int32)
-        picked = jnp.take_along_axis(logp, li[..., None], axis=axis)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(li, axis),
+                                     axis=axis)
         loss = -picked
-        if ignore_index >= 0:
-            loss = jnp.where((label == ignore_index)[..., None], 0.0, loss)
+        # negative sentinels (-1/-100) are valid ignore_index values; the
+        # clip above already keeps the gather in-bounds for them
+        loss = jnp.where(jnp.expand_dims(label == ignore_index, axis),
+                         0.0, loss)
     return loss, sm
 
 

@@ -8,6 +8,12 @@ if 'xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
 
+# The axon sitecustomize registers the TPU plugin at interpreter startup and
+# pins jax_platforms before this file runs; re-pin to cpu post-import.
+import jax
+jax.config.update('jax_platforms', 'cpu')
+assert jax.default_backend() == 'cpu', jax.default_backend()
+
 import pytest
 
 

@@ -1,0 +1,75 @@
+"""Numeric tests for loss ops vs numpy references (SURVEY §4: OpTest parity).
+
+Reference semantics: paddle/fluid/operators/softmax_with_cross_entropy_op.*
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.registry import get_op
+
+
+def _swce(logits, label, **attrs):
+    return get_op('softmax_with_cross_entropy').fn(logits, label, **attrs)
+
+
+def _np_logsoftmax(x, axis=-1):
+    m = x.max(axis=axis, keepdims=True)
+    e = np.exp(x - m)
+    return (x - m) - np.log(e.sum(axis=axis, keepdims=True))
+
+
+class TestSoftmaxWithCrossEntropy:
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        logits = rng.randn(6, 10).astype(np.float32)
+        label = rng.randint(0, 10, (6, 1)).astype(np.int64)
+        loss, sm = _swce(logits, label)
+        logp = _np_logsoftmax(logits)
+        want = -np.take_along_axis(logp, label, -1)
+        np.testing.assert_allclose(np.asarray(loss), want, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(sm), np.exp(logp), rtol=1e-5)
+
+    def test_negative_ignore_index_masks(self):
+        """ignore_index=-1 (the BERT MLM sentinel) must zero those rows."""
+        rng = np.random.RandomState(1)
+        logits = rng.randn(5, 4).astype(np.float32)
+        label = np.array([0, -1, 2, -1, 3], np.int64)[:, None]
+        loss, _ = _swce(logits, label, ignore_index=-1)
+        loss = np.asarray(loss)
+        assert loss[1, 0] == 0.0 and loss[3, 0] == 0.0
+        assert (loss[[0, 2, 4], 0] > 0).all()
+
+    def test_axis0_matches_last_axis_on_transpose(self):
+        rng = np.random.RandomState(2)
+        logits = rng.randn(7, 5).astype(np.float32)  # classes on axis 0
+        label = rng.randint(0, 7, (5,)).astype(np.int64)
+        label[2] = -1
+        l0, sm0 = _swce(logits, label, axis=0, ignore_index=-1)
+        l1, sm1 = _swce(logits.T, label[:, None], axis=-1, ignore_index=-1)
+        assert np.asarray(l0).shape == (1, 5)
+        np.testing.assert_allclose(np.asarray(l0)[0], np.asarray(l1)[:, 0],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(sm0).T, np.asarray(sm1),
+                                   rtol=1e-5)
+
+    def test_soft_label(self):
+        rng = np.random.RandomState(3)
+        logits = rng.randn(4, 6).astype(np.float32)
+        soft = rng.rand(4, 6).astype(np.float32)
+        soft /= soft.sum(-1, keepdims=True)
+        loss, _ = _swce(logits, soft, soft_label=True)
+        want = -(soft * _np_logsoftmax(logits)).sum(-1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(loss), want, rtol=1e-5)
+
+
+class TestCrossEntropy:
+    def test_negative_ignore_index(self):
+        rng = np.random.RandomState(4)
+        probs = rng.rand(4, 3).astype(np.float32) + 0.1
+        probs /= probs.sum(-1, keepdims=True)
+        label = np.array([0, -1, 2, 1], np.int64)[:, None]
+        loss = np.asarray(get_op('cross_entropy').fn(
+            probs, label, ignore_index=-1))
+        assert loss[1, 0] == 0.0
+        np.testing.assert_allclose(
+            loss[0, 0], -np.log(probs[0, 0] + 1e-8), rtol=1e-5)
