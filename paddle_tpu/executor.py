@@ -31,6 +31,9 @@ class _OpRunner:
 
     @staticmethod
     def run(op, read, write, key):
+        if op.type in _CONTROL_FLOW_OPS:
+            _CONTROL_FLOW_OPS[op.type](op, read, write, key)
+            return
         if op.type == '__init__':
             attrs = op.attrs
             out = attrs['initializer'].compute(attrs['shape'], attrs['dtype'],
@@ -54,6 +57,9 @@ class _OpRunner:
         if opdef.needs_rng:
             attrs['key'] = key
         result = opdef.fn(*args, **attrs)
+        if opdef.atomic_output:
+            write(op.outputs['Out'][0], result)
+            return
         results = [result] if len(opdef.output_slots) == 1 else list(result)
         for slot, res in zip(opdef.output_slots, results):
             names = op.outputs.get(slot, [])
@@ -65,6 +71,179 @@ class _OpRunner:
             else:
                 for n, r in zip(names, res_list):
                     write(n, r)
+
+
+# ---------------------------------------------------------------------------
+# structured control flow: sub-Block ops → XLA control-flow primitives.
+# The TPU replacement for the reference's conditional_block/while interpreter
+# ops (paddle/fluid/operators/controlflow/) — branches/bodies stay INSIDE the
+# one compiled program (lax.cond / lax.while_loop / lax.switch / lax.scan).
+# ---------------------------------------------------------------------------
+
+
+def _run_block(block, read, write, key):
+    """Run a sub-Block's ops over a local env chained onto the outer `read`."""
+    for i, op in enumerate(block.ops):
+        _OpRunner.run(op, read, write, jax.random.fold_in(key, i))
+
+
+def _chained_env(overrides, outer_read):
+    local = dict(overrides)
+
+    def read(name):
+        if name in local:
+            return local[name]
+        return outer_read(name)
+
+    return local, read
+
+
+def _as_bool(x):
+    return jnp.reshape(jnp.asarray(x), ()).astype(bool)
+
+
+def _run_cond(op, read, write, key):
+    program = op.block.program
+    pred = _as_bool(read(op.inputs['Cond'][0]))
+    writes = op.attrs.get('writes', [])
+
+    def branch(blk_idx, out_names):
+        blk = program.block(blk_idx)
+
+        def f(_):
+            local, read2 = _chained_env({}, read)
+            _run_block(blk, read2, local.__setitem__, key)
+            # parent-var writes merge out of the branch; an untouched var
+            # passes through its outer value so both branches line up
+            return tuple(read2(n) for n in list(out_names) + writes)
+
+        return f
+
+    res = jax.lax.cond(pred,
+                       branch(op.attrs['true_block'], op.attrs['true_outs']),
+                       branch(op.attrs['false_block'], op.attrs['false_outs']),
+                       None)
+    for n, v in zip(op.outputs['Out'], res):
+        write(n, v)
+
+
+def _run_switch(op, read, write, key):
+    program = op.block.program
+    idx_val = jnp.reshape(jnp.asarray(read(op.inputs['Index'][0])),
+                          ()).astype(jnp.int32)
+    keys = op.attrs['keys']
+    writes = op.attrs.get('writes', [])
+    # map branch_index value → position in blocks list; unmatched → default
+    pos = jnp.asarray(len(keys), jnp.int32)  # default branch position
+    for i, k in enumerate(keys):
+        pos = jnp.where(idx_val == k, jnp.asarray(i, jnp.int32), pos)
+
+    def branch(blk_idx, out_names):
+        blk = program.block(blk_idx)
+
+        def f(_):
+            local, read2 = _chained_env({}, read)
+            _run_block(blk, read2, local.__setitem__, key)
+            return tuple(read2(n) for n in list(out_names) + writes)
+
+        return f
+
+    branches = [branch(b, outs) for b, outs in
+                zip(op.attrs['blocks'], op.attrs['branch_outs'])]
+    res = jax.lax.switch(pos, branches, None)
+    for n, v in zip(op.outputs['Out'], res):
+        write(n, v)
+
+
+def _run_while(op, read, write, key):
+    program = op.block.program
+    carry_names = op.attrs['loop_vars'] + op.attrs.get('writes', [])
+    cond_blk = program.block(op.attrs['cond_block'])
+    body_blk = program.block(op.attrs['body_block'])
+    out_names = op.attrs['body_outs'] + op.attrs.get('writes', [])
+    carry0 = (jnp.zeros((), jnp.int32),) + tuple(
+        jnp.asarray(read(n)) for n in carry_names)
+
+    def run_blk(blk, it, carry, names):
+        local, read2 = _chained_env(dict(zip(carry_names, carry)), read)
+        _run_block(blk, read2, local.__setitem__, jax.random.fold_in(key, it))
+        return tuple(read2(n) for n in names)
+
+    def cond_fun(c):
+        return _as_bool(run_blk(cond_blk, c[0], c[1:],
+                                [op.attrs['cond_out']])[0])
+
+    def body_fun(c):
+        new = run_blk(body_blk, c[0], c[1:], out_names)
+        return (c[0] + 1,) + tuple(
+            jnp.asarray(v).astype(c0.dtype).reshape(c0.shape)
+            for v, c0 in zip(new, c[1:]))
+
+    res = jax.lax.while_loop(cond_fun, body_fun, carry0)
+    for n, v in zip(op.outputs['Out'], res[1:]):
+        write(n, v)
+
+
+def _run_while_legacy(op, read, write, key):
+    program = op.block.program
+    body_blk = program.block(op.attrs['body_block'])
+    carry_names = op.attrs['carry']
+    carry0 = (jnp.zeros((), jnp.int32),) + tuple(
+        jnp.asarray(read(n)) for n in carry_names)
+
+    def cond_fun(c):
+        return _as_bool(c[1])
+
+    def body_fun(c):
+        local, read2 = _chained_env(dict(zip(carry_names, c[1:])), read)
+        _run_block(body_blk, read2, local.__setitem__,
+                   jax.random.fold_in(key, c[0]))
+        return (c[0] + 1,) + tuple(
+            jnp.asarray(read2(n)).astype(c0.dtype).reshape(c0.shape)
+            for n, c0 in zip(carry_names, c[1:]))
+
+    res = jax.lax.while_loop(cond_fun, body_fun, carry0)
+    for n, v in zip(carry_names, res[1:]):
+        write(n, v)
+
+
+def _run_scan(op, read, write, key):
+    program = op.block.program
+    blk = program.block(op.attrs['block'])
+    slice_names = op.attrs['slice_names']
+    pre_names = op.attrs['pre_names']
+    new_names = op.attrs['new_names']
+    out_names = op.attrs['out_names']
+    xs = tuple(read(n) for n in op.inputs.get('X', []))
+    init = tuple(read(n) for n in op.inputs.get('Init', []))
+
+    def scan_fn(carry, x_t):
+        it, mems = carry
+        overrides = dict(zip(pre_names, mems))
+        overrides.update(zip(slice_names, x_t))
+        local, read2 = _chained_env(overrides, read)
+        _run_block(blk, read2, local.__setitem__, jax.random.fold_in(key, it))
+        new_mems = tuple(read2(n) for n in new_names)
+        outs = tuple(read2(n) for n in out_names)
+        return (it + 1, new_mems), outs
+
+    _, ys = jax.lax.scan(scan_fn, (jnp.zeros((), jnp.int32), init), xs)
+    for n, v in zip(op.outputs['Out'], ys):
+        write(n, v)
+
+
+def _run_create_array(op, read, write, key):
+    write(op.outputs['Out'][0], [])
+
+
+_CONTROL_FLOW_OPS = {
+    '__create_array__': _run_create_array,
+    '__cond__': _run_cond,
+    '__switch__': _run_switch,
+    '__while__': _run_while,
+    '__while_legacy__': _run_while_legacy,
+    '__scan__': _run_scan,
+}
 
 
 def _lower(program: Program, feed_names, fetch_names, state_names):

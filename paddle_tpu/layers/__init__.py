@@ -3,8 +3,9 @@ from . import common
 from .nn import *  # noqa
 from .tensor import *  # noqa
 from .loss import *  # noqa
+from .control_flow import *  # noqa
 from .io import data
-from . import nn, tensor, loss, io
+from . import nn, tensor, loss, io, control_flow
 from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()

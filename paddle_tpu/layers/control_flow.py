@@ -1,0 +1,584 @@
+"""Control-flow layers: cond / case / switch_case / while_loop / While /
+StaticRNN / TensorArray ops.
+
+Parity with reference python/paddle/fluid/layers/control_flow.py — redesigned
+for TPU: instead of the reference's sub-block interpreter ops
+(conditional_block, while, ref paddle/fluid/operators/controlflow/*), each
+construct captures its branches/body as sub-Blocks at build time and lowers to
+ONE structured-control-flow XLA op (`lax.cond`, `lax.while_loop`,
+`lax.switch`, `lax.scan`) inside the fused jitted step — no host round-trips.
+
+Note on autodiff: `lax.while_loop` is forward-only (XLA's while has no
+reverse-mode rule); differentiable recurrences should use StaticRNN /
+layers.rnn (lax.scan), matching the TPU design rule of static trip counts.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..framework import (Variable, default_main_program, in_dygraph_mode)
+from ..layer_helper import LayerHelper
+from ..ops.registry import register_op
+from .common import apply_op_layer, generate_layer_fn
+
+__all__ = [
+    'cond', 'case', 'switch_case', 'while_loop', 'While', 'StaticRNN',
+    'increment', 'less_than', 'less_equal', 'greater_than', 'greater_equal',
+    'equal', 'not_equal', 'array_write', 'array_read', 'array_length',
+    'create_array', 'Print', 'is_empty',
+]
+
+# ---------------------------------------------------------------------------
+# comparisons (layer wrappers over registered ops; `cond` kwarg writes into an
+# existing bool var, as the reference's compare layers do)
+# ---------------------------------------------------------------------------
+
+
+def _compare(op_type):
+    base = generate_layer_fn(op_type, in_slots=['x', 'y'])
+
+    def layer(x, y, cond=None, name=None):
+        out = base(x, y, name=name)
+        if cond is not None:
+            return assign_to(out, cond)
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+def assign_to(src, dst):
+    """Copy src into dst's slot (delegates to layers.assign(input, output))."""
+    from .tensor import assign
+    return assign(src, output=dst)
+
+
+less_than = _compare('less_than')
+less_equal = _compare('less_equal')
+greater_than = _compare('greater_than')
+greater_equal = _compare('greater_equal')
+equal = _compare('equal')
+not_equal = _compare('not_equal')
+
+
+def increment(x, value=1.0, in_place=True):
+    """ref: fluid.layers.increment (control_flow.py:1327). in_place rebinds
+    the same var name so loop-carried counters update."""
+    if in_dygraph_mode():
+        from ..dygraph.tape import dispatch_op
+        out = dispatch_op('increment', {'x': x}, {'value': float(value)})
+        if in_place:
+            x.set_value(out)
+            return x
+        return out
+    helper = LayerHelper('increment')
+    if in_place:
+        helper.append_op(type='increment', inputs={'x': x.name},
+                         outputs={'Out': x.name}, attrs={'value': float(value)})
+        return x
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='increment', inputs={'x': x.name},
+                     outputs={'Out': out.name}, attrs={'value': float(value)})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sub-block capture helper
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def _sub_block(program):
+    blk = program._create_block()
+    try:
+        yield blk
+    finally:
+        program._rollback()
+
+
+def _flatten(x):
+    if isinstance(x, (list, tuple)):
+        flat = []
+        for item in x:
+            flat.extend(_flatten(item))
+        return flat
+    return [x]
+
+
+def _pack_like(template, flat):
+    """Rebuild the nested structure of `template` from the flat list."""
+    it = iter(flat)
+
+    def rec(t):
+        if isinstance(t, (list, tuple)):
+            return type(t)(rec(e) for e in t)
+        return next(it)
+
+    return rec(template)
+
+
+def _parent_writes(blk):
+    """Names of parent-block variables written by ops inside `blk` (e.g. via
+    assign(x, output=outer_var)) — these must be merged out of the branch,
+    like the reference conditional_block's output scope promotion."""
+    written = []
+    for op in blk.ops:
+        for n in op.output_names():
+            if n not in blk.vars and n not in written:
+                written.append(n)
+    return written
+
+
+# ---------------------------------------------------------------------------
+# cond / case / switch_case
+# ---------------------------------------------------------------------------
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """ref: fluid.layers.cond (control_flow.py:2259). Lowers to lax.cond —
+    both branches are traced into the same XLA program."""
+    if in_dygraph_mode():
+        import numpy as np
+        flag = bool(np.asarray(pred.numpy()).reshape(()))
+        if flag:
+            return true_fn() if true_fn is not None else None
+        return false_fn() if false_fn is not None else None
+
+    program = default_main_program()
+    helper = LayerHelper('cond', name=name)
+    with _sub_block(program) as tblk:
+        t_out = true_fn() if true_fn is not None else None
+    with _sub_block(program) as fblk:
+        f_out = false_fn() if false_fn is not None else None
+    writes = _parent_writes(tblk)
+    writes += [w for w in _parent_writes(fblk) if w not in writes]
+    if (t_out is None) != (f_out is None):
+        raise ValueError(
+            "cond: one branch returned a value and the other returned None; "
+            "both branches must return the same structure")
+    if t_out is None and not writes:
+        return None
+    t_flat, f_flat = _flatten(t_out), _flatten(f_out)
+    if t_out is None:
+        t_flat = f_flat = []
+    if len(t_flat) != len(f_flat):
+        raise ValueError(
+            f"cond: true_fn returned {len(t_flat)} outputs but false_fn "
+            f"returned {len(f_flat)}; both branches must match")
+    outs = []
+    for tv in t_flat:
+        o = helper.create_variable_for_type_inference(tv.dtype)
+        o.shape = tv.shape
+        outs.append(o)
+    helper.append_op(
+        type='__cond__',
+        inputs={'Cond': pred.name},
+        outputs={'Out': [o.name for o in outs] + writes},
+        attrs={'true_block': tblk.idx, 'false_block': fblk.idx,
+               'true_outs': [v.name for v in t_flat],
+               'false_outs': [v.name for v in f_flat],
+               'writes': writes})
+    return _pack_like(t_out, outs) if t_out is not None else None
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """ref: fluid.layers.case (control_flow.py:2457): first true pred wins.
+    Composed from nested cond (→ nested lax.cond)."""
+    if not pred_fn_pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+
+    def build(pairs):
+        pred, fn = pairs[0]
+        if len(pairs) == 1:
+            fallback = default if default is not None else fn
+            return cond(pred, fn, fallback)
+        return cond(pred, fn, lambda: build(pairs[1:]))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """ref: fluid.layers.switch_case (control_flow.py:2559). Lowers to
+    lax.switch with the default branch appended; out-of-range indices clamp
+    to the default, matching the reference."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = [(i, kv) if callable(kv) else (kv[0], kv[1])
+                 for i, kv in enumerate(branch_fns)]
+    keys = [int(k) for k, _ in pairs]
+    fns = [fn for _, fn in pairs]
+    if default is None:
+        default = fns[-1]
+
+    if in_dygraph_mode():
+        idx = int(branch_index.numpy().reshape(()))
+        for k, fn in zip(keys, fns):
+            if k == idx:
+                return fn()
+        return default()
+
+    program = default_main_program()
+    helper = LayerHelper('switch_case', name=name)
+    blocks, branch_outs, sub_blks = [], [], []
+    for fn in fns + [default]:
+        with _sub_block(program) as blk:
+            out = fn()
+        blocks.append(blk.idx)
+        sub_blks.append(blk)
+        branch_outs.append(_flatten(out))
+    writes = []
+    for blk in sub_blks:
+        writes += [w for w in _parent_writes(blk) if w not in writes]
+    n_out = len(branch_outs[0])
+    if any(len(b) != n_out for b in branch_outs):
+        raise ValueError("switch_case: all branches must return the same "
+                         "number of outputs")
+    template = branch_outs[0]
+    outs = []
+    for tv in template:
+        o = helper.create_variable_for_type_inference(tv.dtype)
+        o.shape = tv.shape
+        outs.append(o)
+    helper.append_op(
+        type='__switch__',
+        inputs={'Index': branch_index.name},
+        outputs={'Out': [o.name for o in outs] + writes},
+        attrs={'blocks': blocks, 'keys': keys,
+               'branch_outs': [[v.name for v in b] for b in branch_outs],
+               'writes': writes})
+    return outs[0] if n_out == 1 else outs
+
+
+# ---------------------------------------------------------------------------
+# while_loop (functional) + While (legacy block form)
+# ---------------------------------------------------------------------------
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """ref: fluid.layers.while_loop (control_flow.py:1054). Lowers to
+    lax.while_loop; carry = loop_vars. Forward-only (see module docstring)."""
+    if in_dygraph_mode():
+        import numpy as np
+        args = list(loop_vars)
+        while bool(np.asarray(cond(*args).numpy()).reshape(())):
+            out = body(*args)
+            args = list(out) if isinstance(out, (list, tuple)) else [out]
+        return args
+
+    program = default_main_program()
+    helper = LayerHelper('while_loop', name=name)
+    flat_vars = _flatten(loop_vars)
+    with _sub_block(program) as cond_blk:
+        c = cond(*loop_vars)
+    with _sub_block(program) as body_blk:
+        b_out = body(*loop_vars)
+    b_flat = _flatten(b_out)
+    if len(b_flat) != len(flat_vars):
+        raise ValueError(
+            f"while_loop: body returned {len(b_flat)} values for "
+            f"{len(flat_vars)} loop_vars")
+    loop_names = [v.name for v in flat_vars]
+    # parent-block vars written inside the body join the loop carry, so
+    # assign(x, output=outer_var) survives iterations
+    writes = [w for w in _parent_writes(body_blk) if w not in loop_names]
+    outs = []
+    for v in flat_vars:
+        o = helper.create_variable_for_type_inference(v.dtype)
+        o.shape = v.shape
+        outs.append(o)
+    helper.append_op(
+        type='__while__',
+        inputs={'X': loop_names + writes},
+        outputs={'Out': [o.name for o in outs] + writes},
+        attrs={'cond_block': cond_blk.idx, 'body_block': body_blk.idx,
+               'cond_out': c.name, 'body_outs': [v.name for v in b_flat],
+               'loop_vars': loop_names, 'writes': writes})
+    return _pack_like(b_out if isinstance(b_out, (list, tuple)) else loop_vars,
+                      outs)
+
+
+class While:
+    """Legacy block-style while (ref: fluid.layers.While, control_flow.py:789).
+
+    Usage:
+        i = fill_constant([1], 'int64', 0)
+        cond_var = less_than(i, n)
+        w = While(cond_var)
+        with w.block():
+            ... increment(i) ...
+            less_than(i, n, cond=cond_var)
+
+    The loop carry is inferred as every parent-block variable written inside
+    the body (including the condition var), then lowered to lax.while_loop.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        if in_dygraph_mode():
+            raise RuntimeError("While is a static-graph construct; use a "
+                               "python loop in dygraph mode")
+        self.cond_var = cond
+        self.helper = LayerHelper('while', name=name)
+
+    @contextlib.contextmanager
+    def block(self):
+        program = default_main_program()
+        blk = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+        parent = program.block(blk.parent_idx)
+        written = []
+        for op in blk.ops:
+            for n in op.output_names():
+                if n not in blk.vars and n not in written:
+                    written.append(n)  # writes to parent-block vars = carry
+        carry = [self.cond_var.name]
+        carry += [n for n in written if n != self.cond_var.name]
+        parent_cur = program.current_block()
+        parent_cur.append_op(
+            type='__while_legacy__',
+            inputs={'X': carry},
+            outputs={'Out': carry},
+            attrs={'body_block': blk.idx, 'carry': carry})
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN → lax.scan
+# ---------------------------------------------------------------------------
+
+
+class StaticRNN:
+    """ref: fluid.layers.StaticRNN (control_flow.py:409): explicit recurrence
+    over the leading (time) dim. Lowers to lax.scan — differentiable, fused,
+    static trip count (the TPU-native recurrence primitive)."""
+
+    def __init__(self, name=None):
+        if in_dygraph_mode():
+            raise RuntimeError("StaticRNN is a static-graph construct")
+        self.helper = LayerHelper('static_rnn', name=name)
+        self._block = None
+        self._seq_inputs = []   # (slice_name, source_name)
+        self._memories = []     # dicts: pre, init, new
+        self._outputs = []      # step output var names
+        self._out_vars = None
+        self._seq_len = None
+
+    @contextlib.contextmanager
+    def step(self):
+        program = default_main_program()
+        self._block = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+            self._complete()
+
+    def step_input(self, x):
+        if self._seq_len is None:
+            self._seq_len = x.shape[0]
+        v = self._block.create_var(
+            name=self.helper.name + f'.in{len(self._seq_inputs)}',
+            shape=x.shape[1:], dtype=x.dtype)
+        self._seq_inputs.append((v.name, x.name))
+        return v
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1, dtype='float32'):
+        from . import tensor as tensor_layers
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory needs `init` or (`shape`+`batch_ref`)")
+            mshape = [batch_ref.shape[ref_batch_dim_idx] if s == -1 else s
+                      for s in shape]
+            # build the init in the PARENT block
+            program = default_main_program()
+            cur = program.current_block_idx
+            program.current_block_idx = self._block.parent_idx
+            try:
+                init = tensor_layers.fill_constant(mshape, dtype,
+                                                   float(init_value))
+            finally:
+                program.current_block_idx = cur
+        pre = self._block.create_var(
+            name=self.helper.name + f'.mem{len(self._memories)}',
+            shape=init.shape, dtype=init.dtype)
+        self._memories.append({'pre': pre.name, 'init': init.name,
+                               'new': None})
+        return pre
+
+    def update_memory(self, mem, var):
+        for m in self._memories:
+            if m['pre'] == mem.name:
+                m['new'] = var.name
+                return
+        raise ValueError(f"update_memory: {mem.name} is not a memory")
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self):
+        for m in self._memories:
+            if m['new'] is None:
+                m['new'] = m['pre']
+        outs = []
+        for ov in self._outputs:
+            o = self.helper.create_variable_for_type_inference(ov.dtype)
+            if ov.shape is not None and self._seq_len is not None:
+                o.shape = (self._seq_len,) + tuple(ov.shape)
+            outs.append(o)
+        self.helper.append_op(
+            type='__scan__',
+            inputs={'X': [src for _, src in self._seq_inputs],
+                    'Init': [m['init'] for m in self._memories]},
+            outputs={'Out': [o.name for o in outs]},
+            attrs={'block': self._block.idx,
+                   'slice_names': [s for s, _ in self._seq_inputs],
+                   'pre_names': [m['pre'] for m in self._memories],
+                   'new_names': [m['new'] for m in self._memories],
+                   'out_names': [o.name for o in self._outputs]})
+        self._out_vars = outs
+
+    def __call__(self):
+        if not self._out_vars:
+            raise ValueError("StaticRNN has no step_output")
+        return self._out_vars[0] if len(self._out_vars) == 1 else self._out_vars
+
+
+# ---------------------------------------------------------------------------
+# TensorArray (ref: LoDTensorArray + array_write/array_read ops,
+# python/paddle/fluid/layers/control_flow.py:1475). On TPU, arrays are Python
+# lists in the traced env; indices must be trace-time constants (counters
+# built from fill_constant/increment are). In-loop accumulation should use
+# StaticRNN / layers.rnn (lax.scan buffers) instead.
+# ---------------------------------------------------------------------------
+
+
+def _concrete_index(i):
+    import numpy as np
+    try:
+        return int(np.asarray(i).reshape(()))
+    except Exception:
+        raise ValueError(
+            "TensorArray index must be a trace-time constant on TPU (built "
+            "from fill_constant/increment); for in-loop accumulation use "
+            "StaticRNN or layers.rnn (lax.scan)") from None
+
+
+@register_op('__array_write__', atomic_output=True)
+def _array_write_op(array, x, i):
+    idx = _concrete_index(i)
+    new = list(array) if array is not None else []
+    while len(new) <= idx:
+        new.append(None)
+    new[idx] = x
+    return new
+
+
+@register_op('__array_read__')
+def _array_read_op(array, i):
+    return array[_concrete_index(i)]
+
+
+@register_op('__array_length__')
+def _array_length_op(array):
+    import jax.numpy as jnp
+    return jnp.asarray(len(array), jnp.int32)
+
+
+class _DygraphTensorArray(list):
+    pass
+
+
+def create_array(dtype='float32'):
+    if in_dygraph_mode():
+        return _DygraphTensorArray()
+    helper = LayerHelper('array')
+    v = helper.main_program.current_block().create_var(
+        name=helper.name, dtype=dtype, shape=(0,))
+    v.is_tensor_array = True
+    helper.append_op(type='__create_array__', inputs={},
+                     outputs={'Out': v.name}, attrs={})
+    return v
+
+
+def array_write(x, i, array=None):
+    if in_dygraph_mode():
+        if array is None:
+            array = _DygraphTensorArray()
+        idx = int(i.numpy().reshape(())) if hasattr(i, 'numpy') else int(i)
+        while len(array) <= idx:
+            array.append(None)
+        array[idx] = x
+        return array
+    helper = LayerHelper('array_write')
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op(
+        type='__array_write__',
+        inputs={'array': array.name, 'x': x.name, 'i': i.name},
+        outputs={'Out': array.name})
+    return array
+
+
+def array_read(array, i):
+    if in_dygraph_mode():
+        idx = int(i.numpy().reshape(())) if hasattr(i, 'numpy') else int(i)
+        return array[idx]
+    helper = LayerHelper('array_read')
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(type='__array_read__',
+                     inputs={'array': array.name, 'i': i.name},
+                     outputs={'Out': out.name})
+    return out
+
+
+def array_length(array):
+    if in_dygraph_mode():
+        from ..dygraph.tape import Tensor
+        return Tensor(len(array), dtype='int64', stop_gradient=True)
+    helper = LayerHelper('array_length')
+    out = helper.create_variable_for_type_inference('int32')
+    out.shape = ()
+    helper.append_op(type='__array_length__', inputs={'array': array.name},
+                     outputs={'Out': out.name})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Print / is_empty
+# ---------------------------------------------------------------------------
+
+
+@register_op('print')
+def _print_op(x, *, message=''):
+    import jax
+    jax.debug.print(message + '{x}', x=x)
+    return x
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase='both'):
+    """ref: fluid.layers.Print (control_flow.py:690) → jax.debug.print."""
+    msg = (message or '') + (f" {input.name}: " if print_tensor_name else ' ')
+    return apply_op_layer('print', {'x': input}, {'message': msg})
+
+
+@register_op('is_empty')
+def _is_empty_op(x):
+    import jax.numpy as jnp
+    return jnp.asarray(x.size == 0)
+
+
+def is_empty(x, cond=None):
+    out = apply_op_layer('is_empty', {'x': x}, {})
+    if cond is not None:
+        return assign_to(out, cond)
+    return out

@@ -62,11 +62,22 @@ def sums(input, out=None):
 
 
 def assign(input, output=None):
+    from ..framework import in_dygraph_mode
     if isinstance(input, (np.ndarray, list, tuple, float, int)):
-        arr = np.asarray(input)
-        return fill_constant_array(arr)
-    out = apply_op_layer('assign', {'x': input})
-    return out
+        if in_dygraph_mode():
+            from ..dygraph.tape import Tensor
+            input = Tensor(np.asarray(input), stop_gradient=True)
+        else:
+            input = fill_constant_array(np.asarray(input))
+    if output is None:
+        return apply_op_layer('assign', {'x': input})
+    if in_dygraph_mode():
+        output.set_value(input)
+        return output
+    helper = LayerHelper('assign')
+    helper.append_op(type='assign', inputs={'x': input.name},
+                     outputs={'Out': output.name})
+    return output
 
 
 def fill_constant_array(arr):

@@ -24,7 +24,8 @@ _REGISTRY: Dict[str, 'OpDef'] = {}
 class OpDef:
     def __init__(self, name: str, fn: Callable, input_slots: List[str],
                  output_slots: List[str], variadic: frozenset,
-                 needs_rng: bool, optional: frozenset):
+                 needs_rng: bool, optional: frozenset,
+                 atomic_output: bool = False):
         self.name = name
         self.fn = fn
         self.input_slots = input_slots
@@ -32,13 +33,17 @@ class OpDef:
         self.variadic = variadic
         self.needs_rng = needs_rng
         self.optional = optional
+        # atomic_output: the single 'Out' result is one value even if it is a
+        # Python list (TensorArray) — never fan it out across output names.
+        self.atomic_output = atomic_output
 
     def __repr__(self):
         return f"OpDef({self.name}, in={self.input_slots}, out={self.output_slots})"
 
 
 def register_op(name: str, outputs: Sequence[str] = ('Out',),
-                variadic: Sequence[str] = (), needs_rng: bool = False):
+                variadic: Sequence[str] = (), needs_rng: bool = False,
+                atomic_output: bool = False):
     """Decorator registering a jax functional as a graph op."""
 
     def deco(fn):
@@ -55,7 +60,7 @@ def register_op(name: str, outputs: Sequence[str] = ('Out',),
             raise ValueError(f"op {name!r} registered twice")
         _REGISTRY[name] = OpDef(name, fn, input_slots, list(outputs),
                                 frozenset(variadic), needs_rng,
-                                frozenset(optional))
+                                frozenset(optional), atomic_output)
         return fn
 
     return deco

@@ -195,7 +195,12 @@ def reverse(x, *, axis):
 
 @register_op('fill_constant')
 def fill_constant(*, shape, value, dtype='float32'):
-    return jnp.full(tuple(shape), value, to_jax_dtype(dtype))
+    # numpy (not jnp): stays a trace-time CONSTANT inside jit, so counters /
+    # TensorArray indices built from it remain concrete; XLA folds it anyway.
+    import numpy as np
+    import ml_dtypes
+    np_dtype = np.dtype(dtype) if dtype not in ('bfloat16',) else ml_dtypes.bfloat16
+    return np.full(tuple(shape), value, np_dtype)
 
 
 @register_op('fill_constant_batch_size_like')

@@ -1,0 +1,213 @@
+"""Control flow: cond / case / switch_case / while_loop / While / StaticRNN /
+TensorArray — static lowering to lax.cond/while_loop/switch/scan."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _fresh():
+    main, start = fluid.Program(), fluid.Program()
+    return main, start
+
+
+def _run(main, start, feed, fetch):
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(start)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_cond_static():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[3], dtype='float32', append_batch_size=False)
+        pred = layers.reduce_sum(x) > 1.0
+        out = layers.cond(pred, lambda: x * 2.0, lambda: x - 1.0)
+    r_true, = _run(main, start, {'x': np.ones(3, np.float32)}, [out])
+    np.testing.assert_allclose(r_true, 2 * np.ones(3), rtol=1e-6)
+    r_false, = _run(main, start, {'x': np.zeros(3, np.float32)}, [out])
+    np.testing.assert_allclose(r_false, -np.ones(3), rtol=1e-6)
+
+
+def test_cond_multiple_outputs():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[2], dtype='float32', append_batch_size=False)
+        pred = layers.reduce_sum(x) > 0.0
+        a, b = layers.cond(pred, lambda: (x + 1.0, x + 2.0),
+                           lambda: (x * 0.0, x * 3.0))
+    ra, rb = _run(main, start, {'x': np.ones(2, np.float32)}, [a, b])
+    np.testing.assert_allclose(ra, [2, 2], rtol=1e-6)
+    np.testing.assert_allclose(rb, [3, 3], rtol=1e-6)
+
+
+def test_switch_case():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        idx = layers.data('i', shape=[1], dtype='int32', append_batch_size=False)
+        out = layers.switch_case(
+            idx,
+            {1: lambda: layers.fill_constant([2], 'float32', 1.0),
+             3: lambda: layers.fill_constant([2], 'float32', 3.0)},
+            default=lambda: layers.fill_constant([2], 'float32', -1.0))
+    for i, expect in [(1, 1.0), (3, 3.0), (7, -1.0)]:
+        r, = _run(main, start, {'i': np.array([i], np.int32)}, [out])
+        np.testing.assert_allclose(r, expect * np.ones(2), rtol=1e-6)
+
+
+def test_case():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[1], dtype='float32', append_batch_size=False)
+        s = layers.reduce_sum(x)
+        out = layers.case(
+            [(s < 0.0, lambda: layers.fill_constant([1], 'float32', -1.0)),
+             (s < 10.0, lambda: layers.fill_constant([1], 'float32', 0.5))],
+            default=lambda: layers.fill_constant([1], 'float32', 99.0))
+    r, = _run(main, start, {'x': np.array([-5.0], np.float32)}, [out])
+    assert r[0] == -1.0
+    r, = _run(main, start, {'x': np.array([5.0], np.float32)}, [out])
+    assert r[0] == 0.5
+    r, = _run(main, start, {'x': np.array([50.0], np.float32)}, [out])
+    assert r[0] == 99.0
+
+
+def test_while_loop_functional():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        i = layers.fill_constant([1], 'int32', 0)
+        acc = layers.fill_constant([1], 'float32', 0.0)
+        limit = layers.data('n', shape=[1], dtype='int32', append_batch_size=False)
+
+        def cond_fn(i, acc):
+            return layers.less_than(i, limit)
+
+        def body_fn(i, acc):
+            return [i + 1, acc + 2.0]
+
+        i_out, acc_out = layers.while_loop(cond_fn, body_fn, [i, acc])
+    ri, racc = _run(main, start, {'n': np.array([5], np.int32)},
+                    [i_out, acc_out])
+    assert ri[0] == 5
+    np.testing.assert_allclose(racc, [10.0], rtol=1e-6)
+
+
+def test_while_legacy_block():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        n = layers.fill_constant([1], 'int64', 4)
+        i = layers.fill_constant([1], 'int64', 0)
+        total = layers.fill_constant([1], 'int64', 0)
+        cond_var = layers.less_than(i, n)
+        w = layers.While(cond_var)
+        with w.block():
+            layers.assign(total + i, total)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond_var)
+    r, = _run(main, start, {}, [total])
+    assert r[0] == 0 + 1 + 2 + 3
+
+
+def test_static_rnn():
+    T, B, D = 4, 2, 3
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[T, B, D], dtype='float32',
+                        append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(shape=[B, D], batch_ref=x, init_value=0.0)
+            h = h_prev + x_t
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+    xv = np.random.RandomState(0).randn(T, B, D).astype(np.float32)
+    r, = _run(main, start, {'x': xv}, [out])
+    np.testing.assert_allclose(r, np.cumsum(xv, axis=0), rtol=1e-5)
+
+
+def test_tensor_array_concrete_index():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[2], dtype='float32', append_batch_size=False)
+        arr = layers.create_array('float32')
+        i0 = layers.fill_constant([1], 'int64', 0)
+        i1 = layers.fill_constant([1], 'int64', 1)
+        layers.array_write(x, i0, arr)
+        layers.array_write(x * 2.0, i1, arr)
+        back = layers.array_read(arr, i1)
+        n = layers.array_length(arr)
+    r, rn = _run(main, start, {'x': np.ones(2, np.float32)}, [back, n])
+    np.testing.assert_allclose(r, [2, 2], rtol=1e-6)
+    assert int(rn) == 2
+
+
+def test_cond_parent_var_write():
+    # assign(x, output=outer_var) inside a branch must merge out of the cond
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[1], dtype='float32', append_batch_size=False)
+        acc = layers.fill_constant([1], 'float32', 0.0)
+        pred = layers.reduce_sum(x) > 0.0
+        layers.cond(pred,
+                    lambda: layers.assign(x * 10.0, output=acc),
+                    lambda: layers.assign(x * -1.0, output=acc))
+    r, = _run(main, start, {'x': np.array([2.0], np.float32)}, [acc])
+    np.testing.assert_allclose(r, [20.0], rtol=1e-6)
+    r, = _run(main, start, {'x': np.array([-3.0], np.float32)}, [acc])
+    np.testing.assert_allclose(r, [3.0], rtol=1e-6)
+
+
+def test_cond_branch_none_mismatch():
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[1], dtype='float32', append_batch_size=False)
+        with pytest.raises(ValueError, match='None'):
+            layers.cond(layers.reduce_sum(x) > 0.0, lambda: x, lambda: None)
+
+
+def test_static_rnn_dropout_rng_varies_per_step():
+    T, B, D = 3, 2, 64
+    main, start = _fresh()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[T, B, D], dtype='float32',
+                        append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            d = layers.dropout(x_t, dropout_prob=0.5)
+            rnn.step_output(d)
+        out = rnn()
+    xv = np.ones((T, B, D), np.float32)
+    r, = _run(main, start, {'x': xv}, [out])
+    masks = (r != 0)
+    assert not np.array_equal(masks[0], masks[1]), \
+        "dropout mask must differ across scan steps"
+
+
+def test_assign_ndarray_output_dygraph():
+    with fluid.dygraph.guard():
+        t = fluid.dygraph.to_variable(np.zeros(2, np.float32))
+        layers.assign(np.ones(2, np.float32), output=t)
+        np.testing.assert_allclose(t.numpy(), [1, 1], rtol=1e-6)
+
+
+def test_cond_dygraph():
+    with fluid.dygraph.guard():
+        x = fluid.dygraph.to_variable(np.ones(3, np.float32))
+        out = layers.cond(layers.reduce_sum(x) > 1.0,
+                          lambda: x * 2.0, lambda: x - 1.0)
+        np.testing.assert_allclose(out.numpy(), 2 * np.ones(3), rtol=1e-6)
+
+
+def test_while_loop_dygraph():
+    with fluid.dygraph.guard():
+        i = fluid.dygraph.to_variable(np.array([0], np.int32))
+        acc = fluid.dygraph.to_variable(np.array([0.0], np.float32))
+        res = layers.while_loop(lambda i, a: i < 3,
+                                lambda i, a: [i + 1, a + 5.0], [i, acc])
+        assert res[0].numpy()[0] == 3
+        np.testing.assert_allclose(res[1].numpy(), [15.0], rtol=1e-6)
