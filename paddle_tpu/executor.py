@@ -176,12 +176,24 @@ def _run_while(op, read, write, key):
     def body_fun(c):
         new = run_blk(body_blk, c[0], c[1:], out_names)
         return (c[0] + 1,) + tuple(
-            jnp.asarray(v).astype(c0.dtype).reshape(c0.shape)
-            for v, c0 in zip(new, c[1:]))
+            _check_carry(v, c0, n)
+            for v, c0, n in zip(new, c[1:], carry_names))
 
     res = jax.lax.while_loop(cond_fun, body_fun, carry0)
     for n, v in zip(op.outputs['Out'], res[1:]):
         write(n, v)
+
+
+def _check_carry(new, init, name):
+    """Loop carries must keep shape+dtype; raise instead of silently casting
+    (a silent cast floors float updates into int carries)."""
+    new = jnp.asarray(new)
+    if new.shape != init.shape or new.dtype != init.dtype:
+        raise TypeError(
+            f"while loop carry '{name}' changed from "
+            f"{init.shape}/{init.dtype} to {new.shape}/{new.dtype}; loop "
+            f"variables must keep a fixed shape and dtype across iterations")
+    return new
 
 
 def _run_while_legacy(op, read, write, key):
@@ -199,7 +211,7 @@ def _run_while_legacy(op, read, write, key):
         _run_block(body_blk, read2, local.__setitem__,
                    jax.random.fold_in(key, c[0]))
         return (c[0] + 1,) + tuple(
-            jnp.asarray(read2(n)).astype(c0.dtype).reshape(c0.shape)
+            _check_carry(read2(n), c0, n)
             for n, c0 in zip(carry_names, c[1:]))
 
     res = jax.lax.while_loop(cond_fun, body_fun, carry0)

@@ -6,6 +6,9 @@ from .loss import *  # noqa
 from .control_flow import *  # noqa
 from .io import data
 from . import nn, tensor, loss, io, control_flow
+from .rnn import *  # noqa — exports the rnn() function over the module name
+from .sequence_lod import *  # noqa
+from . import sequence_lod
 from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()

@@ -220,23 +220,32 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
     program = default_main_program()
     helper = LayerHelper('switch_case', name=name)
-    blocks, branch_outs, sub_blks = [], [], []
-    for fn in fns + [default]:
+    reuse_last_as_default = default is fns[-1]
+    blocks, returns, sub_blks = [], [], []
+    for fn in (fns if reuse_last_as_default else fns + [default]):
         with _sub_block(program) as blk:
             out = fn()
         blocks.append(blk.idx)
         sub_blks.append(blk)
-        branch_outs.append(_flatten(out))
+        returns.append(out)
+    if reuse_last_as_default:
+        blocks.append(blocks[-1])
+        returns.append(returns[-1])
     writes = []
     for blk in sub_blks:
         writes += [w for w in _parent_writes(blk) if w not in writes]
+    if any((r is None) != (returns[0] is None) for r in returns):
+        raise ValueError("switch_case: some branches returned a value and "
+                         "others returned None; all must match")
+    branch_outs = [[] if r is None else _flatten(r) for r in returns]
+    if returns[0] is None and not writes:
+        return None
     n_out = len(branch_outs[0])
     if any(len(b) != n_out for b in branch_outs):
         raise ValueError("switch_case: all branches must return the same "
                          "number of outputs")
-    template = branch_outs[0]
     outs = []
-    for tv in template:
+    for tv in branch_outs[0]:
         o = helper.create_variable_for_type_inference(tv.dtype)
         o.shape = tv.shape
         outs.append(o)
@@ -247,6 +256,8 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         attrs={'blocks': blocks, 'keys': keys,
                'branch_outs': [[v.name for v in b] for b in branch_outs],
                'writes': writes})
+    if returns[0] is None:
+        return None
     return outs[0] if n_out == 1 else outs
 
 
@@ -328,12 +339,7 @@ class While:
             yield
         finally:
             program._rollback()
-        parent = program.block(blk.parent_idx)
-        written = []
-        for op in blk.ops:
-            for n in op.output_names():
-                if n not in blk.vars and n not in written:
-                    written.append(n)  # writes to parent-block vars = carry
+        written = _parent_writes(blk)
         carry = [self.cond_var.name]
         carry += [n for n in written if n != self.cond_var.name]
         parent_cur = program.current_block()
@@ -568,6 +574,8 @@ def Print(input, first_n=-1, message=None, summarize=20,
           print_phase='both'):
     """ref: fluid.layers.Print (control_flow.py:690) → jax.debug.print."""
     msg = (message or '') + (f" {input.name}: " if print_tensor_name else ' ')
+    # escape braces: msg is spliced into jax.debug.print's format string
+    msg = msg.replace('{', '{{').replace('}', '}}')
     return apply_op_layer('print', {'x': input}, {'message': msg})
 
 

@@ -481,7 +481,12 @@ def _gen(op_type, *, fname=None, slots=None):
     return fn
 
 
-for _op in ['relu', 'relu6', 'leaky_relu', 'elu', 'selu', 'brelu', 'soft_relu',
+for _op in ['sigmoid', 'logsigmoid', 'exp', 'tanh', 'atan', 'tanh_shrink',
+            'sqrt', 'rsqrt', 'abs', 'ceil', 'floor', 'cos', 'sin', 'acos',
+            'asin', 'cosh', 'sinh', 'round', 'reciprocal', 'square',
+            'softplus', 'softsign', 'softshrink', 'hard_shrink',
+            'thresholded_relu', 'log_softmax',
+            'relu', 'relu6', 'leaky_relu', 'elu', 'selu', 'brelu', 'soft_relu',
             'stanh', 'hard_sigmoid', 'hard_swish', 'swish', 'maxout', 'pow',
             'gelu', 'erf', 'log', 'sign', 'mean',
             'reduce_sum', 'reduce_mean', 'reduce_max', 'reduce_min',

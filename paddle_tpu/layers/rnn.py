@@ -1,0 +1,604 @@
+"""RNN layers: cells, rnn(), dynamic_lstm/gru, beam search decoding.
+
+Parity with reference python/paddle/fluid/layers/rnn.py (RNNCell/GRUCell/
+LSTMCell, rnn, BeamSearchDecoder, dynamic_decode) and the dynamic_lstm(p)/
+dynamic_gru layers of layers/nn.py — redesigned for TPU:
+
+- whole-sequence recurrences (dynamic_lstm/gru) are ONE registered scan op
+  (ops/rnn_ops.py) over padded (B, T, ...) batches with a length mask, not
+  per-timestep kernels over LoD batches;
+- rnn(cell, ...) captures the cell step as a StaticRNN sub-block → lax.scan;
+- dynamic_decode runs a FIXED max_step_num scan with a finished mask (static
+  trip count — the TPU design rule), then backtraces with the gather_tree op.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Variable, in_dygraph_mode
+from ..layer_helper import LayerHelper
+from ..initializer import XavierInitializer, ConstantInitializer
+from .common import apply_op_layer
+from .control_flow import StaticRNN
+from . import tensor as tensor_layers
+from . import nn as nn_layers
+
+__all__ = ['RNNCell', 'GRUCell', 'LSTMCell', 'rnn', 'birnn', 'dynamic_lstm',
+           'dynamic_lstmp', 'dynamic_gru', 'gru_unit', 'lstm_unit',
+           'BeamSearchDecoder', 'dynamic_decode', 'beam_search',
+           'beam_search_decode', 'gather_tree']
+
+
+from .control_flow import _flatten, _pack_like as _pack
+
+
+def _map_structure(fn, *trees):
+    t0 = trees[0]
+    if isinstance(t0, (list, tuple)):
+        return type(t0)(_map_structure(fn, *elems) for elems in zip(*trees))
+    return fn(*trees)
+
+
+class RNNCell:
+    """ref: layers/rnn.py:33 RNNCell — single-step recurrence unit usable with
+    rnn() and dynamic_decode."""
+
+    def call(self, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, inputs, states, **kwargs):
+        return self.call(inputs, states, **kwargs)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError
+
+    def get_initial_states(self, batch_ref, shape=None, dtype='float32',
+                           init_value=0.0, batch_dim_idx=0):
+        shape = shape if shape is not None else self.state_shape
+
+        def is_shape(s):
+            return isinstance(s, (list, tuple)) and \
+                all(isinstance(e, int) for e in s)
+
+        def mk(s):
+            full = [-1] + list(s)
+            return tensor_layers.fill_constant_batch_size_like(
+                batch_ref, full, dtype, float(init_value),
+                input_dim_idx=batch_dim_idx)
+
+        def rec(s):
+            if is_shape(s):
+                return mk(s)
+            return type(s)(rec(e) for e in s)
+
+        return rec(shape)
+
+
+class GRUCell(RNNCell):
+    """ref: layers/rnn.py:200 GRUCell (BasicGRUUnit formulation):
+    r,u = σ([x,h]Wg + bg); c̃ = tanh([x, r⊙h]Wc + bc); h' = u⊙h + (1-u)⊙c̃."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, dtype='float32',
+                 name='GRUCell'):
+        self.hidden_size = hidden_size
+        self.param_attr = param_attr
+        self.bias_attr = bias_attr
+        self.gate_act = gate_activation or nn_layers.sigmoid
+        self.act = activation or nn_layers.tanh
+        self.dtype = dtype
+        self.name = name
+        self._built = False
+
+    def _build(self, input_size):
+        helper = LayerHelper(self.name, param_attr=self.param_attr,
+                             bias_attr=self.bias_attr)
+        D = self.hidden_size
+        self.gate_w = helper.create_parameter(
+            helper.param_attr, [input_size + D, 2 * D], self.dtype)
+        self.gate_b = helper.create_parameter(
+            helper.bias_attr, [2 * D], self.dtype, is_bias=True)
+        self.cand_w = helper.create_parameter(
+            helper.param_attr, [input_size + D, D], self.dtype)
+        self.cand_b = helper.create_parameter(
+            helper.bias_attr, [D], self.dtype, is_bias=True)
+        self._built = True
+
+    def call(self, inputs, states):
+        if not self._built:
+            self._build(inputs.shape[-1])
+        h = states
+        xh = tensor_layers.concat([inputs, h], axis=-1)
+        gates = self.gate_act(
+            nn_layers.matmul(xh, self.gate_w) + self.gate_b)
+        u, r = nn_layers.split(gates, 2, dim=-1)
+        xrh = tensor_layers.concat([inputs, r * h], axis=-1)
+        c = self.act(nn_layers.matmul(xrh, self.cand_w) + self.cand_b)
+        new_h = u * h + (1.0 - u) * c
+        return new_h, new_h
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+
+class LSTMCell(RNNCell):
+    """ref: layers/rnn.py:289 LSTMCell (BasicLSTMUnit formulation), gate
+    order [i, c̃, f, o] on the fused weight."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, forget_bias=1.0,
+                 dtype='float32', name='LSTMCell'):
+        self.hidden_size = hidden_size
+        self.param_attr = param_attr
+        self.bias_attr = bias_attr
+        self.gate_act = gate_activation or nn_layers.sigmoid
+        self.act = activation or nn_layers.tanh
+        self.forget_bias = forget_bias
+        self.dtype = dtype
+        self.name = name
+        self._built = False
+
+    def _build(self, input_size):
+        helper = LayerHelper(self.name, param_attr=self.param_attr,
+                             bias_attr=self.bias_attr)
+        D = self.hidden_size
+        self.weight = helper.create_parameter(
+            helper.param_attr, [input_size + D, 4 * D], self.dtype)
+        self.bias = helper.create_parameter(
+            helper.bias_attr, [4 * D], self.dtype, is_bias=True)
+        self._built = True
+
+    def call(self, inputs, states):
+        if not self._built:
+            self._build(inputs.shape[-1])
+        pre_h, pre_c = states
+        xh = tensor_layers.concat([inputs, pre_h], axis=-1)
+        gates = nn_layers.matmul(xh, self.weight) + self.bias
+        i, j, f, o = nn_layers.split(gates, 4, dim=-1)
+        new_c = pre_c * self.gate_act(f + self.forget_bias) \
+            + self.gate_act(i) * self.act(j)
+        new_h = self.act(new_c) * self.gate_act(o)
+        return new_h, [new_h, new_c]
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size], [self.hidden_size]]
+
+
+# ---------------------------------------------------------------------------
+# rnn() — run a cell over time (ref: layers/rnn.py:448)
+# ---------------------------------------------------------------------------
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Runs `cell` over the time dim of `inputs` (B, T, D) [or (T, B, D) if
+    time_major]. Returns (outputs, final_states); padded steps (>= their
+    row's sequence_length) carry states through and emit zero outputs."""
+    if initial_states is None:
+        initial_states = cell.get_initial_states(
+            batch_ref=inputs, batch_dim_idx=1 if time_major else 0)
+
+    if in_dygraph_mode():
+        return _rnn_dygraph(cell, inputs, initial_states, sequence_length,
+                            time_major, is_reverse, **kwargs)
+
+    x = inputs if time_major else nn_layers.transpose(inputs, perm=[1, 0, 2])
+    T = x.shape[0]
+    if is_reverse:
+        x = tensor_layers.reverse(x, axis=[0])
+    mask = None
+    if sequence_length is not None:
+        t_idx = tensor_layers.fill_constant_array(
+            np.arange(T).reshape(T, 1).astype(np.int64))
+        # (T, 1) < (1, B) → (T, B) validity mask
+        from .control_flow import less_than
+        mask = less_than(t_idx,
+                         nn_layers.reshape(
+                             tensor_layers.cast(sequence_length, 'int64'),
+                             shape=[1, -1]))
+        if is_reverse:
+            mask = tensor_layers.reverse(mask, axis=[0])
+        mask = tensor_layers.cast(mask, x.dtype)
+
+    srnn = StaticRNN()
+    flat_init = _flatten(initial_states)
+    out_template = None
+    with srnn.step():
+        x_t = srnn.step_input(x)
+        m_t = srnn.step_input(mask) if mask is not None else None
+        pre = [srnn.memory(init=s) for s in flat_init]
+        states = _pack(initial_states, pre)
+        out, new_states = cell.call(x_t, states, **kwargs)
+        out_template = out
+        flat_new = _flatten(new_states)
+        out_flat = _flatten(out)
+        if m_t is not None:
+            m_col = nn_layers.reshape(m_t, shape=[-1, 1])
+            flat_new = [nw * m_col + pv * (1.0 - m_col)
+                        for nw, pv in zip(flat_new, pre)]
+            out_flat = [o * m_col for o in out_flat]
+        for pv, nw in zip(pre, flat_new):
+            srnn.update_memory(pv, nw)
+        for o in out_flat + flat_new:
+            srnn.step_output(o)
+    res = srnn()
+    res = res if isinstance(res, list) else [res]
+    n_states = len(flat_init)
+    outs_seq, states_seq = res[:len(res) - n_states], res[len(res) - n_states:]
+    # final states: masking already carried last-valid values to step T-1
+    final_flat = [nn_layers.reshape(
+        nn_layers.slice(s, axes=[0], starts=[T - 1], ends=[T]),
+        shape=list(s.shape[1:])) for s in states_seq]
+    final_states = _pack(initial_states, final_flat)
+    if is_reverse:
+        outs_seq = [tensor_layers.reverse(o, axis=[0]) for o in outs_seq]
+    if not time_major:
+        outs_seq = [nn_layers.transpose(
+            o, perm=[1, 0] + list(range(2, len(o.shape)))) for o in outs_seq]
+    outputs = _pack(out_template, outs_seq)
+    return outputs, final_states
+
+
+def _rnn_dygraph(cell, inputs, initial_states, sequence_length, time_major,
+                 is_reverse, **kwargs):
+    axis_t = 0 if time_major else 1
+    T = inputs.shape[axis_t]
+    states = initial_states
+    outs = []
+    steps = range(T - 1, -1, -1) if is_reverse else range(T)
+    lens = sequence_length.numpy() if sequence_length is not None else None
+    for t in steps:
+        x_t = inputs[t] if time_major else inputs[:, t]
+        out, new_states = cell.call(x_t, states, **kwargs)
+        if lens is not None:
+            m = (t < lens).astype('float32').reshape(-1, 1)
+            from ..dygraph.tape import Tensor
+            m_t = Tensor(m, stop_gradient=True)
+            new_flat = [nw * m_t + pv * (1.0 - m_t) for nw, pv in
+                        zip(_flatten(new_states), _flatten(states))]
+            new_states = _pack(states, new_flat)
+            out = _map_structure(lambda o: o * m_t, out)
+        states = new_states
+        outs.append(out)
+    if is_reverse:
+        outs = outs[::-1]
+    stacked = _map_structure(
+        lambda *os: nn_layers.stack(list(os), axis=axis_t), *outs)
+    return stacked, states
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None, sequence_length=None,
+          time_major=False, **kwargs):
+    """Bidirectional rnn: concat of forward and reverse passes."""
+    states_fw, states_bw = (initial_states if initial_states is not None
+                            else (None, None))
+    out_fw, st_fw = rnn(cell_fw, inputs, states_fw, sequence_length,
+                        time_major=time_major, **kwargs)
+    out_bw, st_bw = rnn(cell_bw, inputs, states_bw, sequence_length,
+                        time_major=time_major, is_reverse=True, **kwargs)
+    outputs = tensor_layers.concat([out_fw, out_bw], axis=-1)
+    return outputs, (st_fw, st_bw)
+
+
+# ---------------------------------------------------------------------------
+# dynamic_lstm / dynamic_lstmp / dynamic_gru (ref: layers/nn.py dynamic_lstm)
+# — padded-batch scan ops; `sequence_length` replaces the reference's LoD
+# ---------------------------------------------------------------------------
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation='sigmoid', cell_activation='tanh',
+                 candidate_activation='tanh', dtype='float32', name=None,
+                 sequence_length=None):
+    """input: (B, T, 4*hidden) pre-projected (as in the reference, the x
+    projection is an outside fc); returns (hidden (B,T,D), cell (B,T,D))."""
+    helper = LayerHelper('dynamic_lstm', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    D = size // 4
+    w = helper.create_parameter(helper.param_attr, [D, 4 * D], dtype)
+    b = helper.create_parameter(helper.bias_attr, [4 * D], dtype, is_bias=True)
+    peep = helper.create_parameter(
+        helper.bias_attr, [3 * D], dtype, is_bias=True) if use_peepholes \
+        else None
+    h, c = apply_op_layer(
+        'lstm',
+        {'x': input, 'h0': h_0, 'c0': c_0, 'w_h': w, 'bias': b,
+         'peephole': peep, 'seq_len': sequence_length},
+        {'use_peepholes': use_peepholes, 'is_reverse': is_reverse,
+         'gate_activation': gate_activation,
+         'cell_activation': cell_activation,
+         'candidate_activation': candidate_activation})
+    return h, c
+
+
+def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None, param_attr=None,
+                  bias_attr=None, use_peepholes=True, is_reverse=False,
+                  gate_activation='sigmoid', cell_activation='tanh',
+                  candidate_activation='tanh', proj_activation='tanh',
+                  dtype='float32', name=None, sequence_length=None):
+    """LSTM with recurrent projection (ref: layers/nn.py dynamic_lstmp)."""
+    helper = LayerHelper('dynamic_lstmp', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    D = size // 4
+    w = helper.create_parameter(helper.param_attr, [proj_size, 4 * D], dtype)
+    proj_w = helper.create_parameter(helper.param_attr, [D, proj_size], dtype)
+    b = helper.create_parameter(helper.bias_attr, [4 * D], dtype, is_bias=True)
+    peep = helper.create_parameter(
+        helper.bias_attr, [3 * D], dtype, is_bias=True) if use_peepholes \
+        else None
+    h, c = apply_op_layer(
+        'lstm',
+        {'x': input, 'h0': h_0, 'c0': c_0, 'w_h': w, 'bias': b,
+         'peephole': peep, 'seq_len': sequence_length, 'proj_w': proj_w},
+        {'use_peepholes': use_peepholes, 'is_reverse': is_reverse,
+         'gate_activation': gate_activation,
+         'cell_activation': cell_activation,
+         'candidate_activation': candidate_activation})
+    return h, c
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation='sigmoid',
+                candidate_activation='tanh', h_0=None, origin_mode=False,
+                dtype='float32', name=None, sequence_length=None):
+    """input: (B, T, 3*size) pre-projected; returns hidden (B, T, size)."""
+    helper = LayerHelper('dynamic_gru', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    D = size
+    gate_w = helper.create_parameter(helper.param_attr, [D, 2 * D], dtype)
+    cand_w = helper.create_parameter(helper.param_attr, [D, D], dtype)
+    return apply_op_layer(
+        'gru',
+        {'x': input, 'h0': h_0, 'gate_w': gate_w, 'cand_w': cand_w,
+         'seq_len': sequence_length},
+        {'is_reverse': is_reverse, 'gate_activation': gate_activation,
+         'candidate_activation': candidate_activation,
+         'origin_mode': origin_mode})
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation='tanh', gate_activation='sigmoid',
+             origin_mode=False):
+    """Single GRU step (ref: layers/nn.py gru_unit)."""
+    helper = LayerHelper('gru_unit', param_attr=param_attr,
+                         bias_attr=bias_attr)
+    D = size // 3
+    gate_w = helper.create_parameter(helper.param_attr, [D, 2 * D], 'float32')
+    cand_w = helper.create_parameter(helper.param_attr, [D, D], 'float32')
+    bias = helper.create_parameter(helper.bias_attr, [3 * D], 'float32',
+                                   is_bias=True)
+    return apply_op_layer(
+        'gru_unit',
+        {'x': input, 'h_prev': hidden, 'gate_w': gate_w, 'cand_w': cand_w,
+         'bias': bias},
+        {'activation': activation, 'gate_activation': gate_activation,
+         'origin_mode': origin_mode}, n_outputs=None)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Single LSTM step (ref: layers/nn.py lstm_unit)."""
+    helper = LayerHelper('lstm_unit', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    D = hidden_t_prev.shape[-1]
+    in_d = x_t.shape[-1]
+    w = helper.create_parameter(helper.param_attr, [in_d + D, 4 * D],
+                                'float32')
+    b = helper.create_parameter(helper.bias_attr, [4 * D], 'float32',
+                                is_bias=True)
+    return apply_op_layer(
+        'lstm_unit', {'x': x_t, 'h_prev': hidden_t_prev,
+                      'c_prev': cell_t_prev, 'w': w, 'bias': b},
+        {'forget_bias': float(forget_bias)})
+
+
+# ---------------------------------------------------------------------------
+# beam search (ref: layers/rnn.py BeamSearchDecoder + dynamic_decode)
+# ---------------------------------------------------------------------------
+
+
+def gather_tree(ids, parents):
+    return apply_op_layer('gather_tree', {'ids': ids, 'parents': parents}, {})
+
+
+class BeamSearchDecoder:
+    """ref: layers/rnn.py:758 BeamSearchDecoder. Dense (batch, beam) layout;
+    all shapes static; finished beams extend only with end_token."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- beam layout helpers --
+    def _merge(self, x):
+        """(B, W, ...) → (B*W, ...)"""
+        return nn_layers.reshape(x, shape=[-1] + list(x.shape[2:]))
+
+    def _split(self, x, B):
+        """(B*W, ...) → (B, W, ...)"""
+        return nn_layers.reshape(
+            x, shape=[B, self.beam_size] + list(x.shape[1:]))
+
+    def _expand_to_beam(self, x):
+        """(B, ...) → (B*W, ...) by tiling each row W times."""
+        ex = nn_layers.unsqueeze(x, axes=[1])
+        ex = nn_layers.expand(
+            ex, expand_times=[1, self.beam_size] + [1] * (len(x.shape) - 1))
+        return nn_layers.reshape(ex, shape=[-1] + list(x.shape[1:]))
+
+    def initialize(self, initial_cell_states):
+        flat = _flatten(initial_cell_states)
+        B = flat[0].shape[0]
+        self._batch_size = B
+        W = self.beam_size
+        cell_states = _pack(initial_cell_states,
+                            [self._expand_to_beam(s) for s in flat])
+        start_ids = tensor_layers.fill_constant_array(
+            np.full((B, W), self.start_token, np.int64))
+        inputs = self.embedding_fn(start_ids) if self.embedding_fn \
+            else tensor_layers.cast(start_ids, 'float32')
+        log_probs = tensor_layers.fill_constant_array(
+            np.tile(np.array([0.0] + [-1e9] * (W - 1), np.float32), (B, 1)))
+        finished = tensor_layers.fill_constant_array(
+            np.zeros((B, W), np.float32))  # float mask: StaticRNN-friendly
+        lengths = tensor_layers.fill_constant_array(
+            np.zeros((B, W), np.int64))
+        return inputs, [cell_states, log_probs, finished, lengths]
+
+    def step(self, time, inputs, states):
+        cell_states, log_probs, finished, lengths = states
+        B, W = self._batch_size, self.beam_size
+
+        flat_in = self._merge(inputs) if len(inputs.shape) > 2 else inputs
+        cell_out, next_cell_states = self.cell.call(flat_in, cell_states)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+        V = logits.shape[-1]
+        step_lp = apply_op_layer('log_softmax', {'x': logits}, {})  # (B*W, V)
+        step_lp = self._split(step_lp, B)                  # (B, W, V)
+        # finished beams: only end_token continues, with additive score 0
+        noend = np.full((V,), -1e9, np.float32)
+        noend[self.end_token] = 0.0
+        noend_t = tensor_layers.fill_constant_array(noend.reshape(1, 1, V))
+        fin3 = nn_layers.reshape(finished, shape=[B, W, 1])
+        step_lp = step_lp * (1.0 - fin3) + noend_t * fin3
+        total = nn_layers.reshape(log_probs, shape=[B, W, 1]) + step_lp
+        flat_lp = nn_layers.reshape(total, shape=[B, W * V])
+        top_scores, top_idx = nn_layers.topk(flat_lp, W)   # (B, W)
+        beam_idx = tensor_layers.cast(top_idx, 'int64') // np.int64(V)
+        token_ids = tensor_layers.cast(top_idx, 'int64') % np.int64(V)
+        # gather along the beam dim: flat index = b*W + beam_idx
+        offs = tensor_layers.fill_constant_array(
+            (np.arange(B) * W).reshape(B, 1).astype(np.int64))
+        flat_sel = nn_layers.reshape(beam_idx + offs, shape=[B * W])
+
+        def sel(x):
+            return nn_layers.gather(x, flat_sel)
+
+        next_cell_states = _pack(next_cell_states,
+                                 [sel(s) for s in _flatten(next_cell_states)])
+        fin_flat = nn_layers.reshape(finished, shape=[B * W])
+        len_flat = nn_layers.reshape(lengths, shape=[B * W])
+        prev_fin = nn_layers.reshape(sel(fin_flat), shape=[B, W])
+        prev_len = nn_layers.reshape(sel(len_flat), shape=[B, W])
+        now_end = tensor_layers.cast(
+            nn_layers.reshape(token_ids, shape=[B, W]) == np.int64(self.end_token),
+            'float32')
+        next_finished = nn_layers.elementwise_max(prev_fin, now_end)
+        next_lengths = prev_len + tensor_layers.cast(1.0 - prev_fin, 'int64')
+        next_inputs = self.embedding_fn(token_ids) if self.embedding_fn \
+            else tensor_layers.cast(token_ids, 'float32')
+        outputs = [top_scores, token_ids, beam_idx]
+        next_states = [next_cell_states, top_scores, next_finished,
+                       next_lengths]
+        return outputs, next_states, next_inputs, next_finished
+
+    def finalize(self, outputs, final_states, sequence_lengths=None):
+        """outputs: [scores (T,B,W), token_ids (T,B,W), parent_ids (T,B,W)]
+        → backtraced ids (T, B, W) via gather_tree."""
+        scores, token_ids, parent_ids = outputs
+        ids = gather_tree(token_ids, parent_ids)
+        return ids, scores
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, is_test=True, return_length=False,
+                   **kwargs):
+    """ref: layers/rnn.py:1462 dynamic_decode. Runs decoder.step for a FIXED
+    max_step_num steps (static trip count; finished beams are masked), then
+    decoder.finalize backtraces. Returns (outputs, final_states)
+    [+ lengths if return_length]."""
+    if max_step_num is None:
+        max_step_num = 100
+    initial_inputs, initial_states = decoder.initialize(inits)
+
+    if in_dygraph_mode():
+        return _dynamic_decode_dygraph(decoder, initial_inputs,
+                                       initial_states, max_step_num,
+                                       output_time_major, return_length)
+
+    times = tensor_layers.fill_constant_array(
+        np.arange(max_step_num, dtype=np.int64))
+    srnn = StaticRNN()
+    flat_init = _flatten([initial_inputs, initial_states])
+    with srnn.step():
+        t = srnn.step_input(times)
+        pre = [srnn.memory(init=s) for s in flat_init]
+        inputs, states = _pack([initial_inputs, initial_states], pre)
+        outputs, next_states, next_inputs, next_finished = decoder.step(
+            t, inputs, states, **kwargs)
+        flat_new = _flatten([next_inputs, next_states])
+        for pv, nw in zip(pre, flat_new):
+            srnn.update_memory(pv, nw)
+        for o in _flatten(outputs):
+            srnn.step_output(o)
+    res = srnn()
+    res = res if isinstance(res, list) else [res]
+    outputs_seq = _pack(outputs, res)
+    final = decoder.finalize(outputs_seq, None) \
+        if hasattr(decoder, 'finalize') else (outputs_seq, None)
+    ids, scores = final
+    if not output_time_major:
+        ids = nn_layers.transpose(ids, perm=[1, 0, 2])
+        scores = nn_layers.transpose(scores, perm=[1, 0, 2])
+    if return_length:
+        return ids, scores, None
+    return ids, scores
+
+
+def _dynamic_decode_dygraph(decoder, inputs, states, max_step_num,
+                            output_time_major, return_length):
+    outs_t = []
+    finished_np = None
+    for t in range(max_step_num):
+        from ..dygraph.tape import Tensor
+        t_var = Tensor(np.int64(t), stop_gradient=True)
+        outputs, states, inputs, finished = decoder.step(t_var, inputs, states)
+        outs_t.append(outputs)
+        finished_np = finished.numpy()
+        if finished_np.min() > 0.5:
+            break
+    stacked = _map_structure(lambda *os: nn_layers.stack(list(os), axis=0),
+                             *outs_t)
+    ids, scores = decoder.finalize(stacked, None)
+    if not output_time_major:
+        ids = nn_layers.transpose(ids, perm=[1, 0, 2])
+        scores = nn_layers.transpose(scores, perm=[1, 0, 2])
+    if return_length:
+        return ids, scores, None
+    return ids, scores
+
+
+# ---------------------------------------------------------------------------
+# legacy one-step beam_search API (ref: layers/rnn.py beam_search /
+# beam_search_decode over LoD beams) — dense (B*W) formulation
+# ---------------------------------------------------------------------------
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """One beam-search step over dense (B*W, K) candidates: select top
+    beam_size continuations per batch row. Returns (selected_ids,
+    selected_scores[, parent_idx])."""
+    return apply_op_layer(
+        'beam_search_step',
+        {'pre_ids': pre_ids, 'pre_scores': pre_scores, 'ids': ids,
+         'scores': scores},
+        {'beam_size': beam_size, 'end_id': end_id,
+         'is_accumulated': is_accumulated,
+         'return_parent_idx': return_parent_idx},
+        n_outputs=None)
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+    """Backtrace accumulated (T, B, W) ids/parents — see gather_tree."""
+    return gather_tree(ids, scores)

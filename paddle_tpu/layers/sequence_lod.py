@@ -1,0 +1,137 @@
+"""Sequence layer API (ref: python/paddle/fluid/layers/sequence_lod.py).
+
+The reference's LoD-tensor sequence layers, reformulated for TPU over padded
+(B, T, ...) batches: every layer accepts a `sequence_length` kwarg (a (B,)
+int vector) in place of the LoD offset table. `None` means all rows span the
+full time dim. See ops/sequence_ops.py for the op semantics.
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from ..initializer import XavierInitializer
+from .common import apply_op_layer
+
+__all__ = ['sequence_conv', 'sequence_softmax', 'sequence_pool',
+           'sequence_concat', 'sequence_first_step', 'sequence_last_step',
+           'sequence_slice', 'sequence_expand', 'sequence_expand_as',
+           'sequence_pad', 'sequence_unpad', 'sequence_reshape',
+           'sequence_scatter', 'sequence_enumerate', 'sequence_mask',
+           'sequence_reverse']
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None, sequence_length=None):
+    helper = LayerHelper('sequence_conv', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    D = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                [filter_size * D, num_filters], input.dtype,
+                                default_initializer=XavierInitializer())
+    b = helper.create_parameter(helper.bias_attr, [num_filters], input.dtype,
+                                is_bias=True)
+    out = apply_op_layer(
+        'sequence_conv',
+        {'x': input, 'w': w, 'bias': b, 'length': sequence_length},
+        {'context_length': filter_size, 'context_start': padding_start,
+         'padding': padding})
+    return helper.append_activation(out) if act else out
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, sequence_length=None):
+    return apply_op_layer('sequence_softmax',
+                          {'x': input, 'length': sequence_length}, {},
+                          name=name)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  sequence_length=None):
+    out, _ = apply_op_layer('sequence_pool',
+                            {'x': input, 'length': sequence_length},
+                            {'pool_type': pool_type, 'pad_value': pad_value})
+    return out
+
+
+def sequence_first_step(input, sequence_length=None):
+    return sequence_pool(input, 'first', sequence_length=sequence_length)
+
+
+def sequence_last_step(input, sequence_length=None):
+    return sequence_pool(input, 'last', sequence_length=sequence_length)
+
+
+def sequence_concat(input, name=None, sequence_lengths=None):
+    out, out_len = apply_op_layer(
+        'sequence_concat',
+        {'xs': list(input), 'lens': sequence_lengths},
+        {'n_inputs': len(input)}, name=name)
+    return out
+
+
+def sequence_slice(input, offset, length, name=None, sequence_length=None):
+    out, _ = apply_op_layer(
+        'sequence_slice',
+        {'x': input, 'offset': offset, 'slice_length': length,
+         'length': sequence_length}, {}, name=name)
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None, y_length=None):
+    """Dense broadcast formulation — see ops/sequence_ops.py
+    sequence_expand_as note."""
+    return sequence_expand_as(x, y, name=name, y_length=y_length)
+
+
+def sequence_expand_as(x, y, name=None, y_length=None):
+    return apply_op_layer('sequence_expand_as',
+                          {'x': x, 'y': y, 'y_length': y_length}, {},
+                          name=name)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None, sequence_length=None):
+    out, lens = apply_op_layer(
+        'sequence_pad',
+        {'x': x, 'pad_value': pad_value, 'length': sequence_length},
+        {'maxlen': -1 if maxlen is None else maxlen}, name=name)
+    return out, lens
+
+
+def sequence_unpad(x, length, name=None):
+    return apply_op_layer('sequence_unpad', {'x': x, 'length': length}, {},
+                          name=name)
+
+
+def sequence_reshape(input, new_dim, sequence_length=None):
+    out, _ = apply_op_layer('sequence_reshape',
+                            {'x': input, 'length': sequence_length},
+                            {'new_dim': new_dim})
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None, sequence_length=None):
+    return apply_op_layer(
+        'sequence_scatter',
+        {'x': input, 'index': index, 'updates': updates,
+         'length': sequence_length}, {}, name=name)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None,
+                       sequence_length=None):
+    return apply_op_layer('sequence_enumerate',
+                          {'x': input, 'length': sequence_length},
+                          {'win_size': win_size, 'pad_value': pad_value},
+                          name=name)
+
+
+def sequence_mask(x, maxlen=None, dtype='int64', name=None):
+    if maxlen is None:
+        raise ValueError(
+            "sequence_mask on TPU needs a static maxlen (the reference "
+            "derives it from data at runtime, which is not static-shape)")
+    return apply_op_layer('sequence_mask', {'x': x},
+                          {'maxlen': int(maxlen), 'dtype': dtype}, name=name)
+
+
+def sequence_reverse(x, name=None, sequence_length=None):
+    return apply_op_layer('sequence_reverse',
+                          {'x': x, 'length': sequence_length}, {}, name=name)

@@ -1,0 +1,194 @@
+"""Whole-sequence recurrence ops: lstm / gru / gather_tree.
+
+Parity targets: /root/reference/paddle/fluid/operators/lstm_op.cc,
+gru_op.cc, gather_tree_op.cc. The reference runs per-timestep CUDA kernels
+over LoD-batched sequences; the TPU design runs ONE lax.scan over a padded
+(B, T, ...) batch with a length mask — static shapes, reverse-differentiable,
+fused by XLA into a single loop.
+
+Gate layouts (documented, since weights are created by our own layers —
+checkpoints are not imported from the reference):
+  lstm: projected input x is (B, T, 4D) with gate order [i, f, c̃, o]
+        (ref lstm_op.cc:188 formulas; peepholes are D-vectors W_ic/W_fc/W_oc)
+  gru:  projected input x is (B, T, 3D) with order [u, r, c̃]
+        (ref gru_op.cc:152-155: h_t = (1-u)⊙h_{t-1} + u⊙c̃_t)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+_ACTS = {
+    'sigmoid': jax.nn.sigmoid,
+    'tanh': jnp.tanh,
+    'relu': jax.nn.relu,
+    'identity': lambda x: x,
+}
+
+
+def _mask_step(t, seq_len, new, old):
+    """Keep `new` where t < seq_len else carry `old` (per batch row)."""
+    if seq_len is None:
+        return new
+    keep = (t < seq_len)[:, None]
+    return jnp.where(keep, new, old)
+
+
+@register_op('lstm', outputs=('Hidden', 'Cell'))
+def lstm(x, h0, c0, w_h, bias, peephole=None, seq_len=None, proj_w=None, *,
+         use_peepholes=False, is_reverse=False, gate_activation='sigmoid',
+         cell_activation='tanh', candidate_activation='tanh'):
+    """x: (B, T, 4D) pre-projected input; w_h: (H, 4D) recurrent weight where
+    H = proj size if proj_w given else D; bias: (4D,); peephole: (3D,) as
+    [W_ic, W_fc, W_oc]; proj_w: (D, P) for dynamic_lstmp.
+    Returns Hidden (B, T, H), Cell (B, T, D)."""
+    act_g = _ACTS[gate_activation]
+    act_c = _ACTS[cell_activation]
+    act_cand = _ACTS[candidate_activation]
+    x = jnp.asarray(x)
+    B, T, D4 = x.shape
+    D = D4 // 4
+    if is_reverse:
+        x = jnp.flip(x, axis=1) if seq_len is None else _flip_padded(x, seq_len)
+    xs = jnp.swapaxes(x, 0, 1)  # (T, B, 4D)
+    if use_peepholes and peephole is not None:
+        w_ic, w_fc, w_oc = jnp.split(jnp.asarray(peephole), 3)
+    else:
+        w_ic = w_fc = w_oc = None
+
+    def step(carry, inp):
+        t, h, c = carry
+        x_t = inp
+        gates = x_t + h @ w_h + bias
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if w_ic is not None:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i = act_g(gi)
+        f = act_g(gf)
+        cand = act_cand(gc)
+        c_new = f * c + i * cand
+        if w_oc is not None:
+            go = go + c_new * w_oc
+        o = act_g(go)
+        h_new = o * act_c(c_new)
+        if proj_w is not None:
+            h_new = h_new @ proj_w
+        h_new = _mask_step(t, seq_len, h_new, h)
+        c_new = _mask_step(t, seq_len, c_new, c)
+        return (t + 1, h_new, c_new), (h_new, c_new)
+
+    H = w_h.shape[0]
+    h_init = jnp.zeros((B, H), x.dtype) if h0 is None else jnp.asarray(h0)
+    c_init = jnp.zeros((B, D), x.dtype) if c0 is None else jnp.asarray(c0)
+    _, (hs, cs) = jax.lax.scan(step, (jnp.zeros((), jnp.int32), h_init,
+                                      c_init), xs)
+    hs = jnp.swapaxes(hs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        hs = jnp.flip(hs, 1) if seq_len is None else _flip_padded(hs, seq_len)
+        cs = jnp.flip(cs, 1) if seq_len is None else _flip_padded(cs, seq_len)
+    return hs, cs
+
+
+@register_op('gru')
+def gru(x, h0, gate_w, cand_w, seq_len=None, *, is_reverse=False,
+        gate_activation='sigmoid', candidate_activation='tanh',
+        origin_mode=False):
+    """x: (B, T, 3D) pre-projected [u, r, c̃]; gate_w: (D, 2D) recurrent
+    weight for [u, r]; cand_w: (D, D) for the candidate.
+    origin_mode=True uses h = u*h_prev + (1-u)*c̃ (ref gru_op origin_mode)."""
+    act_g = _ACTS[gate_activation]
+    act_c = _ACTS[candidate_activation]
+    x = jnp.asarray(x)
+    B, T, D3 = x.shape
+    D = D3 // 3
+    if is_reverse:
+        x = jnp.flip(x, axis=1) if seq_len is None else _flip_padded(x, seq_len)
+    xs = jnp.swapaxes(x, 0, 1)
+
+    def step(carry, x_t):
+        t, h = carry
+        xu, xr, xc = jnp.split(x_t, 3, axis=-1)
+        ur = act_g(jnp.concatenate([xu, xr], -1) + h @ gate_w)
+        u, r = jnp.split(ur, 2, axis=-1)
+        c = act_c(xc + (r * h) @ cand_w)
+        h_new = u * h + (1.0 - u) * c if origin_mode \
+            else (1.0 - u) * h + u * c
+        h_new = _mask_step(t, seq_len, h_new, h)
+        return (t + 1, h_new), h_new
+
+    h_init = jnp.zeros((B, D), x.dtype) if h0 is None else jnp.asarray(h0)
+    _, hs = jax.lax.scan(step, (jnp.zeros((), jnp.int32), h_init), xs)
+    hs = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        hs = jnp.flip(hs, 1) if seq_len is None else _flip_padded(hs, seq_len)
+    return hs
+
+
+def _flip_padded(x, seq_len):
+    """Reverse each row's valid prefix, keeping padding in place
+    (the LoD-aware reverse of ref sequence_reverse_op.h)."""
+    B, T = x.shape[0], x.shape[1]
+    t_idx = jnp.arange(T)[None, :]                      # (1, T)
+    lens = jnp.asarray(seq_len).reshape(B, 1)
+    src = jnp.where(t_idx < lens, lens - 1 - t_idx, t_idx)
+    return jnp.take_along_axis(
+        x, src.reshape((B, T) + (1,) * (x.ndim - 2)).astype(jnp.int32),
+        axis=1)
+
+
+@register_op('beam_search_step',
+             outputs=('SelectedIds', 'SelectedScores', 'ParentIdx'))
+def beam_search_step(pre_ids, pre_scores, ids, scores, *, beam_size, end_id,
+                     is_accumulated=True, return_parent_idx=False):
+    """One beam step over dense candidates (ref: beam_search_op.cc, LoD
+    formulation → dense): pre_ids/pre_scores (B*W, 1); ids/scores (B*W, K)
+    per-beam candidates. Finished beams (pre_id == end_id) only continue
+    with end_id at their existing score. Returns (B*W, 1) selections and
+    flat parent indices."""
+    pre_ids = jnp.asarray(pre_ids).reshape(-1)        # (B*W,)
+    pre_scores = jnp.asarray(pre_scores).reshape(-1)
+    ids = jnp.asarray(ids)
+    scores = jnp.asarray(scores)
+    BW, K = scores.shape
+    W = beam_size
+    B = BW // W
+    if not is_accumulated:
+        scores = pre_scores[:, None] + jnp.log(jnp.clip(scores, 1e-20))
+    finished = (pre_ids == end_id)
+    # finished beams: candidate 0 = end_id at pre_score, others -inf
+    fin_scores = jnp.full((BW, K), -1e9, scores.dtype).at[:, 0].set(pre_scores)
+    fin_ids = jnp.full((BW, K), end_id, ids.dtype)
+    scores = jnp.where(finished[:, None], fin_scores, scores)
+    ids = jnp.where(finished[:, None], fin_ids, ids)
+    flat_scores = scores.reshape(B, W * K)
+    top_scores, top_idx = jax.lax.top_k(flat_scores, W)     # (B, W)
+    parent = top_idx // K + (jnp.arange(B) * W)[:, None]    # flat beam index
+    sel_ids = ids.reshape(B, W * K)[jnp.arange(B)[:, None], top_idx]
+    return (sel_ids.reshape(BW, 1).astype(jnp.int64),
+            top_scores.reshape(BW, 1),
+            parent.reshape(BW).astype(jnp.int64))
+
+
+@register_op('gather_tree')
+def gather_tree(ids, parents):
+    """Beam-search backtrace (ref: gather_tree_op.cc): walk parent pointers
+    from the last step to reconstruct full beams. ids/parents: (T, B, W)."""
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    T, B, W = ids.shape
+    b_idx = jnp.arange(B)[:, None]
+
+    def step(carry, inp):
+        parent = carry                       # (B, W) current beam index
+        ids_t, parents_t = inp               # each (B, W)
+        out = ids_t[b_idx, parent]
+        new_parent = parents_t[b_idx, parent]
+        return new_parent, out
+
+    init = jnp.tile(jnp.arange(W)[None, :], (B, 1))
+    _, outs = jax.lax.scan(step, init, (ids, parents), reverse=True)
+    return outs

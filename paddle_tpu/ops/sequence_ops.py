@@ -1,0 +1,258 @@
+"""Sequence (LoD) ops on padded batches with explicit lengths.
+
+Parity targets: /root/reference/paddle/fluid/operators/sequence_ops/* and
+python/paddle/fluid/layers/sequence_lod.py. The reference stores ragged
+batches as LoD tensors (flattened rows + offset table); the TPU formulation
+is a padded (B, T, ...) tensor + a (B,) length vector — static shapes, MXU
+friendly, maskable. Every op takes `length=None` meaning "all rows full".
+
+Valid data is always LEFT-PACKED: row b occupies steps [0, length[b]).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _lens(x, length):
+    B, T = x.shape[0], x.shape[1]
+    if length is None:
+        return jnp.full((B,), T, jnp.int32)
+    return jnp.asarray(length).reshape(B).astype(jnp.int32)
+
+
+def _time_mask(x, length):
+    """(B, T) bool validity mask."""
+    B, T = x.shape[0], x.shape[1]
+    return jnp.arange(T)[None, :] < _lens(x, length)[:, None]
+
+
+@register_op('sequence_mask')
+def sequence_mask(x, *, maxlen=-1, dtype='int64'):
+    """x: (B,) lengths → (B, maxlen) 0/1 mask (ref: sequence_mask_op.h)."""
+    x = jnp.asarray(x).reshape(-1)
+    maxlen = int(maxlen)
+    out = jnp.arange(maxlen)[None, :] < x[:, None]
+    from ..core.dtypes import to_jax_dtype
+    return out.astype(to_jax_dtype(dtype))
+
+
+@register_op('sequence_softmax')
+def sequence_softmax(x, length=None):
+    """Masked softmax over the time dim. x: (B, T) or (B, T, 1)."""
+    x = jnp.asarray(x)
+    squeeze = (x.ndim == 3 and x.shape[-1] == 1)
+    v = x[..., 0] if squeeze else x
+    mask = _time_mask(v, length)
+    v = jnp.where(mask, v, -jnp.inf)
+    out = jax.nn.softmax(v, axis=1)
+    out = jnp.where(mask, out, 0.0)
+    return out[..., None] if squeeze else out
+
+
+@register_op('sequence_pool', outputs=('Out', 'MaxIndex'))
+def sequence_pool(x, length=None, *, pool_type='average', pad_value=0.0):
+    """(B, T, D) → (B, D) pooled over valid steps (ref: sequence_pool_op.h).
+    Empty rows get pad_value. Also returns argmax index (for 'max')."""
+    x = jnp.asarray(x)
+    mask = _time_mask(x, length)[:, :, None]
+    lens = _lens(x, length)
+    pt = pool_type.lower()
+    if pt in ('sum', 'average', 'sqrt'):
+        s = jnp.sum(jnp.where(mask, x, 0.0), axis=1)
+        denom = jnp.maximum(lens, 1).astype(x.dtype)[:, None]
+        if pt == 'average':
+            s = s / denom
+        elif pt == 'sqrt':
+            s = s / jnp.sqrt(denom)
+        out = s
+        idx = jnp.zeros((x.shape[0], x.shape[2]), jnp.int64)
+    elif pt == 'max':
+        neg = jnp.where(mask, x, -jnp.inf)
+        out = jnp.max(neg, axis=1)
+        idx = jnp.argmax(neg, axis=1).astype(jnp.int64)
+    elif pt == 'min':
+        out = jnp.min(jnp.where(mask, x, jnp.inf), axis=1)
+        idx = jnp.zeros((x.shape[0], x.shape[2]), jnp.int64)
+    elif pt in ('first', 'last'):
+        t = jnp.zeros_like(lens) if pt == 'first' \
+            else jnp.maximum(lens - 1, 0)
+        out = jnp.take_along_axis(x, t[:, None, None].astype(jnp.int32),
+                                  axis=1)[:, 0]
+        idx = jnp.broadcast_to(t[:, None], (x.shape[0], x.shape[2]))
+        idx = idx.astype(jnp.int64)
+    else:
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    empty = (lens == 0)[:, None]
+    out = jnp.where(empty, jnp.asarray(pad_value, x.dtype), out)
+    return out, idx
+
+
+@register_op('sequence_reverse')
+def sequence_reverse(x, length=None):
+    """Reverse each valid prefix, padding stays (ref: sequence_reverse_op.h)."""
+    from .rnn_ops import _flip_padded
+    x = jnp.asarray(x)
+    if length is None:
+        return jnp.flip(x, axis=1)
+    return _flip_padded(x, _lens(x, length))
+
+
+@register_op('sequence_concat', outputs=('Out', 'OutLen'), variadic=('xs',))
+def sequence_concat(xs, lens=None, *, n_inputs=0):
+    """Concat per-row valid prefixes of several padded batches, left-packing
+    the result (ref: sequence_concat_op.h). lens: list matching xs or None."""
+    xs = [jnp.asarray(x) for x in xs]
+    B = xs[0].shape[0]
+    lens_list = [_lens(x, None if lens is None else lens[i])
+                 for i, x in enumerate(xs)]
+    T_out = sum(x.shape[1] for x in xs)
+    feat = xs[0].shape[2:]
+    out = jnp.zeros((B, T_out) + feat, xs[0].dtype)
+    offset = jnp.zeros((B,), jnp.int32)
+    b_idx = jnp.arange(B)[:, None]
+    for x, ln in zip(xs, lens_list):
+        T = x.shape[1]
+        t_idx = jnp.arange(T)[None, :]
+        valid = t_idx < ln[:, None]
+        tgt = offset[:, None] + t_idx
+        tgt = jnp.where(valid, tgt, T_out)  # dump slot (dropped by mode)
+        out = out.at[b_idx, tgt].set(x, mode='drop')
+        offset = offset + ln
+    return out, offset
+
+
+@register_op('sequence_pad', outputs=('Out', 'Length'))
+def sequence_pad(x, pad_value, length=None, *, maxlen=-1):
+    """Pad/truncate to maxlen, writing pad_value into invalid slots
+    (ref: sequence_pad_op.h)."""
+    x = jnp.asarray(x)
+    pad = jnp.asarray(pad_value, x.dtype)
+    T = x.shape[1]
+    maxlen = T if maxlen in (-1, None) else int(maxlen)
+    lens = jnp.minimum(_lens(x, length), maxlen)
+    if maxlen > T:
+        cfg = [(0, 0, 0), (0, maxlen - T, 0)] + [(0, 0, 0)] * (x.ndim - 2)
+        x = jax.lax.pad(x, jnp.asarray(0, x.dtype), cfg)
+    elif maxlen < T:
+        x = x[:, :maxlen]
+    mask = jnp.arange(maxlen)[None, :] < lens[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    return jnp.where(mask, x, pad), lens.astype(jnp.int64)
+
+
+@register_op('sequence_unpad')
+def sequence_unpad(x, length):
+    """Zero out positions past each row's length (dense inverse of pad)."""
+    x = jnp.asarray(x)
+    mask = _time_mask(x, length)
+    return jnp.where(mask.reshape(mask.shape + (1,) * (x.ndim - 2)), x, 0.0)
+
+
+@register_op('sequence_reshape', outputs=('Out', 'OutLen'))
+def sequence_reshape(x, length=None, *, new_dim):
+    """Per-row ragged reshape: row of len*D elems → len*D/new_dim rows of
+    new_dim (ref: sequence_reshape_op.h). Works because valid data is
+    left-packed; padding must be zero."""
+    x = jnp.asarray(x)
+    B, T, D = x.shape
+    lens = _lens(x, length)
+    mask = _time_mask(x, length)[:, :, None]
+    x = jnp.where(mask, x, 0.0)
+    T_new = T * D // new_dim
+    out = x.reshape(B, T_new, new_dim)
+    new_lens = (lens * D) // new_dim
+    return out, new_lens.astype(jnp.int64)
+
+
+@register_op('sequence_slice', outputs=('Out', 'OutLen'))
+def sequence_slice(x, offset, slice_length, length=None):
+    """Per-row slice [offset, offset+slice_length), left-packed
+    (ref: sequence_slice_op.h)."""
+    x = jnp.asarray(x)
+    B, T = x.shape[0], x.shape[1]
+    off = jnp.asarray(offset).reshape(B).astype(jnp.int32)
+    sl = jnp.asarray(slice_length).reshape(B).astype(jnp.int32)
+    t_idx = jnp.arange(T)[None, :]
+    src = jnp.clip(off[:, None] + t_idx, 0, T - 1)
+    gathered = jnp.take_along_axis(
+        x, src.reshape((B, T) + (1,) * (x.ndim - 2)), axis=1)
+    valid = t_idx < sl[:, None]
+    valid = valid.reshape((B, T) + (1,) * (x.ndim - 2))
+    return jnp.where(valid, gathered, 0.0), sl.astype(jnp.int64)
+
+
+@register_op('sequence_expand_as')
+def sequence_expand_as(x, y, y_length=None):
+    """Broadcast each row's FIRST valid step of x across y's valid steps
+    (ref: sequence_expand_as_op.h — dense broadcast case; general LoD
+    re-batching is not static-shape representable)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    first = x[:, 0] if x.ndim >= 3 else x  # (B, D) or (B,)
+    if first.ndim == 1:
+        first = first[:, None]
+    out = jnp.broadcast_to(first[:, None, :],
+                           (y.shape[0], y.shape[1], first.shape[-1]))
+    mask = _time_mask(y, y_length)[:, :, None]
+    return jnp.where(mask, out, 0.0)
+
+
+@register_op('sequence_enumerate')
+def sequence_enumerate(x, length=None, *, win_size, pad_value=0):
+    """(B, T) ids → (B, T, win) sliding windows, pad past row end
+    (ref: sequence_enumerate_op.h)."""
+    x = jnp.asarray(x)
+    B, T = x.shape[0], x.shape[1]
+    lens = _lens(x, length)
+    t = jnp.arange(T)[None, :, None]
+    w = jnp.arange(win_size)[None, None, :]
+    src = t + w                                       # (1, T, win)
+    valid = src < lens[:, None, None]
+    src = jnp.clip(src, 0, T - 1)
+    gathered = x[jnp.arange(B)[:, None, None],
+                 jnp.broadcast_to(src, (B, T, win_size))]
+    return jnp.where(valid, gathered, jnp.asarray(pad_value, x.dtype))
+
+
+@register_op('sequence_scatter')
+def sequence_scatter(x, index, updates, length=None):
+    """out[b, index[b,t]] += updates[b,t] for valid t
+    (ref: sequence_scatter_op.h)."""
+    x = jnp.asarray(x)
+    index = jnp.asarray(index)
+    updates = jnp.asarray(updates)
+    B = x.shape[0]
+    mask = _time_mask(index, length)
+    upd = jnp.where(mask, updates, 0.0)
+    b_idx = jnp.arange(B)[:, None]
+    return x.at[b_idx, index].add(upd)
+
+
+@register_op('sequence_conv')
+def sequence_conv(x, w, bias=None, length=None, *, context_length=3,
+                  context_start=None, padding=True):
+    """Context-window conv over time (ref: sequence_conv_op.h): gather the
+    window [t+start, t+start+len) per step (zeros outside the valid prefix),
+    flatten to (B, T, len*D), then one MXU matmul with w (len*D, F)."""
+    x = jnp.asarray(x)
+    B, T, D = x.shape
+    start = -((context_length - 1) // 2) if context_start is None \
+        else context_start
+    lens = _lens(x, length)
+    cols = []
+    t_idx = jnp.arange(T)[None, :]
+    for k in range(context_length):
+        src = t_idx + start + k
+        valid = (src >= 0) & (src < lens[:, None])
+        srcc = jnp.clip(src, 0, T - 1)
+        g = jnp.take_along_axis(x, srcc[:, :, None], axis=1)
+        cols.append(jnp.where(valid[:, :, None], g, 0.0))
+    ctx = jnp.concatenate(cols, axis=-1)              # (B, T, len*D)
+    out = ctx @ jnp.asarray(w)
+    if bias is not None:
+        out = out + bias
+    mask = _time_mask(x, length)[:, :, None]
+    return jnp.where(mask, out, 0.0)
