@@ -105,6 +105,28 @@ class CosineDecay(LearningRateDecay):
         return self.lr * 0.5 * (math.cos(cur_epoch * math.pi / self.epochs) + 1)
 
 
+class LinearLrWarmup(LearningRateDecay):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 begin=1, step=1, dtype='float32'):
+        super().__init__(begin, step)
+        self.lr = learning_rate
+        self.warmup_steps = warmup_steps
+        self.start_lr, self.end_lr = start_lr, end_lr
+
+    def step(self):
+        super().step()
+        if isinstance(self.lr, LearningRateDecay):
+            self.lr.step()
+
+    def create_lr_var(self, n):
+        if n < self.warmup_steps:
+            return self.start_lr + (self.end_lr - self.start_lr) * (
+                n / self.warmup_steps)
+        lr = self.lr
+        return lr.create_lr_var(lr.step_num) if isinstance(
+            lr, LearningRateDecay) else lr
+
+
 class NoamDecay(LearningRateDecay):
     def __init__(self, d_model, warmup_steps, begin=1, step=1, dtype='float32',
                  learning_rate=1.0):

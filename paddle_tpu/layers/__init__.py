@@ -9,6 +9,9 @@ from . import nn, tensor, loss, io, control_flow
 from .rnn import *  # noqa — exports the rnn() function over the module name
 from .sequence_lod import *  # noqa
 from . import sequence_lod
+from .learning_rate_scheduler import *  # noqa
+from . import learning_rate_scheduler
+from . import distributions
 from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()

@@ -52,6 +52,13 @@ def apply_op_layer(op_type, inputs, attrs=None, name=None, n_outputs=None,
     return out_vars[0] if len(out_vars) == 1 else tuple(out_vars)
 
 
+def op_call(op_type, **inputs):
+    """Keyword sugar over apply_op_layer: input slots as kwargs, op attrs
+    under the reserved `attrs` kwarg."""
+    attrs = inputs.pop('attrs', None)
+    return apply_op_layer(op_type, inputs, attrs)
+
+
 def generate_layer_fn(op_type, in_slots=None, doc=''):
     """Make a `fn(x, ..., name=None, **attrs) -> Variable` layer from an op."""
     opdef = get_op(op_type)

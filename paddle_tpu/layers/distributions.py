@@ -1,0 +1,153 @@
+"""Probability distributions (ref: python/paddle/fluid/layers/
+distributions.py — Uniform / Normal / Categorical / MultivariateNormalDiag).
+
+Built on registered ops so every method works in both static graph and
+dygraph, and everything inlines into the jitted step. Sampling routes through
+the framework PRNG plumbing (needs_rng ops), not host RNG.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..framework import Variable, in_dygraph_mode
+from .common import apply_op_layer, op_call as _op
+from .tensor import assign, cast, fill_constant
+
+__all__ = ['Uniform', 'Normal', 'Categorical', 'MultivariateNormalDiag']
+
+
+def _to_var(x, dtype='float32'):
+    if isinstance(x, Variable):
+        return x
+    if in_dygraph_mode():
+        from ..dygraph.base import to_variable
+        return to_variable(np.asarray(x, dtype))
+    arr = np.asarray(x, dtype)
+    if arr.ndim == 0:
+        return fill_constant([1], dtype, float(arr))
+    return assign(arr)
+
+
+class Distribution:
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high); low/high broadcastable floats or Variables."""
+
+    def __init__(self, low, high):
+        self.low = _to_var(low)
+        self.high = _to_var(high)
+
+    def sample(self, shape, seed=0):
+        u = _op('uniform_random',
+                attrs={'shape': list(shape) + list(self.low.shape),
+                       'min': 0.0, 'max': 1.0, 'seed': seed})
+        return self.low + u * (self.high - self.low)
+
+    def entropy(self):
+        return _op('log', x=self.high - self.low)
+
+    def log_prob(self, value):
+        lb = cast(apply_op_layer('greater_equal',
+                                 {'x': value, 'y': self.low}), 'float32')
+        ub = cast(apply_op_layer('less_than', {'x': value, 'y': self.high}),
+                  'float32')
+        return _op('log', x=lb * ub) - _op('log', x=self.high - self.low)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def sample(self, shape, seed=0):
+        z = _op('gaussian_random',
+                attrs={'shape': list(shape) + list(self.loc.shape),
+                       'mean': 0.0, 'std': 1.0, 'seed': seed})
+        return self.loc + z * self.scale
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2.0 * math.pi) + _op('log', x=self.scale)
+
+    def log_prob(self, value):
+        var = self.scale * self.scale
+        return (-1.0 * ((value - self.loc) * (value - self.loc)) / (2.0 * var)
+                - 0.5 * math.log(2.0 * math.pi) - _op('log', x=self.scale))
+
+    def kl_divergence(self, other):
+        """KL(self || other), other a Normal."""
+        var_ratio = self.scale / other.scale
+        var_ratio = var_ratio * var_ratio
+        t1 = (self.loc - other.loc) / other.scale
+        t1 = t1 * t1
+        return 0.5 * (var_ratio + t1 - 1.0 - _op('log', x=var_ratio))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits):
+        self.logits = _to_var(logits)
+
+    def _probs(self):
+        return _op('softmax', x=self.logits)
+
+    def entropy(self):
+        p = self._probs()
+        logp = _op('log', x=p + 1e-12)
+        neg = -1.0 * _op('reduce_sum', x=p * logp,
+                         attrs={'dim': -1, 'keep_dim': False})
+        return neg
+
+    def kl_divergence(self, other):
+        p = self._probs()
+        logp = _op('log', x=p + 1e-12)
+        logq = _op('log', x=other._probs() + 1e-12)
+        return _op('reduce_sum', x=p * (logp - logq),
+                   attrs={'dim': -1, 'keep_dim': False})
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale)); scale is the diagonal covariance-factor matrix
+    (the reference takes a full `scale` matrix and uses only its diagonal
+    determinant/inverse — we use the diagonal directly)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def _diag(self):
+        if len(self.scale.shape) >= 2:
+            return apply_op_layer('matrix_diag_part', {'x': self.scale})
+        return self.scale
+
+    def entropy(self):
+        d = self._diag()
+        k = float(self.loc.shape[-1])
+        logdet = _op('reduce_sum', x=_op('log', x=d + 1e-12),
+                     attrs={'dim': -1, 'keep_dim': False})
+        return 0.5 * k * (1.0 + math.log(2.0 * math.pi)) + 0.5 * logdet
+
+    def kl_divergence(self, other):
+        d1, d2 = self._diag(), other._diag()
+        k = float(self.loc.shape[-1])
+        tr = _op('reduce_sum', x=d1 / d2, attrs={'dim': -1, 'keep_dim': False})
+        diff = other.loc - self.loc
+        quad = _op('reduce_sum', x=diff * diff / d2,
+                   attrs={'dim': -1, 'keep_dim': False})
+        logdet = (_op('reduce_sum', x=_op('log', x=d2 + 1e-12),
+                      attrs={'dim': -1, 'keep_dim': False})
+                  - _op('reduce_sum', x=_op('log', x=d1 + 1e-12),
+                        attrs={'dim': -1, 'keep_dim': False}))
+        return 0.5 * (tr + quad - k + logdet)

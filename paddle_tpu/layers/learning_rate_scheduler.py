@@ -1,0 +1,154 @@
+"""Static-graph learning-rate schedules (ref: python/paddle/fluid/layers/
+learning_rate_scheduler.py).
+
+Each schedule is emitted as ordinary ops over a persistable global step
+counter, so the whole schedule fuses into the jitted train step — there is no
+host-side LR computation per step (the reference recomputes the LR var with
+dedicated ops each `Executor.run` too, but through per-op kernel dispatch).
+
+In dygraph mode every function returns the matching
+`dygraph.learning_rate_scheduler` object, mirroring the reference's
+`in_dygraph_mode()` branches.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..framework import in_dygraph_mode
+from ..core import unique_name
+from .common import op_call as _op
+from .tensor import create_global_var, assign, cast, fill_constant
+from .control_flow import increment, less_than, greater_equal
+
+__all__ = ['exponential_decay', 'natural_exp_decay', 'inverse_time_decay',
+           'polynomial_decay', 'piecewise_decay', 'noam_decay', 'cosine_decay',
+           'linear_lr_warmup']
+
+
+def _decay_step_counter(begin=0):
+    """Global step counter var, +1 every executor run (ref: the
+    `@LR_DECAY_COUNTER@` autoincreased_step_counter). Integer-typed like the
+    reference so long runs never hit float32's 2^24 increment ceiling; cast
+    to float32 for the schedule arithmetic."""
+    counter = create_global_var(
+        [1], begin - 1, 'int64', persistable=True,
+        name=unique_name.generate('lr_decay_counter'))
+    increment(counter, value=1, in_place=True)
+    return cast(counter, 'float32')
+
+
+def _dygraph_sched(cls, *args, **kwargs):
+    from ..dygraph import learning_rate_scheduler as imperate_lr
+    return getattr(imperate_lr, cls)(*args, **kwargs)
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    """lr * d_model^-0.5 * min(step^-0.5, step * warmup^-1.5)."""
+    if in_dygraph_mode():
+        return _dygraph_sched('NoamDecay', d_model, warmup_steps,
+                              learning_rate=learning_rate)
+    step = _decay_step_counter(begin=1)
+    a = _op('pow', x=step, attrs={'factor': -0.5})
+    b = (warmup_steps ** -1.5) * step
+    lr = learning_rate * (d_model ** -0.5) * _op('elementwise_min', x=a, y=b)
+    return lr
+
+
+def _div_steps(step, decay_steps, staircase):
+    div = step / float(decay_steps)
+    if staircase:
+        div = _op('floor', x=div)
+    return div
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    if in_dygraph_mode():
+        return _dygraph_sched('ExponentialDecay', learning_rate, decay_steps,
+                              decay_rate, staircase)
+    step = _decay_step_counter()
+    div = _div_steps(step, decay_steps, staircase)
+    # decay_rate ** div == exp(div * log(decay_rate))
+    return learning_rate * _op('exp', x=div * math.log(decay_rate))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    if in_dygraph_mode():
+        return _dygraph_sched('NaturalExpDecay', learning_rate, decay_steps,
+                              decay_rate, staircase)
+    step = _decay_step_counter()
+    div = _div_steps(step, decay_steps, staircase)
+    return learning_rate * _op('exp', x=(-1.0 * decay_rate) * div)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    if in_dygraph_mode():
+        return _dygraph_sched('InverseTimeDecay', learning_rate, decay_steps,
+                              decay_rate, staircase)
+    step = _decay_step_counter()
+    div = _div_steps(step, decay_steps, staircase)
+    return learning_rate / (1.0 + decay_rate * div)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    if in_dygraph_mode():
+        return _dygraph_sched('PolynomialDecay', learning_rate, decay_steps,
+                              end_learning_rate, power, cycle)
+    step = _decay_step_counter()
+    if cycle:
+        mult = _op('ceil', x=step / float(decay_steps))
+        mult = _op('elementwise_max', x=mult,
+                   y=fill_constant([1], 'float32', 1.0))
+        ds = mult * float(decay_steps)
+    else:
+        ds = fill_constant([1], 'float32', float(decay_steps))
+        step = _op('elementwise_min', x=step, y=ds)
+    base = 1.0 - step / ds
+    frac = _op('pow', x=base, attrs={'factor': float(power)})
+    return (learning_rate - end_learning_rate) * frac + end_learning_rate
+
+
+def piecewise_decay(boundaries, values):
+    """Branch-free piecewise schedule: the LR index is the count of
+    boundaries already passed, gathered from a constant value table (the
+    reference builds a Switch op chain; a gather maps better onto XLA)."""
+    if len(values) - len(boundaries) != 1:
+        raise ValueError("len(values) must be len(boundaries) + 1")
+    if in_dygraph_mode():
+        return _dygraph_sched('PiecewiseDecay', boundaries, values, 0)
+    step = _decay_step_counter()
+    bounds = assign(np.asarray(boundaries, 'float32'))
+    table = assign(np.asarray(values, 'float32'))
+    passed = cast(greater_equal(step, bounds), 'float32')
+    idx = cast(_op('reduce_sum', x=passed, attrs={'keep_dim': True}), 'int32')
+    return _op('gather', x=table, index=idx)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    if in_dygraph_mode():
+        return _dygraph_sched('CosineDecay', learning_rate, step_each_epoch,
+                              epochs)
+    step = _decay_step_counter()
+    cur_epoch = _op('floor', x=step / float(step_each_epoch))
+    return learning_rate * 0.5 * (
+        _op('cos', x=cur_epoch * (math.pi / epochs)) + 1.0)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """Linear ramp start_lr→end_lr for warmup_steps, then `learning_rate`
+    (which may itself be a schedule Variable). Select is computed as a mask
+    blend — no control flow inside the compiled step."""
+    if in_dygraph_mode():
+        return _dygraph_sched('LinearLrWarmup', learning_rate, warmup_steps,
+                              start_lr, end_lr)
+    step = _decay_step_counter()
+    if not hasattr(learning_rate, 'name'):   # python float → const var
+        learning_rate = fill_constant([1], 'float32', float(learning_rate))
+    warm = start_lr + (end_lr - start_lr) * (step / float(warmup_steps))
+    in_warmup = cast(less_than(step, fill_constant([1], 'float32',
+                                                   float(warmup_steps))),
+                     'float32')
+    return in_warmup * warm + (1.0 - in_warmup) * learning_rate

@@ -12,59 +12,68 @@ from .registry import register_op
 from ..core.dtypes import to_jax_dtype
 
 
+def _seeded(key, seed):
+    """Paddle semantics: seed==0 → framework PRNG stream; else deterministic."""
+    return jax.random.PRNGKey(seed) if seed else key
+
+
 @register_op('uniform_random', needs_rng=True)
-def uniform_random(*, shape, min=-1.0, max=1.0, dtype='float32', key=None):
-    return jax.random.uniform(key, tuple(shape), to_jax_dtype(dtype), min, max)
+def uniform_random(*, shape, min=-1.0, max=1.0, dtype='float32', seed=0,
+                   key=None):
+    return jax.random.uniform(_seeded(key, seed), tuple(shape),
+                              to_jax_dtype(dtype), min, max)
 
 
 @register_op('gaussian_random', needs_rng=True)
-def gaussian_random(*, shape, mean=0.0, std=1.0, dtype='float32', key=None):
-    return mean + std * jax.random.normal(key, tuple(shape), to_jax_dtype(dtype))
+def gaussian_random(*, shape, mean=0.0, std=1.0, dtype='float32', seed=0,
+                    key=None):
+    return mean + std * jax.random.normal(_seeded(key, seed), tuple(shape),
+                                          to_jax_dtype(dtype))
 
 
 @register_op('truncated_gaussian_random', needs_rng=True)
-def truncated_gaussian_random(*, shape, mean=0.0, std=1.0, dtype='float32', key=None):
+def truncated_gaussian_random(*, shape, mean=0.0, std=1.0, dtype='float32', seed=0, key=None):
     return mean + std * jax.random.truncated_normal(
-        key, -2.0, 2.0, tuple(shape), to_jax_dtype(dtype))
+        _seeded(key, seed), -2.0, 2.0, tuple(shape), to_jax_dtype(dtype))
 
 
 @register_op('randint', needs_rng=True)
-def randint(*, shape, low, high, dtype='int64', key=None):
-    return jax.random.randint(key, tuple(shape), low, high, to_jax_dtype(dtype))
+def randint(*, shape, low, high, dtype='int64', seed=0, key=None):
+    return jax.random.randint(_seeded(key, seed), tuple(shape), low, high, to_jax_dtype(dtype))
 
 
 @register_op('randperm', needs_rng=True)
-def randperm(*, n, dtype='int64', key=None):
-    return jax.random.permutation(key, n).astype(to_jax_dtype(dtype))
+def randperm(*, n, dtype='int64', seed=0, key=None):
+    return jax.random.permutation(_seeded(key, seed), n).astype(to_jax_dtype(dtype))
 
 
 @register_op('uniform_random_batch_size_like', needs_rng=True)
 def uniform_random_batch_size_like(ref, *, shape, min=-1.0, max=1.0,
                                    input_dim_idx=0, output_dim_idx=0,
-                                   dtype='float32', key=None):
+                                   dtype='float32', seed=0, key=None):
     shape = list(shape)
     shape[output_dim_idx] = jnp.asarray(ref).shape[input_dim_idx]
-    return jax.random.uniform(key, tuple(shape), to_jax_dtype(dtype), min, max)
+    return jax.random.uniform(_seeded(key, seed), tuple(shape), to_jax_dtype(dtype), min, max)
 
 
 @register_op('gaussian_random_batch_size_like', needs_rng=True)
 def gaussian_random_batch_size_like(ref, *, shape, mean=0.0, std=1.0,
                                     input_dim_idx=0, output_dim_idx=0,
-                                    dtype='float32', key=None):
+                                    dtype='float32', seed=0, key=None):
     shape = list(shape)
     shape[output_dim_idx] = jnp.asarray(ref).shape[input_dim_idx]
-    return mean + std * jax.random.normal(key, tuple(shape), to_jax_dtype(dtype))
+    return mean + std * jax.random.normal(_seeded(key, seed), tuple(shape), to_jax_dtype(dtype))
 
 
 @register_op('sampling_id', needs_rng=True)
-def sampling_id(x, *, key=None):
+def sampling_id(x, *, seed=0, key=None):
     """Sample category ids from probability rows (ref: sampling_id_op.cc)."""
     x = jnp.asarray(x)
-    return jax.random.categorical(key, jnp.log(jnp.maximum(x, 1e-20)), axis=-1)
+    return jax.random.categorical(_seeded(key, seed), jnp.log(jnp.maximum(x, 1e-20)), axis=-1)
 
 
 @register_op('random_crop', needs_rng=True)
-def random_crop(x, *, shape, key=None):
+def random_crop(x, *, shape, seed=0, key=None):
     """ref: random_crop_op.cc — random spatial crop to `shape` (trailing dims)."""
     x = jnp.asarray(x)
     ndim_crop = len(shape)
@@ -72,7 +81,7 @@ def random_crop(x, *, shape, key=None):
     for i, s in enumerate(shape):
         dim = x.ndim - ndim_crop + i
         limit = x.shape[dim] - s
-        k = jax.random.fold_in(key, i)
+        k = jax.random.fold_in(_seeded(key, seed), i)
         starts.append(jax.random.randint(k, (), 0, limit + 1))
     start_idx = [jnp.asarray(0)] * (x.ndim - ndim_crop) + starts
     sizes = list(x.shape[:x.ndim - ndim_crop]) + list(shape)
@@ -80,7 +89,7 @@ def random_crop(x, *, shape, key=None):
 
 
 @register_op('shuffle_batch', needs_rng=True)
-def shuffle_batch(x, *, key=None):
+def shuffle_batch(x, *, seed=0, key=None):
     x = jnp.asarray(x)
-    perm = jax.random.permutation(key, x.shape[0])
+    perm = jax.random.permutation(_seeded(key, seed), x.shape[0])
     return jnp.take(x, perm, axis=0)

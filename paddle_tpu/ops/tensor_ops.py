@@ -400,3 +400,9 @@ def temporal_shift(x, *, seg_num, shift_ratio=0.25):
     bwd = jnp.concatenate([jnp.zeros_like(x[:, :1, c1:2 * c1]), x[:, :-1, c1:2 * c1]], 1)
     keep = x[:, :, 2 * c1:]
     return jnp.concatenate([fwd, bwd, keep], 2).reshape(nt, c, h, w)
+
+
+@register_op('matrix_diag_part')
+def matrix_diag_part(x):
+    """Diagonal of the last two dims (used by MultivariateNormalDiag)."""
+    return jnp.diagonal(jnp.asarray(x), axis1=-2, axis2=-1)

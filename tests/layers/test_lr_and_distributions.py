@@ -1,0 +1,133 @@
+"""LR schedules (static counter-driven) and layers.distributions."""
+import math
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.layers import distributions as D
+
+
+def _run_steps(build_lr, n):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        lr = build_lr()
+    exe = fluid.Executor()
+    out = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(start)
+        for _ in range(n):
+            out.append(float(exe.run(main, fetch_list=[lr])[0]))
+    return out
+
+
+def test_exponential_decay():
+    got = _run_steps(lambda: layers.exponential_decay(0.1, 10, 0.5), 3)
+    want = [0.1 * 0.5 ** (i / 10) for i in range(3)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_piecewise_decay():
+    got = _run_steps(lambda: layers.piecewise_decay([2, 4], [1.0, 0.5, 0.1]), 6)
+    np.testing.assert_allclose(got, [1.0, 1.0, 0.5, 0.5, 0.1, 0.1], rtol=1e-6)
+
+
+def test_noam_decay():
+    got = _run_steps(lambda: layers.noam_decay(512, 4000), 2)
+    want = [512 ** -0.5 * min(n ** -0.5, n * 4000 ** -1.5) for n in (1, 2)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_polynomial_and_cosine_and_warmup():
+    got = _run_steps(lambda: layers.polynomial_decay(0.1, 10, 0.01, 2.0), 2)
+    want = [(0.1 - 0.01) * (1 - i / 10) ** 2 + 0.01 for i in range(2)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    got = _run_steps(lambda: layers.cosine_decay(0.1, 2, 4), 3)
+    want = [0.1 * 0.5 * (math.cos(math.floor(i / 2) * math.pi / 4) + 1)
+            for i in range(3)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    got = _run_steps(
+        lambda: layers.linear_lr_warmup(0.1, 3, 0.0, 0.1), 5)
+    want = [0.0, 0.1 / 3, 0.2 / 3, 0.1, 0.1]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+
+
+def test_inverse_and_natural_exp_decay():
+    got = _run_steps(lambda: layers.inverse_time_decay(0.1, 5, 0.5, True), 7)
+    want = [0.1 / (1 + 0.5 * (i // 5)) for i in range(7)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    got = _run_steps(lambda: layers.natural_exp_decay(0.1, 5, 0.5), 3)
+    want = [0.1 * math.exp(-0.5 * i / 5) for i in range(3)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def _fetch(build):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        outs = build()
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(start)
+        return exe.run(main, fetch_list=list(outs))
+
+
+def test_normal_distribution():
+    ent, lp, kl = _fetch(lambda: (
+        D.Normal(0.0, 2.0).entropy(),
+        D.Normal(0.0, 2.0).log_prob(layers.fill_constant([1], 'float32', 1.0)),
+        D.Normal(0.0, 2.0).kl_divergence(D.Normal(1.0, 1.0))))
+    np.testing.assert_allclose(ent, 0.5 + 0.5 * math.log(2 * math.pi)
+                               + math.log(2.0), rtol=1e-5)
+    np.testing.assert_allclose(
+        lp, -1.0 / 8 - 0.5 * math.log(2 * math.pi) - math.log(2.0), rtol=1e-5)
+    np.testing.assert_allclose(kl, 0.5 * (4 + 1 - 1 - math.log(4.0)), rtol=1e-5)
+
+
+def test_uniform_sample_and_categorical():
+    s, = _fetch(lambda: D.Uniform(1.0, 3.0).sample([1000], seed=7))
+    assert s.shape == (1000, 1) and s.min() >= 1.0 and s.max() <= 3.0
+    ent, kl = _fetch(lambda: (
+        D.Categorical(layers.fill_constant([4], 'float32', 0.0)).entropy(),
+        D.Categorical(layers.fill_constant([4], 'float32', 0.0)).kl_divergence(
+            D.Categorical(layers.fill_constant([4], 'float32', 1.0)))))
+    np.testing.assert_allclose(ent, math.log(4.0), rtol=1e-4)
+    np.testing.assert_allclose(kl, 0.0, atol=1e-5)
+
+
+def test_mvn_diag():
+    ent, kl = _fetch(lambda: (
+        D.MultivariateNormalDiag(layers.zeros([2], 'float32'),
+                                 layers.ones([2], 'float32')).entropy(),
+        D.MultivariateNormalDiag(layers.zeros([2], 'float32'),
+                                 layers.ones([2], 'float32')).kl_divergence(
+            D.MultivariateNormalDiag(layers.zeros([2], 'float32'),
+                                     layers.ones([2], 'float32')))))
+    np.testing.assert_allclose(ent, 0.5 * 2 * (1 + math.log(2 * math.pi)),
+                               rtol=1e-4)
+    np.testing.assert_allclose(kl, 0.0, atol=1e-4)
+
+
+def test_dygraph_warmup_steps_inner_schedule():
+    from paddle_tpu.dygraph.learning_rate_scheduler import (
+        LinearLrWarmup, NaturalExpDecay)
+    sched = LinearLrWarmup(NaturalExpDecay(0.1, 10, 0.5), 3, 0.0, 0.1, begin=0)
+    vals = []
+    for _ in range(6):
+        vals.append(float(sched()))
+        sched.step()
+    want = [0.0, 0.1 / 3, 0.2 / 3] + [0.1 * math.exp(-0.5 * n / 10)
+                                      for n in (3, 4, 5)]
+    np.testing.assert_allclose(vals, want, rtol=1e-6)
+
+
+def test_dygraph_schedulers():
+    with fluid.dygraph.guard():
+        sched = layers.piecewise_decay([2, 4], [1.0, 0.5, 0.1])
+        vals = []
+        for _ in range(5):
+            vals.append(float(sched()))
+            sched.step()
+    np.testing.assert_allclose(vals, [1.0, 1.0, 0.5, 0.5, 0.1], rtol=1e-6)
