@@ -20,6 +20,13 @@ def apply_op_layer(op_type, inputs, attrs=None, name=None, n_outputs=None,
     eagerly through the tape instead (one code path for both modes, like the
     reference's `in_dygraph_mode()` branches in each layer).
     """
+    if inputs.get('length', 'absent') is None:
+        # lod_reset parity: a var carrying a `sequence_length` attribute
+        # feeds it to any sequence op that wasn't given lengths explicitly
+        for v in inputs.values():
+            if isinstance(v, Variable) and hasattr(v, 'sequence_length'):
+                inputs = dict(inputs, length=v.sequence_length)
+                break
     if in_dygraph_mode():
         from ..dygraph.tape import dispatch_op
         return dispatch_op(op_type, inputs, attrs or {})

@@ -110,6 +110,8 @@ def _pack_like(template, flat):
     it = iter(flat)
 
     def rec(t):
+        if isinstance(t, tuple) and hasattr(t, '_fields'):   # namedtuple
+            return type(t)(*[rec(e) for e in t])
         if isinstance(t, (list, tuple)):
             return type(t)(rec(e) for e in t)
         return next(it)
@@ -590,3 +592,251 @@ def is_empty(x, cond=None):
     if cond is not None:
         return assign_to(out, cond)
     return out
+
+
+# ---------------------------------------------------------------------------
+# legacy block-style control flow (ref: fluid.layers.Switch / IfElse /
+# DynamicRNN / lod_rank_table / reorder_lod_tensor_by_rank)
+# ---------------------------------------------------------------------------
+
+
+class Switch:
+    """ref: control_flow.py:Switch — imperative first-true-wins case chain
+    (the classic LR-schedule construct). Each case body is captured into a
+    sub-block at `with switch.case(cond)` time; on exit the chain lowers to
+    nested __cond__ ops (lax.cond), merging parent-var writes."""
+
+    def __init__(self, name=None):
+        self._cases = []          # [(cond_var, block)]
+        self._default = None
+        self._inside = False
+
+    def __enter__(self):
+        self._inside = True
+        return self
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        if not self._inside:
+            raise ValueError("Switch.case must be used inside 'with switch'")
+        program = default_main_program()
+        with _sub_block(program) as blk:
+            yield
+        self._cases.append((condition, blk))
+
+    @contextlib.contextmanager
+    def default(self):
+        program = default_main_program()
+        with _sub_block(program) as blk:
+            yield
+        self._default = blk
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self._inside = False
+        if exc_type is not None:
+            return False
+        if not self._cases:
+            if self._default is not None:
+                raise ValueError(
+                    "Switch: a default block requires at least one case")
+            return False
+        program = default_main_program()
+        helper = LayerHelper('switch')
+
+        def emit(i):
+            """Append the __cond__ op for case i into the current block."""
+            cvar, tblk = self._cases[i]
+            if i == len(self._cases) - 1:
+                if self._default is not None:
+                    fblk = self._default
+                else:
+                    with _sub_block(program) as fblk:
+                        pass
+            else:
+                with _sub_block(program) as fblk:
+                    emit(i + 1)
+            writes = _parent_writes(tblk)
+            writes += [w for w in _parent_writes(fblk) if w not in writes]
+            helper.append_op(
+                type='__cond__', inputs={'Cond': cvar.name},
+                outputs={'Out': writes},
+                attrs={'true_block': tblk.idx, 'false_block': fblk.idx,
+                       'true_outs': [], 'false_outs': [], 'writes': writes})
+
+        emit(0)
+        return False
+
+
+class IfElse:
+    """ref: control_flow.py:IfElse — batch-partition branching. The reference
+    physically splits rows by the bool mask, runs each branch on its
+    sub-batch, and merges. TPU formulation: both branches compute over the
+    FULL batch (static shapes) and outputs merge rowwise with where(mask) —
+    identical results for the rowwise computations this API serves."""
+
+    def __init__(self, cond, name=None):
+        self._cond = cond
+        self._in_true = None
+        self._outs = {True: [], False: []}
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._in_true = True
+        yield
+        self._in_true = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._in_true = False
+        yield
+        self._in_true = None
+
+    def input(self, x):
+        return x
+
+    def output(self, *outs):
+        if self._in_true is None:
+            raise ValueError("IfElse.output must be called inside a block")
+        self._outs[self._in_true].extend(outs)
+
+    def __call__(self):
+        t, f = self._outs[True], self._outs[False]
+        if len(t) != len(f):
+            raise ValueError(
+                f"IfElse: true block produced {len(t)} outputs, false block "
+                f"{len(f)}; they must match")
+        from .tensor import cast
+        merged = []
+        for tv, fv in zip(t, f):
+            m = cast(self._cond, tv.dtype)
+            # mask is (B, 1); broadcasts over trailing dims
+            merged.append(tv * m + fv * (1.0 - m))
+        return merged
+
+
+class DynamicRNN:
+    """ref: control_flow.py:DynamicRNN — RNN builder over variable-length
+    batches. The reference sorts rows by length and shrinks the batch as
+    sequences end; the TPU formulation runs a fixed T-step StaticRNN over
+    the padded batch and freezes finished rows' memories via masking (static
+    shapes, no re-sorting)."""
+
+    def __init__(self, name=None):
+        self._srnn = StaticRNN()
+        self._lens = None
+        self._t = None
+        self._T = None
+        self._B = None
+        self._x_ref = None
+
+    @contextlib.contextmanager
+    def block(self):
+        with self._srnn.step():
+            yield
+
+    @contextlib.contextmanager
+    def _parent_block(self):
+        """Emit ops into the block enclosing the step body: scan sequence
+        inputs must be parent-block vars."""
+        program = default_main_program()
+        cur = program.current_block_idx
+        program.current_block_idx = self._srnn._block.parent_idx
+        try:
+            yield
+        finally:
+            program.current_block_idx = cur
+
+    def step_input(self, x, level=0, sequence_length=None):
+        """x: (B, T, D) padded batch (+ lengths via kwarg or lod_reset)."""
+        from .nn import transpose
+        if self._lens is None:
+            self._lens = sequence_length if sequence_length is not None \
+                else getattr(x, 'sequence_length', None)
+        self._x_ref = x
+        with self._parent_block():
+            xt = transpose(x, perm=[1, 0] + list(range(2, len(x.shape))))
+            self._T = xt.shape[0]
+            self._B = xt.shape[1]
+            if self._t is None:
+                import numpy as np
+                from .tensor import fill_constant_array
+                times = fill_constant_array(np.arange(self._T, dtype=np.int64))
+        if self._t is None:
+            self._t = self._srnn.step_input(times)
+        return self._srnn.step_input(xt)
+
+    def static_input(self, x):
+        return x
+
+    @property
+    def step_idx(self):
+        return self._t
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype='float32'):
+        if init is None:
+            if shape is None or self._x_ref is None:
+                raise ValueError("DynamicRNN.memory(shape=...) must follow "
+                                 "step_input (batch size comes from it)")
+            from .tensor import fill_constant, fill_constant_batch_size_like
+            with self._parent_block():
+                if isinstance(self._B, int) and self._B > 0:
+                    init = fill_constant([self._B] + list(shape), dtype,
+                                         float(value))
+                else:   # symbolic batch: size comes from the input at run time
+                    init = fill_constant_batch_size_like(
+                        self._x_ref, [-1] + list(shape), dtype, float(value))
+            if getattr(init, 'shape', None) is None:
+                init.shape = tuple([-1] + list(shape))
+        return self._srnn.memory(init=init)
+
+    def update_memory(self, mem, new):
+        if self._lens is not None and self._t is not None:
+            from .tensor import cast
+            from .nn import reshape
+            alive = cast(
+                apply_op_layer('less_than',
+                               {'x': self._t,
+                                'y': cast(self._lens, 'int64')}), new.dtype)
+            rank = len(new.shape if new.shape is not None else mem.shape)
+            alive = reshape(alive, shape=[-1] + [1] * (rank - 1))
+            new = new * alive + mem * (1.0 - alive)
+        self._srnn.update_memory(mem, new)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self._srnn.step_output(o)
+
+    def __call__(self):
+        from .nn import transpose
+        res = self._srnn()
+        outs = res if isinstance(res, list) else [res]
+        outs = [transpose(o, perm=[1, 0] + list(range(2, len(o.shape))))
+                for o in outs]
+        for o in outs:
+            if self._lens is not None:
+                o.sequence_length = self._lens
+        return outs[0] if len(outs) == 1 else outs
+
+
+def lod_rank_table(x, level=0):
+    """Rank table = rows sorted by descending length. Returns the (B,)
+    permutation indices (the padded-batch analogue of the reference's
+    LoDRankTable)."""
+    lens = getattr(x, 'sequence_length', None)
+    if lens is None:
+        raise ValueError("lod_rank_table: input carries no sequence_length "
+                         "(use lod_reset or pass lengths)")
+    neg = apply_op_layer('scale', {'x': lens}, {'scale': -1.0})
+    _, idx = apply_op_layer('argsort', {'x': neg}, {'axis': 0})
+    return idx
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Permute batch rows by a lod_rank_table permutation."""
+    out = apply_op_layer('gather', {'x': x, 'index': rank_table})
+    return out
+
+
+__all__ += ['Switch', 'IfElse', 'DynamicRNN', 'lod_rank_table',
+            'reorder_lod_tensor_by_rank']

@@ -612,3 +612,298 @@ __all__ += ['fc', 'embedding', 'conv2d', 'conv3d', 'conv2d_transpose',
             'stack', 'concat', 'affine_grid', 'image_resize', 'resize_bilinear',
             'resize_nearest', 'resize_trilinear', 'image_resize_short', 'crop',
             'crop_tensor', 'unique', 'unique_with_counts']
+
+
+# ---------------------------------------------------------------------------
+# long-tail nn layers (SURVEY §2.2/§2.3 gap fill)
+# ---------------------------------------------------------------------------
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """ref: layers/nn.py:linear_chain_crf. Creates the (N+2, N) transition
+    parameter (rows 0/1 = start/stop) and returns the per-sequence NLL."""
+    helper = LayerHelper('linear_chain_crf', param_attr=param_attr)
+    n = input.shape[-1]
+    transition = helper.create_parameter(helper.param_attr, [n + 2, n],
+                                         input.dtype)
+    nll, _, _, _ = apply_op_layer(
+        'linear_chain_crf',
+        {'emission': input, 'transition': transition, 'label': label,
+         'length': length})
+    return nll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode with the transition param created by linear_chain_crf.
+    `param_attr` may be the ParamAttr (looked up by name) or the variable."""
+    from ..framework import Variable as _V
+    if isinstance(param_attr, _V):
+        transition = param_attr
+    else:
+        name = param_attr.name if hasattr(param_attr, 'name') else param_attr
+        transition = helper_block_var(name)
+    return apply_op_layer('crf_decoding',
+                          {'emission': input, 'transition': transition,
+                           'length': length})
+
+
+def helper_block_var(name):
+    from ..framework import default_main_program
+    return default_main_program().global_block().var(name)
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    return apply_op_layer(
+        'chunk_eval',
+        {'inference': input, 'label': label, 'length': seq_length},
+        {'num_chunk_types': num_chunk_types, 'chunk_scheme': chunk_scheme,
+         'excluded_chunk_types': list(excluded_chunk_types or [])})
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=-1,
+                       name=None):
+    out, lens = apply_op_layer('ctc_greedy_decoder',
+                               {'x': input, 'length': input_length},
+                               {'blank': blank,
+                                'padding_value': padding_value}, name=name)
+    if input_length is None:
+        return out
+    return out, lens
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """TPU formulation: returns the data with a fresh (B,) `sequence_length`
+    attribute (offsets→lengths) that sequence layers pick up implicitly."""
+    out, lens = apply_op_layer('lod_reset', {'x': x, 'y': y},
+                               {'target_lod': target_lod})
+    out.sequence_length = lens
+    return out
+
+
+def lod_append(x, level):
+    return lod_reset(x, target_lod=level if isinstance(level, (list, tuple))
+                     else None, y=None if isinstance(level, (list, tuple))
+                     else level)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format='NCHW'):
+    if data_format == 'NHWC':   # op normalizes across dim 1 (channels)
+        input = transpose(input, perm=[0, 3, 1, 2])
+    out = apply_op_layer('lrn', {'x': input},
+                         {'n': n, 'k': k, 'alpha': alpha, 'beta': beta},
+                         name=name)
+    if data_format == 'NHWC':
+        out = transpose(out, perm=[0, 2, 3, 1])
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             rois_num=None, batch_ids=None, name=None):
+    out, _ = apply_op_layer('roi_pool',
+                            {'x': input, 'rois': rois,
+                             'batch_ids': batch_ids},
+                            {'pooled_height': pooled_height,
+                             'pooled_width': pooled_width,
+                             'spatial_scale': spatial_scale}, name=name)
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              batch_ids=None, name=None):
+    return apply_op_layer('roi_align',
+                          {'x': input, 'rois': rois, 'batch_ids': batch_ids},
+                          {'pooled_height': pooled_height,
+                           'pooled_width': pooled_width,
+                           'spatial_scale': spatial_scale,
+                           'sampling_ratio': sampling_ratio}, name=name)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, batch_ids=None, name=None):
+    return apply_op_layer('psroi_pool',
+                          {'x': input, 'rois': rois, 'batch_ids': batch_ids},
+                          {'output_channels': output_channels,
+                           'spatial_scale': spatial_scale,
+                           'pooled_height': pooled_height,
+                           'pooled_width': pooled_width}, name=name)
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, batch_ids=None,
+               name=None):
+    return apply_op_layer('prroi_pool',
+                          {'x': input, 'rois': rois, 'batch_ids': batch_ids},
+                          {'spatial_scale': spatial_scale,
+                           'pooled_height': pooled_height,
+                           'pooled_width': pooled_width}, name=name)
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
+                    padding=0, dilation=1, groups=1, deformable_groups=1,
+                    im2col_step=1, param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    """ref: layers/nn.py:deformable_conv (v2 when modulated, v1 otherwise)."""
+    helper = LayerHelper('deformable_conv', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    c_in = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = helper.create_parameter(helper.param_attr,
+                                [num_filters, c_in // groups, fs[0], fs[1]],
+                                input.dtype)
+    out = apply_op_layer(
+        'deformable_conv',
+        {'x': input, 'offset': offset, 'mask': mask, 'weight': w},
+        {'stride': stride, 'padding': padding, 'dilation': dilation,
+         'groups': groups, 'deformable_groups': deformable_groups,
+         'im2col_step': im2col_step, 'modulated': modulated})
+    b = helper.create_parameter(helper.bias_attr, [num_filters],
+                                input.dtype, is_bias=True)
+    if b is not None:
+        out = apply_op_layer('elementwise_add', {'x': out, 'y': b},
+                             {'axis': 1})
+    return out
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=[1, 1],
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=False,
+                           batch_ids=None, name=None):
+    oc = input.shape[1] if not position_sensitive \
+        else input.shape[1] // (pooled_height * pooled_width)
+    ps = part_size[0] if isinstance(part_size, (list, tuple)) else part_size
+    return apply_op_layer(
+        'deformable_roi_pooling',
+        {'x': input, 'rois': rois, 'trans': trans, 'batch_ids': batch_ids},
+        {'no_trans': no_trans, 'spatial_scale': spatial_scale,
+         'output_channels': oc,
+         'group_size': group_size[0] if isinstance(group_size, (list, tuple))
+         else group_size,
+         'pooled_height': pooled_height, 'pooled_width': pooled_width,
+         'part_size': ps, 'sample_per_part': sample_per_part,
+         'trans_std': trans_std}, name=name)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    return apply_op_layer('scatter_nd', {'index': index, 'updates': updates},
+                          {'shape': list(shape)}, name=name)
+
+
+def sum(x):
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return apply_op_layer('sum', {'xs': list(xs)})
+
+
+def shape(input):
+    return apply_op_layer('shape', {'x': input}, dtype='int32')
+
+
+def rank(input):
+    return apply_op_layer('rank', {'x': input}, dtype='int32')
+
+
+def size(input):
+    return apply_op_layer('size', {'x': input}, dtype='int64')
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return apply_op_layer('similarity_focus', {'x': input},
+                          {'axis': axis, 'indexes': list(indexes)}, name=name)
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    return apply_op_layer('hash', {'x': input},
+                          {'num_hash': num_hash, 'mod_by': hash_size},
+                          name=name, dtype='int64')
+
+
+def merge_selected_rows(x, name=None):
+    return apply_op_layer('merge_selected_rows', {'x': x}, name=name)
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    return apply_op_layer('get_tensor_from_selected_rows', {'x': x},
+                          name=name)
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    return apply_op_layer('cvm', {'x': input, 'cvm_in': cvm},
+                          {'use_cvm': use_cvm})
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=False,
+                     out_val_if_empty=0):
+    return apply_op_layer('filter_by_instag',
+                          {'x': ins, 'ins_tag': ins_tag,
+                           'filter_tag': filter_tag},
+                          {'is_lod': is_lod,
+                           'out_val_if_empty': out_val_if_empty})
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python escape hatch (ref: layers/nn.py:py_func). The callable
+    runs via jax.pure_callback inside the compiled step; `out` var(s) you
+    pre-create via create_variable define the result shapes/dtypes.
+    backward_func is accepted for API parity; gradients stop at the callback
+    (register a custom op via ops.custom_op for differentiable extensions)."""
+    from ..ops.registry import has_op, register_op as _reg
+    import jax as _jax
+    import jax.numpy as _jnp
+    import numpy as _np
+    from ..core.dtypes import to_jax_dtype
+
+    from ..core import unique_name
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    shapes = [tuple(int(d) for d in o.shape) for o in outs]
+    dtypes = [to_jax_dtype(o.dtype) for o in outs]
+    op_name = unique_name.generate('py_func')
+
+    def _kernel(*arrays):
+        res = _jax.pure_callback(
+            lambda *a: tuple(_np.asarray(r, dt) for r, dt in
+                             zip(_as_tuple(func(*a)), dtypes)),
+            tuple(_jax.ShapeDtypeStruct(s, d) for s, d in zip(shapes, dtypes)),
+            *arrays)
+        res = tuple(_jax.lax.stop_gradient(r) for r in res)
+        return res if len(res) > 1 else res[0]
+
+    _kernel.__name__ = op_name
+    _reg(op_name, outputs=['Out'] if len(outs) == 1 else
+         [f'Out{i}' for i in range(len(outs))])(
+        _fix_positional(_kernel, len(xs)))
+    helper = LayerHelper('py_func')
+    helper.append_op(type=op_name,
+                     inputs={f'x{i}': v.name for i, v in enumerate(xs)},
+                     outputs=({'Out': [o.name for o in outs]}
+                              if len(outs) == 1 else
+                              {f'Out{i}': [o.name] for i, o in
+                               enumerate(outs)}),
+                     attrs={})
+    return out
+
+
+def _as_tuple(r):
+    return r if isinstance(r, tuple) else (r,)
+
+
+def _fix_positional(kernel, n):
+    """Give the registry an n-positional-arg signature to map input slots."""
+    import inspect
+    params = [inspect.Parameter(f'x{i}', inspect.Parameter.POSITIONAL_OR_KEYWORD)
+              for i in range(n)]
+    kernel.__signature__ = inspect.Signature(params)
+    return kernel
+
+
+__all__ += ['linear_chain_crf', 'crf_decoding', 'chunk_eval',
+            'ctc_greedy_decoder', 'lod_reset', 'lod_append', 'lrn',
+            'roi_pool', 'roi_align', 'psroi_pool', 'prroi_pool',
+            'deformable_conv', 'deformable_roi_pooling', 'scatter_nd', 'sum',
+            'shape', 'rank', 'size', 'similarity_focus', 'hash',
+            'merge_selected_rows', 'get_tensor_from_selected_rows',
+            'continuous_value_model', 'filter_by_instag', 'py_func']

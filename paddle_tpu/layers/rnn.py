@@ -34,6 +34,8 @@ from .control_flow import _flatten, _pack_like as _pack
 
 def _map_structure(fn, *trees):
     t0 = trees[0]
+    if isinstance(t0, tuple) and hasattr(t0, '_fields'):     # namedtuple
+        return type(t0)(*[_map_structure(fn, *elems) for elems in zip(*trees)])
     if isinstance(t0, (list, tuple)):
         return type(t0)(_map_structure(fn, *elems) for elems in zip(*trees))
     return fn(*trees)
@@ -545,13 +547,22 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     outputs_seq = _pack(outputs, res)
     final = decoder.finalize(outputs_seq, None) \
         if hasattr(decoder, 'finalize') else (outputs_seq, None)
-    ids, scores = final
+    a, b = final
     if not output_time_major:
-        ids = nn_layers.transpose(ids, perm=[1, 0, 2])
-        scores = nn_layers.transpose(scores, perm=[1, 0, 2])
+        a = _map_structure(_transpose_batch_time, a)
+        b = _map_structure(_transpose_batch_time, b) if b is not None else b
     if return_length:
-        return ids, scores, None
-    return ids, scores
+        return a, b, None
+    return a, b
+
+
+def _transpose_batch_time(x):
+    """(T, B, ...) ↔ (B, T, ...); anything rank<2 passes through."""
+    if x is None or not hasattr(x, 'name'):
+        return x
+    if getattr(x, 'shape', None) is not None and len(x.shape) < 2:
+        return x
+    return apply_op_layer('transpose_batch_time', {'x': x})
 
 
 def _dynamic_decode_dygraph(decoder, inputs, states, max_step_num,
@@ -568,13 +579,14 @@ def _dynamic_decode_dygraph(decoder, inputs, states, max_step_num,
             break
     stacked = _map_structure(lambda *os: nn_layers.stack(list(os), axis=0),
                              *outs_t)
-    ids, scores = decoder.finalize(stacked, None)
+    a, b = decoder.finalize(stacked, None) \
+        if hasattr(decoder, 'finalize') else (stacked, None)
     if not output_time_major:
-        ids = nn_layers.transpose(ids, perm=[1, 0, 2])
-        scores = nn_layers.transpose(scores, perm=[1, 0, 2])
+        a = _map_structure(_transpose_batch_time, a)
+        b = _map_structure(_transpose_batch_time, b) if b is not None else b
     if return_length:
-        return ids, scores, None
-    return ids, scores
+        return a, b, None
+    return a, b
 
 
 # ---------------------------------------------------------------------------
@@ -602,3 +614,216 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
 def beam_search_decode(ids, scores, beam_size, end_id, name=None):
     """Backtrace accumulated (T, B, W) ids/parents — see gather_tree."""
     return gather_tree(ids, scores)
+
+
+# ---------------------------------------------------------------------------
+# Decoder / DecodeHelper family (ref: layers/rnn.py Decoder, TrainingHelper,
+# GreedyEmbeddingHelper, SampleEmbeddingHelper, BasicDecoder)
+# ---------------------------------------------------------------------------
+import collections
+
+
+class Decoder:
+    """Abstract one-step decoder driven by dynamic_decode."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class DecodeHelper:
+    """Samples ids from step outputs and produces the next step's inputs."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+def _gather_time(x_tm, time):
+    """x_tm: (T, B, ...) var; time: int scalar var → (B, ...)."""
+    idx = nn_layers.reshape(tensor_layers.cast(time, 'int64'), shape=[1])
+    step = nn_layers.gather(x_tm, idx)
+    return nn_layers.reshape(step, shape=list(x_tm.shape[1:]))
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing: feeds the ground-truth sequence step by step."""
+
+    def __init__(self, inputs, sequence_length=None, time_major=False):
+        self.inputs_tm = inputs if time_major \
+            else _transpose_batch_time(inputs)
+        self.T = self.inputs_tm.shape[0]
+        self.sequence_length = sequence_length
+
+    def initialize(self):
+        first = _gather_time(self.inputs_tm,
+                             tensor_layers.fill_constant([1], 'int64', 0))
+        if self.sequence_length is not None:
+            fin = tensor_layers.cast(
+                apply_op_layer('less_equal',
+                               {'x': self.sequence_length,
+                                'y': tensor_layers.fill_constant(
+                                    [1], 'int64', 0)}), 'float32')
+        else:
+            fin = tensor_layers.fill_constant_batch_size_like(
+                self.inputs_tm, [-1], 'float32', 0.0, input_dim_idx=1,
+                output_dim_idx=0)
+        return first, fin
+
+    def sample(self, time, outputs, states):
+        return nn_layers.reshape(
+            tensor_layers.cast(nn_layers.argmax(outputs, axis=-1), 'int64'),
+            shape=[-1])
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        next_time = tensor_layers.cast(time, 'int64') + np.int64(1)
+        last = tensor_layers.fill_constant([1], 'int64', self.T - 1)
+        clipped = nn_layers.elementwise_min(
+            nn_layers.reshape(next_time, shape=[1]), last)
+        nxt = _gather_time(self.inputs_tm, clipped)
+        if self.sequence_length is not None:
+            fin = tensor_layers.cast(
+                apply_op_layer(
+                    'greater_equal',
+                    {'x': nn_layers.reshape(next_time, shape=[1]),
+                     'y': tensor_layers.cast(self.sequence_length, 'int64')}),
+                'float32')
+        else:
+            fin = tensor_layers.cast(
+                apply_op_layer('greater_equal',
+                               {'x': nn_layers.reshape(next_time, shape=[1]),
+                                'y': tensor_layers.fill_constant(
+                                    [1], 'int64', self.T)}), 'float32')
+            ones = tensor_layers.fill_constant_batch_size_like(
+                self.inputs_tm, [-1], 'float32', 1.0, input_dim_idx=1,
+                output_dim_idx=0)
+            fin = ones * fin     # broadcast (B,)·(1,) → per-row mask
+        return fin, nxt
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Greedy generation: argmax id → embedding as the next input."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = start_tokens
+        self.end_token = int(end_token)
+
+    def initialize(self):
+        fin = tensor_layers.fill_constant_batch_size_like(
+            self.start_tokens, [-1], 'float32', 0.0)
+        return self.embedding_fn(self.start_tokens), fin
+
+    def sample(self, time, outputs, states):
+        return nn_layers.reshape(
+            tensor_layers.cast(nn_layers.argmax(outputs, axis=-1), 'int64'),
+            shape=[-1])
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        fin = tensor_layers.cast(
+            apply_op_layer('equal',
+                           {'x': sample_ids,
+                            'y': tensor_layers.fill_constant(
+                                [1], 'int64', self.end_token)}), 'float32')
+        return fin, self.embedding_fn(sample_ids)
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Like GreedyEmbeddingHelper but samples ids from softmax(outputs /
+    softmax_temperature)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+        self.seed = seed
+
+    def sample(self, time, outputs, states):
+        logits = outputs if self.temperature is None \
+            else outputs / float(self.temperature)
+        probs = nn_layers.softmax(logits)
+        ids = apply_op_layer('sampling_id', {'x': probs},
+                             {'seed': self.seed or 0})
+        return nn_layers.reshape(tensor_layers.cast(ids, 'int64'), shape=[-1])
+
+
+BasicDecoderOutput = collections.namedtuple('BasicDecoderOutput',
+                                            ('cell_outputs', 'sample_ids'))
+
+
+class BasicDecoder(Decoder):
+    """cell + helper one-step decoder (ref: layers/rnn.py BasicDecoder)."""
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        initial_inputs, initial_finished = self.helper.initialize()
+        return initial_inputs, [initial_cell_states, initial_finished]
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_states, finished = states
+        cell_outputs, next_cell_states = self.cell.call(inputs, cell_states)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        sample_ids = self.helper.sample(time, cell_outputs, cell_states)
+        helper_fin, next_inputs = self.helper.next_inputs(
+            time, cell_outputs, next_cell_states, sample_ids)
+        next_finished = nn_layers.elementwise_max(finished, helper_fin)
+        outputs = BasicDecoderOutput(cell_outputs, sample_ids)
+        return outputs, [next_cell_states, next_finished], next_inputs, \
+            next_finished
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """Stacked (optionally bidirectional) LSTM over time-major input
+    (T, B, D) — the parity surface for the reference's cuDNN lstm op
+    (layers/nn.py:lstm); lowered to lax.scan per layer instead of a cuDNN
+    descriptor. Returns (out, last_h, last_c) with last_h/c shaped
+    (num_layers*directions, B, hidden_size)."""
+    x = input
+    last_hs, last_cs = [], []
+    for layer in range(num_layers):
+        if is_bidirec:
+            fw = LSTMCell(hidden_size, name=f'{name or "lstm"}_l{layer}_fw')
+            bw = LSTMCell(hidden_size, name=f'{name or "lstm"}_l{layer}_bw')
+            init = None
+            if init_h is not None and init_c is not None:
+                i0, i1 = 2 * layer, 2 * layer + 1
+                init = ([init_h[i0], init_c[i0]], [init_h[i1], init_c[i1]])
+            x, (st_fw, st_bw) = birnn(fw, bw, x, init, time_major=True)
+            last_hs += [st_fw[0], st_bw[0]]
+            last_cs += [st_fw[1], st_bw[1]]
+        else:
+            cell = LSTMCell(hidden_size, name=f'{name or "lstm"}_l{layer}')
+            init = None
+            if init_h is not None and init_c is not None:
+                init = [init_h[layer], init_c[layer]]
+            x, st = rnn(cell, x, init, time_major=True)
+            last_hs.append(st[0])
+            last_cs.append(st[1])
+        if dropout_prob > 0.0 and not is_test and layer < num_layers - 1:
+            x = nn_layers.dropout(x, dropout_prob)
+    last_h = nn_layers.stack(last_hs, axis=0)
+    last_c = nn_layers.stack(last_cs, axis=0)
+    return x, last_h, last_c
+
+
+__all__ += ['Decoder', 'DecodeHelper', 'TrainingHelper',
+            'GreedyEmbeddingHelper', 'SampleEmbeddingHelper', 'BasicDecoder',
+            'BasicDecoderOutput', 'lstm']

@@ -93,11 +93,14 @@ def fill_constant_array(arr):
 
 
 def fill_constant(shape, dtype, value, force_cpu=False, out=None):
-    return apply_op_layer('fill_constant', {},
-                          {'shape': list(shape), 'value': float(value)
-                           if convert_dtype(dtype).startswith('float') else value,
-                           'dtype': convert_dtype(dtype)},
-                          dtype=convert_dtype(dtype))
+    v = apply_op_layer('fill_constant', {},
+                       {'shape': list(shape), 'value': float(value)
+                        if convert_dtype(dtype).startswith('float') else value,
+                        'dtype': convert_dtype(dtype)},
+                       dtype=convert_dtype(dtype))
+    if getattr(v, 'shape', None) is None:
+        v.shape = tuple(shape)
+    return v
 
 
 def fill_constant_batch_size_like(input, shape, dtype, value,

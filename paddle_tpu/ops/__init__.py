@@ -6,7 +6,7 @@ Modules double as a direct functional API (used by dygraph layers), e.g.
 from . import registry
 from .registry import register_op, get_op, has_op, all_ops, custom_op
 from . import (math_ops, tensor_ops, nn_ops, loss_ops, random_ops,
-               optimizer_ops, extra_ops, rnn_ops, sequence_ops)
+               optimizer_ops, extra_ops, rnn_ops, sequence_ops, vision_ops)
 
 # registered lazily by later modules: detection_ops, collective_ops —
 # imported in paddle_tpu/__init__.py once they exist.

@@ -170,19 +170,23 @@ def warpctc(logits, label, logit_len=None, label_len=None, *, blank=0,
 
 
 @register_op('ctc_greedy_decoder', outputs=['Out', 'OutLen'])
-def ctc_greedy_decoder(x, *, blank):
+def ctc_greedy_decoder(x, length=None, *, blank, padding_value=-1):
     """ref: paddle/fluid/operators/ctc_align_op.cc — argmax, merge repeats,
-    drop blanks; output padded with -1."""
+    drop blanks; output padded with padding_value. `length` masks pad frames
+    of the (B, T, C) batch out of the decode."""
     x = jnp.asarray(x)  # (B, T, C) probs
     ids = jnp.argmax(x, -1)  # B, T
+    b, t = ids.shape
     prev = jnp.concatenate([jnp.full_like(ids[:, :1], -1), ids[:, :-1]], 1)
     keep = (ids != blank) & (ids != prev)
-    b, t = ids.shape
+    pos = jnp.arange(t)[None, :]
+    if length is not None:
+        valid = pos < jnp.asarray(length).reshape(b, 1)
+        keep = keep & valid
     order = jnp.argsort(~keep, axis=1, stable=True)
     gathered = jnp.take_along_axis(ids, order, 1)
     counts = jnp.sum(keep, 1)
-    pos = jnp.arange(t)[None, :]
-    out = jnp.where(pos < counts[:, None], gathered, -1)
+    out = jnp.where(pos < counts[:, None], gathered, padding_value)
     return out, counts
 
 
@@ -327,13 +331,19 @@ def crf_decoding(emission, transition, length=None):
 @register_op('chunk_eval', outputs=['Precision', 'Recall', 'F1',
                                     'NumInferChunks', 'NumLabelChunks',
                                     'NumCorrectChunks'])
-def chunk_eval(inference, label, *, num_chunk_types, chunk_scheme='IOB',
-               excluded_chunk_types=None):
+def chunk_eval(inference, label, length=None, *, num_chunk_types,
+               chunk_scheme='IOB', excluded_chunk_types=None):
     """ref: paddle/fluid/operators/chunk_eval_op.cc — IOB span F1 on padded
-    id sequences. Tag encoding: tag = type * tag_num + {B:0, I:1}."""
+    id sequences; `length` masks pad positions out of the chunk counts.
+    Tag encoding: tag = type * tag_num + {B:0, I:1}."""
     inf = jnp.asarray(inference).reshape(jnp.asarray(inference).shape[0], -1)
     lab = jnp.asarray(label).reshape(inf.shape)
     tag_num = 2 if chunk_scheme == 'IOB' else 4
+    if length is not None:
+        valid = (jnp.arange(inf.shape[1])[None, :]
+                 < jnp.asarray(length).reshape(-1, 1))
+    else:
+        valid = jnp.ones_like(inf, bool)
 
     def starts(seq):
         typ = seq // tag_num
@@ -344,8 +354,8 @@ def chunk_eval(inference, label, *, num_chunk_types, chunk_scheme='IOB',
         cont_break = (typ != ptyp)
         return is_b | cont_break
 
-    inf_start = starts(inf)
-    lab_start = starts(lab)
+    inf_start = starts(inf) & valid
+    lab_start = starts(lab) & valid
     num_inf = jnp.sum(inf_start)
     num_lab = jnp.sum(lab_start)
     correct = jnp.sum(inf_start & lab_start & (inf == lab))
@@ -355,3 +365,158 @@ def chunk_eval(inference, label, *, num_chunk_types, chunk_scheme='IOB',
     return (prec.astype(jnp.float32), rec.astype(jnp.float32),
             f1.astype(jnp.float32), num_inf.astype(jnp.int64),
             num_lab.astype(jnp.int64), correct.astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# misc long-tail ops (ref: paddle/fluid/operators/{hash,similarity_focus,
+# cvm,filter_by_instag,scatter_nd,shape,rank,size}_op.*)
+# ---------------------------------------------------------------------------
+
+
+@register_op('scatter_nd')
+def scatter_nd(index, updates, *, shape):
+    """zeros(shape) with `updates` summed in at `index` (scatter_nd_op.h)."""
+    index = jnp.asarray(index)
+    updates = jnp.asarray(updates)
+    out = jnp.zeros(tuple(shape), updates.dtype)
+    return out.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+@register_op('shape')
+def shape_op(x):
+    return jnp.asarray(jnp.asarray(x).shape, jnp.int32)
+
+
+@register_op('rank')
+def rank_op(x):
+    return jnp.asarray(jnp.asarray(x).ndim, jnp.int32)
+
+
+@register_op('size')
+def size_op(x):
+    return jnp.asarray(jnp.asarray(x).size, jnp.int64)
+
+
+@register_op('hash')
+def hash_op(x, *, num_hash=1, mod_by=100000000):
+    """Bucketize integer id rows with num_hash independent hashes
+    (hash_op.h uses XXH64; any well-mixed integer hash satisfies the
+    contract — stable buckets in [0, mod_by))."""
+    x = jnp.asarray(x).astype(jnp.uint32)
+    flat = x.reshape(x.shape[0], -1)
+
+    def mix(v, seed):
+        # splitmix32-style avalanche, vectorized
+        v = v ^ jnp.uint32(seed)
+        v = (v ^ (v >> 16)) * jnp.uint32(0x85ebca6b)
+        v = (v ^ (v >> 13)) * jnp.uint32(0xc2b2ae35)
+        return v ^ (v >> 16)
+
+    outs = []
+    for h in range(num_hash):
+        acc = jnp.full((flat.shape[0],),
+                       jnp.uint32((0x9e3779b9 * (h + 1)) & 0xFFFFFFFF))
+        for c in range(flat.shape[1]):
+            acc = mix(acc ^ flat[:, c],
+                      (0x9e3779b9 + h * 0x61c88647 + c) & 0xFFFFFFFF)
+        outs.append((acc % jnp.uint32(mod_by)).astype(jnp.int64))
+    return jnp.stack(outs, 1)[:, :, None]
+
+
+@register_op('similarity_focus')
+def similarity_focus(x, *, axis, indexes):
+    """Greedy bipartite focus mask (similarity_focus_op.h): repeatedly take
+    the largest untagged element of the selected slice, tag its row+col, and
+    light the full fiber along `axis` at that position. lax.fori_loop with a
+    masked argmax replaces the reference's sort+scan."""
+    x = jnp.asarray(x)
+    if x.ndim != 4 or axis not in (1, 2, 3):
+        raise ValueError("similarity_focus expects rank-4 input, axis in 1..3")
+    # view with `axis` first: (B, A, M, N_)
+    order = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 3, 1, 2)}[axis]
+    xv = x.transpose(order)
+    B, A, M, N_ = xv.shape
+    steps = min(M, N_)
+
+    def per_slice(mat):                       # (M, N_) → (M, N_) 0/1
+        def body(_, st):
+            sel, rt, ct = st
+            masked = jnp.where(rt[:, None] | ct[None, :], -jnp.inf, mat)
+            flat = jnp.argmax(masked)
+            r, c = flat // N_, flat % N_
+            return (sel.at[r, c].set(1.0),
+                    rt.at[r].set(True), ct.at[c].set(True))
+        sel, _, _ = lax.fori_loop(
+            0, steps, body,
+            (jnp.zeros((M, N_), x.dtype), jnp.zeros(M, bool),
+             jnp.zeros(N_, bool)))
+        return sel
+
+    sel = jnp.zeros((B, M, N_), x.dtype)
+    for idx in indexes:
+        sel = jnp.maximum(sel, jax.vmap(per_slice)(xv[:, idx]))
+    out = jnp.broadcast_to(sel[:, None], (B, A, M, N_))
+    inv = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 2, 3, 1)}[axis]
+    return out.transpose(inv)
+
+
+@register_op('cvm')
+def cvm(x, cvm_in, *, use_cvm=True):
+    """Continuous-value-model feature adjust (cvm_op.h): show/click columns
+    are log-transformed in, or stripped out."""
+    x = jnp.asarray(x)
+    c = jnp.asarray(cvm_in)
+    if use_cvm:
+        show = jnp.log(c[:, :1] + 1.0)
+        click = jnp.log(c[:, 1:2] + 1.0) - jnp.log(c[:, :1] + 1.0)
+        return jnp.concatenate([show, click, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+@register_op('filter_by_instag', outputs=['Out', 'LossWeight', 'IndexMap'])
+def filter_by_instag(x, ins_tag, filter_tag, *, is_lod=False,
+                     out_val_if_empty=0):
+    """Row filter by tag membership. TPU formulation: static-shape masking —
+    kept rows pass through, dropped rows zero out, LossWeight marks keeps
+    (the reference compacts rows; downstream loss×weight gives identical
+    training math without dynamic shapes)."""
+    x = jnp.asarray(x)
+    tags = jnp.asarray(ins_tag)            # (B, K) padded tag lists
+    filt = jnp.asarray(filter_tag).reshape(-1)
+    if tags.ndim == 1:
+        tags = tags[:, None]
+    keep = (tags[:, :, None] == filt[None, None, :]).any(axis=(1, 2))
+    w = keep.astype(x.dtype)
+    out = jnp.where(keep.reshape((-1,) + (1,) * (x.ndim - 1)), x,
+                    jnp.asarray(out_val_if_empty, x.dtype))
+    idx = jnp.arange(x.shape[0], dtype=jnp.int64)
+    return out, w[:, None], jnp.stack([idx, idx], axis=1)
+
+
+@register_op('lod_reset', outputs=['Out', 'Length'])
+def lod_reset(x, y=None, *, target_lod=None):
+    """Re-associate sequence structure: emits the data unchanged plus the
+    new (B,) length vector (offsets→lengths; the padded-batch analogue of
+    swapping the LoD table, lod_reset_op.h)."""
+    x = jnp.asarray(x)
+    if y is not None:
+        off = jnp.asarray(y).reshape(-1).astype(jnp.int32)
+    elif target_lod is not None:
+        off = jnp.asarray(target_lod, jnp.int32)
+    else:
+        raise ValueError("lod_reset needs y or target_lod")
+    # both y's data and target_lod are LoD OFFSET tables like the reference;
+    # the padded-batch formulation carries lengths = diff(offsets)
+    return x, off[1:] - off[:-1]
+
+
+@register_op('merge_selected_rows')
+def merge_selected_rows(x):
+    """SelectedRows (sparse grad rows) are already dense-coalesced in the
+    TPU lowering — identity (merge_selected_rows_op.h)."""
+    return jnp.asarray(x)
+
+
+@register_op('get_tensor_from_selected_rows')
+def get_tensor_from_selected_rows(x):
+    return jnp.asarray(x)

@@ -406,3 +406,11 @@ def temporal_shift(x, *, seg_num, shift_ratio=0.25):
 def matrix_diag_part(x):
     """Diagonal of the last two dims (used by MultivariateNormalDiag)."""
     return jnp.diagonal(jnp.asarray(x), axis1=-2, axis2=-1)
+
+
+@register_op('transpose_batch_time')
+def transpose_batch_time(x):
+    """Swap leading (time, batch) dims; rank<2 passes through. Rank-agnostic
+    so decode outputs with build-time-unknown shapes can still be wired."""
+    x = jnp.asarray(x)
+    return jnp.swapaxes(x, 0, 1) if x.ndim >= 2 else x

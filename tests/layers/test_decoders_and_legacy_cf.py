@@ -1,0 +1,143 @@
+"""Decode helpers (Training/GreedyEmbedding/BasicDecoder), lstm(), and the
+legacy Switch / IfElse / DynamicRNN constructs."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(main, start, feed, fetch):
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(start)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_switch_first_true_wins():
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[1], dtype='float32',
+                        append_batch_size=False)
+        out = layers.create_global_var([1], 0.0, 'float32', persistable=True)
+        one = layers.fill_constant([1], 'float32', 1.0)
+        two = layers.fill_constant([1], 'float32', 2.0)
+        three = layers.fill_constant([1], 'float32', 3.0)
+        with layers.Switch() as sw:
+            with sw.case(layers.reduce_sum(x) > 10.0):
+                layers.assign(one, output=out)
+            with sw.case(layers.reduce_sum(x) > 5.0):
+                layers.assign(two, output=out)
+            with sw.default():
+                layers.assign(three, output=out)
+    for val, want in [(20.0, 1.0), (7.0, 2.0), (1.0, 3.0)]:
+        r, = _run(main, start, {'x': np.array([val], 'float32')}, [out])
+        assert float(r) == want, (val, float(r), want)
+
+
+def test_ifelse_rowwise_merge():
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[1], dtype='float32')
+        cond = layers.greater_than(
+            x, layers.fill_constant([1], 'float32', 0.0))
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(ie.input(x) * 2.0)
+        with ie.false_block():
+            ie.output(ie.input(x) - 1.0)
+        out = ie()[0]
+    xin = np.array([[1.0], [-2.0], [3.0]], 'float32')
+    r, = _run(main, start, {'x': xin}, [out])
+    np.testing.assert_allclose(r, [[2.0], [-3.0], [6.0]])
+
+
+def test_dynamic_rnn_masked_accumulation():
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[3, 2], dtype='float32')
+        lens = layers.data('lens', shape=[1], dtype='int64')
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            step = drnn.step_input(x, sequence_length=lens)
+            acc = drnn.memory(shape=[2], value=0.0)
+            new = acc + step
+            drnn.update_memory(acc, new)
+            drnn.output(new)
+        out = drnn()
+        final = layers.sequence_last_step(out)
+    xin = np.ones((2, 3, 2), 'float32')
+    lens_in = np.array([3, 1], 'int64')
+    r, = _run(main, start, {'x': xin, 'lens': lens_in}, [final])
+    # row 0 runs 3 steps → 3.0; row 1 freezes after 1 step → 1.0
+    np.testing.assert_allclose(r, [[3.0, 3.0], [1.0, 1.0]])
+
+
+def test_training_helper_basic_decoder():
+    B, T, D, H = 2, 4, 3, 5
+    rng = np.random.RandomState(0)
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        tgt = layers.data('tgt', shape=[T, D], dtype='float32')
+        cell = layers.LSTMCell(H)
+        helper = layers.TrainingHelper(tgt)
+        dec = layers.BasicDecoder(cell, helper)
+        h0 = layers.zeros([B, H], 'float32')
+        c0 = layers.zeros([B, H], 'float32')
+        outs, _ = layers.dynamic_decode(dec, inits=[h0, c0], max_step_num=T)
+    feed = {'tgt': rng.randn(B, T, D).astype('float32')}
+    o, ids = _run(main, start, feed, [outs.cell_outputs, outs.sample_ids])
+    assert o.shape == (B, T, H)
+    assert ids.shape == (B, T)
+
+
+def test_greedy_embedding_helper_decode():
+    B, V, E = 2, 6, 4
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        emb_w = layers.create_parameter([V, E], 'float32', name='dec_emb')
+
+        def embed(ids):
+            return layers.gather(emb_w, layers.reshape(ids, shape=[-1]))
+
+        start_toks = layers.assign(np.zeros(B, 'int64'))
+        cell = layers.GRUCell(E)
+        helper = layers.GreedyEmbeddingHelper(embed, start_toks, end_token=1)
+        dec = layers.BasicDecoder(cell, helper,
+                                  output_fn=lambda h: layers.fc(h, V))
+        h0 = layers.zeros([B, E], 'float32')
+        outs, _ = layers.dynamic_decode(dec, inits=h0, max_step_num=5)
+    o, ids = _run(main, start, {}, [outs.cell_outputs, outs.sample_ids])
+    assert o.shape == (B, 5, V)
+    assert ids.shape == (B, 5)
+    assert (ids >= 0).all() and (ids < V).all()
+
+
+def test_lstm_layer_shapes():
+    T, B, D, H, L = 4, 2, 3, 6, 2
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[B, D], dtype='float32',
+                        append_batch_size=False)
+        xt = layers.expand(layers.unsqueeze(x, axes=[0]),
+                           expand_times=[T, 1, 1])
+        out, h, c = layers.lstm(xt, None, None, T, H, L)
+        out2, h2, c2 = layers.lstm(xt, None, None, T, H, 1, is_bidirec=True)
+    feed = {'x': np.random.RandomState(0).randn(B, D).astype('float32')}
+    o, hh, cc, o2, hh2 = _run(main, start, feed, [out, h, c, out2, h2])
+    assert o.shape == (T, B, H) and hh.shape == (L, B, H)
+    assert o2.shape == (T, B, 2 * H) and hh2.shape == (2, B, H)
+
+
+def test_lod_rank_table_reorder():
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[3, 2], dtype='float32')
+        off = layers.data('off', shape=[3], dtype='int64',
+                          append_batch_size=False)
+        x2 = layers.lod_reset(x, y=off)   # y's data is a LoD offset table
+        table = layers.lod_rank_table(x2)
+        out = layers.reorder_lod_tensor_by_rank(x2, table)
+    xin = np.arange(12, dtype='float32').reshape(2, 3, 2)
+    r, = _run(main, start, {'x': xin, 'off': np.array([0, 1, 4], 'int64')},
+              [out])
+    np.testing.assert_allclose(r, xin[::-1])  # longer sequence first

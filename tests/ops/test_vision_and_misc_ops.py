@@ -1,0 +1,259 @@
+"""ROI family, deformable conv, and misc long-tail ops vs numpy references."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(build, feed=None):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        outs = build()
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(start)
+        return exe.run(main, feed=feed or {}, fetch_list=list(outs))
+
+
+def test_roi_pool_identity_bin():
+    # one roi covering a 2x2 region, 1x1 pooling → max of region
+    x = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 1, 1]], dtype='float32')  # x1,y1,x2,y2
+
+    def build():
+        xv = layers.data('x', shape=[1, 4, 4], dtype='float32')
+        rv = layers.data('rois', shape=[4], dtype='float32')
+        return layers.roi_pool(xv, rv, 1, 1, 1.0)
+
+    out, = _run(build, {'x': x, 'rois': rois})
+    assert out.shape == (1, 1, 1, 1)
+    assert float(out[0, 0, 0, 0]) == 5.0  # max of [[0,1],[4,5]]
+
+
+def test_roi_pool_bins():
+    x = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 3, 3]], dtype='float32')
+
+    def build():
+        xv = layers.data('x', shape=[1, 4, 4], dtype='float32')
+        rv = layers.data('rois', shape=[4], dtype='float32')
+        return layers.roi_pool(xv, rv, 2, 2, 1.0)
+
+    out, = _run(build, {'x': x, 'rois': rois})
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_roi_align_center():
+    x = np.ones((1, 2, 6, 6), dtype='float32') * 3.0
+    rois = np.array([[1, 1, 4, 4]], dtype='float32')
+
+    def build():
+        xv = layers.data('x', shape=[2, 6, 6], dtype='float32')
+        rv = layers.data('rois', shape=[4], dtype='float32')
+        return layers.roi_align(xv, rv, 2, 2, 1.0, sampling_ratio=2)
+
+    out, = _run(build, {'x': x, 'rois': rois})
+    np.testing.assert_allclose(out, np.full((1, 2, 2, 2), 3.0), rtol=1e-6)
+
+
+def test_psroi_pool_channel_select():
+    # C = oc * ph * pw = 1*2*2; constant per channel → out[0,i,j] = const of ch i*2+j
+    x = np.stack([np.full((4, 4), c, 'float32') for c in range(4)])[None]
+    rois = np.array([[0, 0, 3, 3]], dtype='float32')
+
+    def build():
+        xv = layers.data('x', shape=[4, 4, 4], dtype='float32')
+        rv = layers.data('rois', shape=[4], dtype='float32')
+        return layers.psroi_pool(xv, rv, 1, 1.0, 2, 2)
+
+    out, = _run(build, {'x': x, 'rois': rois})
+    np.testing.assert_allclose(out[0, 0], [[0, 1], [2, 3]], rtol=1e-6)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 3, 5, 5).astype('float32')
+    kh = kw = 3
+
+    def build():
+        xv = layers.data('x', shape=[3, 5, 5], dtype='float32')
+        off = layers.zeros([1, 2 * kh * kw, 5, 5], 'float32')
+        mask = layers.ones([1, kh * kw, 5, 5], 'float32')
+        out = layers.deformable_conv(xv, off, mask, 4, 3, padding=1,
+                                     param_attr=fluid.ParamAttr(
+                                         initializer=fluid.initializer.
+                                         ConstantInitializer(0.1)),
+                                     bias_attr=False)
+        ref = layers.conv2d(xv, 4, 3, padding=1,
+                            param_attr=fluid.ParamAttr(
+                                initializer=fluid.initializer.
+                                ConstantInitializer(0.1)),
+                            bias_attr=False)
+        return out, ref
+
+    out, ref = _run(build, {'x': x})
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_scatter_nd_shape_rank_size_sum():
+    def build():
+        idx = layers.assign(np.array([[1], [3]], 'int32'))
+        upd = layers.assign(np.array([9.0, 10.0], 'float32'))
+        s = layers.scatter_nd(idx, upd, [5])
+        xv = layers.assign(np.zeros((2, 3), 'float32'))
+        return s, layers.shape(xv), layers.rank(xv), layers.size(xv), \
+            layers.sum([upd, upd])
+
+    s, shp, rk, sz, sm = _run(build)
+    np.testing.assert_allclose(s, [0, 9, 0, 10, 0])
+    assert list(shp) == [2, 3] and int(rk) == 2 and int(sz) == 6
+    np.testing.assert_allclose(sm, [18.0, 20.0])
+
+
+def test_hash_deterministic_in_range():
+    def build():
+        xv = layers.assign(np.array([[1, 2], [1, 2], [3, 4]], 'int64'))
+        return layers.hash(xv, hash_size=1000, num_hash=2)
+
+    h, = _run(build)
+    assert h.shape == (3, 2, 1)
+    assert (h >= 0).all() and (h < 1000).all()
+    assert (h[0] == h[1]).all() and not (h[0] == h[2]).all()
+
+
+def test_similarity_focus():
+    x = np.zeros((1, 2, 2, 2), 'float32')
+    x[0, 0] = [[5.0, 1.0], [2.0, 4.0]]   # greedy: (0,0) then (1,1)
+
+    def build():
+        xv = layers.data('x', shape=[2, 2, 2], dtype='float32')
+        return layers.similarity_focus(xv, axis=1, indexes=[0])
+
+    out, = _run(build, {'x': x})
+    want = np.zeros((1, 2, 2, 2), 'float32')
+    want[:, :, 0, 0] = 1
+    want[:, :, 1, 1] = 1
+    np.testing.assert_allclose(out, want)
+
+
+def test_cvm_and_filter_by_instag():
+    def build():
+        xv = layers.assign(np.arange(8, dtype='float32').reshape(2, 4))
+        cv = layers.assign(np.array([[1.0, 0.0], [3.0, 1.0]], 'float32'))
+        kept = layers.continuous_value_model(xv, cv, use_cvm=False)
+        ins = layers.assign(np.arange(6, dtype='float32').reshape(3, 2))
+        tags = layers.assign(np.array([[1], [2], [3]], 'int64'))
+        filt = layers.assign(np.array([1, 3], 'int64'))
+        out, w, _ = layers.filter_by_instag(ins, tags, filt)
+        return kept, out, w
+
+    kept, out, w = _run(build)
+    np.testing.assert_allclose(kept, [[2, 3], [6, 7]])
+    np.testing.assert_allclose(w[:, 0], [1, 0, 1])
+    np.testing.assert_allclose(out[1], [0, 0])
+
+
+def test_crf_layers_end_to_end():
+    B, T, N = 2, 4, 3
+    rng = np.random.RandomState(1)
+    em = rng.randn(B, T, N).astype('float32')
+    lab = rng.randint(0, N, (B, T)).astype('int64')
+
+    def build():
+        ev = layers.data('em', shape=[T, N], dtype='float32')
+        lv = layers.data('lab', shape=[T], dtype='int64')
+        nll = layers.linear_chain_crf(ev, lv,
+                                      param_attr=fluid.ParamAttr(name='crf_w'))
+        path = layers.crf_decoding(ev, 'crf_w')
+        return nll, path
+
+    nll, path = _run(build, {'em': em, 'lab': lab})
+    assert nll.shape == (B, 1) and (nll > 0).all()
+    assert path.shape == (B, T)
+
+
+def test_py_func_callback():
+    def double_plus_one(a):
+        return np.asarray(a) * 2 + 1
+
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[3], dtype='float32',
+                        append_batch_size=False)
+        out = main.global_block().create_var(
+            name='pyfunc_out', shape=[3], dtype='float32')
+        layers.py_func(double_plus_one, x, out)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(start)
+        r, = exe.run(main, feed={'x': np.array([1, 2, 3], 'float32')},
+                     fetch_list=[out])
+    np.testing.assert_allclose(r, [3, 5, 7])
+
+
+def test_lod_reset_feeds_sequence_ops():
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[3, 2], dtype='float32')
+        x2 = layers.lod_reset(x, target_lod=[0, 1, 3])
+        # lengths [1, 2] — mean over valid steps only
+        pooled = layers.sequence_pool(x2, 'average')
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(start)
+        xin = np.arange(12, dtype='float32').reshape(2, 3, 2)
+        r, = exe.run(main, feed={'x': xin}, fetch_list=[pooled])
+    np.testing.assert_allclose(r[0], xin[0, 0])
+    np.testing.assert_allclose(r[1], xin[1, :2].mean(0))
+
+
+def test_ctc_greedy_decoder_masks_pad_frames():
+    B, T, C = 2, 4, 3   # blank = 2
+    x = np.zeros((B, T, C), 'float32')
+    x[0, :, 0] = 1.0                    # row 0: 0,0,0,0 → merges to [0]
+    x[1, 0, 1] = 1.0                    # row 1: 1,(pad frames argmax 1...)
+    x[1, 1:, 1] = 1.0
+
+    def build():
+        xv = layers.data('x', shape=[T, C], dtype='float32')
+        lv = layers.data('lens', shape=[1], dtype='int64')
+        return layers.ctc_greedy_decoder(xv, blank=2, input_length=lv)
+
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        out, lens = build()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(start)
+        o, l = exe.run(main, feed={'x': x, 'lens': np.array([4, 1], 'int64')},
+                       fetch_list=[out, lens])
+    assert list(l) == [1, 1]
+    assert o[0][0] == 0 and o[1][0] == 1
+    assert (o[:, 1:] == -1).all()
+
+
+def test_chunk_eval_masks_padding():
+    # one chunk in row 0 (B-0 at t=0), padding after t=1 would fake chunks
+    inf = np.array([[0, 1, 0, 0]], 'int64')   # B-0 I-0 B-0 B-0
+    lab = np.array([[0, 1, 0, 0]], 'int64')
+
+    def run(with_len):
+        main, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, start):
+            iv = layers.data('inf', shape=[4], dtype='int64')
+            lv = layers.data('lab', shape=[4], dtype='int64')
+            args = dict(seq_length=layers.assign(np.array([2], 'int64'))) \
+                if with_len else {}
+            outs = layers.chunk_eval(iv, lv, 'IOB', 1, **args)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(start)
+            return exe.run(main, feed={'inf': inf, 'lab': lab},
+                           fetch_list=list(outs))
+
+    full = run(False)
+    masked = run(True)
+    assert int(full[3]) == 3      # unmasked: 3 inferred chunks
+    assert int(masked[3]) == 1    # masked to length 2: just the B-0 I-0 chunk
+    assert float(masked[0]) == 1.0 and float(masked[1]) == 1.0
