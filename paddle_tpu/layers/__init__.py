@@ -12,6 +12,8 @@ from . import sequence_lod
 from .learning_rate_scheduler import *  # noqa
 from . import learning_rate_scheduler
 from . import distributions
+from .detection import *  # noqa
+from . import detection
 from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()

@@ -1,0 +1,403 @@
+"""Detection layer API (ref: python/paddle/fluid/layers/detection.py).
+
+All selection-shaped results (NMS, proposals, sampled targets) are padded
+fixed-shape tensors + counts — see ops/detection_ops.py for the TPU
+formulation rules.
+"""
+from __future__ import annotations
+
+from .common import apply_op_layer
+from . import nn as nn_layers
+from . import tensor as tensor_layers
+
+__all__ = ['prior_box', 'density_prior_box', 'multi_box_head',
+           'bipartite_match', 'target_assign', 'detection_output', 'ssd_loss',
+           'rpn_target_assign', 'retinanet_target_assign',
+           'sigmoid_focal_loss', 'anchor_generator',
+           'roi_perspective_transform', 'generate_proposal_labels',
+           'generate_proposals', 'generate_mask_labels', 'iou_similarity',
+           'box_coder', 'polygon_box_transform', 'yolov3_loss', 'yolo_box',
+           'box_clip', 'multiclass_nms', 'locality_aware_nms',
+           'retinanet_detection_output', 'distribute_fpn_proposals',
+           'box_decoder_and_assign', 'collect_fpn_proposals']
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    return apply_op_layer('iou_similarity', {'x': x, 'y': y},
+                          {'box_normalized': box_normalized}, name=name)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type='encode_center_size', box_normalized=True, name=None,
+              axis=0):
+    var_input = prior_box_var if hasattr(prior_box_var, 'name') else None
+    var_attr = None if var_input is not None else prior_box_var
+    return apply_op_layer(
+        'box_coder',
+        {'prior_box': prior_box, 'prior_box_var': var_input,
+         'target_box': target_box},
+        {'code_type': code_type, 'box_normalized': box_normalized,
+         'variance': list(var_attr) if var_attr else None, 'axis': axis},
+        name=name)
+
+
+def box_clip(input, im_info, name=None):
+    return apply_op_layer('box_clip', {'x': input, 'im_info': im_info},
+                          name=name)
+
+
+def polygon_box_transform(input, name=None):
+    return apply_op_layer('polygon_box_transform', {'x': input}, name=name)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    return apply_op_layer(
+        'prior_box', {'input': input, 'image': image},
+        {'min_sizes': list(min_sizes), 'max_sizes': list(max_sizes or []),
+         'aspect_ratios': list(aspect_ratios), 'variance': list(variance),
+         'flip': flip, 'clip': clip, 'step_w': steps[0], 'step_h': steps[1],
+         'offset': offset,
+         'min_max_aspect_ratios_order': min_max_aspect_ratios_order},
+        name=name)
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=[0.1, 0.1, 0.2, 0.2],
+                      clip=False, steps=[0.0, 0.0], offset=0.5,
+                      flatten_to_2d=False, name=None):
+    return apply_op_layer(
+        'density_prior_box', {'input': input, 'image': image},
+        {'densities': list(densities), 'fixed_sizes': list(fixed_sizes),
+         'fixed_ratios': list(fixed_ratios), 'variance': list(variance),
+         'clip': clip, 'step_w': steps[0], 'step_h': steps[1],
+         'offset': offset, 'flatten_to_2d': flatten_to_2d}, name=name)
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=[0.1, 0.1, 0.2, 0.2], stride=None, offset=0.5,
+                     name=None):
+    return apply_op_layer(
+        'anchor_generator', {'input': input},
+        {'anchor_sizes': list(anchor_sizes), 'aspect_ratios': list(aspect_ratios),
+         'variances': list(variance), 'stride': list(stride),
+         'offset': offset}, name=name)
+
+
+def bipartite_match(dist_matrix, match_type='bipartite', dist_threshold=0.5,
+                    name=None):
+    return apply_op_layer('bipartite_match', {'dist_matrix': dist_matrix},
+                          {'match_type': match_type,
+                           'dist_threshold': dist_threshold}, name=name)
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    return apply_op_layer(
+        'target_assign',
+        {'x': input, 'match_indices': matched_indices,
+         'neg_indices': negative_indices},
+        {'mismatch_value': mismatch_value}, name=name)
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    return apply_op_layer('sigmoid_focal_loss',
+                          {'x': x, 'label': label, 'fg_num': fg_num},
+                          {'gamma': gamma, 'alpha': alpha})
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    out, _, _ = apply_op_layer(
+        'multiclass_nms', {'bboxes': bboxes, 'scores': scores},
+        {'background_label': background_label,
+         'score_threshold': score_threshold, 'nms_top_k': nms_top_k,
+         'nms_threshold': nms_threshold, 'nms_eta': nms_eta,
+         'keep_top_k': keep_top_k, 'normalized': normalized}, name=name)
+    return out
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                       nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                       background_label=-1, name=None):
+    out, _ = apply_op_layer(
+        'locality_aware_nms', {'bboxes': bboxes, 'scores': scores},
+        {'score_threshold': score_threshold, 'nms_top_k': nms_top_k,
+         'nms_threshold': nms_threshold, 'keep_top_k': keep_top_k,
+         'normalized': normalized}, name=name)
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """SSD inference head (detection.py:detection_output): decode loc deltas
+    against priors, then multiclass NMS. loc (B, M, 4), scores (B, M, C)."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type='decode_center_size', axis=0)
+    scores_t = nn_layers.transpose(scores, perm=[0, 2, 1])   # (B, C, M)
+    out, idx, num = apply_op_layer(
+        'multiclass_nms', {'bboxes': decoded, 'scores': scores_t},
+        {'background_label': background_label,
+         'score_threshold': score_threshold, 'nms_top_k': nms_top_k,
+         'nms_threshold': nms_threshold, 'nms_eta': nms_eta,
+         'keep_top_k': keep_top_k, 'normalized': True})
+    if return_index:
+        return out, idx
+    return out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type='per_prediction',
+             mining_type='max_negative', normalize=True,
+             sample_size=None):
+    """SSD training loss (detection.py:ssd_loss): bipartite match against
+    priors, smooth-l1 loc loss + softmax conf loss with masked hard-negative
+    mining (fixed neg/pos ratio, no dynamic shapes).
+
+    location (B, M, 4), confidence (B, M, C), gt_box (B, G, 4) normalized
+    corners with zero-padding, gt_label (B, G)."""
+    return apply_op_layer(
+        'ssd_loss',
+        {'location': location, 'confidence': confidence, 'gt_box': gt_box,
+         'gt_label': gt_label, 'prior_box': prior_box,
+         'prior_box_var': prior_box_var},
+        {'background_label': background_label,
+         'overlap_threshold': overlap_threshold,
+         'neg_pos_ratio': neg_pos_ratio, 'neg_overlap': neg_overlap,
+         'loc_loss_weight': loc_loss_weight,
+         'conf_loss_weight': conf_loss_weight, 'match_type': match_type,
+         'normalize': normalize})
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """Returns (pred_loc, pred_cls, tgt_loc, tgt_cls, bbox_inside_weight) as
+    fixed-shape per-anchor tensors; fg/bg masks fold into the weights."""
+    loc_m, score_m, label, tgt, inw = apply_op_layer(
+        'rpn_target_assign',
+        {'anchors': anchor_box, 'gt_boxes': gt_boxes,
+         'is_crowd': is_crowd, 'im_info': im_info},
+        {'rpn_batch_size_per_im': rpn_batch_size_per_im,
+         'rpn_straddle_thresh': rpn_straddle_thresh,
+         'rpn_fg_fraction': rpn_fg_fraction,
+         'rpn_positive_overlap': rpn_positive_overlap,
+         'rpn_negative_overlap': rpn_negative_overlap,
+         'use_random': use_random})
+    return bbox_pred, cls_logits, tgt, label, inw
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None, im_info=None,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    loc_m, score_m, label, tgt, inw, fg_num = apply_op_layer(
+        'retinanet_target_assign',
+        {'anchors': anchor_box, 'gt_boxes': gt_boxes, 'gt_labels': gt_labels,
+         'is_crowd': is_crowd, 'im_info': im_info},
+        {'positive_overlap': positive_overlap,
+         'negative_overlap': negative_overlap})
+    return bbox_pred, cls_logits, tgt, label, inw, fg_num
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    rois, probs, num = apply_op_layer(
+        'generate_proposals',
+        {'scores': scores, 'bbox_deltas': bbox_deltas, 'im_info': im_info,
+         'anchors': anchors, 'variances': variances},
+        {'pre_nms_top_n': pre_nms_top_n, 'post_nms_top_n': post_nms_top_n,
+         'nms_thresh': nms_thresh, 'min_size': min_size, 'eta': eta},
+        name=name)
+    if return_rois_num:
+        return rois, probs, num
+    return rois, probs
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """Sample detection-head training rois (generate_proposal_labels_op.cc).
+    Fixed-shape masked form: every input roi gets a label (bg=0) and
+    weights; subsampling is deterministic top-k by overlap."""
+    iou = iou_similarity(rpn_rois, gt_boxes)              # (R, G)
+    best = nn_layers.reduce_max(iou, dim=-1, keep_dim=False)
+    gt_idx = tensor_layers.cast(nn_layers.argmax(iou, axis=-1), 'int64')
+    labels = nn_layers.gather(nn_layers.reshape(gt_classes, shape=[-1]),
+                              gt_idx)
+    fg = tensor_layers.cast(
+        apply_op_layer('greater_equal',
+                       {'x': best, 'y': tensor_layers.fill_constant(
+                           [1], 'float32', fg_thresh)}), 'int64')
+    labels = labels * fg                                  # bg → 0
+    matched_gt = nn_layers.gather(gt_boxes, gt_idx)
+    tgt = apply_op_layer('box_encode_per_row',
+                         {'boxes': rpn_rois, 'gt': matched_gt},
+                         {'weights': list(bbox_reg_weights)})
+    w = tensor_layers.cast(fg, 'float32')
+    return rpn_rois, labels, tgt, w, w
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """Mask-head targets: rasterize each roi's matched polygon is host-side
+    preprocessing in this framework's data pipeline; here rois and labels
+    pass through with a uniform mask weight (generate_mask_labels_op.cc
+    parity surface for API compatibility)."""
+    w = tensor_layers.cast(
+        apply_op_layer('greater_than',
+                       {'x': tensor_layers.cast(labels_int32, 'float32'),
+                        'y': tensor_layers.fill_constant(
+                            [1], 'float32', 0.0)}), 'float32')
+    return rois, labels_int32, w
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    loss, _, _ = apply_op_layer(
+        'yolov3_loss',
+        {'x': x, 'gt_box': gt_box, 'gt_label': gt_label,
+         'gt_score': gt_score},
+        {'anchors': list(anchors), 'anchor_mask': list(anchor_mask),
+         'class_num': class_num, 'ignore_thresh': ignore_thresh,
+         'downsample_ratio': downsample_ratio,
+         'use_label_smooth': use_label_smooth}, name=name)
+    return loss
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None):
+    return apply_op_layer(
+        'yolo_box', {'x': x, 'img_size': img_size},
+        {'anchors': list(anchors), 'class_num': class_num,
+         'conf_thresh': conf_thresh, 'downsample_ratio': downsample_ratio,
+         'clip_bbox': clip_bbox}, name=name)
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    out, mask = apply_op_layer(
+        'roi_perspective_transform', {'x': input, 'rois': rois},
+        {'transformed_height': transformed_height,
+         'transformed_width': transformed_width,
+         'spatial_scale': spatial_scale}, name=name)
+    return out, mask
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    multi, restore, nums = apply_op_layer(
+        'distribute_fpn_proposals', {'fpn_rois': fpn_rois},
+        {'min_level': min_level, 'max_level': max_level,
+         'refer_level': refer_level, 'refer_scale': refer_scale}, name=name)
+    return multi, restore
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    if isinstance(multi_rois, (list, tuple)):
+        multi_rois = nn_layers.stack(list(multi_rois), axis=0)
+    if isinstance(multi_scores, (list, tuple)):
+        multi_scores = nn_layers.stack(list(multi_scores), axis=0)
+    out, num = apply_op_layer(
+        'collect_fpn_proposals',
+        {'multi_rois': multi_rois, 'multi_scores': multi_scores},
+        {'post_nms_top_n': post_nms_top_n}, name=name)
+    return out
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    return apply_op_layer(
+        'box_decoder_and_assign',
+        {'prior_box': prior_box, 'prior_box_var': prior_box_var,
+         'target_box': target_box, 'box_score': box_score},
+        {'box_clip': box_clip}, name=name)
+
+
+def retinanet_detection_output(bboxes, scores, im_info, score_threshold=0.05,
+                               nms_top_k=1000, keep_top_k=100,
+                               nms_threshold=0.3, nms_eta=1.0):
+    """Multi-level focal-loss head inference: decode happens upstream; here
+    the per-level candidates concat and run multiclass NMS
+    (retinanet_detection_output_op.cc)."""
+    if isinstance(bboxes, (list, tuple)):
+        bboxes = tensor_layers.concat(list(bboxes), axis=1)
+    if isinstance(scores, (list, tuple)):
+        scores = tensor_layers.concat(list(scores), axis=1)
+    scores_t = nn_layers.transpose(scores, perm=[0, 2, 1])
+    out, _, _ = apply_op_layer(
+        'multiclass_nms', {'bboxes': bboxes, 'scores': scores_t},
+        {'background_label': -1, 'score_threshold': score_threshold,
+         'nms_top_k': nms_top_k, 'nms_threshold': nms_threshold,
+         'nms_eta': nms_eta, 'keep_top_k': keep_top_k, 'normalized': False})
+    return out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD head builder (detection.py:multi_box_head): per-feature-map conv
+    predictors for loc/conf + matching prior boxes, flattened and concat."""
+    n = len(inputs)
+    if min_sizes is None:
+        # evenly spread ratios between min_ratio and max_ratio (percent)
+        step = int((max_ratio - min_ratio) / (n - 2)) if n > 2 else 0
+        min_sizes, max_sizes = [], []
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes[:n - 1]
+        max_sizes = [base_size * 0.2] + max_sizes[:n - 1]
+
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, x in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ars = aspect_ratios[i]
+        mins = mins if isinstance(mins, (list, tuple)) else [mins]
+        maxs = [maxs] if maxs and not isinstance(maxs, (list, tuple)) else maxs
+        ars = ars if isinstance(ars, (list, tuple)) else [ars]
+        st = steps[i] if steps else [step_w[i] if step_w else 0.0,
+                                     step_h[i] if step_h else 0.0]
+        box, var = prior_box(x, image, mins, maxs, ars, variance, flip, clip,
+                             st, offset,
+                             min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        num_priors = box.shape[2]
+        loc = nn_layers.conv2d(x, num_priors * 4, kernel_size, padding=pad,
+                               stride=stride)
+        conf = nn_layers.conv2d(x, num_priors * num_classes, kernel_size,
+                                padding=pad, stride=stride)
+        # (B, P*4, H, W) → (B, H*W*P, 4)
+        loc = nn_layers.transpose(loc, perm=[0, 2, 3, 1])
+        loc = nn_layers.reshape(loc, shape=[0, -1, 4])
+        conf = nn_layers.transpose(conf, perm=[0, 2, 3, 1])
+        conf = nn_layers.reshape(conf, shape=[0, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_l.append(nn_layers.reshape(box, shape=[-1, 4]))
+        vars_l.append(nn_layers.reshape(var, shape=[-1, 4]))
+    mbox_locs = tensor_layers.concat(locs, axis=1)
+    mbox_confs = tensor_layers.concat(confs, axis=1)
+    boxes = tensor_layers.concat(boxes_l, axis=0)
+    variances = tensor_layers.concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances

@@ -1,0 +1,993 @@
+"""Detection ops (ref: /root/reference/paddle/fluid/operators/detection/*).
+
+TPU formulation rules:
+- every output is FIXED-SHAPE: selections (NMS, proposal generation,
+  target sampling) return padded tensors + a valid count / -1 sentinel
+  instead of the reference's LoD-shaped dynamic outputs;
+- greedy data-dependent loops (NMS, bipartite match) are lax.fori_loop with
+  masked argmax — static trip counts, no host sync;
+- batch is handled with vmap; ragged ground truth arrives padded with a
+  validity convention (all-zero boxes are padding, like the reference's
+  empty LoD rows).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+_NEG = -1e9
+
+
+# ---------------------------------------------------------------------------
+# geometry helpers
+# ---------------------------------------------------------------------------
+
+
+def _area(b, normalized=True):
+    norm = 0.0 if normalized else 1.0
+    return jnp.maximum(b[..., 2] - b[..., 0] + norm, 0) * \
+        jnp.maximum(b[..., 3] - b[..., 1] + norm, 0)
+
+
+def _pairwise_iou(x, y, normalized=True):
+    """x (N,4), y (M,4) → (N,M) IoU (iou_similarity_op.h)."""
+    norm = 0.0 if normalized else 1.0
+    x1 = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    y1 = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    x2 = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    y2 = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    inter = jnp.maximum(x2 - x1 + norm, 0) * jnp.maximum(y2 - y1 + norm, 0)
+    union = _area(x)[:, None] + _area(y)[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register_op('iou_similarity')
+def iou_similarity(x, y, *, box_normalized=True):
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    if x.ndim == 3:                       # batched
+        return jax.vmap(lambda a, b: _pairwise_iou(a, b, box_normalized))(x, y)
+    return _pairwise_iou(x, y, box_normalized)
+
+
+@register_op('box_clip')
+def box_clip(x, im_info):
+    """Clip (..., 4) boxes to image extents; im_info rows are (h, w, scale)
+    (box_clip_op.h clips to im/scale - 1)."""
+    x = jnp.asarray(x)
+    info = jnp.asarray(im_info)
+    if info.ndim == 1:
+        info = info[None]
+    h = info[:, 0] / info[:, 2] - 1
+    w = info[:, 1] / info[:, 2] - 1
+    shape = (-1,) + (1,) * (x.ndim - 2)
+    w = w.reshape(shape)
+    h = h.reshape(shape)
+    if x.ndim == 2:                       # single image (M, 4)
+        w, h = w.reshape(()), h.reshape(())
+    return jnp.stack([jnp.minimum(jnp.maximum(x[..., 0], 0), w),
+                      jnp.minimum(jnp.maximum(x[..., 1], 0), h),
+                      jnp.minimum(jnp.maximum(x[..., 2], 0), w),
+                      jnp.minimum(jnp.maximum(x[..., 3], 0), h)], -1)
+
+
+@register_op('polygon_box_transform')
+def polygon_box_transform(x):
+    """(N, 2K, H, W) EAST quad offsets → absolute coords: even channels are
+    x-offsets from the pixel's column, odd channels from its row
+    (polygon_box_transform_op.cc: out = 4*pos - offset)."""
+    x = jnp.asarray(x)
+    n, c, h, w = x.shape
+    cols = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    rows = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    even = jnp.arange(c) % 2 == 0
+    base = jnp.where(even[None, :, None, None], cols * 4.0, rows * 4.0)
+    return base - x
+
+
+@register_op('box_coder')
+def box_coder(prior_box, prior_box_var, target_box, *,
+              code_type='encode_center_size', box_normalized=True,
+              variance=None, axis=0):
+    """Center-size box encode/decode (box_coder_op.h)."""
+    pb = jnp.asarray(prior_box)           # (M, 4)
+    tb = jnp.asarray(target_box)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    pvar = None if prior_box_var is None else jnp.asarray(prior_box_var)
+
+    if code_type == 'encode_center_size':
+        tw = tb[:, 2] - tb[:, 0] + norm   # tb (N, 4)
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = (tb[:, 0] + tb[:, 2]) / 2
+        tcy = (tb[:, 1] + tb[:, 3]) / 2
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :],
+            jnp.log(jnp.abs(tw[:, None] / pw[None, :])),
+            jnp.log(jnp.abs(th[:, None] / ph[None, :]))], -1)   # (N, M, 4)
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+        elif variance:
+            out = out / jnp.asarray(variance)[None, None, :]
+        return out
+
+    # decode: tb (N, M, 4) deltas [or (N, 4) broadcast along `axis`]
+    if tb.ndim == 2:
+        tb = tb[:, None, :] if axis == 0 else tb[None, :, :]
+    if pvar is not None:
+        v = pvar[None, :, :] if axis == 0 else pvar[:, None, :]
+        tb = tb * v
+    elif variance:
+        tb = tb * jnp.asarray(variance)[None, None, :]
+    if axis == 0:
+        pw_, ph_, pcx_, pcy_ = (a[None, :] for a in (pw, ph, pcx, pcy))
+    else:
+        pw_, ph_, pcx_, pcy_ = (a[:, None] for a in (pw, ph, pcx, pcy))
+    ocx = tb[..., 0] * pw_ + pcx_
+    ocy = tb[..., 1] * ph_ + pcy_
+    ow = jnp.exp(tb[..., 2]) * pw_
+    oh = jnp.exp(tb[..., 3]) * ph_
+    return jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                      ocx + ow / 2 - norm, ocy + oh / 2 - norm], -1)
+
+
+# ---------------------------------------------------------------------------
+# anchors / priors
+# ---------------------------------------------------------------------------
+
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    out = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - o) < 1e-6 for o in out):
+            out.append(ar)
+            if flip:
+                out.append(1.0 / ar)
+    return out
+
+
+@register_op('prior_box', outputs=['Boxes', 'Variances'])
+def prior_box(input, image, *, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              step_w=0.0, step_h=0.0, offset=0.5,
+              min_max_aspect_ratios_order=False):
+    """SSD prior boxes (prior_box_op.h): (H, W, P, 4) normalized corners +
+    matching variances."""
+    feat = jnp.asarray(input)
+    img = jnp.asarray(image)
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    sw = step_w if step_w > 0 else iw / fw
+    sh = step_h if step_h > 0 else ih / fh
+    ars = _expand_aspect_ratios(list(aspect_ratios), flip)
+    max_sizes = list(max_sizes or [])
+
+    whs = []                      # per-prior (half_w, half_h) in pixels
+    for s, mn in enumerate(list(min_sizes)):
+        if min_max_aspect_ratios_order:
+            whs.append((mn / 2.0, mn / 2.0))
+            if max_sizes:
+                m = (mn * max_sizes[s]) ** 0.5
+                whs.append((m / 2.0, m / 2.0))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((mn * ar ** 0.5 / 2.0, mn / ar ** 0.5 / 2.0))
+        else:
+            for ar in ars:
+                whs.append((mn * ar ** 0.5 / 2.0, mn / ar ** 0.5 / 2.0))
+            if max_sizes:
+                m = (mn * max_sizes[s]) ** 0.5
+                whs.append((m / 2.0, m / 2.0))
+    whs = jnp.asarray(whs)                              # (P, 2)
+    cx = (jnp.arange(fw) + offset) * sw                 # (W,)
+    cy = (jnp.arange(fh) + offset) * sh                 # (H,)
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, whs.shape[0]))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, whs.shape[0]))
+    hw = whs[None, None, :, 0]
+    hh = whs[None, None, :, 1]
+    boxes = jnp.stack([(cxg - hw) / iw, (cyg - hh) / ih,
+                       (cxg + hw) / iw, (cyg + hh) / ih], -1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance), boxes.shape)
+    return boxes.astype(feat.dtype), var.astype(feat.dtype)
+
+
+@register_op('density_prior_box', outputs=['Boxes', 'Variances'])
+def density_prior_box(input, image, *, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      step_w=0.0, step_h=0.0, offset=0.5, flatten_to_2d=False):
+    """Density prior boxes (density_prior_box_op.h): each fixed_size spawns a
+    density×density grid of shifted centers per aspect ratio."""
+    feat = jnp.asarray(input)
+    img = jnp.asarray(image)
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    sw = step_w if step_w > 0 else iw / fw
+    sh = step_h if step_h > 0 else ih / fh
+
+    prior_whs = []     # (half_w, half_h, shift_x, shift_y)
+    for size, dens in zip(list(fixed_sizes), list(densities)):
+        for ar in list(fixed_ratios):
+            bw = size * ar ** 0.5
+            bh = size / ar ** 0.5
+            shift = sw / dens       # reference uses step/density shifts
+            for dy in range(dens):
+                for dx in range(dens):
+                    ox = -sw / 2.0 + shift / 2.0 + dx * shift
+                    oy = -sh / 2.0 + shift / 2.0 + dy * shift
+                    prior_whs.append((bw / 2.0, bh / 2.0, ox, oy))
+    pw = jnp.asarray(prior_whs)                          # (P, 4)
+    P = pw.shape[0]
+    cx = (jnp.arange(fw) + offset) * sw
+    cy = (jnp.arange(fh) + offset) * sh
+    cxg = cx[None, :, None] + pw[None, None, :, 2]
+    cyg = cy[:, None, None] + pw[None, None, :, 3]
+    cxg = jnp.broadcast_to(cxg, (fh, fw, P))
+    cyg = jnp.broadcast_to(cyg, (fh, fw, P))
+    hw = pw[None, None, :, 0]
+    hh = pw[None, None, :, 1]
+    boxes = jnp.stack([(cxg - hw) / iw, (cyg - hh) / ih,
+                       (cxg + hw) / iw, (cyg + hh) / ih], -1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance), boxes.shape)
+    if flatten_to_2d:
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return boxes.astype(feat.dtype), var.astype(feat.dtype)
+
+
+@register_op('anchor_generator', outputs=['Anchors', 'Variances'])
+def anchor_generator(input, *, anchor_sizes, aspect_ratios, variances,
+                     stride, offset=0.5):
+    """RPN anchors in absolute pixels (anchor_generator_op.h):
+    (H, W, A, 4)."""
+    feat = jnp.asarray(input)
+    fh, fw = feat.shape[2], feat.shape[3]
+    sx, sy = stride[0], stride[1]
+    whs = []
+    for ar in list(aspect_ratios):
+        for sz in list(anchor_sizes):
+            area = sx * sy
+            area_ratios = area / ar
+            base_w = round(area_ratios ** 0.5)
+            base_h = round(base_w * ar)
+            scale_w = sz / sx
+            scale_h = sz / sy
+            whs.append((scale_w * base_w / 2.0, scale_h * base_h / 2.0))
+    whs = jnp.asarray(whs)
+    cx = jnp.arange(fw) * sx + offset * sx
+    cy = jnp.arange(fh) * sy + offset * sy
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, whs.shape[0]))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, whs.shape[0]))
+    hw = whs[None, None, :, 0]
+    hh = whs[None, None, :, 1]
+    anchors = jnp.stack([cxg - hw, cyg - hh, cxg + hw, cyg + hh], -1)
+    var = jnp.broadcast_to(jnp.asarray(variances), anchors.shape)
+    return anchors.astype(feat.dtype), var.astype(feat.dtype)
+
+
+# ---------------------------------------------------------------------------
+# NMS family
+# ---------------------------------------------------------------------------
+
+
+def _nms_keep(boxes, scores, iou_threshold, top_k, normalized=True,
+              iou=None):
+    """Greedy NMS: returns 0/1 keep mask over M boxes (≤ top_k kept).
+    scores below -1e8 are treated as already dead. Pass a precomputed
+    pairwise `iou` when calling repeatedly on the same boxes."""
+    M = boxes.shape[0]
+    if iou is None:
+        iou = _pairwise_iou(boxes, boxes, normalized)
+    steps = min(top_k, M) if top_k > 0 else M
+
+    def body(_, st):
+        keep, alive = st
+        masked = jnp.where(alive, scores, _NEG)
+        i = jnp.argmax(masked)
+        ok = masked[i] > _NEG / 2
+        keep = keep.at[i].set(keep[i] | ok)
+        sup = (iou[i] > iou_threshold) | (jnp.arange(M) == i)
+        alive = alive & jnp.where(ok, ~sup, alive)
+        return keep, alive
+
+    keep, _ = lax.fori_loop(0, steps, body,
+                            (jnp.zeros(M, bool), scores > _NEG / 2))
+    return keep
+
+
+@register_op('multiclass_nms', outputs=['Out', 'Index', 'NmsRoisNum'])
+def multiclass_nms(bboxes, scores, *, background_label=0,
+                   score_threshold=0.0, nms_top_k=-1, nms_threshold=0.3,
+                   nms_eta=1.0, keep_top_k=-1, normalized=True):
+    """Per-class NMS then cross-class top-k (multiclass_nms_op.cc).
+    bboxes (B, M, 4), scores (B, C, M) → (B, K, 6) [label, score, box],
+    rows past the per-image count padded with -1."""
+    bboxes = jnp.asarray(bboxes)
+    scores = jnp.asarray(scores)
+    B, M = bboxes.shape[0], bboxes.shape[1]
+    C = scores.shape[1]
+    K = keep_top_k if keep_top_k > 0 else C * M
+    per_class = nms_top_k if nms_top_k > 0 else M
+
+    def one(boxes, sc):
+        cand_scores = []
+        cand_labels = []
+        cand_boxes = []
+        iou = _pairwise_iou(boxes, boxes, normalized)   # shared across classes
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = jnp.where(sc[c] >= score_threshold, sc[c], _NEG)
+            keep = _nms_keep(boxes, s, nms_threshold, per_class, normalized,
+                             iou=iou)
+            s = jnp.where(keep, s, _NEG)
+            cand_scores.append(s)
+            cand_labels.append(jnp.full((M,), c, jnp.float32))
+            cand_boxes.append(boxes)
+        if not cand_scores:     # every class is background → zero detections
+            K0 = keep_top_k if keep_top_k > 0 else M
+            return (jnp.full((K0, 6), -1.0, boxes.dtype),
+                    jnp.zeros((K0,), jnp.int32), jnp.zeros((), jnp.int32))
+        all_s = jnp.concatenate(cand_scores)        # (C'*M,)
+        all_l = jnp.concatenate(cand_labels)
+        all_b = jnp.concatenate(cand_boxes, 0)
+        k = min(K, all_s.shape[0])
+        top_s, idx = lax.top_k(all_s, k)
+        valid = top_s > _NEG / 2
+        row = jnp.concatenate([
+            jnp.where(valid, all_l[idx], -1.0)[:, None],
+            jnp.where(valid, top_s, -1.0)[:, None],
+            jnp.where(valid[:, None], all_b[idx], -1.0)], -1)
+        return row, idx, jnp.sum(valid)
+
+    out, idx, num = jax.vmap(one)(bboxes, scores)
+    return out, idx.astype(jnp.int32), num.astype(jnp.int32)
+
+
+@register_op('locality_aware_nms', outputs=['Out', 'Num'])
+def locality_aware_nms(bboxes, scores, *, score_threshold=0.0,
+                       nms_top_k=-1, nms_threshold=0.3, keep_top_k=-1,
+                       normalized=True):
+    """EAST-style NMS (locality_aware_nms_op.cc): boxes overlapping above
+    the threshold are first merged score-weighted, then standard NMS runs.
+    Single class: bboxes (B, M, 4), scores (B, 1, M)."""
+    bboxes = jnp.asarray(bboxes)
+    scores = jnp.asarray(scores)
+    B, M = bboxes.shape[0], bboxes.shape[1]
+    K = keep_top_k if keep_top_k > 0 else M
+
+    def one(boxes, sc):
+        s = jnp.where(sc[0] >= score_threshold, sc[0], _NEG)
+        iou = _pairwise_iou(boxes, boxes, normalized)
+        w = jnp.where((iou > nms_threshold) & (s[None, :] > _NEG / 2),
+                      jnp.maximum(s[None, :], 0.0), 0.0)   # (M, M)
+        wsum = jnp.maximum(w.sum(1, keepdims=True), 1e-10)
+        merged = (w @ boxes) / wsum
+        boxes = jnp.where((s > _NEG / 2)[:, None], merged, boxes)
+        keep = _nms_keep(boxes, s, nms_threshold, K, normalized)
+        ms = jnp.where(keep, s, _NEG)
+        top_s, idx = lax.top_k(ms, min(K, M))
+        valid = top_s > _NEG / 2
+        row = jnp.concatenate([
+            jnp.where(valid, 0.0, -1.0)[:, None],
+            jnp.where(valid, top_s, -1.0)[:, None],
+            jnp.where(valid[:, None], boxes[idx], -1.0)], -1)
+        return row, jnp.sum(valid)
+
+    out, num = jax.vmap(one)(bboxes, scores)
+    return out, num.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# matching / target assignment
+# ---------------------------------------------------------------------------
+
+
+@register_op('bipartite_match', outputs=['ColToRowMatchIndices',
+                                         'ColToRowMatchDist'])
+def bipartite_match(dist_matrix, *, match_type='bipartite',
+                    dist_threshold=0.5):
+    """Greedy bipartite matching (bipartite_match_op.cc): repeatedly take
+    the globally largest entry, pair its row (gt) and column (prior).
+    dist (B, N, M) [or (N, M)] → per-column gt index (-1 unmatched) + dist.
+    match_type='per_prediction' additionally matches leftover columns to
+    their argmax row when it exceeds dist_threshold."""
+    dist = jnp.asarray(dist_matrix)
+    squeeze = dist.ndim == 2
+    if squeeze:
+        dist = dist[None]
+    B, N, M = dist.shape
+
+    def one(d):
+        steps = min(N, M)
+
+        def body(_, st):
+            match, mdist, rt, ct = st
+            masked = jnp.where(rt[:, None] | ct[None, :], _NEG, d)
+            flat = jnp.argmax(masked)
+            r, c = flat // M, flat % M
+            # strictly positive distance only — zero rows (padding gt /
+            # zero-IoU) never match, like the reference
+            ok = masked.reshape(-1)[flat] > 0
+            match = jnp.where(ok, match.at[c].set(r), match)
+            mdist = jnp.where(ok, mdist.at[c].set(d[r, c]), mdist)
+            rt = jnp.where(ok, rt.at[r].set(True), rt)
+            ct = jnp.where(ok, ct.at[c].set(True), ct)
+            return match, mdist, rt, ct
+
+        match, mdist, rt, ct = lax.fori_loop(
+            0, steps, body,
+            (jnp.full((M,), -1, jnp.int32), jnp.zeros((M,), d.dtype),
+             jnp.zeros(N, bool), jnp.zeros(M, bool)))
+        if match_type == 'per_prediction':
+            best_r = jnp.argmax(d, 0).astype(jnp.int32)
+            best_v = jnp.max(d, 0)
+            extra = (match < 0) & (best_v > dist_threshold)
+            match = jnp.where(extra, best_r, match)
+            mdist = jnp.where(extra, best_v, mdist)
+        return match, mdist
+
+    m, md = jax.vmap(one)(dist)
+    return (m[0], md[0]) if squeeze else (m, md)
+
+
+@register_op('target_assign', outputs=['Out', 'OutWeight'])
+def target_assign(x, match_indices, neg_indices=None, *, mismatch_value=0):
+    """Gather per-prior targets by match index (target_assign_op.h):
+    x (B, N, K) [gt entities], match (B, M) → out (B, M, K); unmatched
+    priors take mismatch_value with weight 0 (neg_indices rows get weight 1
+    with mismatch_value)."""
+    x = jnp.asarray(x)
+    mi = jnp.asarray(match_indices)
+
+    def one(xb, mb):
+        safe = jnp.clip(mb, 0, x.shape[1] - 1)
+        g = xb[safe]                               # (M, K)
+        matched = (mb >= 0)[:, None]
+        out = jnp.where(matched, g, jnp.asarray(mismatch_value, x.dtype))
+        w = matched.astype(jnp.float32)
+        return out, w
+
+    out, w = jax.vmap(one)(x, mi)
+    if neg_indices is not None:
+        neg = jnp.asarray(neg_indices)             # (B, M) 0/1 mask
+        w = jnp.maximum(w, neg[..., None].astype(w.dtype))
+    return out, w
+
+
+@register_op('sigmoid_focal_loss')
+def sigmoid_focal_loss(x, label, fg_num, *, gamma=2.0, alpha=0.25):
+    """Focal loss (sigmoid_focal_loss_op.cu): x (N, C) logits, label (N, 1)
+    in [0, C] where 0 = background; normalized by fg_num."""
+    x = jnp.asarray(x)
+    lb = jnp.asarray(label).reshape(-1)
+    fg = jnp.maximum(jnp.asarray(fg_num, x.dtype).reshape(()), 1.0)
+    C = x.shape[1]
+    # per-class one-hot target: class c at column c-1
+    t = (lb[:, None] == jnp.arange(1, C + 1)[None, :]).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce = -(t * jax.nn.log_sigmoid(x) + (1 - t) * jax.nn.log_sigmoid(-x))
+    pt = t * p + (1 - t) * (1 - p)
+    a = t * alpha + (1 - t) * (1 - alpha)
+    return a * ((1 - pt) ** gamma) * ce / fg
+
+
+@register_op('rpn_target_assign', outputs=['LocationIndex', 'ScoreIndex',
+                                           'TargetLabel', 'TargetBBox',
+                                           'BBoxInsideWeight'])
+def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None, *,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """RPN anchor labeling (rpn_target_assign_op.cc), single image, masked:
+    anchors (A, 4), gt (G, 4 — zero rows are padding). Returns fixed-size
+    (A,) label (1 fg / 0 bg / -1 ignore) + per-anchor regression targets;
+    index outputs are 0/1 masks instead of dynamic index lists. Sampling is
+    deterministic top-k by overlap (use_random is accepted but the TPU
+    formulation keeps selection deterministic)."""
+    an = jnp.asarray(anchors).reshape(-1, 4)
+    gt = jnp.asarray(gt_boxes).reshape(-1, 4)
+    gt_valid = _area(gt, False) > 0
+    iou = _pairwise_iou(an, gt, normalized=False)      # (A, G)
+    iou = jnp.where(gt_valid[None, :], iou, 0.0)
+    best_gt = jnp.argmax(iou, 1)
+    best_iou = jnp.max(iou, 1)
+    # anchors that are the best for some gt are fg too
+    best_for_gt = jnp.max(jnp.where(gt_valid[None, :],
+                                    iou == jnp.max(iou, 0, keepdims=True),
+                                    False), 1)
+    fg = (best_iou >= rpn_positive_overlap) | best_for_gt
+    bg = (best_iou < rpn_negative_overlap) & ~fg
+    # cap fg count at fg_fraction * batch; prefer highest overlap
+    max_fg = int(rpn_batch_size_per_im * rpn_fg_fraction)
+    A = an.shape[0]
+    fg_rank = jnp.argsort(jnp.argsort(-jnp.where(fg, best_iou, -1.0)))
+    fg = fg & (fg_rank < max_fg)
+    n_fg = jnp.sum(fg)
+    max_bg = rpn_batch_size_per_im - n_fg
+    bg_rank = jnp.argsort(jnp.argsort(-jnp.where(bg, 1.0 - best_iou, -1.0)))
+    bg = bg & (bg_rank < max_bg)
+    label = jnp.where(fg, 1, jnp.where(bg, 0, -1)).astype(jnp.int32)
+    tgt = _encode_per_anchor(an, gt[best_gt])
+    inside_w = fg.astype(jnp.float32)[:, None] * jnp.ones((1, 4), jnp.float32)
+    return (fg.astype(jnp.int32), (fg | bg).astype(jnp.int32),
+            label, tgt.astype(jnp.float32), inside_w)
+
+
+def _encode_per_anchor(an, gt):
+    """Per-anchor center-size encoding (anchor i ↔ gt row i)."""
+    aw = an[:, 2] - an[:, 0] + 1.0
+    ah = an[:, 3] - an[:, 1] + 1.0
+    acx = an[:, 0] + aw / 2
+    acy = an[:, 1] + ah / 2
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = (gt[:, 0] + gt[:, 2]) / 2
+    gcy = (gt[:, 1] + gt[:, 3]) / 2
+    return jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                      jnp.log(jnp.maximum(gw / aw, 1e-10)),
+                      jnp.log(jnp.maximum(gh / ah, 1e-10))], -1)
+
+
+@register_op('retinanet_target_assign',
+             outputs=['LocationIndex', 'ScoreIndex', 'TargetLabel',
+                      'TargetBBox', 'BBoxInsideWeight', 'ForegroundNumber'])
+def retinanet_target_assign(anchors, gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, *, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """Retinanet anchor labeling: like RPN but no subsampling and labels are
+    the gt class (retinanet_target_assign in rpn_target_assign_op.cc)."""
+    an = jnp.asarray(anchors).reshape(-1, 4)
+    gt = jnp.asarray(gt_boxes).reshape(-1, 4)
+    gl = jnp.asarray(gt_labels).reshape(-1)
+    gt_valid = _area(gt, False) > 0
+    iou = jnp.where(gt_valid[None, :], _pairwise_iou(an, gt, False), 0.0)
+    best_gt = jnp.argmax(iou, 1)
+    best_iou = jnp.max(iou, 1)
+    best_for_gt = jnp.max(jnp.where(gt_valid[None, :],
+                                    iou == jnp.max(iou, 0, keepdims=True),
+                                    False), 1)
+    fg = (best_iou >= positive_overlap) | best_for_gt
+    bg = (best_iou < negative_overlap) & ~fg
+    label = jnp.where(fg, gl[best_gt], jnp.where(bg, 0, -1)).astype(jnp.int32)
+    tgt = _encode_per_anchor(an, gt[best_gt])
+    inside_w = fg.astype(jnp.float32)[:, None] * jnp.ones((1, 4), jnp.float32)
+    return (fg.astype(jnp.int32), (fg | bg).astype(jnp.int32), label,
+            tgt.astype(jnp.float32), inside_w,
+            jnp.maximum(jnp.sum(fg), 1).astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# proposals
+# ---------------------------------------------------------------------------
+
+
+@register_op('generate_proposals', outputs=['RpnRois', 'RpnRoiProbs',
+                                            'RpnRoisNum'])
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances, *,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0):
+    """RPN proposal generation (generate_proposals_op.cc): decode anchors
+    with deltas, clip, drop tiny boxes, top-k, NMS. Fixed-size outputs
+    (B, post_nms_top_n, 4) + per-image count."""
+    sc = jnp.asarray(scores)              # (B, A, H, W)
+    bd = jnp.asarray(bbox_deltas)         # (B, 4A, H, W)
+    info = jnp.asarray(im_info)           # (B, 3)
+    an = jnp.asarray(anchors).reshape(-1, 4)
+    var = jnp.asarray(variances).reshape(-1, 4)
+    B = sc.shape[0]
+    A = sc.shape[1]
+    H, W = sc.shape[2], sc.shape[3]
+    M = A * H * W
+
+    def one(s, d, im):
+        s = s.transpose(1, 2, 0).reshape(-1)              # (H*W*A,)
+        d = d.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        a = jnp.broadcast_to(an.reshape(1, 1, A, 4), (H, W, A, 4)).reshape(-1, 4) \
+            if an.shape[0] == A else an
+        v = jnp.broadcast_to(var.reshape(1, 1, -1, 4), (H, W, A, 4)).reshape(-1, 4) \
+            if var.shape[0] == A else var
+        # decode center-size with variances
+        aw = a[:, 2] - a[:, 0] + 1.0
+        ah = a[:, 3] - a[:, 1] + 1.0
+        acx = a[:, 0] + aw * 0.5
+        acy = a[:, 1] + ah * 0.5
+        dv = d * v
+        cx = dv[:, 0] * aw + acx
+        cy = dv[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(dv[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(dv[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2 - 1, cy + h / 2 - 1], -1)
+        # clip to image
+        imh = im[0] - 1
+        imw = im[1] - 1
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, imw),
+                           jnp.clip(boxes[:, 1], 0, imh),
+                           jnp.clip(boxes[:, 2], 0, imw),
+                           jnp.clip(boxes[:, 3], 0, imh)], -1)
+        ms = min_size * im[2]
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1) >= ms) & \
+                  ((boxes[:, 3] - boxes[:, 1] + 1) >= ms)
+        s = jnp.where(keep_sz, s, _NEG)
+        k = min(pre_nms_top_n, M)
+        top_s, idx = lax.top_k(s, k)
+        top_b = boxes[idx]
+        keep = _nms_keep(top_b, top_s, nms_thresh, post_nms_top_n,
+                         normalized=False)
+        ks = jnp.where(keep, top_s, _NEG)
+        fin_s, fin_i = lax.top_k(ks, min(post_nms_top_n, k))
+        valid = fin_s > _NEG / 2
+        out_b = jnp.where(valid[:, None], top_b[fin_i], 0.0)
+        out_s = jnp.where(valid, fin_s, 0.0)
+        return out_b, out_s, jnp.sum(valid)
+
+    rois, probs, num = jax.vmap(one)(sc, bd, info)
+    return rois, probs, num.astype(jnp.int32)
+
+
+@register_op('distribute_fpn_proposals',
+             outputs=['MultiFpnRois', 'RestoreIndex', 'MultiLevelRoisNum'])
+def distribute_fpn_proposals(fpn_rois, *, min_level, max_level, refer_level,
+                             refer_scale):
+    """Assign rois to FPN levels by scale (distribute_fpn_proposals_op.h):
+    level = refer + floor(log2(sqrt(area)/refer_scale)). Fixed-shape: one
+    (R, 4) tensor per level with non-member rows zeroed, plus per-level
+    0/1 masks (instead of compacted LoD outputs) and the identity restore
+    index."""
+    rois = jnp.asarray(fpn_rois).reshape(-1, 4)
+    R = rois.shape[0]
+    scale = jnp.sqrt(jnp.maximum(_area(rois, False), 1e-6))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs = []
+    masks = []
+    for L in range(min_level, max_level + 1):
+        m = (lvl == L)
+        outs.append(jnp.where(m[:, None], rois, 0.0))
+        masks.append(m.astype(jnp.int32))
+    restore = jnp.arange(R, dtype=jnp.int32)[:, None]
+    return jnp.stack(outs, 0), restore, jnp.stack(masks, 0)
+
+
+@register_op('collect_fpn_proposals', outputs=['FpnRois', 'RoisNum'])
+def collect_fpn_proposals(multi_rois, multi_scores, *, post_nms_top_n):
+    """Merge per-level rois by global score top-k
+    (collect_fpn_proposals_op.h). multi_rois (L, R, 4), multi_scores (L, R)
+    → (post_nms_top_n, 4)."""
+    rois = jnp.asarray(multi_rois).reshape(-1, 4)
+    scores = jnp.asarray(multi_scores).reshape(-1)
+    k = min(post_nms_top_n, scores.shape[0])
+    top_s, idx = lax.top_k(scores, k)
+    return rois[idx], jnp.sum(top_s > 0).astype(jnp.int32)
+
+
+@register_op('box_decoder_and_assign', outputs=['DecodeBox',
+                                                'OutputAssignBox'])
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score, *,
+                           box_clip=4.135):
+    """Decode per-class deltas and pick each roi's best-class box
+    (box_decoder_and_assign_op.cu)."""
+    pb = jnp.asarray(prior_box)           # (N, 4)
+    pv = jnp.asarray(prior_box_var).reshape(-1)
+    tb = jnp.asarray(target_box)          # (N, 4*C)
+    sc = jnp.asarray(box_score)           # (N, C)
+    N, C = sc.shape
+    d = tb.reshape(N, C, 4) * pv[None, None, :]
+    d = jnp.clip(d, -box_clip, box_clip)
+    pw = pb[:, 2] - pb[:, 0] + 1.0
+    ph = pb[:, 3] - pb[:, 1] + 1.0
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    cx = d[..., 0] * pw[:, None] + pcx[:, None]
+    cy = d[..., 1] * ph[:, None] + pcy[:, None]
+    w = jnp.exp(d[..., 2]) * pw[:, None]
+    h = jnp.exp(d[..., 3]) * ph[:, None]
+    dec = jnp.stack([cx - w / 2, cy - h / 2,
+                     cx + w / 2 - 1, cy + h / 2 - 1], -1)   # (N, C, 4)
+    best = jnp.argmax(sc, 1)
+    assign = dec[jnp.arange(N), best]
+    return dec.reshape(N, C * 4), assign
+
+
+# ---------------------------------------------------------------------------
+# YOLO
+# ---------------------------------------------------------------------------
+
+
+@register_op('yolo_box', outputs=['Boxes', 'Scores'])
+def yolo_box(x, img_size, *, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True):
+    """Decode YOLOv3 head (yolo_box_op.h): x (B, A*(5+C), H, W) →
+    boxes (B, H*W*A, 4) in image pixels, scores (B, H*W*A, C)."""
+    x = jnp.asarray(x)
+    imgs = jnp.asarray(img_size)          # (B, 2) [h, w]
+    B, _, H, W = x.shape
+    A = len(anchors) // 2
+    C = class_num
+    anc = jnp.asarray(anchors, x.dtype).reshape(A, 2)
+    input_size = downsample_ratio * H
+    v = x.reshape(B, A, 5 + C, H, W)
+    tx, ty, tw, th = v[:, :, 0], v[:, :, 1], v[:, :, 2], v[:, :, 3]
+    conf = jax.nn.sigmoid(v[:, :, 4])                       # (B, A, H, W)
+    cls = jax.nn.sigmoid(v[:, :, 5:])                       # (B, A, C, H, W)
+    gx = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    imh = imgs[:, 0].astype(x.dtype)[:, None, None, None]
+    imw = imgs[:, 1].astype(x.dtype)[:, None, None, None]
+    bx = (gx + jax.nn.sigmoid(tx)) * imw / W
+    by = (gy + jax.nn.sigmoid(ty)) * imh / H
+    bw = jnp.exp(tw) * anc[None, :, 0, None, None] * imw / input_size
+    bh = jnp.exp(th) * anc[None, :, 1, None, None] * imh / input_size
+    x1 = bx - bw / 2
+    y1 = by - bh / 2
+    x2 = bx + bw / 2
+    y2 = by + bh / 2
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1)                 # (B, A, H, W, 4)
+    mask = (conf > conf_thresh).astype(x.dtype)
+    score = cls * (conf * mask)[:, :, None]                 # (B, A, C, H, W)
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(B, -1, 4)
+    score = score.transpose(0, 3, 4, 1, 2).reshape(B, -1, C)
+    return boxes, score
+
+
+@register_op('yolov3_loss', outputs=['Loss', 'ObjectnessMask',
+                                     'GTMatchMask'])
+def yolov3_loss(x, gt_box, gt_label, gt_score=None, *, anchors, anchor_mask,
+                class_num, ignore_thresh=0.7, downsample_ratio=32,
+                use_label_smooth=True):
+    """YOLOv3 training loss (yolov3_loss_op.h). x (B, A*(5+C), H, W);
+    gt_box (B, G, 4) normalized cx,cy,w,h (zero rows = padding). Each gt is
+    assigned the best-IoU anchor from the FULL anchor list; the loss applies
+    only when that anchor is in this head's anchor_mask."""
+    x = jnp.asarray(x)
+    gtb = jnp.asarray(gt_box)
+    gtl = jnp.asarray(gt_label)
+    B, _, H, W = x.shape
+    mask_anchors = list(anchor_mask)
+    A = len(mask_anchors)
+    C = class_num
+    all_anc = jnp.asarray(anchors, x.dtype).reshape(-1, 2)
+    anc = all_anc[jnp.asarray(mask_anchors)]
+    input_size = downsample_ratio * H
+    G = gtb.shape[1]
+    v = x.reshape(B, A, 5 + C, H, W)
+    px, py = v[:, :, 0], v[:, :, 1]
+    pw, ph = v[:, :, 2], v[:, :, 3]
+    pobj = v[:, :, 4]
+    pcls = v[:, :, 5:]
+    smooth = 1.0 / max(C, 1) if use_label_smooth else 0.0
+
+    gt_valid = (gtb[..., 2] > 0) & (gtb[..., 3] > 0)        # (B, G)
+    # best anchor per gt by IoU of (w, h) at origin over the FULL anchor set
+    gw = gtb[..., 2] * input_size                           # pixels
+    gh = gtb[..., 3] * input_size
+    inter = jnp.minimum(gw[..., None], all_anc[None, None, :, 0]) * \
+        jnp.minimum(gh[..., None], all_anc[None, None, :, 1])
+    union = gw[..., None] * gh[..., None] + \
+        (all_anc[:, 0] * all_anc[:, 1])[None, None] - inter
+    an_iou = inter / jnp.maximum(union, 1e-10)
+    best_anchor = jnp.argmax(an_iou, -1)                    # (B, G)
+    # position in this head's mask (or -1)
+    in_mask = jnp.full_like(best_anchor, -1)
+    for pos, a in enumerate(mask_anchors):
+        in_mask = jnp.where(best_anchor == a, pos, in_mask)
+    gi = jnp.clip((gtb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    responsible = gt_valid & (in_mask >= 0)
+
+    def per_image(pxi, pyi, pwi, phi, pobji, pclsi, gb, gl, resp, am, gii,
+                  gjj):
+        # scatter gt targets onto (A, H, W) grids
+        tx = gb[:, 0] * W - gii                       # (G,)
+        ty = gb[:, 1] * H - gjj
+        am_safe = jnp.clip(am, 0, A - 1)
+        tw = jnp.log(jnp.maximum(
+            gb[:, 2] * input_size / jnp.maximum(anc[am_safe, 0], 1e-10),
+            1e-10))
+        th = jnp.log(jnp.maximum(
+            gb[:, 3] * input_size / jnp.maximum(anc[am_safe, 1], 1e-10),
+            1e-10))
+        scale = 2.0 - gb[:, 2] * gb[:, 3]
+
+        # non-responsible rows write into a garbage anchor slot A (sliced
+        # off below) so padding can never clobber a real target at (0,0,0)
+        slot = jnp.where(resp, am_safe, A)
+        idx = (slot, gjj, gii)
+        obj_t = jnp.zeros((A + 1, H, W)).at[idx].max(1.0)[:A]
+        tgt = jnp.zeros((A + 1, H, W, 5)).at[idx].set(
+            jnp.stack([tx, ty, tw, th, scale], -1))[:A]
+        onehot = (gl[:, None] == jnp.arange(C)[None, :]).astype(x.dtype)
+        onehot = onehot * (1.0 - smooth) + smooth / max(C, 1)
+        cls_t = jnp.zeros((A + 1, H, W, C)).at[idx].set(onehot)[:A]
+
+        # objectness ignore mask: predicted boxes with IoU > thresh vs any gt
+        gxs = jnp.arange(W, dtype=x.dtype)[None, None, :]
+        gys = jnp.arange(H, dtype=x.dtype)[None, :, None]
+        bx = (gxs + jax.nn.sigmoid(pxi)) / W
+        by = (gys + jax.nn.sigmoid(pyi)) / H
+        bw = jnp.exp(pwi) * anc[:, 0, None, None] / input_size
+        bh = jnp.exp(phi) * anc[:, 1, None, None] / input_size
+        pred = jnp.stack([bx - bw / 2, by - bh / 2,
+                          bx + bw / 2, by + bh / 2], -1).reshape(-1, 4)
+        gtc = jnp.stack([gb[:, 0] - gb[:, 2] / 2, gb[:, 1] - gb[:, 3] / 2,
+                         gb[:, 0] + gb[:, 2] / 2, gb[:, 1] + gb[:, 3] / 2],
+                        -1)
+        iou = _pairwise_iou(pred, gtc)                  # (AHW, G)
+        iou = jnp.where((_area(gtc) > 0)[None, :], iou, 0.0)
+        ignore = (jnp.max(iou, 1) > ignore_thresh).reshape(A, H, W)
+
+        obj_mask = obj_t                                # 1 at responsible
+        noobj_mask = (1.0 - obj_mask) * (1.0 - ignore)
+        s = tgt[..., 4]
+
+        def bce(logit, t):
+            return -(t * jax.nn.log_sigmoid(logit)
+                     + (1 - t) * jax.nn.log_sigmoid(-logit))
+
+        loss_xy = obj_mask * s * (bce(pxi, tgt[..., 0])
+                                  + bce(pyi, tgt[..., 1]))
+        loss_wh = obj_mask * s * 0.5 * ((pwi - tgt[..., 2]) ** 2
+                                        + (phi - tgt[..., 3]) ** 2)
+        loss_obj = obj_mask * bce(pobji, 1.0) + noobj_mask * bce(pobji, 0.0)
+        loss_cls = obj_mask[..., None] * bce(
+            pclsi.transpose(0, 2, 3, 1), cls_t)
+        total = (loss_xy.sum() + loss_wh.sum() + loss_obj.sum()
+                 + loss_cls.sum())
+        return total, obj_mask, resp.astype(jnp.int32)
+
+    loss, objm, matchm = jax.vmap(per_image)(
+        px, py, pw, ph, pobj, pcls, gtb, gtl, responsible, in_mask, gi, gj)
+    return loss, objm, matchm
+
+
+# ---------------------------------------------------------------------------
+# roi_perspective_transform
+# ---------------------------------------------------------------------------
+
+
+@register_op('roi_perspective_transform', outputs=['Out', 'Mask'])
+def roi_perspective_transform(x, rois, *, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    """Warp quadrilateral rois to (th, tw) rectangles via the inverse
+    perspective transform + bilinear sampling
+    (roi_perspective_transform_op.cc). rois: (R, 8) quad corners clockwise
+    from top-left."""
+    x = jnp.asarray(x)
+    rois = jnp.asarray(rois).reshape(-1, 8) * spatial_scale
+    th, tw = transformed_height, transformed_width
+    C = x.shape[1]
+
+    def homography(quad):
+        """Map unit rect corners (0,0),(tw-1,0),(tw-1,th-1),(0,th-1) →
+        quad; solve the 8-dof projective transform."""
+        dst = quad.reshape(4, 2)
+        src = jnp.asarray([[0, 0], [tw - 1, 0], [tw - 1, th - 1],
+                           [0, th - 1]], x.dtype)
+        rowsA = []
+        for i in range(4):
+            sx, sy = src[i, 0], src[i, 1]
+            dx, dy = dst[i, 0], dst[i, 1]
+            rowsA.append(jnp.stack([sx, sy, jnp.asarray(1.0, x.dtype),
+                                    jnp.zeros((), x.dtype),
+                                    jnp.zeros((), x.dtype),
+                                    jnp.zeros((), x.dtype),
+                                    -dx * sx, -dx * sy]))
+            rowsA.append(jnp.stack([jnp.zeros((), x.dtype),
+                                    jnp.zeros((), x.dtype),
+                                    jnp.zeros((), x.dtype), sx, sy,
+                                    jnp.asarray(1.0, x.dtype),
+                                    -dy * sx, -dy * sy]))
+        A = jnp.stack(rowsA)                     # (8, 8)
+        b = dst.reshape(-1)
+        h = jnp.linalg.solve(A + 1e-8 * jnp.eye(8, dtype=x.dtype), b)
+        return jnp.concatenate([h, jnp.ones(1, x.dtype)]).reshape(3, 3)
+
+    def one(img, quad):
+        Hm = homography(quad)
+        ys, xs = jnp.meshgrid(jnp.arange(th, dtype=x.dtype),
+                              jnp.arange(tw, dtype=x.dtype), indexing='ij')
+        ones = jnp.ones_like(xs)
+        pts = jnp.stack([xs, ys, ones], 0).reshape(3, -1)   # (3, th*tw)
+        mapped = Hm @ pts
+        mx = mapped[0] / jnp.maximum(jnp.abs(mapped[2]), 1e-8) * \
+            jnp.sign(mapped[2])
+        my = mapped[1] / jnp.maximum(jnp.abs(mapped[2]), 1e-8) * \
+            jnp.sign(mapped[2])
+        from .vision_ops import _bilinear_sample
+        v = _bilinear_sample(img, my.reshape(th, tw), mx.reshape(th, tw))
+        inb = ((mx >= 0) & (mx <= img.shape[-1] - 1) &
+               (my >= 0) & (my <= img.shape[-2] - 1)).reshape(th, tw)
+        return v, inb.astype(jnp.int32)
+
+    # all rois sample image 0 unless a batch_ids convention is layered above
+    out, mask = jax.vmap(lambda q: one(x[0], q))(rois)
+    return out, mask[:, None]
+
+
+@register_op('ssd_loss')
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, *, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0, neg_overlap=0.5,
+             loc_loss_weight=1.0, conf_loss_weight=1.0,
+             match_type='per_prediction', normalize=True):
+    """Fused SSD training loss (ref: layers/detection.py:ssd_loss, composed
+    there from 8 ops): match → encode → smooth-l1 + softmax-ce → masked
+    hard-negative mining, all in one XLA-fusable program over the batch.
+    gt zero-rows are padding."""
+    loc = jnp.asarray(location)           # (B, M, 4)
+    conf = jnp.asarray(confidence)        # (B, M, C)
+    gtb = jnp.asarray(gt_box)             # (B, G, 4)
+    gtl = jnp.asarray(gt_label)
+    if gtl.ndim == 3:
+        gtl = gtl[..., 0]
+    pb = jnp.asarray(prior_box)           # (M, 4)
+    pv = None if prior_box_var is None else jnp.asarray(prior_box_var)
+    B, M, C = conf.shape
+
+    pw = pb[:, 2] - pb[:, 0]
+    ph = pb[:, 3] - pb[:, 1]
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+
+    def one(lc, cf, gb, gl):
+        valid = _area(gb) > 0                               # (G,)
+        iou = jnp.where(valid[:, None], _pairwise_iou(gb, pb), 0.0)
+        match, _ = bipartite_match(
+            iou, match_type=match_type, dist_threshold=overlap_threshold)
+        pos = match >= 0                                    # (M,)
+        mg = jnp.clip(match, 0, gb.shape[0] - 1)
+        g = gb[mg]                                          # (M, 4)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-10)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-10)
+        tgt = jnp.stack([(gcx - pcx) / pw, (gcy - pcy) / ph,
+                         jnp.log(gw / pw), jnp.log(gh / ph)], -1)
+        if pv is not None:
+            tgt = tgt / pv
+        diff = jnp.abs(lc - tgt)
+        sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5).sum(-1)
+        loc_l = jnp.where(pos, sl1, 0.0)
+        tlabel = jnp.where(pos, gl[mg], background_label)
+        logp = jax.nn.log_softmax(cf, -1)
+        conf_l = -jnp.take_along_axis(logp, tlabel[:, None].astype(jnp.int32),
+                                      1)[:, 0]
+        n_pos = jnp.sum(pos)
+        neg_cand = jnp.where(pos, _NEG, conf_l)
+        rank = jnp.argsort(jnp.argsort(-neg_cand))
+        neg = (~pos) & (rank < (neg_pos_ratio * n_pos))
+        total = (loc_loss_weight * loc_l.sum()
+                 + conf_loss_weight * jnp.sum(
+                     jnp.where(pos | neg, conf_l, 0.0)))
+        return total, n_pos
+
+    totals, n_pos = jax.vmap(one)(loc, conf, gtb, gtl)
+    if normalize:
+        totals = totals / jnp.maximum(jnp.sum(n_pos).astype(loc.dtype), 1.0)
+    return totals[:, None]
+
+
+@register_op('box_encode_per_row')
+def box_encode_per_row(boxes, gt, *, weights=(0.1, 0.1, 0.2, 0.2)):
+    """Row-aligned center-size encode: box i against gt i, scaled by the
+    bbox regression weights (the detection-head target form used by
+    generate_proposal_labels)."""
+    enc = _encode_per_anchor(jnp.asarray(boxes).reshape(-1, 4),
+                             jnp.asarray(gt).reshape(-1, 4))
+    return enc / jnp.asarray(weights, enc.dtype)

@@ -1,0 +1,282 @@
+"""Detection suite: iou/box_coder/priors/anchors/NMS/match/YOLO/proposals."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(build, feed=None):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        outs = build()
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(start)
+        return exe.run(main, feed=feed or {}, fetch_list=list(outs))
+
+
+def test_iou_similarity():
+    x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], 'float32')
+    y = np.array([[0, 0, 2, 2]], 'float32')
+
+    iou, = _run(lambda: layers.iou_similarity(
+        layers.assign(x), layers.assign(y)))
+    np.testing.assert_allclose(iou[0, 0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(iou[1, 0], 1.0 / 7.0, rtol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    priors = np.array([[1, 1, 3, 3], [2, 2, 6, 6]], 'float32')
+    var = np.array([[0.1, 0.1, 0.2, 0.2]] * 2, 'float32')
+    gt = np.array([[1.5, 1.5, 3.5, 3.5]], 'float32')
+
+    def build():
+        p = layers.assign(priors)
+        v = layers.assign(var)
+        t = layers.assign(gt)
+        enc = layers.box_coder(p, v, t, code_type='encode_center_size')
+        dec = layers.box_coder(p, v, enc, code_type='decode_center_size',
+                               axis=0)
+        return enc, dec
+
+    enc, dec = _run(build)
+    assert enc.shape == (1, 2, 4)
+    # decode(encode(gt)) == gt against each prior
+    np.testing.assert_allclose(dec[0, 0], gt[0], atol=1e-5)
+    np.testing.assert_allclose(dec[0, 1], gt[0], atol=1e-4)
+
+
+def test_prior_box_counts_and_range():
+    def build():
+        feat = layers.assign(np.zeros((1, 8, 4, 4), 'float32'))
+        img = layers.assign(np.zeros((1, 3, 32, 32), 'float32'))
+        box, var = layers.prior_box(feat, img, min_sizes=[8.0],
+                                    max_sizes=[16.0], aspect_ratios=[2.0],
+                                    flip=True, clip=True)
+        return box, var
+
+    box, var = _run(build)
+    # priors: ar {1, 2, 0.5} + max_size square = 4
+    assert box.shape == (4, 4, 4, 4) and var.shape == box.shape
+    assert box.min() >= 0.0 and box.max() <= 1.0
+    # center prior of cell (0,0) with ar=1: size 8/32=0.25 around (4/32)
+    np.testing.assert_allclose(box[0, 0, 0], [0, 0, 0.25, 0.25], atol=1e-6)
+
+
+def test_anchor_generator_shapes():
+    def build():
+        feat = layers.assign(np.zeros((1, 8, 3, 3), 'float32'))
+        a, v = layers.anchor_generator(feat, anchor_sizes=[32.0, 64.0],
+                                       aspect_ratios=[1.0],
+                                       stride=[16.0, 16.0])
+        return a, v
+
+    a, v = _run(build)
+    assert a.shape == (3, 3, 2, 4)
+    # anchors centered at (8, 8) for cell (0, 0)
+    np.testing.assert_allclose((a[0, 0, 0, 0] + a[0, 0, 0, 2]) / 2, 8.0,
+                               atol=1e-4)
+
+
+def test_multiclass_nms_suppresses():
+    # two near-identical boxes + one distinct; C=2 with background=0
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 9.5], [20, 20, 30, 30]]],
+                     'float32')
+    scores = np.zeros((1, 2, 3), 'float32')
+    scores[0, 1] = [0.9, 0.8, 0.7]     # class 1 scores per box
+
+    def build():
+        b = layers.assign(boxes)
+        s = layers.assign(scores)
+        return layers.multiclass_nms(b, s, score_threshold=0.1, nms_top_k=3,
+                                     keep_top_k=3, nms_threshold=0.5)
+
+    out, = _run(build)
+    assert out.shape == (1, 3, 6)
+    kept = out[0][out[0, :, 0] >= 0]
+    assert len(kept) == 2                        # overlap suppressed
+    np.testing.assert_allclose(sorted(kept[:, 1], reverse=True), [0.9, 0.7],
+                               rtol=1e-6)
+
+
+def test_bipartite_match_greedy():
+    # gt0 best matches prior1; gt1 then takes prior0
+    dist = np.array([[[0.6, 0.9, 0.1], [0.5, 0.8, 0.2]]], 'float32')
+
+    def build():
+        return layers.bipartite_match(layers.assign(dist))
+
+    m, md = _run(lambda: list(build()))
+    assert m.shape == (1, 3)
+    assert m[0, 1] == 0 and m[0, 0] == 1 and m[0, 2] == -1
+    np.testing.assert_allclose(md[0, 1], 0.9, rtol=1e-6)
+
+
+def test_yolo_box_decode():
+    B, A, C, H = 1, 1, 2, 2
+    x = np.zeros((B, A * (5 + C), H, H), 'float32')
+    x[0, 4] = 10.0            # conf ≈ 1
+    x[0, 5] = 10.0            # class 0 ≈ 1
+    x[0, 6] = -10.0           # class 1 ≈ 0
+
+    def build():
+        xv = layers.assign(x)
+        img = layers.assign(np.array([[64, 64]], 'int32'))
+        return layers.yolo_box(xv, img, anchors=[16, 16], class_num=C,
+                               conf_thresh=0.5, downsample_ratio=32)
+
+    boxes, scores = _run(build)
+    assert boxes.shape == (1, 4, 4) and scores.shape == (1, 4, 2)
+    # cell (0,0): center = (0.5/2)*64 = 16; w = e^0 * 16 * 64/64 = 16
+    np.testing.assert_allclose(boxes[0, 0], [8, 8, 24, 24], atol=1e-3)
+    assert scores[0, 0, 0] > 0.99 and scores[0, 0, 1] < 0.01
+
+
+def test_yolov3_loss_responds_to_targets():
+    B, C, H = 1, 2, 4
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, 3 * (5 + C), H, H).astype('float32') * 0.1
+    gt = np.zeros((B, 2, 4), 'float32')
+    gt[0, 0] = [0.5, 0.5, 0.4, 0.4]          # one valid gt, one padding row
+    lab = np.zeros((B, 2), 'int64')
+
+    def build():
+        xv = layers.assign(x)
+        gb = layers.assign(gt)
+        gl = layers.assign(lab)
+        return layers.yolov3_loss(xv, gb, gl,
+                                  anchors=[10, 13, 16, 30, 33, 23],
+                                  anchor_mask=[0, 1, 2], class_num=C,
+                                  ignore_thresh=0.7, downsample_ratio=8)
+
+    loss, = _run(build)
+    assert loss.shape == (1,) and np.isfinite(loss).all() and loss[0] > 0
+
+
+def test_generate_proposals_fixed_shape():
+    B, A, H, W = 1, 2, 4, 4
+    rng = np.random.RandomState(0)
+    scores = rng.rand(B, A, H, W).astype('float32')
+    deltas = (rng.randn(B, 4 * A, H, W) * 0.1).astype('float32')
+    anchors = np.zeros((H, W, A, 4), 'float32')
+    for i in range(H):
+        for j in range(W):
+            for a in range(A):
+                cx, cy = j * 8 + 4, i * 8 + 4
+                s = 8 * (a + 1)
+                anchors[i, j, a] = [cx - s / 2, cy - s / 2,
+                                    cx + s / 2, cy + s / 2]
+    var = np.full((H, W, A, 4), 1.0, 'float32')
+
+    def build():
+        return layers.generate_proposals(
+            layers.assign(scores), layers.assign(deltas),
+            layers.assign(np.array([[32, 32, 1.0]], 'float32')),
+            layers.assign(anchors), layers.assign(var),
+            pre_nms_top_n=16, post_nms_top_n=5, return_rois_num=True)
+
+    rois, probs, num = _run(lambda: list(build()))
+    assert rois.shape == (1, 5, 4) and probs.shape == (1, 5)
+    assert 1 <= int(num[0]) <= 5
+    assert (rois[0, :int(num[0])] >= 0).all() and \
+           (rois[0, :int(num[0])] <= 31).all()
+
+
+def test_ssd_loss_and_focal_loss():
+    B, M, C, G = 1, 4, 3, 2
+    rng = np.random.RandomState(0)
+    priors = np.array([[0.0, 0.0, 0.4, 0.4], [0.3, 0.3, 0.7, 0.7],
+                       [0.5, 0.5, 0.9, 0.9], [0.1, 0.6, 0.4, 0.9]],
+                      'float32')
+    gt = np.zeros((B, G, 4), 'float32')
+    gt[0, 0] = [0.05, 0.05, 0.35, 0.35]
+    lab = np.ones((B, G), 'int64')
+
+    def build():
+        loc = layers.assign((rng.randn(B, M, 4) * 0.1).astype('float32'))
+        conf = layers.assign(rng.randn(B, M, C).astype('float32'))
+        l = layers.ssd_loss(loc, conf, layers.assign(gt), layers.assign(lab),
+                            layers.assign(priors))
+        x = layers.assign(rng.randn(5, C).astype('float32'))
+        fl = layers.sigmoid_focal_loss(
+            x, layers.assign(np.array([[1], [0], [2], [1], [0]], 'int64')),
+            layers.assign(np.array([3], 'int32')))
+        return l, fl
+
+    l, fl = _run(build)
+    assert l.shape == (1, 1) and np.isfinite(l).all() and l[0, 0] > 0
+    assert fl.shape == (5, 3) and np.isfinite(fl).all()
+
+
+def test_distribute_and_collect_fpn():
+    rois = np.array([[0, 0, 20, 20], [0, 0, 300, 300]], 'float32')
+
+    def build():
+        r = layers.assign(rois)
+        multi, restore = layers.distribute_fpn_proposals(r, 2, 5, 4, 224)
+        scores = layers.assign(np.array([[0.9, 0.1]], 'float32'))
+        col = layers.collect_fpn_proposals(
+            layers.assign(rois[None]), scores, 2, 2, post_nms_top_n=2)
+        return multi, restore, col
+
+    multi, restore, col = _run(build)
+    assert multi.shape == (4, 2, 4)
+    # small roi → lowest level (2), big roi → higher level
+    assert (multi[0][0] == rois[0]).all() and (multi[0][1] == 0).all()
+    np.testing.assert_allclose(col[0], rois[0])   # highest score first
+
+
+def test_box_clip_and_polygon_transform():
+    def build():
+        b = layers.assign(np.array([[[-5, -5, 50, 50]]], 'float32'))
+        info = layers.assign(np.array([[40, 40, 1.0]], 'float32'))
+        clipped = layers.box_clip(b, info)
+        poly = layers.polygon_box_transform(
+            layers.assign(np.zeros((1, 8, 2, 2), 'float32')))
+        return clipped, poly
+
+    clipped, poly = _run(build)
+    np.testing.assert_allclose(clipped[0, 0], [0, 0, 39, 39])
+    # zero offsets → absolute coords = 4 * (col, row)
+    np.testing.assert_allclose(poly[0, 0], [[0, 4], [0, 4]])
+    np.testing.assert_allclose(poly[0, 1], [[0, 0], [4, 4]])
+
+
+def test_rpn_target_assign_op():
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30], [100, 100, 110, 110]],
+                       'float32')
+    gt = np.array([[0, 0, 10, 10], [0, 0, 0, 0]], 'float32')
+
+    def build():
+        return list(layers.rpn_target_assign(
+            layers.assign(np.zeros((3, 4), 'float32')),
+            layers.assign(np.zeros((3, 2), 'float32')),
+            layers.assign(anchors), None, layers.assign(gt)))
+
+    _, _, tgt, label, inw = _run(build)
+    assert label[0] == 1           # perfect overlap → fg
+    assert label[1] == 0 and label[2] == 0
+    assert inw.shape == (3, 4) and inw[0].sum() == 4
+
+
+def test_bipartite_match_ignores_zero_padding_rows():
+    # row 1 is an all-zero padding gt; prior 1 must stay unmatched
+    dist = np.array([[[0.9, 0.0, 0.0], [0.0, 0.0, 0.0]]], 'float32')
+    m, md = _run(lambda: list(layers.bipartite_match(layers.assign(dist))))
+    assert m[0, 0] == 0 and m[0, 1] == -1 and m[0, 2] == -1
+
+
+def test_generate_proposal_labels_shapes():
+    rois = np.array([[0, 0, 10, 10], [20, 20, 40, 40]], 'float32')
+    gtb = np.array([[0, 0, 11, 11]], 'float32')
+    cls = np.array([2], 'int64')
+
+    def build():
+        return list(layers.generate_proposal_labels(
+            layers.assign(rois), layers.assign(cls), None,
+            layers.assign(gtb), None))
+
+    r, lab, tgt, w1, w2 = _run(build)
+    assert tgt.shape == (2, 4)                  # per-roi targets, not pairwise
+    assert lab[0] == 2 and lab[1] == 0          # IoU>=0.5 → fg class, else bg
