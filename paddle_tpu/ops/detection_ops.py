@@ -66,6 +66,10 @@ def box_clip(x, im_info):
     w = w.reshape(shape)
     h = h.reshape(shape)
     if x.ndim == 2:                       # single image (M, 4)
+        if info.shape[0] != 1:
+            raise ValueError(
+                "box_clip: 2-D boxes need a single im_info row; batch the "
+                "boxes to (B, M, 4) for per-image clipping")
         w, h = w.reshape(()), h.reshape(())
     return jnp.stack([jnp.minimum(jnp.maximum(x[..., 0], 0), w),
                       jnp.minimum(jnp.maximum(x[..., 1], 0), h),
@@ -319,36 +323,34 @@ def multiclass_nms(bboxes, scores, *, background_label=0,
     K = keep_top_k if keep_top_k > 0 else C * M
     per_class = nms_top_k if nms_top_k > 0 else M
 
+    classes = [c for c in range(C) if c != background_label]
+    if not classes:          # every class is background → zero detections
+        K0 = keep_top_k if keep_top_k > 0 else M
+        return (jnp.full((B, K0, 6), -1.0, bboxes.dtype),
+                jnp.full((B, K0), -1, jnp.int32),
+                jnp.zeros((B,), jnp.int32))
+    cls_ids = jnp.asarray(classes)
+
     def one(boxes, sc):
-        cand_scores = []
-        cand_labels = []
-        cand_boxes = []
         iou = _pairwise_iou(boxes, boxes, normalized)   # shared across classes
-        for c in range(C):
-            if c == background_label:
-                continue
-            s = jnp.where(sc[c] >= score_threshold, sc[c], _NEG)
-            keep = _nms_keep(boxes, s, nms_threshold, per_class, normalized,
-                             iou=iou)
-            s = jnp.where(keep, s, _NEG)
-            cand_scores.append(s)
-            cand_labels.append(jnp.full((M,), c, jnp.float32))
-            cand_boxes.append(boxes)
-        if not cand_scores:     # every class is background → zero detections
-            K0 = keep_top_k if keep_top_k > 0 else M
-            return (jnp.full((K0, 6), -1.0, boxes.dtype),
-                    jnp.zeros((K0,), jnp.int32), jnp.zeros((), jnp.int32))
-        all_s = jnp.concatenate(cand_scores)        # (C'*M,)
-        all_l = jnp.concatenate(cand_labels)
-        all_b = jnp.concatenate(cand_boxes, 0)
+        cls_sc = sc[cls_ids]                            # (C', M)
+        s = jnp.where(cls_sc >= score_threshold, cls_sc, _NEG)
+        keep = jax.vmap(lambda row: _nms_keep(
+            boxes, row, nms_threshold, per_class, normalized, iou=iou))(s)
+        s = jnp.where(keep, s, _NEG)
+        all_s = s.reshape(-1)                           # (C'*M,)
+        all_l = jnp.broadcast_to(cls_ids[:, None].astype(jnp.float32),
+                                 s.shape).reshape(-1)
         k = min(K, all_s.shape[0])
         top_s, idx = lax.top_k(all_s, k)
+        box_idx = idx % M                               # index into INPUT boxes
         valid = top_s > _NEG / 2
         row = jnp.concatenate([
             jnp.where(valid, all_l[idx], -1.0)[:, None],
             jnp.where(valid, top_s, -1.0)[:, None],
-            jnp.where(valid[:, None], all_b[idx], -1.0)], -1)
-        return row, idx, jnp.sum(valid)
+            jnp.where(valid[:, None], boxes[box_idx], -1.0)], -1)
+        box_idx = jnp.where(valid, box_idx, -1)
+        return row, box_idx, jnp.sum(valid)
 
     out, idx, num = jax.vmap(one)(bboxes, scores)
     return out, idx.astype(jnp.int32), num.astype(jnp.int32)
@@ -498,6 +500,8 @@ def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None, *,
     an = jnp.asarray(anchors).reshape(-1, 4)
     gt = jnp.asarray(gt_boxes).reshape(-1, 4)
     gt_valid = _area(gt, False) > 0
+    if is_crowd is not None:    # crowd gts never become matching targets
+        gt_valid = gt_valid & (jnp.asarray(is_crowd).reshape(-1) == 0)
     iou = _pairwise_iou(an, gt, normalized=False)      # (A, G)
     iou = jnp.where(gt_valid[None, :], iou, 0.0)
     best_gt = jnp.argmax(iou, 1)
@@ -508,6 +512,17 @@ def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None, *,
                                     False), 1)
     fg = (best_iou >= rpn_positive_overlap) | best_for_gt
     bg = (best_iou < rpn_negative_overlap) & ~fg
+    if im_info is not None and rpn_straddle_thresh >= 0:
+        # anchors straddling the image boundary by more than the threshold
+        # are excluded from both fg and bg (label -1), like the reference
+        info = jnp.asarray(im_info).reshape(-1)
+        imh, imw = info[0], info[1]
+        inside = ((an[:, 0] >= -rpn_straddle_thresh) &
+                  (an[:, 1] >= -rpn_straddle_thresh) &
+                  (an[:, 2] < imw + rpn_straddle_thresh) &
+                  (an[:, 3] < imh + rpn_straddle_thresh))
+        fg = fg & inside
+        bg = bg & inside
     # cap fg count at fg_fraction * batch; prefer highest overlap
     max_fg = int(rpn_batch_size_per_im * rpn_fg_fraction)
     A = an.shape[0]
@@ -551,6 +566,8 @@ def retinanet_target_assign(anchors, gt_boxes, gt_labels, is_crowd=None,
     gt = jnp.asarray(gt_boxes).reshape(-1, 4)
     gl = jnp.asarray(gt_labels).reshape(-1)
     gt_valid = _area(gt, False) > 0
+    if is_crowd is not None:
+        gt_valid = gt_valid & (jnp.asarray(is_crowd).reshape(-1) == 0)
     iou = jnp.where(gt_valid[None, :], _pairwise_iou(an, gt, False), 0.0)
     best_gt = jnp.argmax(iou, 1)
     best_iou = jnp.max(iou, 1)
@@ -791,8 +808,8 @@ def yolov3_loss(x, gt_box, gt_label, gt_score=None, *, anchors, anchor_mask,
     gj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
     responsible = gt_valid & (in_mask >= 0)
 
-    def per_image(pxi, pyi, pwi, phi, pobji, pclsi, gb, gl, resp, am, gii,
-                  gjj):
+    def per_image(pxi, pyi, pwi, phi, pobji, pclsi, gb, gl, gs, resp, am,
+                  gii, gjj):
         # scatter gt targets onto (A, H, W) grids
         tx = gb[:, 0] * W - gii                       # (G,)
         ty = gb[:, 1] * H - gjj
@@ -810,6 +827,8 @@ def yolov3_loss(x, gt_box, gt_label, gt_score=None, *, anchors, anchor_mask,
         slot = jnp.where(resp, am_safe, A)
         idx = (slot, gjj, gii)
         obj_t = jnp.zeros((A + 1, H, W)).at[idx].max(1.0)[:A]
+        # per-gt sample weight (mixup gt_score); default 1
+        sc_t = jnp.zeros((A + 1, H, W)).at[idx].max(gs)[:A]
         tgt = jnp.zeros((A + 1, H, W, 5)).at[idx].set(
             jnp.stack([tx, ty, tw, th, scale], -1))[:A]
         onehot = (gl[:, None] == jnp.arange(C)[None, :]).astype(x.dtype)
@@ -840,19 +859,24 @@ def yolov3_loss(x, gt_box, gt_label, gt_score=None, *, anchors, anchor_mask,
             return -(t * jax.nn.log_sigmoid(logit)
                      + (1 - t) * jax.nn.log_sigmoid(-logit))
 
-        loss_xy = obj_mask * s * (bce(pxi, tgt[..., 0])
-                                  + bce(pyi, tgt[..., 1]))
-        loss_wh = obj_mask * s * 0.5 * ((pwi - tgt[..., 2]) ** 2
-                                        + (phi - tgt[..., 3]) ** 2)
-        loss_obj = obj_mask * bce(pobji, 1.0) + noobj_mask * bce(pobji, 0.0)
-        loss_cls = obj_mask[..., None] * bce(
+        w = obj_mask * sc_t
+        loss_xy = w * s * (bce(pxi, tgt[..., 0])
+                           + bce(pyi, tgt[..., 1]))
+        loss_wh = w * s * 0.5 * ((pwi - tgt[..., 2]) ** 2
+                                 + (phi - tgt[..., 3]) ** 2)
+        loss_obj = obj_mask * sc_t * bce(pobji, 1.0) \
+            + noobj_mask * bce(pobji, 0.0)
+        loss_cls = w[..., None] * bce(
             pclsi.transpose(0, 2, 3, 1), cls_t)
         total = (loss_xy.sum() + loss_wh.sum() + loss_obj.sum()
                  + loss_cls.sum())
         return total, obj_mask, resp.astype(jnp.int32)
 
+    gts = jnp.ones(gtl.shape, x.dtype) if gt_score is None \
+        else jnp.asarray(gt_score).reshape(gtl.shape).astype(x.dtype)
     loss, objm, matchm = jax.vmap(per_image)(
-        px, py, pw, ph, pobj, pcls, gtb, gtl, responsible, in_mask, gi, gj)
+        px, py, pw, ph, pobj, pcls, gtb, gtl, gts, responsible, in_mask,
+        gi, gj)
     return loss, objm, matchm
 
 

@@ -156,3 +156,31 @@ def dpsgd(param, grad, lr, *, clip=10.0, batch_size=16.0, sigma=1.0, key=None):
     g = g / jnp.maximum(1.0, gn / clip)
     noise = sigma * clip / batch_size * jax.random.normal(key, g.shape, g.dtype)
     return p - jnp.asarray(lr) * (g + noise)
+
+
+@register_op('dgc_momentum', outputs=['ParamOut', 'VelocityOut', 'ErrorOut'])
+def dgc_momentum(param, grad, velocity, error, lr, *, mu=0.9,
+                 sparsity=0.999, rampup_step=1.0, use_nesterov=False):
+    """Deep Gradient Compression momentum (ref: paddle/fluid/operators/
+    dgc_op.h + optimizer.py:DGCMomentumOptimizer): error-feedback
+    accumulation, top-k magnitude sparsification of the local gradient,
+    momentum step on the sparse gradient. On TPU the sparse gradient stays
+    dense-with-zeros (XLA AllReduce already bucketizes); the compression
+    semantics — what the update sees — match."""
+    p, g = jnp.asarray(param), jnp.asarray(grad)
+    v, e = jnp.asarray(velocity), jnp.asarray(error)
+    lr = jnp.asarray(lr)
+    acc = e + g
+    flat = jnp.abs(acc).reshape(-1)
+    n = flat.shape[0]
+    k = max(1, int(n * (1.0 - sparsity)))
+    thresh = jax.lax.top_k(flat, k)[0][-1]
+    mask = (jnp.abs(acc) >= thresh).astype(p.dtype)
+    sparse = acc * mask
+    e_new = acc - sparse
+    v_new = mu * v + sparse
+    if use_nesterov:
+        p_new = p - lr * (sparse + mu * v_new)
+    else:
+        p_new = p - lr * v_new
+    return p_new, v_new, e_new

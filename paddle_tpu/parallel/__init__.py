@@ -6,3 +6,8 @@ from .mesh import (make_mesh, set_default_mesh, get_default_mesh, mesh_guard,
 from . import collective
 from .fleet import (fleet, Fleet, DistributedStrategy, DistributedOptimizer,
                     PaddleCloudRoleMaker, UserDefinedRoleMaker)
+from .ring_attention import ring_attention
+from .tensor_parallel import (megatron_param_spec, shard_params,
+                              column_parallel_matmul, row_parallel_matmul,
+                              vocab_parallel_embedding)
+from .pipeline import gpipe, stack_stage_params
