@@ -13,6 +13,19 @@ class DataFeeder:
         self.feed_vars = feed_list
         self.place = place
 
+    @staticmethod
+    def _convert(var, arr):
+        """Cast/reshape one batched array to its feed var's declared spec."""
+        if isinstance(var, Variable):
+            want = np.dtype(convert_dtype(var.dtype)
+                            .replace('bfloat16', 'float32'))
+            arr = arr.astype(want, copy=False)
+            # reshape trailing dims to the declared var shape
+            tail = [s for s in var.shape[1:]]
+            if tail and all(s > 0 for s in tail):
+                arr = arr.reshape((arr.shape[0], *tail))
+        return arr
+
     def feed(self, iterable):
         columns = None
         for row in iterable:
@@ -23,14 +36,15 @@ class DataFeeder:
         out = {}
         for var, col in zip(self.feed_vars, columns or []):
             name = var.name if isinstance(var, Variable) else var
-            arr = np.stack(col)
-            if isinstance(var, Variable):
-                want = np.dtype(convert_dtype(var.dtype)
-                                .replace('bfloat16', 'float32'))
-                arr = arr.astype(want, copy=False)
-                # reshape trailing dims to the declared var shape
-                tail = [s for s in var.shape[1:]]
-                if tail and all(s > 0 for s in tail):
-                    arr = arr.reshape((arr.shape[0], *tail))
-            out[name] = arr
+            out[name] = self._convert(var, np.stack(col))
+        return out
+
+
+    def feed_batch(self, fields):
+        """Already-batched per-field arrays → feed dict with the same
+        cast/reshape rules as feed() (the native-pipeline fast path)."""
+        out = {}
+        for var, arr in zip(self.feed_vars, fields):
+            name = var.name if isinstance(var, Variable) else var
+            out[name] = self._convert(var, np.asarray(arr))
         return out

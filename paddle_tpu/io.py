@@ -189,3 +189,7 @@ def _save_jit_model(dirname, layer, params, buffers):
              **{k: np.asarray(v) for k, v in params.items()})
     np.savez(os.path.join(dirname, 'jit_buffers.npz'),
              **{k: np.asarray(v) for k, v in buffers.items()})
+
+
+# parity: the reference exposes DataLoader under fluid.io as well
+from .reader import DataLoader  # noqa: E402

@@ -1,0 +1,152 @@
+// Native data-pipeline core: shuffle buffer + batcher + prefetch ring.
+//
+// Parity target: the reference's C++ reader stack
+// (/root/reference/paddle/fluid/operators/reader/buffered_reader.cc,
+// python/paddle/reader/decorator.py lowered to C++). The Python DataLoader
+// pushes raw samples (contiguous float/int rows) into this core; worker
+// threads shuffle and assemble fixed-shape batch buffers; Python pops ready
+// batches zero-copy (ctypes view) and ships them to HBM with device_put.
+//
+// Plain C ABI throughout — loaded with ctypes, no pybind11.
+
+#include <algorithm>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <vector>
+
+namespace {
+
+struct Sample {
+  std::vector<uint8_t> bytes;
+};
+
+struct Batch {
+  std::vector<uint8_t> bytes;  // batch_size * sample_nbytes, contiguous
+  int64_t count = 0;           // rows actually filled
+};
+
+struct Pipeline {
+  int64_t sample_nbytes;    // fixed serialized sample size
+  int64_t batch_size;
+  int64_t shuffle_capacity; // 0 = no shuffling
+  int64_t ring_capacity;    // max ready batches buffered ahead
+  bool drop_last;
+  std::mt19937_64 rng;
+
+  std::mutex mu;
+  std::condition_variable ready_cv;   // batches available / finished
+  std::condition_variable space_cv;   // ring has space
+  std::vector<Sample> reservoir;      // shuffle buffer
+  std::vector<uint8_t> partial;       // current batch under assembly
+  int64_t partial_count = 0;
+  std::deque<Batch> ring;             // ready batches
+  bool finished = false;              // producer called finish()
+
+  Pipeline(int64_t nbytes, int64_t bs, int64_t shuf, int64_t ring_cap,
+           bool drop, uint64_t seed)
+      : sample_nbytes(nbytes), batch_size(bs), shuffle_capacity(shuf),
+        ring_capacity(ring_cap < 1 ? 1 : ring_cap), drop_last(drop),
+        rng(seed) {
+    partial.resize(sample_nbytes * batch_size);
+    if (shuffle_capacity > 0) reservoir.reserve(shuffle_capacity);
+  }
+
+  // -- producer side (Python feed thread) --
+  void emit_locked(const uint8_t* data) {
+    std::memcpy(partial.data() + partial_count * sample_nbytes, data,
+                sample_nbytes);
+    if (++partial_count == batch_size) flush_locked();
+  }
+
+  void flush_locked() {
+    if (partial_count == 0) return;
+    Batch b;
+    b.bytes.assign(partial.begin(),
+                   partial.begin() + partial_count * sample_nbytes);
+    b.count = partial_count;
+    partial_count = 0;
+    ring.push_back(std::move(b));
+    ready_cv.notify_all();
+  }
+
+  bool push(const uint8_t* data) {
+    std::unique_lock<std::mutex> lk(mu);
+    space_cv.wait(lk, [&] {
+      return (int64_t)ring.size() < ring_capacity || finished;
+    });
+    if (finished) return false;
+    if (shuffle_capacity > 0) {
+      if ((int64_t)reservoir.size() < shuffle_capacity) {
+        Sample s;
+        s.bytes.assign(data, data + sample_nbytes);
+        reservoir.push_back(std::move(s));
+        return true;
+      }
+      // swap a random resident out, emit it, keep the newcomer
+      std::uniform_int_distribution<int64_t> d(0, shuffle_capacity - 1);
+      int64_t j = d(rng);
+      Sample out = std::move(reservoir[j]);
+      reservoir[j].bytes.assign(data, data + sample_nbytes);
+      emit_locked(out.bytes.data());
+    } else {
+      emit_locked(data);
+    }
+    return true;
+  }
+
+  void finish() {
+    std::unique_lock<std::mutex> lk(mu);
+    if (shuffle_capacity > 0) {
+      std::shuffle(reservoir.begin(), reservoir.end(), rng);
+      for (auto& s : reservoir) emit_locked(s.bytes.data());
+      reservoir.clear();
+    }
+    if (!drop_last) flush_locked();
+    partial_count = 0;
+    finished = true;
+    ready_cv.notify_all();
+    space_cv.notify_all();
+  }
+
+  // -- consumer side --
+  // returns rows in the popped batch, 0 on end-of-stream
+  int64_t pop(uint8_t* out) {
+    std::unique_lock<std::mutex> lk(mu);
+    ready_cv.wait(lk, [&] { return !ring.empty() || finished; });
+    if (ring.empty()) return 0;
+    Batch b = std::move(ring.front());
+    ring.pop_front();
+    space_cv.notify_all();
+    std::memcpy(out, b.bytes.data(), b.bytes.size());
+    return b.count;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptpu_pipeline_create(int64_t sample_nbytes, int64_t batch_size,
+                           int64_t shuffle_capacity, int64_t ring_capacity,
+                           int drop_last, uint64_t seed) {
+  return new Pipeline(sample_nbytes, batch_size, shuffle_capacity,
+                      ring_capacity, drop_last != 0, seed);
+}
+
+int ptpu_pipeline_push(void* h, const uint8_t* data) {
+  return static_cast<Pipeline*>(h)->push(data) ? 1 : 0;
+}
+
+void ptpu_pipeline_finish(void* h) { static_cast<Pipeline*>(h)->finish(); }
+
+int64_t ptpu_pipeline_pop(void* h, uint8_t* out) {
+  return static_cast<Pipeline*>(h)->pop(out);
+}
+
+void ptpu_pipeline_destroy(void* h) { delete static_cast<Pipeline*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,82 @@
+// LoD/ragged packing utilities.
+//
+// Parity target: the reference's LoDTensor host-side packing
+// (/root/reference/paddle/fluid/framework/lod_tensor.cc) — the TPU framework
+// represents ragged batches as padded (B, T, D) + lengths, and these
+// routines do the hot host-side conversions without Python loops:
+//   pack:   concatenated rows + per-seq lengths → padded batch (+ pad value)
+//   unpack: padded batch + lengths → concatenated rows
+//   bucket: argsort lengths descending (for length-bucketed batching)
+// float32/int64 element types; plain C ABI for ctypes.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+namespace {
+
+template <typename T>
+void pack_impl(const T* flat, const int64_t* lengths, int64_t batch,
+               int64_t max_len, int64_t width, T pad, T* out) {
+  int64_t offset = 0;
+  for (int64_t b = 0; b < batch; ++b) {
+    int64_t n = std::min(lengths[b], max_len);
+    T* row = out + b * max_len * width;
+    std::memcpy(row, flat + offset * width, n * width * sizeof(T));
+    std::fill(row + n * width, row + max_len * width, pad);
+    offset += lengths[b];
+  }
+}
+
+template <typename T>
+int64_t unpack_impl(const T* padded, const int64_t* lengths, int64_t batch,
+                    int64_t max_len, int64_t width, T* out) {
+  int64_t offset = 0;
+  for (int64_t b = 0; b < batch; ++b) {
+    int64_t n = std::min(lengths[b], max_len);
+    std::memcpy(out + offset * width, padded + b * max_len * width,
+                n * width * sizeof(T));
+    offset += n;
+  }
+  return offset;
+}
+
+}  // namespace
+
+extern "C" {
+
+void ptpu_pack_f32(const float* flat, const int64_t* lengths, int64_t batch,
+                   int64_t max_len, int64_t width, float pad, float* out) {
+  pack_impl(flat, lengths, batch, max_len, width, pad, out);
+}
+
+void ptpu_pack_i64(const int64_t* flat, const int64_t* lengths, int64_t batch,
+                   int64_t max_len, int64_t width, int64_t pad, int64_t* out) {
+  pack_impl(flat, lengths, batch, max_len, width, pad, out);
+}
+
+int64_t ptpu_unpack_f32(const float* padded, const int64_t* lengths,
+                        int64_t batch, int64_t max_len, int64_t width,
+                        float* out) {
+  return unpack_impl(padded, lengths, batch, max_len, width, out);
+}
+
+int64_t ptpu_unpack_i64(const int64_t* padded, const int64_t* lengths,
+                        int64_t batch, int64_t max_len, int64_t width,
+                        int64_t* out) {
+  return unpack_impl(padded, lengths, batch, max_len, width, out);
+}
+
+// indices of lengths sorted descending (stable) — length bucketing
+void ptpu_bucket_by_length(const int64_t* lengths, int64_t n, int64_t* idx) {
+  std::vector<int64_t> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    return lengths[a] > lengths[b];
+  });
+  std::memcpy(idx, order.data(), n * sizeof(int64_t));
+}
+
+}  // extern "C"

@@ -1,0 +1,116 @@
+// WordPiece tokenizer for the BERT data path.
+//
+// Parity target: the reference models' Python wordpiece preprocessing
+// (PaddlePaddle/models BERT tokenization) moved to native code so the host
+// CPU can keep up with the TPU input pipeline. Greedy longest-match-first
+// over a vocab hash map, basic whitespace+punctuation pre-split, lowercase
+// option. Plain C ABI for ctypes.
+
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Tokenizer {
+  std::unordered_map<std::string, int64_t> vocab;
+  int64_t unk_id = 0;
+  bool lowercase = true;
+  int64_t max_chars_per_word = 100;
+
+  std::vector<int64_t> tokenize(const std::string& text) const {
+    std::vector<int64_t> ids;
+    std::vector<std::string> words;
+    std::string cur;
+    for (unsigned char ch : text) {
+      if (std::isspace(ch)) {
+        if (!cur.empty()) { words.push_back(cur); cur.clear(); }
+      } else if (std::ispunct(ch)) {
+        if (!cur.empty()) { words.push_back(cur); cur.clear(); }
+        words.emplace_back(1, (char)ch);
+      } else {
+        cur.push_back(lowercase ? (char)std::tolower(ch) : (char)ch);
+      }
+    }
+    if (!cur.empty()) words.push_back(cur);
+
+    for (const auto& w : words) {
+      if ((int64_t)w.size() > max_chars_per_word) {
+        ids.push_back(unk_id);
+        continue;
+      }
+      size_t start = 0;
+      std::vector<int64_t> sub;
+      bool bad = false;
+      while (start < w.size()) {
+        size_t end = w.size();
+        int64_t cur_id = -1;
+        while (start < end) {
+          std::string piece = (start > 0 ? "##" : "") +
+                              w.substr(start, end - start);
+          auto it = vocab.find(piece);
+          if (it != vocab.end()) { cur_id = it->second; break; }
+          --end;
+        }
+        if (cur_id < 0) { bad = true; break; }
+        sub.push_back(cur_id);
+        start = end;
+      }
+      if (bad) ids.push_back(unk_id);
+      else ids.insert(ids.end(), sub.begin(), sub.end());
+    }
+    return ids;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// vocab_blob: '\n'-separated tokens, line index = id
+void* ptpu_wp_create(const char* vocab_blob, int64_t blob_len, int lowercase,
+                     const char* unk_token) {
+  auto* t = new Tokenizer();
+  t->lowercase = lowercase != 0;
+  std::string blob(vocab_blob, blob_len);
+  size_t pos = 0;
+  int64_t id = 0;
+  while (pos <= blob.size()) {
+    size_t nl = blob.find('\n', pos);
+    if (nl == std::string::npos) nl = blob.size();
+    std::string tok = blob.substr(pos, nl - pos);
+    if (!tok.empty()) t->vocab[tok] = id++;
+    pos = nl + 1;
+    if (nl == blob.size()) break;
+  }
+  auto it = t->vocab.find(unk_token ? unk_token : "[UNK]");
+  t->unk_id = it != t->vocab.end() ? it->second : 0;
+  return t;
+}
+
+int64_t ptpu_wp_vocab_size(void* h) {
+  return (int64_t)static_cast<Tokenizer*>(h)->vocab.size();
+}
+
+int64_t ptpu_wp_lookup(void* h, const char* token) {
+  auto* t = static_cast<Tokenizer*>(h);
+  auto it = t->vocab.find(token);
+  return it != t->vocab.end() ? it->second : -1;
+}
+
+// returns number of ids written (truncated to max_len)
+int64_t ptpu_wp_tokenize(void* h, const char* text, int64_t text_len,
+                         int64_t* out_ids, int64_t max_len) {
+  auto ids = static_cast<Tokenizer*>(h)->tokenize(
+      std::string(text, text_len));
+  int64_t n = std::min<int64_t>((int64_t)ids.size(), max_len);
+  std::memcpy(out_ids, ids.data(), n * sizeof(int64_t));
+  return n;
+}
+
+void ptpu_wp_destroy(void* h) { delete static_cast<Tokenizer*>(h); }
+
+}  // extern "C"

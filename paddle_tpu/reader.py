@@ -158,8 +158,35 @@ class _GeneratorLoader:
     # -- configuration (ref API) --
     def set_sample_generator(self, reader, batch_size, drop_last=True,
                              places=None):
-        self.set_sample_list_generator(batch(reader, batch_size, drop_last),
-                                       places)
+        from .data_feeder import DataFeeder
+        feeder = DataFeeder(self._feed_list)
+
+        def batch_reader():
+            """Batch in the native C++ pipeline core when samples are
+            fixed-shape numeric tuples; fall back to the python batcher."""
+            import itertools
+            from . import native
+            it = iter(reader())
+            try:
+                first = next(it)
+            except StopIteration:
+                return
+            fields = first if isinstance(first, (list, tuple)) else (first,)
+            arrs = [np.asarray(f) for f in fields]
+            stream = itertools.chain([first], it)
+            if native.is_native() and all(a.dtype.kind in 'fiub'
+                                          for a in arrs):
+                pipe = native.TupleDataPipeline(
+                    [a.shape for a in arrs], [a.dtype for a in arrs],
+                    batch_size, drop_last=drop_last)
+                pipe.feed(stream)
+                for batch_fields in pipe:
+                    yield feeder.feed_batch(batch_fields)
+            else:
+                for rows in batch(lambda: stream, batch_size, drop_last)():
+                    yield feeder.feed(rows)
+        self._batch_reader = batch_reader
+        self._places = places
         return self
 
     def set_sample_list_generator(self, reader, places=None):

@@ -1,0 +1,108 @@
+"""Native C++ pipeline / tokenizer / packing (with python-fallback parity)."""
+import numpy as np
+
+from paddle_tpu import native
+
+
+def test_pipeline_batches_all_samples():
+    pl = native.DataPipeline((2,), 'float32', batch_size=3,
+                             shuffle_capacity=4, seed=7)
+    data = np.arange(20, dtype='float32').reshape(10, 2)
+    pl.feed(iter(data))
+    out = np.concatenate(list(pl))
+    assert out.shape == (10, 2)
+    assert sorted(out[:, 0].tolist()) == sorted(data[:, 0].tolist())
+
+
+def test_pipeline_drop_last():
+    pl = native.DataPipeline((1,), 'float32', batch_size=4, drop_last=True)
+    pl.feed(np.arange(10, dtype='float32').reshape(10, 1))
+    batches = list(pl)
+    assert len(batches) == 2 and all(b.shape == (4, 1) for b in batches)
+
+
+def test_tuple_pipeline_keeps_fields_aligned():
+    img = np.arange(12, dtype='float32').reshape(6, 2)
+    lab = np.arange(6, dtype='int64')
+    tp = native.TupleDataPipeline([(2,), ()], ['float32', 'int64'],
+                                  batch_size=2, shuffle_capacity=4, seed=3)
+    tp.feed(zip(img, lab))
+    for bi, bl in tp:
+        assert bi.shape == (2, 2) and bl.shape == (2,)
+        for row, l in zip(bi, bl):
+            np.testing.assert_allclose(row, img[l])   # field alignment
+
+
+def test_wordpiece():
+    tok = native.WordPieceTokenizer(
+        ['[UNK]', '[CLS]', 'un', '##aff', '##able', 'hello', ','])
+    assert tok.tokenize('unaffable') == [2, 3, 4]
+    assert tok.tokenize('Hello, unaffable') == [5, 6, 2, 3, 4]
+    assert tok.tokenize('xyzzy') == [0]               # unk
+    assert tok.vocab_size == 7 and tok.lookup('##aff') == 3
+
+
+def test_pack_unpack_bucket():
+    flat = np.arange(12, dtype='float32').reshape(6, 2)
+    lens = np.array([2, 1, 3])
+    p = native.pack_padded(flat, lens, pad_value=-1.0)
+    assert p.shape == (3, 3, 2)
+    np.testing.assert_allclose(p[1, 0], flat[2])
+    assert (p[1, 1:] == -1).all()
+    u = native.unpack_padded(p, lens)
+    np.testing.assert_allclose(u, flat)
+    ids = np.arange(5, dtype='int64').reshape(5, 1)
+    pi = native.pack_padded(ids, np.array([3, 2]), pad_value=0)
+    assert pi.dtype == np.int64 and pi.shape == (2, 3, 1)
+    assert native.bucket_by_length(np.array([2, 9, 9, 1])).tolist() == \
+        [1, 2, 0, 3]
+
+
+def test_dataloader_uses_native_batching():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[2], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='int64')
+        loader = fluid.io.DataLoader.from_generator(feed_list=[x, y],
+                                                    capacity=4)
+
+    def sample_gen():
+        for i in range(7):
+            yield np.full(2, i, 'float32'), np.array([i], 'int64')
+
+    loader.set_sample_generator(sample_gen, batch_size=3, drop_last=False)
+    batches = list(loader)
+    total = sum(b['x'].shape[0] for b in batches)
+    assert total == 7
+    for b in batches:
+        np.testing.assert_allclose(np.asarray(b['x'])[:, 0],
+                                   np.asarray(b['y'])[:, 0])
+
+
+def test_pipeline_propagates_producer_error():
+    import pytest
+    tp = native.TupleDataPipeline([(2,)], ['float32'], batch_size=2)
+
+    def bad_gen():
+        yield (np.zeros(2, 'float32'),)
+        yield (np.zeros(3, 'float32'),)   # shape change mid-stream
+
+    tp.feed(bad_gen())
+    with pytest.raises(ValueError, match='shape'):
+        list(tp)
+
+
+def test_pipeline_early_break_cancels_producer():
+    import threading
+    before = threading.active_count()
+    for _ in range(3):
+        pl = native.DataPipeline((1,), 'float32', batch_size=1,
+                                 ring_capacity=1)
+        pl.feed(np.zeros((100, 1), 'float32'))
+        for b in pl:
+            break              # consumer bails; producer must unblock
+    import time
+    time.sleep(0.3)
+    assert threading.active_count() <= before + 1
