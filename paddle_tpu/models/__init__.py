@@ -3,3 +3,11 @@ from .lenet import LeNet, build_static_lenet
 from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet101,
                      ResNet152)
 from .bert import (BertConfig, BertModel, BertForPretraining, pretrain_loss)
+from .transformer import (TransformerConfig, Transformer, transformer_loss,
+                          greedy_decode, beam_search_decode)
+from .vision import (MobileNetV1, MobileNetV2, VGG, TSM, DCGenerator,
+                     DCDiscriminator)
+from .nlp_rec import Word2Vec, Seq2SeqAttn, DeepFM, GRU4Rec
+from .detection_models import DarkNet53, YOLOv3, CRNN
+from .ernie import (ErnieConfig, ErnieForSequenceClassification,
+                    finetune_optimizer)

@@ -1,0 +1,210 @@
+"""YOLOv3 (DarkNet-53) and OCR CRNN-CTC models (SURVEY §2.10).
+
+Parity targets: PaddlePaddle/models yolov3 and ocr_recognition (CRNN-CTC),
+wired onto this framework's detection ops (yolov3_loss / yolo_box /
+multiclass_nms) and warpctc/ctc_greedy_decoder.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..dygraph import Layer
+from ..dygraph.nn import Conv2D, BatchNorm, Pool2D, Linear
+from ..dygraph.tape import dispatch_op, Tensor
+
+
+class _ConvBNLeaky(Layer):
+    def __init__(self, cin, cout, k, stride=1):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride,
+                           padding=(k - 1) // 2, bias_attr=False)
+        self.bn = BatchNorm(cout)
+
+    def forward(self, x):
+        return dispatch_op('leaky_relu', {'x': self.bn(self.conv(x))},
+                           {'alpha': 0.1})
+
+
+class _DarkBlock(Layer):
+    def __init__(self, c):
+        super().__init__()
+        self.c1 = _ConvBNLeaky(c, c // 2, 1)
+        self.c2 = _ConvBNLeaky(c // 2, c, 3)
+
+    def forward(self, x):
+        return x + self.c2(self.c1(x))
+
+
+class DarkNet53(Layer):
+    """Backbone; returns C3/C4/C5 feature maps."""
+
+    def __init__(self, depths=(1, 2, 8, 8, 4)):
+        super().__init__()
+        self.stem = _ConvBNLeaky(3, 32, 3)
+        chans = [64, 128, 256, 512, 1024]
+        self.stages = []
+        cin = 32
+        for si, (n, c) in enumerate(zip(depths, chans)):
+            stage = [_ConvBNLeaky(cin, c, 3, stride=2)]
+            for bi in range(n):
+                stage.append(_DarkBlock(c))
+            for li, l in enumerate(stage):
+                self.add_sublayer(f's{si}_{li}', l)
+            self.stages.append(stage)
+            cin = c
+
+    def forward(self, x):
+        x = self.stem(x)
+        feats = []
+        for stage in self.stages:
+            for l in stage:
+                x = l(x)
+            feats.append(x)
+        return feats[2], feats[3], feats[4]       # C3, C4, C5
+
+
+class _YoloHead(Layer):
+    def __init__(self, cin, cmid, n_anchors, class_num):
+        super().__init__()
+        self.body = []
+        chans = [cin, cmid, cmid * 2, cmid, cmid * 2, cmid]
+        for i in range(5):
+            k = 1 if i % 2 == 0 else 3
+            l = _ConvBNLeaky(chans[i], chans[i + 1], k)
+            self.add_sublayer(f'h{i}', l)
+            self.body.append(l)
+        self.tip = _ConvBNLeaky(cmid, cmid * 2, 3)
+        self.pred = Conv2D(cmid * 2, n_anchors * (5 + class_num), 1)
+
+    def forward(self, x):
+        for l in self.body:
+            x = l(x)
+        route = x
+        return route, self.pred(self.tip(x))
+
+
+class YOLOv3(Layer):
+    ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45,
+               59, 119, 116, 90, 156, 198, 373, 326]
+    ANCHOR_MASKS = [[6, 7, 8], [3, 4, 5], [0, 1, 2]]
+
+    def __init__(self, class_num=80):
+        super().__init__()
+        self.class_num = class_num
+        self.backbone = DarkNet53()
+        self.head5 = _YoloHead(1024, 512, 3, class_num)
+        self.route5 = _ConvBNLeaky(512, 256, 1)
+        self.head4 = _YoloHead(512 + 256, 256, 3, class_num)
+        self.route4 = _ConvBNLeaky(256, 128, 1)
+        self.head3 = _YoloHead(256 + 128, 128, 3, class_num)
+
+    @staticmethod
+    def _up2(x):
+        h, w = x.shape[2], x.shape[3]
+        return dispatch_op('interpolate', {'x': x},
+                           {'out_shape': [h * 2, w * 2], 'method': 'nearest',
+                            'align_corners': False})
+
+    def forward(self, img):
+        c3, c4, c5 = self.backbone(img)
+        r5, p5 = self.head5(c5)
+        u5 = self._up2(self.route5(r5))
+        r4, p4 = self.head4(dispatch_op('concat', {'xs': [u5, c4]},
+                                        {'axis': 1}))
+        u4 = self._up2(self.route4(r4))
+        _, p3 = self.head3(dispatch_op('concat', {'xs': [u4, c3]},
+                                       {'axis': 1}))
+        return [p5, p4, p3]                # strides 32, 16, 8
+
+    def loss(self, outputs, gt_box, gt_label, gt_score=None,
+             ignore_thresh=0.7):
+        total = None
+        for out, mask, down in zip(outputs, self.ANCHOR_MASKS, (32, 16, 8)):
+            l = dispatch_op(
+                'yolov3_loss',
+                {'x': out, 'gt_box': gt_box, 'gt_label': gt_label,
+                 'gt_score': gt_score},
+                {'anchors': self.ANCHORS, 'anchor_mask': mask,
+                 'class_num': self.class_num, 'ignore_thresh': ignore_thresh,
+                 'downsample_ratio': down})[0]
+            s = dispatch_op('reduce_mean', {'x': l}, {})
+            total = s if total is None else total + s
+        return total
+
+    def infer(self, outputs, img_size, conf_thresh=0.01, nms_thresh=0.45,
+              keep_top_k=100):
+        boxes, scores = [], []
+        for out, mask, down in zip(outputs, self.ANCHOR_MASKS, (32, 16, 8)):
+            anchors = []
+            for m in mask:
+                anchors += self.ANCHORS[2 * m:2 * m + 2]
+            b, s = dispatch_op(
+                'yolo_box', {'x': out, 'img_size': img_size},
+                {'anchors': anchors, 'class_num': self.class_num,
+                 'conf_thresh': conf_thresh, 'downsample_ratio': down})
+            boxes.append(b)
+            scores.append(s)
+        all_b = dispatch_op('concat', {'xs': boxes}, {'axis': 1})
+        all_s = dispatch_op('concat', {'xs': scores}, {'axis': 1})
+        all_s = dispatch_op('transpose', {'x': all_s}, {'perm': [0, 2, 1]})
+        out = dispatch_op(
+            'multiclass_nms', {'bboxes': all_b, 'scores': all_s},
+            {'background_label': -1, 'score_threshold': conf_thresh,
+             'nms_top_k': 400, 'nms_threshold': nms_thresh,
+             'keep_top_k': keep_top_k, 'normalized': False})[0]
+        return out
+
+
+# ---------------------------------------------------------------------------
+# OCR CRNN-CTC
+# ---------------------------------------------------------------------------
+
+
+class CRNN(Layer):
+    """Conv feature extractor → bidirectional GRU → per-timestep vocab
+    logits; train with warpctc, decode with ctc_greedy_decoder."""
+
+    def __init__(self, num_classes=95, image_channels=1, hidden=96):
+        super().__init__()
+        self.convs = []
+        cfg = [(image_channels, 16, 2), (16, 32, 2), (32, 64, (2, 1)),
+               (64, 96, (2, 1))]
+        for i, (cin, cout, stride) in enumerate(cfg):
+            conv = Conv2D(cin, cout, 3, stride=1, padding=1, act='relu')
+            pool = Pool2D(2, 'max', stride, 0, ceil_mode=True)
+            self.add_sublayer(f'conv_{i}', conv)
+            self.add_sublayer(f'pool_{i}', pool)
+            self.convs.append((conv, pool))
+        from .nlp_rec import DyGRU
+        feat_dim = 96 * 2      # channels × collapsed height (32→2 via pools)
+        self.fw = DyGRU(feat_dim, hidden)
+        self.bw = DyGRU(feat_dim, hidden, reverse=True)
+        self.proj = Linear(hidden * 2, num_classes + 1)   # + blank
+        self.blank = num_classes
+
+    def forward(self, img):
+        x = img
+        for conv, pool in self.convs:
+            x = pool(conv(x))
+        # (B, C, H, W) → time-major sequence over W: (B, W, C*H)
+        b, c, h, w = x.shape
+        x = dispatch_op('transpose', {'x': x}, {'perm': [0, 3, 1, 2]})
+        x = dispatch_op('reshape', {'x': x}, {'shape': [b, w, c * h]})
+        fw_outs, _ = self.fw(x)
+        bw_outs, _ = self.bw(x)
+        outs = dispatch_op('concat', {'xs': [fw_outs, bw_outs]},
+                           {'axis': -1})
+        return self.proj(outs)                            # (B, W, classes+1)
+
+    def ctc_loss(self, logits, label, label_length=None):
+        loss = dispatch_op('warpctc',
+                           {'logits': logits, 'label': label,
+                            'label_len': label_length},
+                           {'blank': self.blank, 'norm_by_times': False})
+        return dispatch_op('reduce_mean', {'x': loss}, {})
+
+    def decode(self, logits):
+        probs = dispatch_op('softmax', {'x': logits}, {})
+        out, lens = dispatch_op('ctc_greedy_decoder', {'x': probs},
+                                {'blank': self.blank})
+        return out, lens

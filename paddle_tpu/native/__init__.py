@@ -46,8 +46,9 @@ def _load():
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_int, ctypes.c_uint64]
     lib.ptpu_pipeline_push.restype = ctypes.c_int
-    lib.ptpu_pipeline_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ptpu_pipeline_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.ptpu_pipeline_finish.argtypes = [ctypes.c_void_p]
+    lib.ptpu_pipeline_cancel.argtypes = [ctypes.c_void_p]
     lib.ptpu_pipeline_pop.restype = ctypes.c_int64
     lib.ptpu_pipeline_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.ptpu_pipeline_destroy.argtypes = [ctypes.c_void_p]
@@ -74,6 +75,23 @@ def _load():
 
 def is_native():
     return _load() is not None
+
+
+def _start_feed(target, iterable):
+    """Shared producer thread: push until the target cancels, route errors
+    into the target so the consumer re-raises them from pop()."""
+    def run():
+        try:
+            for s in iterable:
+                if not target.push(s):
+                    return          # consumer cancelled
+        except BaseException as e:  # propagate to the consumer
+            target._set_error(e)
+        finally:
+            target.finish()         # always unblock the consumer
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
 
 
 # ---------------------------------------------------------------------------
@@ -124,7 +142,8 @@ class DataPipeline:
                              f"{self.sample_shape}")
         arr = np.ascontiguousarray(arr, self.dtype)
         if self._h is not None:
-            return bool(self._lib.ptpu_pipeline_push(self._h, arr.tobytes()))
+            return bool(self._lib.ptpu_pipeline_push(
+                self._h, arr.ctypes.data_as(ctypes.c_void_p)))
         with self._fb_cv:
             # backpressure like the native ring: block while full
             self._fb_cv.wait_for(
@@ -158,11 +177,29 @@ class DataPipeline:
             if self._fb_cap > 0:
                 self._fb_rng.shuffle(self._fb_buf)
                 for a in self._fb_buf:
+                    # honor the ring bound while draining; cancel breaks out
+                    self._fb_cv.wait_for(
+                        lambda: len(self._fb_batches) < self._fb_ring_cap
+                        or self._fb_done)
+                    if self._fb_done:
+                        break
                     self._fb_emit(a)
                 self._fb_buf = []
-            if self._fb_partial and not self.drop_last:
+            if self._fb_partial and not self.drop_last and not self._fb_done:
                 self._fb_batches.append(np.stack(self._fb_partial))
             self._fb_partial = []
+            self._fb_done = True
+            self._fb_cv.notify_all()
+
+    def _set_error(self, e):
+        self._error = e
+
+    def cancel(self):
+        """Consumer-side early exit: unblock the producer, drop the rest."""
+        if self._h is not None:
+            self._lib.ptpu_pipeline_cancel(self._h)
+            return
+        with self._fb_cv:
             self._fb_done = True
             self._fb_cv.notify_all()
 
@@ -170,17 +207,7 @@ class DataPipeline:
         """Run the producer on a background thread (prefetch overlap).
         Producer exceptions are re-raised from pop() rather than dying
         silently in the thread."""
-        def run():
-            try:
-                for s in iterable:
-                    if not self.push(s):
-                        return          # consumer cancelled
-            except BaseException as e:  # propagate to the consumer
-                self._error = e
-            finally:
-                self.finish()           # always unblock the consumer
-        self._thread = threading.Thread(target=run, daemon=True)
-        self._thread.start()
+        self._thread = _start_feed(self, iterable)
         return self
 
     # -- consumer --
@@ -216,12 +243,20 @@ class DataPipeline:
                     return
                 yield b
         finally:
-            self.finish()   # early break: unblock + cancel the producer
+            self.cancel()   # early break: unblock the producer
 
     def __del__(self):
         if getattr(self, '_h', None) is not None and self._lib is not None:
-            self._lib.ptpu_pipeline_destroy(self._h)
-            self._h = None
+            # the feed thread may still hold the native handle: cancel and
+            # join before freeing (avoids use-after-free on the C++ side)
+            try:
+                self._lib.ptpu_pipeline_cancel(self._h)
+                t = getattr(self, '_thread', None)
+                if t is not None and t.is_alive():
+                    t.join(timeout=5.0)
+            finally:
+                self._lib.ptpu_pipeline_destroy(self._h)
+                self._h = None
 
 
 # ---------------------------------------------------------------------------
@@ -272,26 +307,37 @@ class WordPieceTokenizer:
         return self._py_tokenize(text)[:max_len]
 
     def _py_tokenize(self, text):
+        """Byte-identical to the C++ tokenizer: ASCII-only classification
+        and lowercasing (std::isspace/ispunct/tolower over utf-8 bytes) and
+        the 100-char max word cap."""
         import string
+        punct = set(string.punctuation.encode())
+        space = set(b' \t\n\r\v\f')
         unk = self._vocab.get(self.unk_token, 0)
         words = []
-        cur = ''
-        for ch in text:
-            if ch.isspace():
+        cur = bytearray()
+        for b in text.encode('utf-8'):
+            if b in space:
                 if cur:
-                    words.append(cur)
-                    cur = ''
-            elif ch in string.punctuation:
+                    words.append(bytes(cur))
+                    cur = bytearray()
+            elif b in punct:
                 if cur:
-                    words.append(cur)
-                    cur = ''
-                words.append(ch)
+                    words.append(bytes(cur))
+                    cur = bytearray()
+                words.append(bytes([b]))
             else:
-                cur += ch.lower() if self.lowercase else ch
+                cur.append(b + 32 if self.lowercase and 65 <= b <= 90 else b)
         if cur:
-            words.append(cur)
+            words.append(bytes(cur))
+        # vocab lookup is on str; a byte word maps back via utf-8 (tokens
+        # whose bytes aren't valid utf-8 can't be in the vocab → UNK)
+        words = [bw.decode('utf-8', errors='replace') for bw in words]
         ids = []
         for w in words:
+            if len(w.encode('utf-8')) > 100:
+                ids.append(unk)
+                continue
             start, sub, bad = 0, [], False
             while start < len(w):
                 end = len(w)
@@ -417,24 +463,22 @@ class TupleDataPipeline:
                 raise TypeError(
                     f"field {i}: sample dtype {a.dtype} incompatible with "
                     f"{d} inferred from the first sample")
-            parts.append(np.ascontiguousarray(a, d).tobytes())
-        return self._pipe.push(np.frombuffer(b''.join(parts), np.uint8))
+            parts.append(np.ascontiguousarray(a, d).view(np.uint8)
+                         .reshape(-1))
+        return self._pipe.push(np.concatenate(parts) if len(parts) > 1
+                               else parts[0])
 
     def finish(self):
         self._pipe.finish()
 
+    def cancel(self):
+        self._pipe.cancel()
+
+    def _set_error(self, e):
+        self._pipe._set_error(e)
+
     def feed(self, iterable):
-        def run():
-            try:
-                for s in iterable:
-                    if not self.push(s):
-                        return
-            except BaseException as e:
-                self._pipe._error = e
-            finally:
-                self.finish()
-        t = threading.Thread(target=run, daemon=True)
-        t.start()
+        self._thread = _start_feed(self, iterable)
         return self
 
     def pop(self):
@@ -459,4 +503,4 @@ class TupleDataPipeline:
                     return
                 yield b
         finally:
-            self.finish()
+            self.cancel()

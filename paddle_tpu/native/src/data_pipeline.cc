@@ -102,11 +102,27 @@ struct Pipeline {
     std::unique_lock<std::mutex> lk(mu);
     if (shuffle_capacity > 0) {
       std::shuffle(reservoir.begin(), reservoir.end(), rng);
-      for (auto& s : reservoir) emit_locked(s.bytes.data());
+      for (auto& s : reservoir) {
+        // honor the ring bound while draining (consumer pops concurrently);
+        // a cancel() from the consumer side breaks the wait
+        space_cv.wait(lk, [&] {
+          return (int64_t)ring.size() < ring_capacity || finished;
+        });
+        if (finished) break;
+        emit_locked(s.bytes.data());
+      }
       reservoir.clear();
     }
-    if (!drop_last) flush_locked();
+    if (!finished && !drop_last) flush_locked();
     partial_count = 0;
+    finished = true;
+    ready_cv.notify_all();
+    space_cv.notify_all();
+  }
+
+  // consumer-side early exit: unblock any producer without draining
+  void cancel() {
+    std::unique_lock<std::mutex> lk(mu);
     finished = true;
     ready_cv.notify_all();
     space_cv.notify_all();
@@ -142,6 +158,8 @@ int ptpu_pipeline_push(void* h, const uint8_t* data) {
 }
 
 void ptpu_pipeline_finish(void* h) { static_cast<Pipeline*>(h)->finish(); }
+
+void ptpu_pipeline_cancel(void* h) { static_cast<Pipeline*>(h)->cancel(); }
 
 int64_t ptpu_pipeline_pop(void* h, uint8_t* out) {
   return static_cast<Pipeline*>(h)->pop(out);

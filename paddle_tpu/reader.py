@@ -218,6 +218,7 @@ class _GeneratorLoader:
     def __iter__(self):
         q = queue.Queue(maxsize=self._capacity)
         end = object()
+        err_box = []
 
         def producer():
             try:
@@ -225,6 +226,8 @@ class _GeneratorLoader:
                     staged = {k: jax.device_put(np.ascontiguousarray(v))
                               for k, v in feed.items()}
                     q.put(staged)
+            except BaseException as e:   # surface in the consumer, not stderr
+                err_box.append(e)
             finally:
                 q.put(end)
 
@@ -233,6 +236,8 @@ class _GeneratorLoader:
         while True:
             item = q.get()
             if item is end:
+                if err_box:
+                    raise err_box[0]
                 break
             if self._return_list:
                 yield [item[k] for k in item]

@@ -106,3 +106,32 @@ def test_pipeline_early_break_cancels_producer():
     import time
     time.sleep(0.3)
     assert threading.active_count() <= before + 1
+
+
+def test_dataloader_surfaces_producer_error():
+    import pytest
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[2], dtype='float32')
+        loader = fluid.io.DataLoader.from_generator(feed_list=[x])
+
+    def bad_gen():
+        yield (np.zeros(2, 'float32'),)
+        yield (np.zeros(3, 'float32'),)
+
+    loader.set_sample_generator(bad_gen, batch_size=1)
+    with pytest.raises(ValueError, match='shape'):
+        list(loader)
+
+
+def test_tokenizer_fallback_parity():
+    # compare native vs pure-python on the tricky cases
+    vocab = ['[UNK]', 'école', 'a' * 4, '##' + 'a' * 4]
+    tok = native.WordPieceTokenizer(vocab)
+    if native.is_native():
+        long_word = 'a' * 150
+        assert tok.tokenize(long_word) == tok._py_tokenize(long_word)
+        assert tok.tokenize('École') == tok._py_tokenize('École')
+        assert tok.tokenize('aaaaaaaa') == tok._py_tokenize('aaaaaaaa')

@@ -1,0 +1,164 @@
+"""Model zoo: forward shapes + a training step for each family."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph.tape import Tensor
+from paddle_tpu import models
+
+
+def _train_steps(model, loss_fn, n=3, lr=0.01):
+    opt = fluid.optimizer.AdamOptimizer(lr,
+                                        parameter_list=model.parameters())
+    losses = []
+    for _ in range(n):
+        loss = loss_fn()
+        loss.backward()
+        opt.minimize(loss)
+        model.clear_gradients()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def test_transformer_train_and_decode():
+    from paddle_tpu.models.transformer import (TransformerConfig, Transformer,
+                                               transformer_loss,
+                                               greedy_decode)
+    with dygraph.guard():
+        cfg = TransformerConfig.tiny()
+        model = Transformer(cfg)
+        rng = np.random.RandomState(0)
+        src = rng.randint(3, cfg.src_vocab_size, (2, 8)).astype(np.int64)
+        trg_in = np.concatenate([np.ones((2, 1), np.int64), src[:, :-1]], 1)
+        losses = _train_steps(
+            model, lambda: transformer_loss(
+                model(Tensor(src), Tensor(trg_in)), Tensor(src)), n=5)
+        assert losses[-1] < losses[0]
+        model.eval()
+        out = greedy_decode(model, Tensor(src), 1, 2, max_len=4)
+        assert out.shape[0] == 2
+
+
+def test_mobilenets_and_vgg_forward():
+    with dygraph.guard():
+        x = Tensor(np.random.randn(2, 3, 32, 32).astype('float32'))
+        m1 = models.MobileNetV1(num_classes=10, scale=0.25)
+        m1.eval()
+        assert m1(x).shape == (2, 10)
+        m2 = models.MobileNetV2(num_classes=10, scale=0.35)
+        m2.eval()
+        assert m2(x).shape == (2, 10)
+        vgg = models.VGG(11, num_classes=10, input_size=32, fc_dim=64)
+        vgg.eval()
+        assert vgg(x).shape == (2, 10)
+
+
+def test_word2vec_trains():
+    with dygraph.guard():
+        model = models.Word2Vec(vocab_size=50, embedding_size=16, neg_num=3)
+        rng = np.random.RandomState(0)
+        center = rng.randint(0, 50, (8,)).astype(np.int64)
+        targets = rng.randint(0, 50, (8, 4)).astype(np.int64)
+        losses = _train_steps(
+            model, lambda: model(Tensor(center), Tensor(targets)), n=10,
+            lr=0.1)
+        assert losses[-1] < losses[0]
+
+
+def test_seq2seq_attention_shapes():
+    with dygraph.guard():
+        model = models.Seq2SeqAttn(src_vocab=30, trg_vocab=40, hidden=16,
+                                   emb_dim=16)
+        src = np.random.randint(0, 30, (2, 5)).astype(np.int64)
+        trg = np.random.randint(0, 40, (2, 6)).astype(np.int64)
+        logits = model(Tensor(src), Tensor(trg))
+        assert logits.shape == (2, 6, 40)
+
+
+def test_deepfm_and_gru4rec_train():
+    with dygraph.guard():
+        fm = models.DeepFM(field_num=4, feature_size=100, embedding_size=4,
+                           deep_layers=(8, 8))
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 100, (16, 4)).astype(np.int64)
+        vals = np.ones((16, 4), 'float32')
+        y = rng.randint(0, 2, (16, 1)).astype('float32')
+
+        def fm_loss():
+            logit = fm(Tensor(ids), Tensor(vals))
+            from paddle_tpu.dygraph.tape import dispatch_op
+            l = dispatch_op('sigmoid_cross_entropy_with_logits',
+                            {'x': logit, 'label': Tensor(y)}, {})
+            return dispatch_op('reduce_mean', {'x': l}, {})
+
+        losses = _train_steps(fm, fm_loss, n=10, lr=0.05)
+        assert losses[-1] < losses[0]
+
+        g4r = models.GRU4Rec(vocab_size=30, hidden=16, emb_dim=16)
+        seq = rng.randint(0, 30, (2, 5)).astype(np.int64)
+        logits = g4r(Tensor(seq))
+        assert logits.shape == (2, 5, 30)
+
+
+def test_yolov3_forward_loss_infer():
+    with dygraph.guard():
+        model = models.YOLOv3(class_num=3)
+        model.eval()
+        img = Tensor(np.random.randn(1, 3, 64, 64).astype('float32'))
+        outs = model(img)
+        assert outs[0].shape == (1, 3 * 8, 2, 2)
+        assert outs[1].shape == (1, 3 * 8, 4, 4)
+        assert outs[2].shape == (1, 3 * 8, 8, 8)
+        gt = np.zeros((1, 2, 4), 'float32')
+        gt[0, 0] = [0.5, 0.5, 0.3, 0.3]
+        loss = model.loss(outs, Tensor(gt),
+                          Tensor(np.zeros((1, 2), np.int64)))
+        assert np.isfinite(float(loss.numpy()))
+        det = model.infer(outs, Tensor(np.array([[64, 64]], np.int32)),
+                          keep_top_k=5)
+        assert det.shape == (1, 5, 6)
+
+
+def test_crnn_ctc_train_decode():
+    with dygraph.guard():
+        model = models.CRNN(num_classes=10, hidden=16)
+        img = Tensor(np.random.randn(2, 1, 32, 48).astype('float32'))
+        logits = model(img)
+        B, T, V = logits.shape
+        assert B == 2 and V == 11
+        labels = np.random.randint(0, 10, (2, 4)).astype(np.int64)
+        lab_len = np.array([4, 3], np.int64)
+        loss = model.ctc_loss(logits, Tensor(labels), Tensor(lab_len))
+        assert np.isfinite(float(loss.numpy()))
+        out, lens = model.decode(logits)
+        assert out.shape[0] == 2
+
+
+def test_tsm_and_dcgan():
+    with dygraph.guard():
+        gen = models.DCGenerator(z_dim=8, base=8)
+        disc = models.DCDiscriminator(base=8)
+        z = Tensor(np.random.randn(2, 8).astype('float32'))
+        fake = gen(z)
+        assert fake.shape == (2, 1, 32, 32)
+        score = disc(fake)
+        assert score.shape == (2, 1)
+
+        tsm = models.TSM(num_classes=5, seg_num=2, backbone_layers=18)
+        tsm.eval()
+        clip = Tensor(np.random.randn(4, 3, 32, 32).astype('float32'))
+        out = tsm(clip)
+        assert out.shape == (2, 5)
+
+
+def test_ernie_classifier():
+    with dygraph.guard():
+        cfg = models.ErnieConfig(vocab_size=100, hidden_size=32,
+                                 num_hidden_layers=2, num_attention_heads=2,
+                                 intermediate_size=64,
+                                 max_position_embeddings=32)
+        model = models.ErnieForSequenceClassification(cfg, num_labels=3)
+        ids = Tensor(np.random.randint(0, 100, (2, 16)).astype(np.int64))
+        logits = model(ids)
+        assert logits.shape == (2, 3)
