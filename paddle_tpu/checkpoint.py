@@ -1,0 +1,98 @@
+"""Orbax-backed checkpointing for large/sharded state (SURVEY §2.7).
+
+Parity target: the reference's save_persistables/load_persistables for
+training state, upgraded the TPU way: orbax handles sharded arrays (each
+host writes its shards), atomic step directories, and async save so the
+train loop overlaps checkpoint IO with compute.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+
+
+def _checkpointer(use_async=False):
+    import orbax.checkpoint as ocp
+    if use_async:
+        return ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(state, directory, step=None, use_async=False,
+                    overwrite=True):
+    """state: pytree (e.g. {name: array} param dict, optimizer slots, …).
+    Writes to directory[/step]. With use_async=True returns immediately;
+    call wait_until_finished(ckptr) (returned) before exiting."""
+    path = os.path.join(os.path.abspath(directory),
+                        str(step)) if step is not None \
+        else os.path.abspath(directory)
+    ckptr = _checkpointer(use_async)
+    ckptr.save(path, state, force=overwrite)
+    return ckptr
+
+
+def load_checkpoint(directory, step=None, target=None):
+    """Restore a pytree. `target` (optional) provides structure/shardings —
+    pass the current state pytree to restore sharded arrays in place."""
+    import orbax.checkpoint as ocp
+    path = os.path.join(os.path.abspath(directory),
+                        str(step)) if step is not None \
+        else os.path.abspath(directory)
+    ckptr = ocp.PyTreeCheckpointer()
+    if target is not None:
+        restore_args = jax.tree_util.tree_map(
+            lambda x: ocp.ArrayRestoreArgs(sharding=getattr(x, 'sharding',
+                                                            None))
+            if hasattr(x, 'sharding') else ocp.RestoreArgs(), target)
+        return ckptr.restore(path, item=target, restore_args=restore_args)
+    return ckptr.restore(path)
+
+
+def latest_step(directory):
+    """Largest numeric subdirectory (checkpoint step layout)."""
+    if not os.path.isdir(directory):
+        return None
+    steps = [int(d) for d in os.listdir(directory) if d.isdigit()]
+    return max(steps) if steps else None
+
+
+class CheckpointManager:
+    """Rolling checkpoint manager (keep last N, async-capable)."""
+
+    def __init__(self, directory, max_to_keep=3, use_async=False):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        self.use_async = use_async
+        self._pending = None
+
+    def save(self, step, state):
+        if self._pending is not None:
+            self._pending.wait_until_finished()
+            self._pending = None
+        ck = save_checkpoint(state, self.directory, step,
+                             use_async=self.use_async)
+        if self.use_async:
+            self._pending = ck
+        self._gc()
+        return ck
+
+    def restore(self, step=None, target=None):
+        step = step if step is not None else latest_step(self.directory)
+        if step is None:
+            return None
+        return load_checkpoint(self.directory, step, target)
+
+    def wait(self):
+        if self._pending is not None:
+            self._pending.wait_until_finished()
+            self._pending = None
+
+    def _gc(self):
+        import shutil
+        steps = sorted(int(d) for d in os.listdir(self.directory)
+                       if d.isdigit())
+        for s in steps[:-self.max_to_keep]:
+            shutil.rmtree(os.path.join(self.directory, str(s)),
+                          ignore_errors=True)

@@ -1,0 +1,151 @@
+"""Model compression: QAT fake-quant + post-training quantization.
+
+Parity target: the reference's slim quantization passes
+(python/paddle/fluid/contrib/slim/quantization) — the reference rewrites the
+Program graph inserting fake_quantize ops before every quantizable op; the
+dygraph formulation wraps quantizable Layers (Conv2D/Linear) so their
+weights and input activations pass through the STE quant-dequant ops
+(ops/quant_ops.py), which is the same math fused into the jitted step.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..dygraph import Layer
+from ..dygraph.nn import Conv2D, Linear
+from ..dygraph.tape import dispatch_op, Tensor
+
+
+class FakeQuantWrapper(Layer):
+    """Wraps a Conv2D/Linear: channel-wise weight fake-quant + EMA
+    activation fake-quant (training observers; exact QAT rule of the
+    reference's QuantizationTransformPass)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self.inner = layer
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self._act_scale = np.ones(1, np.float32)
+        self._act_state = np.ones(1, np.float32)
+        self._act_accum = np.ones(1, np.float32)
+
+    def forward(self, x, *args, **kwargs):
+        out = dispatch_op(
+            'fake_quantize_dequantize_moving_average_abs_max',
+            {'x': x, 'in_scale': Tensor(self._act_scale, stop_gradient=True),
+             'state': Tensor(self._act_state, stop_gradient=True),
+             'accum': Tensor(self._act_accum, stop_gradient=True)},
+            {'moving_rate': self.moving_rate,
+             'bit_length': self.activation_bits,
+             'is_test': not self.training})
+        xq, scale, state, accum = out
+        if self.training:
+            self._act_scale = np.asarray(scale.numpy())
+            self._act_state = np.asarray(state.numpy())
+            self._act_accum = np.asarray(accum.numpy())
+        w = self.inner.weight
+        wq, _ = dispatch_op(
+            'fake_channel_wise_quantize_dequantize_abs_max',
+            {'x': w}, {'bit_length': self.weight_bits, 'quant_axis': 0})
+        orig_value = w.value
+        try:
+            w.value = wq.value if hasattr(wq, 'value') else wq
+            return self.inner(xq, *args, **kwargs)
+        finally:
+            w.value = orig_value
+
+    @property
+    def act_scale(self):
+        return float(self._act_scale[0])
+
+
+QUANTIZABLE = (Conv2D, Linear)
+
+
+def quant_aware(model, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                quantizable_types=QUANTIZABLE):
+    """In-place QAT transform: every quantizable sublayer is wrapped with
+    fake-quant observers. Returns the model (ref: quant_aware API of
+    paddleslim / the QuantizationTransformPass)."""
+
+    def transform(layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, quantizable_types):
+                layer._sub_layers[name] = FakeQuantWrapper(
+                    sub, weight_bits, activation_bits, moving_rate)
+            elif isinstance(sub, FakeQuantWrapper):
+                continue
+            else:
+                transform(sub)
+        return layer
+
+    return transform(model)
+
+
+def convert(model):
+    """Strip QAT wrappers for deployment, returning (model, scales): the
+    recorded activation scales + channel-wise weight scales per wrapped
+    layer (ref: QuantizationFreezePass)."""
+    scales = {}
+
+    def strip(layer, prefix=''):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f'{prefix}.{name}' if prefix else name
+            if isinstance(sub, FakeQuantWrapper):
+                w = np.asarray(sub.inner.weight.numpy())
+                axes = tuple(range(1, w.ndim))
+                scales[full] = {
+                    'activation': sub.act_scale,
+                    'weight': np.max(np.abs(w), axis=axes),
+                }
+                layer._sub_layers[name] = sub.inner
+            else:
+                strip(sub, full)
+        return layer
+
+    return strip(model), scales
+
+
+def quant_post(model, calib_reader, num_batches=10, activation_bits=8,
+               weight_bits=8):
+    """Post-training quantization: run calibration batches through the
+    float model recording per-layer abs-max activation scales, and compute
+    channel-wise weight scales. Returns a scales dict usable with the
+    quantize_linear/dequantize_linear ops (ref: quant_post / the
+    PostTrainingQuantization pass)."""
+    acts = {}
+    hooks = []
+
+    def make_hook(name):
+        def hook(layer, inputs, output):
+            x = inputs[0]
+            v = float(np.max(np.abs(np.asarray(x.numpy()))))
+            acts[name] = max(acts.get(name, 0.0), v)
+        return hook
+
+    for name, sub in model.named_sublayers():
+        if isinstance(sub, QUANTIZABLE):
+            hooks.append(sub.register_forward_post_hook(make_hook(name)))
+    model.eval()
+    for i, batch in enumerate(calib_reader()):
+        if i >= num_batches:
+            break
+        model(*[b if isinstance(b, Tensor) else Tensor(np.asarray(b))
+                for b in (batch if isinstance(batch, (list, tuple))
+                          else [batch])])
+    for h in hooks:
+        if h is not None and hasattr(h, 'remove'):
+            h.remove()
+    scales = {}
+    for name, sub in model.named_sublayers():
+        if isinstance(sub, QUANTIZABLE):
+            w = np.asarray(sub.weight.numpy())
+            axes = tuple(range(1, w.ndim))
+            scales[name] = {
+                'activation': acts.get(name, 1.0),
+                'weight': np.max(np.abs(w), axis=axes),
+            }
+    return scales

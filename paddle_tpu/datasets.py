@@ -1,0 +1,143 @@
+"""Dataset readers (SURVEY §2.7): MNIST, CIFAR, ImageNet-folder, synthetic.
+
+Parity target: python/paddle/dataset/{mnist,cifar,flowers}.py — reader
+creators yielding (image, label) samples, composable with the reader
+decorators and DataLoader. This environment has no network egress, so the
+readers load the standard files from a data_dir when present and otherwise
+fall back to a deterministic synthetic stream with identical shapes/dtypes
+(marked by `is_synthetic`), which keeps benches and tests runnable anywhere.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+DATA_HOME = os.environ.get('PADDLE_TPU_DATA_HOME',
+                           os.path.expanduser('~/.cache/paddle_tpu/dataset'))
+
+
+def _synthetic(shape, num_classes, n, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for _ in range(n):
+            img = rng.rand(*shape).astype('float32')
+            yield img, rng.randint(0, num_classes)
+    reader.is_synthetic = True
+    return reader
+
+
+# ---------------------------------------------------------------------------
+# MNIST (IDX files)
+# ---------------------------------------------------------------------------
+
+
+def _mnist_reader(images_path, labels_path, n_synth, seed):
+    if os.path.exists(images_path) and os.path.exists(labels_path):
+        def reader():
+            with gzip.open(images_path, 'rb') if images_path.endswith('.gz') \
+                    else open(images_path, 'rb') as f:
+                magic, n, rows, cols = struct.unpack('>IIII', f.read(16))
+                imgs = np.frombuffer(f.read(), np.uint8).reshape(n, rows,
+                                                                 cols)
+            with gzip.open(labels_path, 'rb') if labels_path.endswith('.gz') \
+                    else open(labels_path, 'rb') as f:
+                struct.unpack('>II', f.read(8))
+                labels = np.frombuffer(f.read(), np.uint8)
+            for img, lab in zip(imgs, labels):
+                yield (img.astype('float32') / 127.5 - 1.0).reshape(1, 28,
+                                                                    28), \
+                    int(lab)
+        reader.is_synthetic = False
+        return reader
+    return _synthetic((1, 28, 28), 10, n_synth, seed)
+
+
+def mnist_train(data_dir=None):
+    d = data_dir or os.path.join(DATA_HOME, 'mnist')
+    return _mnist_reader(os.path.join(d, 'train-images-idx3-ubyte.gz'),
+                         os.path.join(d, 'train-labels-idx1-ubyte.gz'),
+                         1024, 0)
+
+
+def mnist_test(data_dir=None):
+    d = data_dir or os.path.join(DATA_HOME, 'mnist')
+    return _mnist_reader(os.path.join(d, 't10k-images-idx3-ubyte.gz'),
+                         os.path.join(d, 't10k-labels-idx1-ubyte.gz'),
+                         256, 1)
+
+
+# ---------------------------------------------------------------------------
+# CIFAR-10/100 (python pickle tarballs)
+# ---------------------------------------------------------------------------
+
+
+def _cifar_reader(tar_path, member_match, label_key, n_synth, seed):
+    if os.path.exists(tar_path):
+        def reader():
+            with tarfile.open(tar_path) as tf:
+                for m in tf.getmembers():
+                    if member_match in m.name:
+                        batch = pickle.load(tf.extractfile(m),
+                                            encoding='bytes')
+                        data = batch[b'data'].reshape(-1, 3, 32, 32)
+                        labels = batch[label_key]
+                        for img, lab in zip(data, labels):
+                            yield (img.astype('float32') / 127.5 - 1.0), \
+                                int(lab)
+        reader.is_synthetic = False
+        return reader
+    return _synthetic((3, 32, 32), 10, n_synth, seed)
+
+
+def cifar10_train(data_dir=None):
+    d = data_dir or os.path.join(DATA_HOME, 'cifar')
+    return _cifar_reader(os.path.join(d, 'cifar-10-python.tar.gz'),
+                         'data_batch', b'labels', 1024, 2)
+
+
+def cifar10_test(data_dir=None):
+    d = data_dir or os.path.join(DATA_HOME, 'cifar')
+    return _cifar_reader(os.path.join(d, 'cifar-10-python.tar.gz'),
+                         'test_batch', b'labels', 256, 3)
+
+
+# ---------------------------------------------------------------------------
+# ImageNet-style folder (class subdirectories of .npy images)
+# ---------------------------------------------------------------------------
+
+
+def image_folder(root, shape=(3, 224, 224), n_synth=256, seed=4):
+    """root/<class_name>/*.npy — .npy files hold CHW float32 images (decode
+    jpegs to .npy in preprocessing; raw-jpeg decode needs an image lib this
+    environment doesn't guarantee)."""
+    if os.path.isdir(root):
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        idx = {c: i for i, c in enumerate(classes)}
+        files = [(os.path.join(root, c, f), idx[c])
+                 for c in classes
+                 for f in sorted(os.listdir(os.path.join(root, c)))
+                 if f.endswith('.npy')]
+        if files:
+            def reader():
+                for path, lab in files:
+                    yield np.load(path).astype('float32'), lab
+            reader.is_synthetic = False
+            return reader
+    return _synthetic(shape, 1000, n_synth, seed)
+
+
+# ---------------------------------------------------------------------------
+# synthetic (bench configs)
+# ---------------------------------------------------------------------------
+
+
+def synthetic(shape=(3, 224, 224), num_classes=1000, num_samples=1024,
+              seed=0):
+    return _synthetic(shape, num_classes, num_samples, seed)

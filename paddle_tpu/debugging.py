@@ -1,0 +1,95 @@
+"""Failure detection: NaN/Inf checks + error clip + env report (SURVEY
+§2.11).
+
+Parity target: the reference's check_nan_inf machinery
+(paddle/fluid/framework/details/nan_inf_utils*, FLAGS_check_nan_inf) and
+fluid's debugger/device report. On TPU the check compiles INTO the step
+(jnp.isfinite reductions are nearly free next to the matmuls) instead of
+the reference's post-kernel host scans; jax's native debug_nans is also
+wired through for eager paths.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_check_enabled = os.environ.get('FLAGS_check_nan_inf', '0') not in ('0', '')
+
+
+def enable_check_nan_inf(enable=True):
+    """Also enables jax_debug_nans so eager/dygraph ops raise at the
+    producing op, like the reference's per-op scan."""
+    global _check_enabled
+    _check_enabled = enable
+    jax.config.update('jax_debug_nans', bool(enable))
+
+
+def check_nan_inf_enabled():
+    return _check_enabled
+
+
+def check_numerics(value, name='tensor'):
+    """Raise if `value` (array or pytree) has NaN/Inf. Usable on fetched
+    numpy results or inside eager code."""
+    bad = []
+
+    def visit(path, v):
+        arr = np.asarray(v)
+        if arr.dtype.kind == 'f' and not np.isfinite(arr).all():
+            n_nan = int(np.isnan(arr).sum())
+            n_inf = int(np.isinf(arr).sum())
+            bad.append(f"{path}: {n_nan} NaN, {n_inf} Inf "
+                       f"(shape {arr.shape})")
+
+    leaves = jax.tree_util.tree_leaves_with_path(value) \
+        if not hasattr(value, 'shape') else [((name,), value)]
+    for path, v in leaves:
+        visit('/'.join(str(p) for p in path) or name, v)
+    if bad:
+        raise FloatingPointError(
+            f"check_nan_inf: non-finite values in {name}:\n  "
+            + "\n  ".join(bad))
+    return value
+
+
+def assert_all_finite(x, message='tensor'):
+    """In-graph check: poisons the whole tensor to NaN when any value is
+    non-finite so the failure is unmissable on fetch (branchless)."""
+    finite = jnp.all(jnp.isfinite(x))
+    return jnp.where(finite, x, jnp.full_like(x, jnp.nan))
+
+
+def device_report():
+    """Environment/device summary (ref: fluid's install-time env report)."""
+    lines = [
+        f"jax {jax.__version__}, backend {jax.default_backend()}",
+        f"devices: {[str(d) for d in jax.devices()]}",
+        f"process {jax.process_index()}/{jax.process_count()}",
+        f"x64: {jax.config.read('jax_enable_x64')}",
+    ]
+    return '\n'.join(lines)
+
+
+def install_check():
+    """Self-test (ref: fluid.install_check.run_check): build and run one
+    tiny train step end to end on the active backend."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[4], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(start)
+        l0, = exe.run(main, feed={'x': np.ones((8, 4), 'float32'),
+                                  'y': np.zeros((8, 1), 'float32')},
+                      fetch_list=[loss])
+        check_numerics(l0, 'install_check loss')
+    print('paddle_tpu install check passed —', device_report().split('\n')[0])
+    return True

@@ -385,6 +385,40 @@ class Executor:
         return fetches
 
     # ------------------------------------------------------------------
+    def lower_to_callable(self, program, feed, fetch_list, scope=None):
+        """(program, example feed dict, fetch_list) → (fn, arg_vals): a pure
+        jittable fn over the feed arrays with the scope's parameters closed
+        over as constants — the export surface for StableHLO (inference.py)."""
+        scope = scope if scope is not None else global_scope()
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in fetch_list]
+        feed_names = sorted(feed)
+        state_names = sorted(v.name for v in program.list_vars()
+                             if v.persistable)
+        state = {}
+        for n in state_names:
+            val = scope.find(n)
+            if val is None:
+                raise RuntimeError(f"persistable var '{n}' is uninitialized")
+            state[n] = jnp.asarray(val)
+        step = _lower(program, feed_names, fetch_names, state_names)
+        base_key = default_generator.base_key()
+
+        def fn(*feed_arrays):
+            feed_vals = dict(zip(feed_names, feed_arrays))
+            _, fetches = step(dict(state), feed_vals, base_key)
+            return fetches
+
+        block = program.global_block()
+        arg_vals = []
+        for n in feed_names:
+            dtype = block.var(n).dtype if block.has_var(n) else None
+            arg_vals.append(jnp.asarray(feed[n],
+                                        to_jax_dtype(dtype) if dtype
+                                        else None))
+        return fn, arg_vals
+
+    # ------------------------------------------------------------------
     def _run_startup(self, program, scope):
         """Run an init program eagerly (once-per-training cost; not jitted)."""
         self._step_counter += 1

@@ -1,0 +1,144 @@
+"""Inference library (SURVEY §2.9): Predictor + StableHLO export.
+
+Parity target: the reference's C++ inference library
+(/root/reference/paddle/fluid/inference: AnalysisPredictor, TensorRT/Anakin
+subgraphs). The TPU analogue: load_inference_model → lower the program ONCE
+to a jitted function cached by feed shapes (the same compile cache as the
+Executor) → run. Engine export goes to StableHLO text/bytecode — the
+portable compiler IR playing TensorRT's role on TPU — via jax.jit(...).lower.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class Config:
+    """ref: AnalysisConfig — model path + precision switches."""
+
+    def __init__(self, model_dir=None, model_filename=None,
+                 params_filename=None):
+        self.model_dir = model_dir
+        self.model_filename = model_filename
+        self.params_filename = params_filename
+        self.precision = 'float32'
+
+    def enable_bf16(self):
+        self.precision = 'bfloat16'
+        return self
+
+    # GPU-era toggles accepted as no-ops for script parity
+    def enable_use_gpu(self, *a, **k):
+        return self
+
+    def switch_use_feed_fetch_ops(self, *a, **k):
+        return self
+
+    def disable_glog_info(self):
+        return self
+
+
+class Predictor:
+    """ref: create_paddle_predictor(config) → AnalysisPredictor.
+
+    Loads a saved inference model and runs it as one jitted XLA program.
+    """
+
+    def __init__(self, config_or_dir, executor=None):
+        import paddle_tpu as fluid
+        cfg = config_or_dir if isinstance(config_or_dir, Config) \
+            else Config(str(config_or_dir))
+        self.config = cfg
+        self._exe = executor or fluid.Executor()
+        self._scope = fluid.Scope()
+        with fluid.scope_guard(self._scope):
+            prog, feeds, fetches = fluid.io.load_inference_model(
+                cfg.model_dir, self._exe, cfg.model_filename,
+                cfg.params_filename)
+        self.program = prog
+        self.feed_names = feeds
+        self.fetch_vars = fetches
+
+    def get_input_names(self):
+        return list(self.feed_names)
+
+    def get_output_names(self):
+        return [v.name if hasattr(v, 'name') else v for v in self.fetch_vars]
+
+    def run(self, inputs):
+        """inputs: list of arrays (feed order) or dict name→array.
+        Returns the fetch arrays. Compiled once per feed-shape set."""
+        import paddle_tpu as fluid
+        if isinstance(inputs, dict):
+            feed = inputs
+        else:
+            feed = dict(zip(self.feed_names, inputs))
+        if self.config.precision == 'bfloat16':
+            feed = {k: _to_bf16(v) for k, v in feed.items()}
+        with fluid.scope_guard(self._scope):
+            return self._exe.run(self.program, feed=feed,
+                                 fetch_list=self.fetch_vars)
+
+
+def _to_bf16(v):
+    v = np.asarray(v)
+    return v.astype(jnp.bfloat16) if v.dtype == np.float32 else v
+
+
+def create_paddle_predictor(config):
+    return Predictor(config)
+
+
+# ---------------------------------------------------------------------------
+# StableHLO export
+# ---------------------------------------------------------------------------
+
+
+def export_stablehlo(fn, example_args, path=None, bf16=False):
+    """Lower a jittable function to StableHLO text. `fn(*example_args)` must
+    be jax-traceable (use dygraph.jit.functionalize or TracedLayer to get
+    one from a Layer). Returns the StableHLO module text; writes it to
+    `path` when given."""
+    if bf16:
+        example_args = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a, jnp.bfloat16)
+            if hasattr(a, 'dtype') and np.asarray(a).dtype == np.float32
+            else a, example_args)
+    lowered = jax.jit(fn).lower(*example_args)
+    text = lowered.as_text(dialect='stablehlo')
+    if path:
+        os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+        with open(path, 'w') as f:
+            f.write(text)
+    return text
+
+
+def export_program_stablehlo(program, feed_shapes, fetch_list, path=None,
+                             scope=None, feed_dtypes=None):
+    """Lower a static Program's (feed→fetch) computation to StableHLO.
+    feed_shapes: {name: shape}."""
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import Executor
+    exe = Executor()
+    dummy = {}
+    for name, shape in feed_shapes.items():
+        dt = (feed_dtypes or {}).get(name, 'float32')
+        dummy[name] = np.zeros(shape, dt)
+
+    ctx = fluid.scope_guard(scope) if scope is not None else None
+    if ctx is not None:
+        ctx.__enter__()
+    try:
+        fn, arg_vals = exe.lower_to_callable(program, dummy, fetch_list)
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+    lowered = jax.jit(fn).lower(*arg_vals)
+    text = lowered.as_text(dialect='stablehlo')
+    if path:
+        with open(path, 'w') as f:
+            f.write(text)
+    return text

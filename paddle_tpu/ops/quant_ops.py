@@ -1,0 +1,107 @@
+"""Fake-quantization ops for QAT/PTQ (SURVEY §2.6).
+
+Parity targets: /root/reference/paddle/fluid/operators/fake_quantize_op.*
+(abs_max, channel_wise_abs_max, moving_average_abs_max) as driven by the
+reference's slim/quantization passes. Quant-dequant with a straight-through
+estimator (jax.custom_vjp): the forward snaps to the int grid, the backward
+passes gradients through inside the clip range — the standard QAT rule the
+reference implements with its fake_quantize grad kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@jax.custom_vjp
+def _ste_quant_dequant(x, scale, bit_length):
+    qmax = 2.0 ** (bit_length - 1) - 1
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _ste_fwd(x, scale, bit_length):
+    return _ste_quant_dequant(x, scale, bit_length), (x, scale)
+
+
+def _ste_bwd(res, g):
+    x, scale = res
+    s = jnp.maximum(scale, 1e-8)
+    inside = (jnp.abs(x) <= s).astype(g.dtype)
+    return g * inside, None, None
+
+
+_ste_quant_dequant.defvjp(_ste_fwd, _ste_bwd)
+
+
+@register_op('fake_quantize_dequantize_abs_max', outputs=['Out', 'OutScale'])
+def fake_quantize_dequantize_abs_max(x, *, bit_length=8):
+    x = jnp.asarray(x)
+    scale = jnp.max(jnp.abs(x))
+    return _ste_quant_dequant(x, scale, bit_length), scale.reshape(1)
+
+
+@register_op('fake_channel_wise_quantize_dequantize_abs_max',
+             outputs=['Out', 'OutScale'])
+def fake_channel_wise_quantize_dequantize_abs_max(x, *, bit_length=8,
+                                                  quant_axis=0):
+    x = jnp.asarray(x)
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    out = _ste_quant_dequant(x, scale, bit_length)
+    return out, scale.reshape(-1)
+
+
+@register_op('fake_quantize_dequantize_moving_average_abs_max',
+             outputs=['Out', 'OutScale', 'StateOut', 'AccumOut'])
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, in_scale, state=None, accum=None, *, moving_rate=0.9,
+        bit_length=8, is_test=False):
+    """Activation observer: EMA of abs-max (fake_quantize_op.cc
+    FakeQuantizeMovingAverageAbsMax)."""
+    x = jnp.asarray(x)
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        scale = jnp.asarray(in_scale).reshape(())
+        st = jnp.asarray(state).reshape(()) if state is not None \
+            else jnp.ones(())
+        ac = jnp.asarray(accum).reshape(()) if accum is not None \
+            else scale
+    else:
+        st_prev = jnp.asarray(state).reshape(()) if state is not None \
+            else jnp.ones(())
+        ac_prev = jnp.asarray(accum).reshape(()) if accum is not None \
+            else jnp.asarray(in_scale).reshape(())
+        st = st_prev * moving_rate + 1.0
+        ac = ac_prev * moving_rate + cur
+        scale = ac / st
+    out = _ste_quant_dequant(x, scale, bit_length)
+    return out, scale.reshape(1), st.reshape(1), ac.reshape(1)
+
+
+@register_op('quantize_linear')
+def quantize_linear(x, scale, *, bit_length=8, quant_axis=-1):
+    """x / scale → rounded int8 values (inference-time real quantization)."""
+    x = jnp.asarray(x)
+    s = jnp.maximum(jnp.asarray(scale), 1e-8)
+    if quant_axis >= 0 and s.ndim >= 1 and s.size > 1:
+        shape = [1] * x.ndim
+        shape[quant_axis] = -1
+        s = s.reshape(shape)
+    qmax = 2.0 ** (bit_length - 1) - 1
+    return jnp.clip(jnp.round(x / s * qmax), -qmax, qmax).astype(jnp.int8)
+
+
+@register_op('dequantize_linear')
+def dequantize_linear(x, scale, *, bit_length=8, quant_axis=-1):
+    x = jnp.asarray(x).astype(jnp.float32)
+    s = jnp.asarray(scale)
+    if quant_axis >= 0 and s.ndim >= 1 and s.size > 1:
+        shape = [1] * x.ndim
+        shape[quant_axis] = -1
+        s = s.reshape(shape)
+    qmax = 2.0 ** (bit_length - 1) - 1
+    return x * s / qmax
