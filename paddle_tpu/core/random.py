@@ -8,6 +8,8 @@ cuRAND states (ref: paddle/fluid/operators/dropout_op.cu seed handling).
 """
 from __future__ import annotations
 
+import contextlib
+
 import jax
 
 
@@ -25,6 +27,20 @@ class KeyGenerator:
 
     def base_key(self):
         return self._base
+
+    @contextlib.contextmanager
+    def bind_base(self, base_key):
+        """Derive keys from `base_key` (possibly a jit tracer) inside the
+        context. Used by `to_static` tracing so random ops fold counters into
+        a per-call key argument instead of baking a host constant into the
+        compiled program (which would freeze dropout masks across calls)."""
+        old = self._base, self._counter
+        self._base = base_key
+        self._counter = 0
+        try:
+            yield
+        finally:
+            self._base, self._counter = old
 
 
 default_generator = KeyGenerator(0)

@@ -8,7 +8,8 @@ from .nn import (Conv2D, Conv3D, Pool2D, Linear, BatchNorm, Embedding,
                  Conv2DTranspose, Conv3DTranspose, GroupNorm, SpectralNorm,
                  TreeConv, Dropout)
 from . import jit
-from .jit import TracedLayer, declarative
+from .jit import (TracedLayer, declarative, to_static, ProgramTranslator,
+                  StaticFunction, InputSpec)
 from .parallel import DataParallel, ParallelEnv, prepare_context
 from .checkpoint import save_dygraph, load_dygraph
 from .learning_rate_scheduler import (LearningRateDecay, PiecewiseDecay,

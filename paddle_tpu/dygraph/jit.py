@@ -93,14 +93,339 @@ class TracedLayer:
         _save_jit_model(dirname, self._layer, self._params, self._buffers)
 
 
-def declarative(fn):
-    """@declarative / to_static: jit the eager function. Parameters of any
-    Layer bound as `self` are captured fresh each call."""
-    @functools.wraps(fn)
-    def wrapper(*args, **kwargs):
-        return fn(*args, **kwargs)
-    wrapper._is_declarative = True
-    return wrapper
+class InputSpec:
+    """Declared input signature for `to_static` (paddle.static.InputSpec
+    parity). `shape` entries of None mean "any size" — the concrete size is
+    taken from the first call (each distinct size compiles once)."""
+
+    def __init__(self, shape, dtype='float32', name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+
+class ProgramTranslator:
+    """ref: dygraph_to_static/program_translator.py:ProgramTranslator —
+    process-wide switch; `enable(False)` makes every StaticFunction fall back
+    to plain eager execution (the reference's escape hatch)."""
+
+    _instance = None
+    enabled = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def enable(self, flag: bool):
+        ProgramTranslator.enabled = bool(flag)
+
+    _fn_cache = {}
+
+    def get_output(self, fn, *args, **kwargs):
+        if isinstance(fn, StaticFunction):
+            sf = fn
+        else:
+            sf = ProgramTranslator._fn_cache.get(fn)
+            if sf is None:
+                sf = ProgramTranslator._fn_cache.setdefault(
+                    fn, StaticFunction(fn))
+        return sf(*args, **kwargs)
+
+
+def _find_layers(fn, instance, args, kwargs):
+    """Layers whose parameters the traced program must treat as inputs: the
+    bound instance, Layer positional/kw args, Layers captured in the
+    function's closure cells, and Layers reachable from the function's module
+    globals (one container level deep). The reference discovers these via AST
+    rewrite + the program cache; here object inspection suffices."""
+    layers = []
+    seen = set()
+
+    def add(obj, depth=0):
+        if isinstance(obj, Layer):
+            if id(obj) not in seen:
+                seen.add(id(obj))
+                layers.append(obj)
+        elif depth < 1:
+            if isinstance(obj, (list, tuple)):
+                for v in obj:
+                    add(v, depth + 1)
+            elif isinstance(obj, dict):
+                for v in obj.values():
+                    add(v, depth + 1)
+
+    add(instance)
+    for a in args:
+        add(a)
+    for a in kwargs.values():
+        add(a)
+    raw = getattr(fn, '__wrapped__', fn)
+    for cell in (getattr(raw, '__closure__', None) or ()):
+        try:
+            add(cell.cell_contents)
+        except ValueError:
+            pass
+    for v in getattr(raw, '__globals__', {}).values():
+        add(v)
+    return layers
+
+
+def _is_array_like(x):
+    return isinstance(x, (Tensor, np.ndarray, jnp.ndarray)) or (
+        hasattr(x, 'shape') and hasattr(x, 'dtype'))
+
+
+class StaticFunction:
+    """Real dygraph→static translation (ref: dygraph_to_static/
+    program_translator.py:StaticFunction). Instead of AST-rewriting Python to
+    a fluid Program, the eager function is traced with jax tracers — the tape
+    dispatches the same registered functionals either way — producing ONE
+    fused XLA program per input signature, cached by (shapes, dtypes, static
+    args, grad mode). Gradients flow: the whole compiled forward becomes a
+    single tape node whose vjp is itself a cached jitted XLA program."""
+
+    def __init__(self, fn, input_spec=None):
+        functools.update_wrapper(self, fn)
+        self._fn = fn
+        self._input_spec = input_spec
+        self._instance = None
+        self._cache = {}
+        # shared mutable cell: bound copies made by __get__ must increment
+        # the same counter the descriptor exposes
+        self._stats = {'compiles': 0}
+        self._is_declarative = True
+
+    @property
+    def _compile_count(self):
+        return self._stats['compiles']
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = StaticFunction.__new__(StaticFunction)
+        bound.__dict__ = dict(self.__dict__)
+        bound._instance = instance
+        return bound
+
+    # -- signature handling --------------------------------------------------
+    def _split_args(self, args, kwargs):
+        """→ (arr_vals, rebuild, sig). Array-likes become traced inputs;
+        everything else (python scalars, strings, None, Layers) is static and
+        partakes in the cache key."""
+        spec = self._input_spec
+        arr_vals, slots = [], []
+        sig = []
+
+        def classify(x, spec_i=None):
+            if _is_array_like(x):
+                v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+                if spec_i is not None and spec_i.dtype is not None:
+                    from ..core.dtypes import to_jax_dtype
+                    v = v.astype(to_jax_dtype(spec_i.dtype))
+                arr_vals.append(v)
+                slots.append(None)
+                sig.append(('arr', v.shape, str(v.dtype)))
+            else:
+                slots.append(x)
+                sig.append(('static', repr(x)))
+
+        for i, a in enumerate(args):
+            s = spec[i] if spec is not None and i < len(spec) else None
+            classify(a, s)
+        kw_keys = sorted(kwargs)
+        for k in kw_keys:
+            sig.append(('kw', k))
+            classify(kwargs[k])
+
+        def rebuild(traced_vals):
+            it = iter(traced_vals)
+            vals = [next(it) if s is None else s for s in slots]
+            pos = vals[:len(args)]
+            kw = dict(zip(kw_keys, vals[len(args):]))
+            return pos, kw
+
+        return arr_vals, rebuild, tuple(sig)
+
+    def _compile(self, layers, arr_vals, rebuild, grad_flag, args_need_grad):
+        from ..core.random import default_generator
+        from .tape import watch_tensors
+        all_params, all_buffers = {}, {}
+        for li, layer in enumerate(layers):
+            for n, p in layer.named_parameters():
+                all_params[f'{li}.{n}'] = p
+            for n, b in layer.named_buffers():
+                all_buffers[f'{li}.{n}'] = b
+        fn, instance = self._fn, self._instance
+
+        def make_run(params, buffers, pnames, bnames):
+            def run(pvals, bvals, key, arr):
+                pts = {n: params[n] for n in pnames}
+                bts = {n: buffers[n] for n in bnames}
+                with _bind(pts, dict(zip(pnames, pvals))), \
+                        _bind(bts, dict(zip(bnames, bvals))), \
+                        default_generator.bind_base(key), no_grad_guard():
+                    pos, kw = rebuild(_tensorize_keep(arr))
+                    if instance is not None:
+                        out = fn(instance, *pos, **kw)
+                    else:
+                        out = fn(*pos, **kw)
+                    new_b = [buffers[n].value for n in bnames]
+                flat, treedef = jax.tree_util.tree_flatten(_devalue(out))
+                return flat, treedef, new_b
+            return run
+
+        # Discovery pass (abstract, no FLOPs): bind every candidate
+        # param/buffer to protect it from tracer leaks, watch which tensors
+        # the function actually reads, and capture the output structure.
+        touched = []
+        k0 = default_generator.base_key()
+        run_all = make_run(all_params, all_buffers,
+                           list(all_params), list(all_buffers))
+        with watch_tensors(touched):
+            jax.eval_shape(lambda p, b, k, a: run_all(p, b, k, a)[0],
+                           [p.value for p in all_params.values()],
+                           [b.value for b in all_buffers.values()],
+                           k0, tuple(arr_vals))
+        touched_ids = {id(t) for t in touched}
+        params = {n: p for n, p in all_params.items() if id(p) in touched_ids}
+        # keep every buffer of any layer the trace actually used (buffer
+        # writes don't flow through dispatch, so reads alone can't prove
+        # a buffer is untouched)
+        used_layers = set()
+        for li, layer in enumerate(layers):
+            names = [n for n in list(all_params) + list(all_buffers)
+                     if n.startswith(f'{li}.')]
+            tensors = [all_params.get(n) or all_buffers.get(n) for n in names]
+            if any(id(t) in touched_ids for t in tensors):
+                used_layers.add(li)
+        buffers = {n: b for n, b in all_buffers.items()
+                   if int(n.split('.', 1)[0]) in used_layers}
+        pnames = list(params)
+        bnames = list(buffers)
+
+        treedef_box = []
+        run = make_run(params, buffers, pnames, bnames)
+
+        def run_flat(pvals, bvals, key, arr):
+            flat, treedef, new_b = run(pvals, bvals, key, arr)
+            if not treedef_box:
+                treedef_box.append(treedef)
+            return tuple(flat), new_b
+
+        shapes = jax.eval_shape(run_flat,
+                                [params[n].value for n in pnames],
+                                [buffers[n].value for n in bnames],
+                                k0, tuple(arr_vals))
+        n_out = len(shapes[0])
+        treedef = treedef_box.pop()
+
+        needs_grad = grad_flag and (
+            args_need_grad or
+            any(getattr(p, 'trainable', False) for p in params.values()))
+        if not needs_grad:
+            infer = jax.jit(run_flat)
+            return ('infer', infer, pnames, bnames, treedef, n_out,
+                    params, buffers)
+
+        def fwd_fn(pvals, bvals, key, arr):
+            def g(pv, a):
+                flat, new_b = run_flat(pv, bvals, key, a)
+                out = flat[0] if n_out == 1 else tuple(flat)
+                return out, new_b
+            out, vjp_fn, new_b = jax.vjp(g, pvals, tuple(arr), has_aux=True)
+            flat = [out] if n_out == 1 else list(out)
+            return flat, new_b, vjp_fn
+
+        fwd = jax.jit(fwd_fn)
+        bwd = jax.jit(lambda vf, ct: vf(ct))
+        return ('grad', (fwd, bwd), pnames, bnames, treedef, n_out,
+                params, buffers)
+
+    # -- call ----------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if not ProgramTranslator.enabled:
+            if self._instance is not None:
+                return self._fn(self._instance, *args, **kwargs)
+            return self._fn(*args, **kwargs)
+        from ..core.random import default_generator
+        arr_vals, rebuild, sig = self._split_args(args, kwargs)
+
+        ordered_args = list(args) + [kwargs[k] for k in sorted(kwargs)]
+        grad_flag = _grad_enabled()
+        arg_req = tuple(isinstance(a, Tensor) and not a.stop_gradient
+                        for a in ordered_args)
+        # The entry stores the param/buffer Tensor objects it bound at
+        # compile time, so cache hits skip layer discovery entirely.
+        # (Rebinding a module global to a NEW Layer instance mid-training is
+        # not retraced — same staleness semantics as the reference's program
+        # cache, which also keys on function identity + input spec.)
+        key = (sig, grad_flag, arg_req, id(self._instance))
+        entry = self._cache.get(key)
+        if entry is None:
+            layers = _find_layers(self._fn, self._instance, args, kwargs)
+            entry = self._compile(layers, arr_vals, rebuild, grad_flag,
+                                  any(arg_req))
+            self._cache[key] = entry
+            self._stats['compiles'] += 1  # one trace+compile per signature
+        mode, compiled, pnames, bnames, treedef, n_out, params, buffers = entry
+        pvals = [params[n].value for n in pnames]
+        bvals = [buffers[n].value for n in bnames]
+        rng = default_generator.next_key()
+
+        if mode == 'infer':
+            flat, new_b = compiled(pvals, bvals, rng, tuple(arr_vals))
+            for n, v in zip(bnames, new_b):
+                buffers[n].value = v
+            outs = [Tensor(v, stop_gradient=True) for v in flat]
+            return jax.tree_util.tree_unflatten(treedef, outs)
+
+        fwd, bwd = compiled
+        flat, new_b, vjp_fn = fwd(pvals, bvals, rng, tuple(arr_vals))
+        for n, v in zip(bnames, new_b):
+            buffers[n].value = v
+
+        param_tensors = [params[n] for n in pnames]
+
+        from .tape import Node
+
+        def node_vjp(ct):
+            p_cts, a_cts = bwd(vjp_fn, ct)
+            by_val = list(p_cts) + list(a_cts)
+            # map cotangents back to node.inputs order (params then arr args)
+            return by_val
+
+        # Tensors corresponding to traced arr inputs, in arr order
+        arr_tensors = [a if isinstance(a, Tensor)
+                       else Tensor(a, stop_gradient=True)
+                       for a in ordered_args if _is_array_like(a)]
+        node_inputs = param_tensors + arr_tensors
+        node = Node(node_vjp, node_inputs, n_out,
+                    [(v.shape, v.dtype) for v in flat], 'to_static')
+        outs = []
+        for i, v in enumerate(flat):
+            t = Tensor(v)
+            t._node = node
+            t._out_index = i
+            outs.append(t)
+        return jax.tree_util.tree_unflatten(treedef, outs)
+
+
+def _tensorize_keep(vals):
+    return [Tensor(v, stop_gradient=True) for v in vals]
+
+
+def _grad_enabled():
+    from . import tape
+    return tape._grad_enabled
+
+
+def declarative(fn=None, input_spec=None):
+    """@declarative / @to_static: trace the eager function into a cached
+    jitted XLA program (see StaticFunction)."""
+    if fn is None:
+        return lambda f: StaticFunction(f, input_spec=input_spec)
+    return StaticFunction(fn, input_spec=input_spec)
 
 
 to_static = declarative

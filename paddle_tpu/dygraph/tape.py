@@ -22,6 +22,19 @@ from ..core.random import default_generator
 from ..ops.registry import get_op
 
 _grad_enabled = True
+_tensor_watchers = []
+
+
+@contextlib.contextmanager
+def watch_tensors(collector: list):
+    """Record every Tensor that flows into an op while active (used by
+    `to_static` to discover which Parameters/buffers a traced function
+    actually reads, so only those become inputs of the compiled program)."""
+    _tensor_watchers.append(collector)
+    try:
+        yield
+    finally:
+        _tensor_watchers.pop()
 
 
 @contextlib.contextmanager
@@ -161,6 +174,10 @@ def dispatch_op(op_type, inputs, attrs):
             t = v if isinstance(v, Tensor) else Tensor(v, stop_gradient=True)
             arg_spec.append(('single', len(flat_tensors)))
             flat_tensors.append(t)
+
+    if _tensor_watchers:
+        for w in _tensor_watchers:
+            w.extend(flat_tensors)
 
     attrs = dict(attrs)
     if opdef.needs_rng and 'key' not in attrs:
@@ -329,6 +346,9 @@ def monkey_patch_tensor():
     T.__hash__ = lambda self: id(self)
 
     def _getitem(self, idx):
+        if _tensor_watchers:
+            for w in _tensor_watchers:
+                w.append(self)
         if isinstance(idx, Tensor):
             idx = idx.value
         if (self.stop_gradient or not _grad_enabled

@@ -705,12 +705,16 @@ class IfElse:
             raise ValueError(
                 f"IfElse: true block produced {len(t)} outputs, false block "
                 f"{len(f)}; they must match")
-        from .tensor import cast
+        from .nn import where, reshape
         merged = []
         for tv, fv in zip(t, f):
-            m = cast(self._cond, tv.dtype)
-            # mask is (B, 1); broadcasts over trailing dims
-            merged.append(tv * m + fv * (1.0 - m))
+            m = self._cond
+            # rowwise select (never multiply-blend: 0*NaN from the unselected
+            # branch must not poison the result, and int dtypes must survive)
+            extra = len(tv.shape) - len(m.shape)
+            if extra > 0:
+                m = reshape(m, shape=list(m.shape) + [1] * extra)
+            merged.append(where(m, tv, fv))
         return merged
 
 

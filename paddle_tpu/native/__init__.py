@@ -270,14 +270,18 @@ class WordPieceTokenizer:
         if isinstance(vocab, str):
             with open(vocab, 'rb') as f:
                 blob = f.read()
-            tokens = [t for t in blob.decode('utf-8').split('\n') if t]
+            # BERT convention: id == line number. Blank lines stay in the
+            # list as placeholders so subsequent ids don't shift.
+            tokens = blob.decode('utf-8').split('\n')
+            if tokens and tokens[-1] == '':
+                tokens.pop()  # trailing newline is not a vocab line
         elif isinstance(vocab, dict):
             tokens = [t for t, _ in sorted(vocab.items(),
                                            key=lambda kv: kv[1])]
         else:
             tokens = list(vocab)
         self._tokens = tokens
-        self._vocab = {t: i for i, t in enumerate(tokens)}
+        self._vocab = {t: i for i, t in enumerate(tokens) if t}
         self.lowercase = lowercase
         self.unk_token = unk_token
         self._lib = _load()
@@ -306,33 +310,43 @@ class WordPieceTokenizer:
             return out[:n].tolist()
         return self._py_tokenize(text)[:max_len]
 
+    @staticmethod
+    def _is_cjk(cp):
+        return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF or
+                0xF900 <= cp <= 0xFAFF or 0x20000 <= cp <= 0x2A6DF or
+                0x2A700 <= cp <= 0x2B73F or 0x2B740 <= cp <= 0x2B81F or
+                0x2B820 <= cp <= 0x2CEAF or 0x2F800 <= cp <= 0x2FA1F)
+
     def _py_tokenize(self, text):
-        """Byte-identical to the C++ tokenizer: ASCII-only classification
-        and lowercasing (std::isspace/ispunct/tolower over utf-8 bytes) and
-        the 100-char max word cap."""
+        """Matches the C++ tokenizer: ASCII space/punct split + ASCII-only
+        lowercasing, non-ASCII chars kept intact, CJK ideographs split off as
+        standalone words (BERT BasicTokenizer ranges), 100-byte word cap."""
         import string
-        punct = set(string.punctuation.encode())
-        space = set(b' \t\n\r\v\f')
+        punct = set(string.punctuation)
+        space = set(' \t\n\r\v\f')
         unk = self._vocab.get(self.unk_token, 0)
         words = []
-        cur = bytearray()
-        for b in text.encode('utf-8'):
-            if b in space:
+        cur = []
+        for ch in text:
+            if ch in space:
                 if cur:
-                    words.append(bytes(cur))
-                    cur = bytearray()
-            elif b in punct:
+                    words.append(''.join(cur))
+                    cur = []
+            elif ch in punct:
                 if cur:
-                    words.append(bytes(cur))
-                    cur = bytearray()
-                words.append(bytes([b]))
+                    words.append(''.join(cur))
+                    cur = []
+                words.append(ch)
+            elif ord(ch) >= 0x80 and self._is_cjk(ord(ch)):
+                if cur:
+                    words.append(''.join(cur))
+                    cur = []
+                words.append(ch)
             else:
-                cur.append(b + 32 if self.lowercase and 65 <= b <= 90 else b)
+                cur.append(ch.lower() if self.lowercase and 'A' <= ch <= 'Z'
+                           else ch)
         if cur:
-            words.append(bytes(cur))
-        # vocab lookup is on str; a byte word maps back via utf-8 (tokens
-        # whose bytes aren't valid utf-8 can't be in the vocab → UNK)
-        words = [bw.decode('utf-8', errors='replace') for bw in words]
+            words.append(''.join(cur))
         ids = []
         for w in words:
             if len(w.encode('utf-8')) > 100:

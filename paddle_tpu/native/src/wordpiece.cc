@@ -15,6 +15,34 @@
 
 namespace {
 
+// Length of the UTF-8 sequence starting at lead byte `c` (invalid bytes → 1).
+inline size_t U8Len(unsigned char c) {
+  if (c < 0x80) return 1;
+  if ((c >> 5) == 0x6) return 2;
+  if ((c >> 4) == 0xE) return 3;
+  if ((c >> 3) == 0x1E) return 4;
+  return 1;
+}
+
+// Decode the codepoint at s[i] (length n). Returns 0 on malformed input.
+inline uint32_t U8Decode(const std::string& s, size_t i, size_t n) {
+  if (i + n > s.size()) return 0;
+  unsigned char c0 = s[i];
+  if (n == 1) return c0;
+  uint32_t cp = c0 & (0x7F >> n);
+  for (size_t k = 1; k < n; ++k) cp = (cp << 6) | ((unsigned char)s[i + k] & 0x3F);
+  return cp;
+}
+
+// BERT BasicTokenizer._is_chinese_char ranges: CJK ideographs are split off
+// as standalone single-char words.
+inline bool IsCJK(uint32_t cp) {
+  return (cp >= 0x4E00 && cp <= 0x9FFF) || (cp >= 0x3400 && cp <= 0x4DBF) ||
+         (cp >= 0xF900 && cp <= 0xFAFF) || (cp >= 0x20000 && cp <= 0x2A6DF) ||
+         (cp >= 0x2A700 && cp <= 0x2B73F) || (cp >= 0x2B740 && cp <= 0x2B81F) ||
+         (cp >= 0x2B820 && cp <= 0x2CEAF) || (cp >= 0x2F800 && cp <= 0x2FA1F);
+}
+
 struct Tokenizer {
   std::unordered_map<std::string, int64_t> vocab;
   int64_t unk_id = 0;
@@ -25,15 +53,32 @@ struct Tokenizer {
     std::vector<int64_t> ids;
     std::vector<std::string> words;
     std::string cur;
-    for (unsigned char ch : text) {
-      if (std::isspace(ch)) {
-        if (!cur.empty()) { words.push_back(cur); cur.clear(); }
-      } else if (std::ispunct(ch)) {
-        if (!cur.empty()) { words.push_back(cur); cur.clear(); }
-        words.emplace_back(1, (char)ch);
+    // UTF-8 aware pre-split: ASCII space/punct split + optional ASCII
+    // lowercase; multi-byte sequences are kept intact (no byte-wise
+    // tolower/ispunct) and CJK ideographs become standalone words.
+    // Non-ASCII lowercasing/accent-stripping is out of scope (documented).
+    for (size_t i = 0; i < text.size();) {
+      unsigned char ch = text[i];
+      size_t n = U8Len(ch);
+      if (n == 1) {
+        if (std::isspace(ch)) {
+          if (!cur.empty()) { words.push_back(cur); cur.clear(); }
+        } else if (std::ispunct(ch)) {
+          if (!cur.empty()) { words.push_back(cur); cur.clear(); }
+          words.emplace_back(1, (char)ch);
+        } else {
+          cur.push_back(lowercase ? (char)std::tolower(ch) : (char)ch);
+        }
       } else {
-        cur.push_back(lowercase ? (char)std::tolower(ch) : (char)ch);
+        uint32_t cp = U8Decode(text, i, n);
+        if (IsCJK(cp)) {
+          if (!cur.empty()) { words.push_back(cur); cur.clear(); }
+          words.push_back(text.substr(i, n));
+        } else {
+          cur.append(text, i, n);
+        }
       }
+      i += n;
     }
     if (!cur.empty()) words.push_back(cur);
 
@@ -53,7 +98,8 @@ struct Tokenizer {
                               w.substr(start, end - start);
           auto it = vocab.find(piece);
           if (it != vocab.end()) { cur_id = it->second; break; }
-          --end;
+          // shrink to the previous UTF-8 char boundary, never mid-sequence
+          do { --end; } while (end > start && ((unsigned char)w[end] & 0xC0) == 0x80);
         }
         if (cur_id < 0) { bad = true; break; }
         sub.push_back(cur_id);
@@ -80,9 +126,13 @@ void* ptpu_wp_create(const char* vocab_blob, int64_t blob_len, int lowercase,
   int64_t id = 0;
   while (pos <= blob.size()) {
     size_t nl = blob.find('\n', pos);
-    if (nl == std::string::npos) nl = blob.size();
+    bool last = (nl == std::string::npos);
+    if (last) nl = blob.size();
     std::string tok = blob.substr(pos, nl - pos);
-    if (!tok.empty()) t->vocab[tok] = id++;
+    if (last && tok.empty()) break;  // trailing newline is not a vocab line
+    // BERT convention: id == line number, so blank lines still consume an id
+    if (!tok.empty()) t->vocab[tok] = id;
+    ++id;
     pos = nl + 1;
     if (nl == blob.size()) break;
   }

@@ -334,7 +334,7 @@ def multiclass_nms(bboxes, scores, *, background_label=0,
     def one(boxes, sc):
         iou = _pairwise_iou(boxes, boxes, normalized)   # shared across classes
         cls_sc = sc[cls_ids]                            # (C', M)
-        s = jnp.where(cls_sc >= score_threshold, cls_sc, _NEG)
+        s = jnp.where(cls_sc > score_threshold, cls_sc, _NEG)
         keep = jax.vmap(lambda row: _nms_keep(
             boxes, row, nms_threshold, per_class, normalized, iou=iou))(s)
         s = jnp.where(keep, s, _NEG)
@@ -369,7 +369,7 @@ def locality_aware_nms(bboxes, scores, *, score_threshold=0.0,
     K = keep_top_k if keep_top_k > 0 else M
 
     def one(boxes, sc):
-        s = jnp.where(sc[0] >= score_threshold, sc[0], _NEG)
+        s = jnp.where(sc[0] > score_threshold, sc[0], _NEG)
         iou = _pairwise_iou(boxes, boxes, normalized)
         w = jnp.where((iou > nms_threshold) & (s[None, :] > _NEG / 2),
                       jnp.maximum(s[None, :], 0.0), 0.0)   # (M, M)
@@ -726,7 +726,8 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score, *,
 def yolo_box(x, img_size, *, anchors, class_num, conf_thresh=0.01,
              downsample_ratio=32, clip_bbox=True):
     """Decode YOLOv3 head (yolo_box_op.h): x (B, A*(5+C), H, W) →
-    boxes (B, H*W*A, 4) in image pixels, scores (B, H*W*A, C)."""
+    boxes (B, A*H*W, 4) in image pixels, scores (B, A*H*W, C) —
+    anchor-major box index order, matching the reference kernel."""
     x = jnp.asarray(x)
     imgs = jnp.asarray(img_size)          # (B, 2) [h, w]
     B, _, H, W = x.shape
@@ -758,8 +759,10 @@ def yolo_box(x, img_size, *, anchors, class_num, conf_thresh=0.01,
     boxes = jnp.stack([x1, y1, x2, y2], -1)                 # (B, A, H, W, 4)
     mask = (conf > conf_thresh).astype(x.dtype)
     score = cls * (conf * mask)[:, :, None]                 # (B, A, C, H, W)
-    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(B, -1, 4)
-    score = score.transpose(0, 3, 4, 1, 2).reshape(B, -1, C)
+    # Anchor-major (A, H, W) box index order + zeroed coords below
+    # conf_thresh, matching yolo_box_op.h (box_idx = an_idx-major).
+    boxes = (boxes * mask[..., None]).reshape(B, -1, 4)
+    score = score.transpose(0, 1, 3, 4, 2).reshape(B, -1, C)
     return boxes, score
 
 

@@ -1,0 +1,175 @@
+"""to_static / declarative: real traced+jitted translation (VERDICT r1 #1).
+
+ref: python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py —
+the reference AST-rewrites Python into a fluid Program; here the eager code
+is traced with jax tracers into ONE cached XLA program per input signature.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph import to_variable, Linear, BatchNorm
+from paddle_tpu.dygraph.jit import (to_static, declarative, InputSpec,
+                                    ProgramTranslator, StaticFunction)
+
+
+def _rand(*shape):
+    return np.random.RandomState(sum(shape)).randn(*shape).astype('float32')
+
+
+def test_function_parity_and_single_compile():
+    with dygraph.guard():
+        lin = Linear(4, 3)
+
+        @to_static
+        def f(x):
+            return fluid.layers.relu(lin(x))
+
+        x = to_variable(_rand(2, 4))
+        out = f(x)
+        ProgramTranslator().enable(False)
+        ref = f(x)
+        ProgramTranslator().enable(True)
+        np.testing.assert_allclose(np.asarray(out.value), np.asarray(ref.value),
+                                   rtol=1e-5)
+        assert f._compile_count == 1
+        f(x)
+        f(x)
+        assert f._compile_count == 1  # cached: one trace for the signature
+
+
+def test_method_decoration_grad_parity():
+    with dygraph.guard():
+        class Net(dygraph.Layer):
+            def __init__(self):
+                super().__init__()
+                self.l1 = Linear(4, 8, act='relu')
+                self.l2 = Linear(8, 2)
+
+            @declarative
+            def forward(self, x):
+                return self.l2(self.l1(x))
+
+        net = Net()
+        x = to_variable(_rand(5, 4))
+
+        loss = fluid.layers.reduce_sum(net.forward(x))
+        loss.backward()
+        static_grads = {n: np.asarray(p.grad)
+                        for n, p in net.named_parameters()}
+        for p in net.parameters():
+            p.clear_gradient()
+
+        ProgramTranslator().enable(False)
+        loss_e = fluid.layers.reduce_sum(net.forward(x))
+        loss_e.backward()
+        ProgramTranslator().enable(True)
+        np.testing.assert_allclose(loss.item(), loss_e.item(), rtol=1e-5)
+        for n, p in net.named_parameters():
+            np.testing.assert_allclose(static_grads[n], np.asarray(p.grad),
+                                       rtol=1e-4, atol=1e-5)
+
+
+def test_buffer_mutation_batchnorm():
+    with dygraph.guard():
+        bn = BatchNorm(3)
+        bn.train()
+
+        @to_static
+        def f(x):
+            return bn(x)
+
+        x = to_variable(_rand(8, 3))
+        mean_before = np.asarray(dict(bn.named_buffers())['_mean'].value).copy() \
+            if '_mean' in dict(bn.named_buffers()) else None
+        buf_names = list(dict(bn.named_buffers()))
+        before = {n: np.asarray(b.value).copy()
+                  for n, b in bn.named_buffers()}
+        f(x)
+        after = {n: np.asarray(b.value) for n, b in bn.named_buffers()}
+        # running statistics must update through the compiled program
+        changed = any(not np.allclose(before[n], after[n]) for n in buf_names)
+        assert changed, f"no buffer updated; buffers={buf_names}"
+
+
+def test_recompile_on_new_shape():
+    with dygraph.guard():
+        lin = Linear(4, 3)
+
+        @to_static
+        def f(x):
+            return lin(x)
+
+        f(to_variable(_rand(2, 4)))
+        assert f._compile_count == 1
+        out = f(to_variable(_rand(7, 4)))
+        assert f._compile_count == 2
+        assert out.shape == (7, 3)
+
+
+def test_input_spec_dtype_cast():
+    with dygraph.guard():
+        lin = Linear(4, 3)
+        sf = StaticFunction(lambda x: lin(x),
+                            input_spec=[InputSpec([None, 4], 'float32')])
+        out = sf(np.ones((2, 4), np.float64))
+        assert out.dtype == 'float32'
+
+
+def test_dropout_randomness_not_baked():
+    with dygraph.guard():
+        fluid.core.random.seed(0) if hasattr(fluid, 'core') else None
+
+        @to_static
+        def f(x):
+            return fluid.layers.dropout(x, dropout_prob=0.5,
+                                        dropout_implementation='upscale_in_train')
+
+        x = to_variable(np.ones((64, 64), np.float32))
+        a = np.asarray(f(x).value)
+        b = np.asarray(f(x).value)
+        assert f._compile_count == 1
+        assert not np.allclose(a, b), \
+            "dropout mask is identical across calls — key baked into trace"
+
+
+def test_program_translator_disable():
+    with dygraph.guard():
+        lin = Linear(2, 2)
+
+        @to_static
+        def f(x):
+            return lin(x)
+
+        x = to_variable(_rand(3, 2))
+        ProgramTranslator().enable(False)
+        out = f(x)
+        ProgramTranslator().enable(True)
+        assert f._compile_count == 0  # never traced while disabled
+        assert out.shape == (3, 2)
+
+
+def test_kwarg_tensor_gets_grad():
+    with dygraph.guard():
+        @to_static
+        def f(x):
+            return fluid.layers.reduce_sum(x * x)
+
+        t = dygraph.Parameter(np.array([2.0, 3.0], np.float32))
+        f(x=t).backward()
+        np.testing.assert_allclose(t.gradient(), [4.0, 6.0], rtol=1e-6)
+
+
+def test_static_args_in_cache_key():
+    with dygraph.guard():
+        @to_static
+        def f(x, scale):
+            return fluid.layers.scale(x, scale=scale)
+
+        x = to_variable(_rand(2, 2))
+        a = f(x, 2.0)
+        b = f(x, 3.0)
+        np.testing.assert_allclose(np.asarray(b.value),
+                                   1.5 * np.asarray(a.value), rtol=1e-5)
+        assert f._compile_count == 2  # python scalars are static attrs
