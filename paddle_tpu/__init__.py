@@ -45,6 +45,10 @@ from . import parallel
 from . import distributed
 from . import contrib
 from . import profiler
+from . import transpiler
+from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
+                         memory_optimize, release_memory)
+from . import incubate
 
 # `import paddle_tpu.fluid as fluid` parity: fluid IS this module's namespace.
 import sys as _sys

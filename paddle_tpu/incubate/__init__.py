@@ -1,0 +1,2 @@
+"""Incubate namespace (ref: python/paddle/fluid/incubate/__init__.py)."""
+from . import fleet

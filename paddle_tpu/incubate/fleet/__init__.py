@@ -1,0 +1,6 @@
+"""Fleet distributed-training namespace (ref: python/paddle/fluid/incubate/
+fleet/__init__.py). `collective` and `parameter_server` modes both lower to
+mesh data-parallelism with XLA collectives (SURVEY 2.8)."""
+from . import base
+from . import collective
+from . import parameter_server

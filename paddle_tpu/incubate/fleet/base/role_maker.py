@@ -1,0 +1,15 @@
+"""Role makers (ref: python/paddle/fluid/incubate/fleet/base/role_maker.py).
+Implementations live in parallel/fleet.py; this module provides the
+reference import path so fleet scripts run unmodified."""
+from ....parallel.fleet import (Role, RoleMakerBase, PaddleCloudRoleMaker,
+                                UserDefinedRoleMaker,
+                                UserDefinedCollectiveRoleMaker)
+
+# MPI role makers map to the single-controller jax runtime: symmetric
+# worker-only topology (no MPI in the TPU stack; jax.distributed covers
+# multi-host rendezvous).
+MPISymetricRoleMaker = PaddleCloudRoleMaker
+
+__all__ = ['Role', 'RoleMakerBase', 'PaddleCloudRoleMaker',
+           'UserDefinedRoleMaker', 'UserDefinedCollectiveRoleMaker',
+           'MPISymetricRoleMaker']

@@ -1,0 +1,11 @@
+"""Collective fleet (ref: python/paddle/fluid/incubate/fleet/collective/
+__init__.py). The TPU lowering lives in parallel/fleet.py: one jitted
+program, feeds sharded over the mesh 'dp' axis, XLA AllReduce over ICI."""
+from ....parallel.fleet import (fleet, Fleet, DistributedStrategy,
+                                DistributedOptimizer)
+
+# ref name for the strategy-honoring optimizer wrapper
+CollectiveOptimizer = DistributedOptimizer
+
+__all__ = ['fleet', 'Fleet', 'DistributedStrategy', 'DistributedOptimizer',
+           'CollectiveOptimizer']

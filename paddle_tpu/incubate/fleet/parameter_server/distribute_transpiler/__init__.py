@@ -1,0 +1,60 @@
+"""Parameter-server fleet (ref: python/paddle/fluid/incubate/fleet/
+parameter_server/distribute_transpiler/__init__.py:DistributedTranspiler).
+
+TPU lowering (SURVEY 2.8 "parameter-server mode parity"): there are no
+pserver processes — sparse/dense parameter state is replicated (or sharded)
+over the device mesh and gradient sync is an XLA AllReduce over ICI instead
+of grad send / param recv RPC. The API below keeps the reference surface so
+a PS fleet script runs unmodified: `fleet.init(role)` accepts PS role
+makers, `is_server()` gates to the worker branch (unless the role maker pins
+Role.SERVER), and `distributed_optimizer(...).minimize(...)` produces the
+same collective-DP program the collective fleet does.
+"""
+from .....parallel.fleet import (fleet as _collective_fleet, Fleet,
+                                DistributedStrategy, DistributedOptimizer)
+from .....transpiler import DistributeTranspiler, DistributeTranspilerConfig
+
+
+class TranspilerOptimizer(DistributedOptimizer):
+    """ref: TranspilerOptimizer — accepts a DistributeTranspilerConfig as
+    strategy; transpiler knobs (slice_var_up, sync_mode, …) have no TPU
+    meaning, so minimize() behaves as the collective DistributedOptimizer
+    with default strategy."""
+
+    def __init__(self, optimizer, strategy=None):
+        if isinstance(strategy, DistributeTranspilerConfig) or strategy is None:
+            ds = DistributedStrategy()
+        else:
+            ds = strategy
+        super().__init__(optimizer, ds)
+        self.transpiler_config = strategy
+
+
+class _PSFleet(Fleet):
+    """PS-flavored fleet singleton: distributed_optimizer returns a
+    TranspilerOptimizer (reference name), everything else is the collective
+    lowering from parallel/fleet.py."""
+
+    def __init__(self):
+        super().__init__(mode='ps')
+        self._transpiler = None
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._strategy = strategy
+        return TranspilerOptimizer(optimizer, strategy)
+
+    @property
+    def main_program(self):
+        from .....framework import default_main_program
+        return default_main_program()
+
+    @property
+    def startup_program(self):
+        from .....framework import default_startup_program
+        return default_startup_program()
+
+
+fleet = _PSFleet()
+
+__all__ = ['fleet', 'TranspilerOptimizer', 'DistributeTranspiler',
+           'DistributeTranspilerConfig']

@@ -11,3 +11,4 @@ from .tensor_parallel import (megatron_param_spec, shard_params,
                               column_parallel_matmul, row_parallel_matmul,
                               vocab_parallel_embedding)
 from .pipeline import gpipe, stack_stage_params
+from .local_sgd import LocalSGDStep

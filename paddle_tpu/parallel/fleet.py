@@ -15,16 +15,29 @@ from .mesh import get_default_mesh, make_mesh, set_default_mesh, topology
 
 
 class Fleet:
-    def __init__(self):
+    def __init__(self, mode='collective'):
         self._role_maker = None
         self._inited = False
         self._strategy = None
+        self._mode = mode
 
     # ---- lifecycle ----
-    def init(self, role_maker=None, is_collective=True):
+    def init(self, role_maker=None, is_collective=True, mesh_shape=None):
+        """Accepts both collective and parameter-server role makers (ref:
+        incubate/fleet/base/fleet_base.py:Fleet.init). PS roles lower to
+        collective DP on TPU: there are no parameter servers — every process
+        is a worker and parameter state is replicated over the mesh, with XLA
+        AllReduce replacing the send/recv to pservers (SURVEY 2.8).
+
+        mesh_shape (TPU extension): dict of mesh axes, e.g.
+        {'dp': 4, 'tp': 2} — installs the hybrid-parallel device mesh that
+        the parallel helpers (tensor_parallel, ring_attention, …) pick up as
+        the default."""
         self._role_maker = role_maker or PaddleCloudRoleMaker(
             is_collective=is_collective)
-        if get_default_mesh() is None:
+        if mesh_shape:
+            set_default_mesh(make_mesh(dict(mesh_shape)))
+        elif get_default_mesh() is None:
             n = len(jax.devices())
             set_default_mesh(make_mesh({'dp': n}))
         self._inited = True
@@ -32,29 +45,57 @@ class Fleet:
 
     @property
     def worker_index(self):
-        return jax.process_index()
+        rm = self._role_maker
+        return rm.worker_index() if rm is not None else jax.process_index()
 
     def worker_num(self):
-        return jax.process_count()
+        rm = self._role_maker
+        return rm.worker_num() if rm is not None else jax.process_count()
 
     def worker_endpoints(self, to_string=False):
-        eps = [f"process:{i}" for i in range(jax.process_count())]
+        eps = [f"process:{i}" for i in range(self.worker_num())]
         return ','.join(eps) if to_string else eps
 
     def is_first_worker(self):
-        return jax.process_index() == 0
+        rm = self._role_maker
+        return rm.is_first_worker() if rm is not None \
+            else jax.process_index() == 0
 
     def is_worker(self):
-        return True
+        rm = self._role_maker
+        return rm.is_worker() if hasattr(rm, 'is_worker') else True
 
     def is_server(self):
-        return False
+        # PS lowering: no process acts as a parameter server on TPU; scripts
+        # branching on is_server() fall through to the worker/training path
+        # unless the user pinned Role.SERVER explicitly in the role maker.
+        rm = self._role_maker
+        return rm.is_server() if hasattr(rm, 'is_server') else False
 
     def barrier_worker(self):
         # collective barrier across processes via a tiny psum
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices('fleet_barrier')
+
+    # PS-mode lifecycle API (ref: incubate/fleet/parameter_server/
+    # distribute_transpiler/__init__.py) — accepted; all are no-ops or
+    # collective equivalents since there are no pserver processes.
+    def init_worker(self):
+        pass
+
+    def init_server(self, model_dir=None):
+        pass
+
+    def run_server(self):
+        # Returns immediately: parameter state lives replicated on the mesh
+        # and syncs via XLA AllReduce, so there is nothing to serve. A
+        # launcher need not spawn server processes at all; one that does gets
+        # a clean exit instead of a hang.
+        import logging
+        logging.getLogger(__name__).warning(
+            "fleet.run_server(): parameter servers are lowered to collective "
+            "DP on TPU; returning immediately (nothing to serve)")
 
     def stop_worker(self):
         pass
@@ -80,9 +121,16 @@ class Fleet:
 
 
 class DistributedStrategy:
-    """ref: incubate/fleet/collective DistributedStrategy knobs. XLA subsumes
-    fuse_allreduce (bucketing) and overlap; gradient-merge / localsgd / remat
-    are honored by DistributedOptimizer."""
+    """ref: incubate/fleet/collective DistributedStrategy knobs.
+
+    Honored by DistributedOptimizer.minimize: recompute, amp,
+    gradient_merge_steps (wraps GradientMergeOptimizer), use_local_sgd +
+    local_sgd_steps (lowered to the sync-every-k-steps schedule — see
+    DistributedOptimizer.minimize for why replicas cannot diverge inside one
+    SPMD program; parallel/local_sgd.py provides true divergent-replica
+    LocalSGD for the functional path). Subsumed by XLA and accepted as
+    no-ops: fuse_all_reduce_ops (gradient bucketing), nccl_comm_num,
+    use_hierarchical_allreduce (ICI/DCN mesh axes give this for free)."""
 
     def __init__(self):
         self.fuse_all_reduce_ops = True
@@ -116,21 +164,44 @@ class DistributedOptimizer:
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         inner = self._inner
-        if self._strategy.recompute:
+        strat = self._strategy
+        if strat.recompute:
             from ..optimizer import RecomputeOptimizer
             inner = RecomputeOptimizer(inner)
-            inner._set_checkpoints(self._strategy.recompute_checkpoints)
-        if self._strategy.amp:
+            inner._set_checkpoints(strat.recompute_checkpoints)
+        if strat.amp:
             from ..contrib.mixed_precision import decorate
             inner = decorate(inner,
-                             init_loss_scaling=self._strategy.amp_loss_scale)
+                             init_loss_scaling=strat.amp_loss_scale)
+        merge_k = int(strat.gradient_merge_steps or 1)
+        if strat.use_local_sgd:
+            # Inside ONE jitted SPMD program, replicated parameters cannot
+            # hold per-device values, so replicas can never diverge — true
+            # LocalSGD is representable only with an explicit replica axis
+            # (parallel/local_sgd.py). What the knob CAN honor here is
+            # LocalSGD's communication schedule: one global parameter sync
+            # per local_sgd_steps instead of a per-step gradient AllReduce,
+            # i.e. accumulate k steps locally, apply once — GradientMerge.
+            merge_k = max(merge_k, int(strat.local_sgd_steps or 1))
+        if merge_k > 1:
+            from ..optimizer import GradientMergeOptimizer
+            inner = GradientMergeOptimizer(inner, k_steps=merge_k, avg=True)
         return inner.minimize(loss, startup_program, parameter_list,
                               no_grad_set)
+
+
+class Role:
+    """ref: incubate/fleet/base/role_maker.py:Role."""
+    WORKER = 1
+    SERVER = 2
 
 
 class RoleMakerBase:
     def __init__(self, is_collective=True):
         self._is_collective = is_collective
+
+    def generate_role(self):
+        pass
 
     def worker_num(self):
         return jax.process_count()
@@ -138,15 +209,98 @@ class RoleMakerBase:
     def worker_index(self):
         return jax.process_index()
 
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
 
 class PaddleCloudRoleMaker(RoleMakerBase):
-    pass
+    """ref: role_maker.py:PaddleCloudRoleMaker — reads PADDLE_* env vars.
+    On TPU, topology comes from the jax runtime. In PS mode
+    (is_collective=False), TRAINING_ROLE=PSERVER processes report as servers
+    so PS launch scripts behave (nothing is served — see Fleet.run_server);
+    collective jobs ignore the env var, like the reference."""
+
+    def is_server(self):
+        if self._is_collective:
+            return False
+        import os
+        return os.environ.get('TRAINING_ROLE', 'TRAINER').upper() == 'PSERVER'
+
+    def is_worker(self):
+        return not self.is_server()
 
 
 class UserDefinedRoleMaker(RoleMakerBase):
-    def __init__(self, current_id=0, role=None, worker_num=1,
+    """ref: role_maker.py:UserDefinedRoleMaker (same validation rules)."""
+
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
                  server_endpoints=None, **kw):
         super().__init__()
+        if not isinstance(server_endpoints, list) or not server_endpoints:
+            raise TypeError("server_endpoints must be a non-empty list")
+        if len(server_endpoints) != len(set(server_endpoints)):
+            raise ValueError("server_endpoints can't have duplicate elements")
+        if role not in (Role.WORKER, Role.SERVER):
+            raise TypeError("role must be Role.WORKER or Role.SERVER")
+        if current_id < 0:
+            raise ValueError("current_id must be >= 0")
+        if worker_num <= 0:
+            raise ValueError("worker_num must be greater than 0")
+        self._server_endpoints = server_endpoints
+        self._role = role
+        self._current_id = current_id
+        self._worker_num = worker_num
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self._role == Role.WORKER and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num
+
+
+class UserDefinedCollectiveRoleMaker(RoleMakerBase):
+    """ref: role_maker.py:UserDefinedCollectiveRoleMaker (same validation)."""
+
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__()
+        if not isinstance(worker_endpoints, list) or not worker_endpoints:
+            raise TypeError("worker_endpoints must be a non-empty list")
+        if len(worker_endpoints) != len(set(worker_endpoints)):
+            raise ValueError("worker_endpoints can't have duplicate elements")
+        if not isinstance(current_id, int) or current_id < 0:
+            raise ValueError("current_id must be an int >= 0")
+        if current_id >= len(worker_endpoints):
+            raise ValueError("current_id must be less than len(worker_"
+                             "endpoints)")
+        self._current_id = current_id
+        self._worker_endpoints = worker_endpoints
+
+    def is_first_worker(self):
+        return self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return len(self._worker_endpoints)
 
 
 fleet = Fleet()

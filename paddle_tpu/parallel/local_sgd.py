@@ -1,0 +1,91 @@
+"""LocalSGD with truly divergent replicas (ref: the LocalSGD strategy in
+python/paddle/fluid/incubate/fleet/collective/__init__.py, which patches the
+transpiled program so each trainer updates with local gradients and
+parameters all-reduce every `local_sgd_steps`).
+
+TPU-first formulation: parameters carry an explicit leading replica axis
+sharded over the mesh `dp` axis. Under shard_map each device updates its own
+replica with gradients from its own batch shard only — no per-step
+collective — and every k-th step replicas are averaged with ONE pmean
+(AllReduce) over ICI. This is the only way divergent replicas can exist
+inside an SPMD program: a replicated array holds one value by construction,
+so the static-graph fleet path lowers `use_local_sgd` to the
+sync-every-k-steps GradientMerge schedule instead (parallel/fleet.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class LocalSGDStep:
+    """Builds a jitted LocalSGD training step over `mesh` axis `axis`.
+
+    loss_fn(params: dict, batch) -> scalar (mean over the LOCAL shard).
+    params: dict name -> array (un-replicated values; broadcast to
+    (n_replicas, *shape) internally and sharded over `axis`).
+
+        step = LocalSGDStep(loss_fn, params, mesh, k_steps=4, lr=0.1)
+        for batch in data:           # batch leading dim sharded over `axis`
+            loss = step(batch)
+        final = step.averaged_params()
+    """
+
+    def __init__(self, loss_fn, params, mesh, k_steps, lr=0.1, axis='dp'):
+        # k/lr/axis are baked into the compiled step below — rebuild the
+        # LocalSGDStep to change them
+        self._k = int(k_steps)
+        n = self._n = mesh.shape[axis]
+        rep_sharding = {
+            name: NamedSharding(mesh, P(axis, *([None] * jnp.ndim(v))))
+            for name, v in params.items()}
+        self._params = {
+            name: jax.device_put(
+                jnp.broadcast_to(jnp.asarray(v), (n,) + jnp.shape(v)),
+                rep_sharding[name])
+            for name, v in params.items()}
+        self._t = 0
+        k = self._k
+
+        def body(stacked, batch, t):
+            local = {m: v[0] for m, v in stacked.items()}
+            loss, grads = jax.value_and_grad(loss_fn)(local, batch)
+            new = {m: v - lr * grads[m] for m, v in local.items()}
+
+            def sync(p):
+                # pmean output is replication-invariant; pcast back to
+                # varying so both cond branches type-match under shard_map
+                return {m: lax.pcast(lax.pmean(v, axis), axis, to='varying')
+                        for m, v in p.items()}
+
+            new = lax.cond((t % k) == (k - 1), sync, lambda p: p, new)
+            return ({m: v[None] for m, v in new.items()},
+                    lax.pmean(loss, axis))
+
+        pspec = {name: P(axis, *([None] * jnp.ndim(v)))
+                 for name, v in params.items()}
+        fn = jax.shard_map(body, mesh=mesh,
+                           in_specs=(pspec, P(axis), P()),
+                           out_specs=(pspec, P()))
+        self._step = jax.jit(fn, donate_argnums=(0,))
+
+    def __call__(self, batch):
+        self._params, loss = self._step(self._params,
+                                        jnp.asarray(batch),
+                                        jnp.int32(self._t))
+        self._t += 1
+        return loss
+
+    def replica_params(self):
+        """dict name -> (n_replicas, *shape) array of per-replica values."""
+        return dict(self._params)
+
+    def averaged_params(self):
+        return {m: jnp.mean(v, axis=0) for m, v in self._params.items()}
+
+    def replicas_in_sync(self, rtol=1e-6):
+        return all(
+            bool(jnp.allclose(v, jnp.broadcast_to(v[:1], v.shape), rtol=rtol))
+            for v in self._params.values())

@@ -1,0 +1,125 @@
+"""Transpiler API shims (ref: python/paddle/fluid/transpiler/__init__.py).
+
+The reference DistributeTranspiler rewrites a Program into trainer programs
+(send/recv grad ops) + pserver programs (param update + listen_and_serv),
+routed over RPC (ref: transpiler/distribute_transpiler.py). On TPU there are
+no parameter servers: parameters are replicated over the device mesh and XLA
+AllReduce over ICI replaces the grad send / param recv pair. The shim keeps
+the full API surface so reference PS scripts run unmodified — the trainer
+program is the original program (executed data-parallel via sharded feeds),
+and pserver programs are empty placeholders.
+
+memory_optimize / release_memory (ref: transpiler/memory_optimization_
+transpiler.py) are no-ops: XLA's buffer assignment performs liveness-based
+reuse during compilation, which is exactly the pass these implemented.
+"""
+from __future__ import annotations
+
+from ..framework import Program, default_main_program, default_startup_program
+
+
+class DistributeTranspilerConfig:
+    """ref: transpiler/distribute_transpiler.py:DistributeTranspilerConfig."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+        self.enable_dc_asgd = False
+        self.mode = 'pserver'
+        self.print_log = False
+        self.wait_port = True
+        self.runtime_split_send_recv = False
+        self.sync_mode = True
+        # geo-sgd knobs (accepted)
+        self.geo_sgd_mode = False
+        self.geo_sgd_need_push_nums = 100
+
+
+class DistributeTranspiler:
+    """ref: transpiler/distribute_transpiler.py:DistributeTranspiler.
+
+    transpile() records the topology; get_trainer_program() returns the
+    original main program unchanged — data parallelism comes from running it
+    through a CompiledProgram/fleet with feeds sharded over the mesh 'dp'
+    axis, so no send/recv ops are inserted. get_pserver_program() returns an
+    empty Program: no process serves parameters on TPU.
+    """
+
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._main = None
+        self._startup = None
+        self.trainer_id = 0
+        self.trainers = 1
+        self._pserver_eps = []
+
+    def transpile(self, trainer_id, program=None, pservers='127.0.0.1:6174',
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint='127.0.0.1:6174'):
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        self._main = program or default_main_program()
+        self._startup = startup_program or default_startup_program()
+        if isinstance(pservers, str):
+            self._pserver_eps = [e for e in pservers.split(',') if e]
+        else:
+            self._pserver_eps = list(pservers or [])
+        self.config.sync_mode = sync_mode
+
+    def get_trainer_program(self, wait_port=True):
+        if self._main is None:
+            raise RuntimeError("call transpile() before get_trainer_program()")
+        return self._main
+
+    def get_pserver_program(self, endpoint):
+        if endpoint not in self._pserver_eps:
+            raise ValueError(f"endpoint {endpoint!r} not in pserver list "
+                             f"{self._pserver_eps}")
+        return Program()
+
+    def get_pserver_programs(self, endpoint):
+        prog = self.get_pserver_program(endpoint)
+        return prog, Program()
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        return self._startup if self._startup is not None else Program()
+
+
+class HashName:
+    """ref: transpiler/ps_dispatcher.py — param→pserver placement policy
+    (irrelevant on TPU; kept for API parity)."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+
+    def dispatch(self, varlist):
+        return [self._eps[hash(v.name) % len(self._eps)] for v in varlist]
+
+
+class RoundRobin:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._i = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            out.append(self._eps[self._i % len(self._eps)])
+            self._i += 1
+        return out
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """No-op: XLA buffer assignment already does liveness-based reuse."""
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return None
+
+
+__all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig', 'HashName',
+           'RoundRobin', 'memory_optimize', 'release_memory']

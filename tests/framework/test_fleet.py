@@ -1,0 +1,277 @@
+"""Fleet distributed parity on the 8-device CPU mesh (VERDICT r1 #2/#3):
+strategy knobs change observable behavior, PS-mode scripts run unmodified,
+DP grads == single-device grads through the CompiledProgram path, TP parity
+through the fleet-installed mesh, true divergent-replica LocalSGD.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.parallel import (fleet, DistributedStrategy, make_mesh,
+                                 mesh_guard, set_default_mesh,
+                                 get_default_mesh, LocalSGDStep,
+                                 column_parallel_matmul, row_parallel_matmul)
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    old = get_default_mesh()
+    yield
+    set_default_mesh(old)
+
+
+def _linreg_program(opt_builder, w_name):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[2], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        pred = layers.fc(x, 1, bias_attr=False,
+                         param_attr=fluid.ParamAttr(
+                             name=w_name,
+                             initializer=fluid.initializer.
+                             ConstantInitializer(0.0)))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt_builder(loss)
+    return main, start, loss
+
+
+def test_gradient_merge_steps_honored():
+    """strategy.gradient_merge_steps=2 → params update every 2nd step only."""
+    fleet.init()
+    strat = DistributedStrategy()
+    strat.gradient_merge_steps = 2
+
+    def build(loss):
+        fleet.distributed_optimizer(
+            fluid.optimizer.SGD(0.1), strategy=strat).minimize(loss)
+
+    main, start, loss = _linreg_program(build, 'fleet_gm_w')
+    exe = fluid.Executor()
+    X = np.ones((4, 2), 'float32')
+    Y = np.ones((4, 1), 'float32')
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(start)
+        w0, = exe.run(main, feed={'x': X, 'y': Y}, fetch_list=['fleet_gm_w'])
+        np.testing.assert_allclose(w0, 0.0)        # off-step: no update
+        w1, = exe.run(main, feed={'x': X, 'y': Y}, fetch_list=['fleet_gm_w'])
+        assert np.abs(w1).sum() > 0                # merge step: applied
+
+
+def test_local_sgd_knob_honored():
+    """use_local_sgd + local_sgd_steps=3 → one sync/update per 3 steps."""
+    fleet.init()
+    strat = DistributedStrategy()
+    strat.use_local_sgd = True
+    strat.local_sgd_steps = 3
+
+    def build(loss):
+        fleet.distributed_optimizer(
+            fluid.optimizer.SGD(0.1), strategy=strat).minimize(loss)
+
+    main, start, loss = _linreg_program(build, 'fleet_ls_w')
+    exe = fluid.Executor()
+    X = np.ones((4, 2), 'float32')
+    Y = np.ones((4, 1), 'float32')
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(start)
+        for step in range(6):
+            w, = exe.run(main, feed={'x': X, 'y': Y},
+                         fetch_list=['fleet_ls_w'])
+            if step in (0, 1, 3, 4):
+                ref = 0.0 if step < 3 else w_after_first
+                np.testing.assert_allclose(w, ref, rtol=1e-6,
+                                           err_msg=f'step {step}')
+            elif step == 2:
+                assert np.abs(w).sum() > 0
+                w_after_first = w
+
+
+def test_dp_grads_equal_single_device():
+    """SURVEY §4: CompiledProgram DP grads == single-device grads."""
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[4], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        h = layers.fc(x, 8, act='tanh',
+                      param_attr=fluid.ParamAttr(name='dp_w1'))
+        pred = layers.fc(h, 1, param_attr=fluid.ParamAttr(name='dp_w2'))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.0).minimize(loss)   # lr 0: params frozen
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 4).astype('float32')        # 16 % 8 == 0
+    Y = rng.randn(16, 1).astype('float32')
+    grads = ['dp_w1@GRAD', 'dp_w2@GRAD']
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(start)
+        single = exe.run(main, feed={'x': X, 'y': Y}, fetch_list=grads)
+
+    set_default_mesh(make_mesh({'dp': 8}))
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    exe2 = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(start)
+        sharded = exe2.run(compiled, feed={'x': X, 'y': Y}, fetch_list=grads)
+
+    for s, d, name in zip(single, sharded, grads):
+        np.testing.assert_allclose(s, d, rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+
+
+def test_fleet_dp_loss_and_params_match_single():
+    """Same training trajectory with and without the 8-way sharded feeds."""
+    def build(loss):
+        fleet.distributed_optimizer(
+            fluid.optimizer.SGD(0.1), strategy=DistributedStrategy()
+        ).minimize(loss)
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(32, 2).astype('float32')
+    Y = (X @ np.array([[1.0], [-2.0]], 'float32')).astype('float32')
+
+    def train(parallel):
+        fleet.init()
+        main, start, loss = _linreg_program(build, 'fleet_dp_w')
+        prog = main
+        if parallel:
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(start)
+            for _ in range(5):
+                out = exe.run(prog, feed={'x': X, 'y': Y},
+                              fetch_list=['fleet_dp_w'])
+            return out[0]
+
+    w_single = train(False)
+    set_default_mesh(make_mesh({'dp': 8}))
+    w_dp = train(True)
+    np.testing.assert_allclose(w_single, w_dp, rtol=1e-5, atol=1e-6)
+
+
+def test_tp_parity_through_fleet_mesh():
+    """TP matmuls pick up the fleet-installed hybrid mesh (dp×tp)."""
+    fleet.init(mesh_shape={'dp': 4, 'tp': 2})
+    mesh = get_default_mesh()
+    assert set(mesh.axis_names) == {'dp', 'tp'}
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(4, 16).astype('float32'))
+    w1 = jnp.asarray(rng.randn(16, 32).astype('float32'))
+    w2 = jnp.asarray(rng.randn(32, 16).astype('float32'))
+    h = column_parallel_matmul(x, w1)            # mesh=None → fleet default
+    y = row_parallel_matmul(h, w2)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x @ w1 @ w2),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ps_script_runs_unmodified():
+    """A reference-shaped PS fleet script trains end-to-end (lowered to
+    collective DP; ref: incubate/fleet/parameter_server/distribute_transpiler
+    usage pattern)."""
+    from paddle_tpu.incubate.fleet.parameter_server.distribute_transpiler \
+        import fleet as ps_fleet
+    from paddle_tpu.incubate.fleet.base import role_maker
+
+    role = role_maker.PaddleCloudRoleMaker()
+    ps_fleet.init(role)
+    assert not ps_fleet.is_server()
+    assert ps_fleet.is_worker()
+
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[4], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = ps_fleet.distributed_optimizer(
+            fluid.optimizer.SGD(0.05),
+            fluid.DistributeTranspilerConfig())
+        opt.minimize(loss)
+
+    if ps_fleet.is_server():
+        ps_fleet.init_server()
+        ps_fleet.run_server()
+    else:
+        ps_fleet.init_worker()
+        exe = fluid.Executor()
+        rng = np.random.RandomState(3)
+        X = rng.randn(16, 4).astype('float32')
+        Y = (X @ rng.randn(4, 1)).astype('float32')
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(start)
+            losses = [float(exe.run(main, feed={'x': X, 'y': Y},
+                                    fetch_list=[loss])[0])
+                      for _ in range(20)]
+        ps_fleet.stop_worker()
+        assert losses[-1] < losses[0] * 0.5
+
+
+def test_distribute_transpiler_shim():
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[2], dtype='float32')
+        pred = layers.fc(x, 1)
+        loss = layers.mean(pred)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    config = fluid.DistributeTranspilerConfig()
+    t = fluid.DistributeTranspiler(config=config)
+    t.transpile(trainer_id=0, program=main,
+                pservers='127.0.0.1:6174,127.0.0.1:6175', trainers=2,
+                startup_program=start)
+    trainer_prog = t.get_trainer_program()
+    assert trainer_prog is main                    # collective DP: unchanged
+    ps_prog = t.get_pserver_program('127.0.0.1:6174')
+    assert isinstance(ps_prog, fluid.Program)
+    with pytest.raises(ValueError):
+        t.get_pserver_program('10.0.0.1:9999')
+    # trainer program still runs
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(t.get_startup_program())
+        out = exe.run(trainer_prog,
+                      feed={'x': np.ones((4, 2), 'float32')},
+                      fetch_list=[loss])
+    assert np.isfinite(out[0]).all()
+
+
+def test_local_sgd_divergent_replicas():
+    """True LocalSGD (shard_map path): replicas diverge between syncs and
+    equalize on the sync step; k=1 matches fully-synchronous DP."""
+    mesh = make_mesh({'dp': 8})
+    rng = np.random.RandomState(4)
+    W = rng.randn(3, 1).astype('float32')
+    X = rng.randn(64, 3).astype('float32')
+    Y = (X @ W).astype('float32')
+    batch = np.concatenate([X, Y], axis=1)       # (64, 4) shardable
+
+    def loss_fn(params, b):
+        x, y = b[:, :3], b[:, 3:]
+        return jnp.mean((x @ params['w'] - y) ** 2)
+
+    k = 4
+    step = LocalSGDStep(loss_fn, {'w': np.zeros((3, 1), 'float32')},
+                        mesh, k_steps=k, lr=0.05)
+    for t in range(k - 1):
+        step(batch)
+    assert not step.replicas_in_sync()           # diverged mid-window
+    step(batch)                                  # k-th step → pmean
+    assert step.replicas_in_sync()
+
+    # k=1 == synchronous DP (global-mean gradient every step)
+    sync = LocalSGDStep(loss_fn, {'w': np.zeros((3, 1), 'float32')},
+                        mesh, k_steps=1, lr=0.05)
+    w_ref = jnp.zeros((3, 1))
+    for t in range(5):
+        sync(batch)
+        g = jax.grad(lambda p: loss_fn({'w': p}, jnp.asarray(batch)))(w_ref)
+        w_ref = w_ref - 0.05 * g
+    np.testing.assert_allclose(np.asarray(sync.averaged_params()['w']),
+                               np.asarray(w_ref), rtol=1e-4, atol=1e-5)
