@@ -66,6 +66,10 @@ class CheckpointManager:
         self.max_to_keep = max_to_keep
         self.use_async = use_async
         self._pending = None
+        # steps tracked in-memory: an async save's directory may not be
+        # visible on disk yet, so gc can't rely on listdir alone
+        self._steps = [] if not os.path.isdir(self.directory) else \
+            sorted(int(d) for d in os.listdir(self.directory) if d.isdigit())
 
     def save(self, step, state):
         if self._pending is not None:
@@ -75,6 +79,7 @@ class CheckpointManager:
                              use_async=self.use_async)
         if self.use_async:
             self._pending = ck
+        self._steps = sorted(set(self._steps) | {int(step)})
         self._gc()
         return ck
 
@@ -91,8 +96,10 @@ class CheckpointManager:
 
     def _gc(self):
         import shutil
-        steps = sorted(int(d) for d in os.listdir(self.directory)
-                       if d.isdigit())
-        for s in steps[:-self.max_to_keep]:
+        drop, self._steps = (self._steps[:-self.max_to_keep],
+                             self._steps[-self.max_to_keep:])
+        for s in drop:
+            # only fully-written steps are dropped: the newest (possibly
+            # in-flight) save is always within the keep window
             shutil.rmtree(os.path.join(self.directory, str(s)),
                           ignore_errors=True)

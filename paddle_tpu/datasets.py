@@ -20,6 +20,18 @@ import numpy as np
 DATA_HOME = os.environ.get('PADDLE_TPU_DATA_HOME',
                            os.path.expanduser('~/.cache/paddle_tpu/dataset'))
 
+_log = __import__('logging').getLogger(__name__)
+
+
+def _fallback(name, missing):
+    """Loud, once-per-path warning: convergence/accuracy runs must not
+    silently train on random pixels."""
+    _log.warning(
+        "paddle_tpu.datasets.%s: data files not found (%s) — falling back "
+        "to a SYNTHETIC random stream (reader.is_synthetic=True). Results "
+        "are meaningless for accuracy; set PADDLE_TPU_DATA_HOME or pass "
+        "data_dir to use real data.", name, missing)
+
 
 def _synthetic(shape, num_classes, n, seed):
     rng = np.random.RandomState(seed)
@@ -55,6 +67,7 @@ def _mnist_reader(images_path, labels_path, n_synth, seed):
                     int(lab)
         reader.is_synthetic = False
         return reader
+    _fallback('mnist', images_path)
     return _synthetic((1, 28, 28), 10, n_synth, seed)
 
 
@@ -92,6 +105,7 @@ def _cifar_reader(tar_path, member_match, label_key, n_synth, seed):
                                 int(lab)
         reader.is_synthetic = False
         return reader
+    _fallback('cifar', tar_path)
     return _synthetic((3, 32, 32), 10, n_synth, seed)
 
 
@@ -130,6 +144,7 @@ def image_folder(root, shape=(3, 224, 224), n_synth=256, seed=4):
                     yield np.load(path).astype('float32'), lab
             reader.is_synthetic = False
             return reader
+    _fallback('image_folder', root)
     return _synthetic(shape, 1000, n_synth, seed)
 
 

@@ -1,6 +1,7 @@
 """Imperative (dygraph) mode — ref: python/paddle/fluid/dygraph/."""
 from .base import guard, enable_dygraph, disable_dygraph, enabled, to_variable
-from .tape import Tensor, Parameter, no_grad, no_grad_guard, dispatch_op
+from .tape import (Tensor, Parameter, no_grad, no_grad_guard, dispatch_op,
+                   grad)
 from .layers import Layer
 from .container import Sequential, LayerList, ParameterList
 from .nn import (Conv2D, Conv3D, Pool2D, Linear, BatchNorm, Embedding,

@@ -60,10 +60,18 @@ def prepare_context(strategy=None):
 
 
 class DataParallel(Layer):
-    """Wraps a Layer for data-parallel training. With a mesh (see
-    parallel.mesh.get_default_mesh) the fused TrainStep shards batches over
-    the 'dp' axis; eagerly, grads are averaged across the mesh when one is
-    active (single-host: identity, matching ref nranks==1 behavior)."""
+    """Wraps a Layer for data-parallel training (ref semantics: each rank
+    computes a LOCAL loss; scale_loss divides by nranks before backward and
+    apply_collective_grads all-reduce-sums grads after, so the net update
+    uses the global-mean gradient).
+
+    TPU redesign: a rank is a host process (single-controller SPMD — the
+    devices under one process already compute the global gradient when the
+    eager batch is the global batch or is sharded over the mesh 'dp' axis,
+    because vjp sums over the whole batch). So both hooks are identity at
+    process_count()==1 — dividing by the mesh dp size here would shrink
+    grads by n² — and perform a REAL cross-process mean reduction under
+    multi-host, replacing the reference's NCCL allreduce."""
 
     def __init__(self, layers, strategy=None):
         super().__init__()
@@ -75,11 +83,7 @@ class DataParallel(Layer):
 
     @property
     def _nranks(self):
-        from ..parallel.mesh import get_default_mesh
-        mesh = get_default_mesh()
-        if mesh is not None and 'dp' in mesh.axis_names:
-            return mesh.shape['dp']
-        return 1
+        return jax.process_count()
 
     def scale_loss(self, loss):
         n = self._nranks
@@ -88,14 +92,20 @@ class DataParallel(Layer):
         return loss * (1.0 / n)
 
     def apply_collective_grads(self):
-        """Average gradients across the dp mesh axis. Under the sharded jit
-        step XLA already psums grads; eager path averages explicitly."""
+        """Sum gradients across host processes (each holds grads from its
+        local batch). Single-process: grads are already the global sum —
+        identity. Multi-host: psum over all processes' devices."""
         n = self._nranks
         if n <= 1:
             return
+        from jax.experimental import multihost_utils
         for p in self._layers.parameters():
             if p.grad is not None:
-                p.grad = p.grad / n
+                # global-sum across processes: allgather (nranks, *shape)
+                # then sum — scale_loss already divided by nranks
+                gathered = multihost_utils.process_allgather(
+                    jnp.asarray(p.grad))
+                p.grad = jnp.sum(gathered, axis=0)
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)

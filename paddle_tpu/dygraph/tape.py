@@ -61,14 +61,20 @@ def no_grad(fn=None):
 
 
 class Node:
-    __slots__ = ('vjp_fn', 'inputs', 'n_outputs', 'out_avals', 'op_type')
+    __slots__ = ('vjp_fn', 'inputs', 'n_outputs', 'out_avals', 'op_type',
+                 'call_fn')
 
-    def __init__(self, vjp_fn, inputs, n_outputs, out_avals, op_type):
+    def __init__(self, vjp_fn, inputs, n_outputs, out_avals, op_type,
+                 call_fn=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs          # list[Tensor] in vjp arg order
         self.n_outputs = n_outputs
         self.out_avals = out_avals    # [(shape, dtype)] per output
         self.op_type = op_type
+        # pure primal replay `call_fn(*input_values) -> op result` — lets
+        # grad(create_graph=True) rebuild the forward as a jax function and
+        # differentiate it to any order (ref: imperative/partial_grad_engine)
+        self.call_fn = call_fn
 
 
 class Tensor:
@@ -117,7 +123,7 @@ class Tensor:
 
     # ---- autograd ----
     def backward(self, retain_graph=False, backward_strategy=None):
-        run_backward(self)
+        run_backward(self, retain_graph=retain_graph)
 
     def gradient(self):
         return None if self.grad is None else np.asarray(self.grad)
@@ -206,7 +212,8 @@ def dispatch_op(op_type, inputs, attrs):
     result, vjp_fn = jax.vjp(call, *vals)
     flat_res = _flatten_result(opdef, result)
     node = Node(vjp_fn, flat_tensors, len(flat_res),
-                [(r.shape, r.dtype) for r in flat_res], op_type)
+                [(r.shape, r.dtype) for r in flat_res], op_type,
+                call_fn=call)
     return _wrap_outputs(opdef, result, node)
 
 
@@ -242,8 +249,11 @@ def _wrap_outputs(opdef, result, node):
     return tuple(outs)
 
 
-def run_backward(loss: Tensor):
-    """Reverse-topological tape walk (ref: imperative/engine.cc)."""
+def run_backward(loss: Tensor, retain_graph=False):
+    """Reverse-topological tape walk (ref: imperative/engine.cc).
+    With retain_graph=False (default, ref parity) the walked nodes' vjp
+    residuals are released afterwards; a second backward() through them
+    raises instead of silently re-accumulating."""
     if loss._node is None:
         raise RuntimeError("backward() on a tensor with no grad history")
     topo = []
@@ -259,6 +269,11 @@ def run_backward(loss: Tensor):
         topo.append(node)
 
     dfs(loss._node)
+    if any(n.vjp_fn is None for n in topo):
+        raise RuntimeError(
+            "trying to run backward() through a graph that has already been "
+            "freed; pass retain_graph=True to the first backward() if you "
+            "need to backward through it again")
 
     cotangents = {}  # id(node) → [array or None per output]
 
@@ -295,6 +310,9 @@ def run_backward(loss: Tensor):
         # leaf accumulation also for tensors that have nodes but are params?
         # params are leaves (no node), handled above.
     # intermediate tensors keep no .grad (matches ref default)
+    if not retain_graph:
+        for n in topo:
+            n.vjp_fn = None          # release residual buffers (ref parity)
 
 
 def _rebuild_ct(node, flat):
@@ -308,6 +326,103 @@ def _rebuild_ct(node, flat):
             return flat[0]
         return flat  # variadic single-slot (e.g. split) → list
     return tuple(flat)
+
+
+def _node_flat_result(node, result):
+    try:
+        opdef = get_op(node.op_type)
+    except KeyError:
+        return list(result) if isinstance(result, (list, tuple)) else [result]
+    return _flatten_result(opdef, result)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """Partial gradients d(outputs)/d(inputs) (ref: imperative/
+    partial_grad_engine.cc via fluid.dygraph.grad).
+
+    create_graph=True returns Tensors that carry grad history, enabling
+    double-backward: the recorded subgraph between `inputs` and `outputs` is
+    replayed as a pure jax function (each tape Node keeps its primal
+    `call_fn`) and differentiated with jax.vjp — the grads' own node holds
+    the vjp of THAT gradient function, so any order composes."""
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if not outputs or not inputs:
+        raise ValueError("grad(): outputs and inputs must be non-empty")
+    for o in outputs:
+        if o._node is None:
+            raise RuntimeError(f"grad(): output {o.name} has no grad history")
+
+    # collect the ancestor subgraph, stopping at `inputs`
+    input_pos = {id(t): i for i, t in enumerate(inputs)}
+    topo, seen = [], set()
+
+    def dfs(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for t in node.inputs:
+            if id(t) not in input_pos and t._node is not None:
+                dfs(t._node)
+        topo.append(node)
+
+    for o in outputs:
+        dfs(o._node)
+    for n in topo:
+        if n.call_fn is None:
+            raise RuntimeError(
+                f"grad(): op '{n.op_type}' on the path has no replayable "
+                f"primal (e.g. a to_static fused node); use backward() or "
+                f"compute this gradient inside the traced function")
+
+    node_order = {id(n): i for i, n in enumerate(topo)}
+
+    def replay(*in_vals):
+        produced = {}
+
+        def val(t):
+            if id(t) in input_pos:
+                return in_vals[input_pos[id(t)]]
+            if t._node is not None and id(t._node) in node_order:
+                return produced[(id(t._node), t._out_index)]
+            return t.value
+
+        for node in topo:
+            res = node.call_fn(*[val(t) for t in node.inputs])
+            for i, v in enumerate(_node_flat_result(node, res)):
+                produced[(id(node), i)] = v
+        return tuple(val(o) for o in outputs)
+
+    in_vals = tuple(t.value for t in inputs)
+    if grad_outputs is None:
+        cts = tuple(jnp.ones(o.shape, to_jax_dtype(o.dtype)) for o in outputs)
+    else:
+        gos = [grad_outputs] if isinstance(grad_outputs, Tensor) \
+            else list(grad_outputs)
+        cts = tuple(g.value if isinstance(g, Tensor) else jnp.asarray(g)
+                    for g in gos)
+
+    def grad_fn(*iv):
+        _, vjp_fn = jax.vjp(replay, *iv)
+        return vjp_fn(cts)    # replay always returns a tuple
+
+    if not create_graph:
+        gvals = grad_fn(*in_vals)
+        return [Tensor(g, stop_gradient=True) for g in gvals]
+
+    gvals, vjp2 = jax.vjp(grad_fn, *in_vals)
+    node = Node(vjp2, inputs, len(gvals),
+                [(g.shape, g.dtype) for g in gvals], 'grad',
+                call_fn=grad_fn)
+    outs = []
+    for i, g in enumerate(gvals):
+        t = Tensor(g)
+        t._node = node
+        t._out_index = i
+        outs.append(t)
+    return outs
 
 
 def monkey_patch_tensor():
@@ -354,8 +469,10 @@ def monkey_patch_tensor():
         if (self.stop_gradient or not _grad_enabled
                 or not jnp.issubdtype(self.value.dtype, jnp.inexact)):
             return Tensor(self.value[idx], stop_gradient=True)
-        out, vjp_fn = jax.vjp(lambda v: v[idx], self.value)
-        node = Node(vjp_fn, [self], 1, [(out.shape, out.dtype)], '__getitem__')
+        getter = lambda v: v[idx]  # noqa: E731
+        out, vjp_fn = jax.vjp(getter, self.value)
+        node = Node(vjp_fn, [self], 1, [(out.shape, out.dtype)],
+                    '__getitem__', call_fn=getter)
         t = Tensor(out)
         t._node = node
         return t

@@ -25,9 +25,23 @@ class Config:
         self.model_filename = model_filename
         self.params_filename = params_filename
         self.precision = 'float32'
+        self.quant_scales = {}
+        self.weight_bits = 8
 
     def enable_bf16(self):
         self.precision = 'bfloat16'
+        return self
+
+    def enable_int8(self, quant_scales=None, weight_bits=8):
+        """Weight-only int8 inference (ref: slim int8 deploy flow,
+        contrib/slim/quantization/quantization_pass.py). `quant_scales`:
+        optional {param_name: per-out-channel abs-max scale array} — e.g.
+        the 'weight' entries produced by slim.quant_post / slim.convert;
+        params without a provided scale get abs-max calibration from their
+        own values."""
+        self.precision = 'int8'
+        self.quant_scales = dict(quant_scales or {})
+        self.weight_bits = weight_bits
         return self
 
     # GPU-era toggles accepted as no-ops for script parity
@@ -61,6 +75,51 @@ class Predictor:
         self.program = prog
         self.feed_names = feeds
         self.fetch_vars = fetches
+        self.quantized_params = {}
+        if cfg.precision == 'int8':
+            self._quantize_weights()
+
+    def _quantize_weights(self):
+        """Rewrite the loaded program for weight-only int8: each ≥2-D float
+        param becomes an int8 persistable + per-out-channel scale, and a
+        `dequantize_linear` op prepended to the program reconstructs the
+        float weight INSIDE the jitted step (XLA fuses it; HBM holds int8 —
+        the TPU counterpart of the reference's quantized inference kernels,
+        paddle/fluid/operators/fake_dequantize_op.cc)."""
+        prog, scope = self.program, self._scope
+        block = prog.global_block()
+        bits = self.config.weight_bits
+        qmax = 2.0 ** (bits - 1) - 1
+        for var in list(prog.list_vars()):
+            if not var.persistable:
+                continue
+            val = scope.find(var.name)
+            if val is None:
+                continue
+            w = np.asarray(val)
+            if w.dtype != np.float32 or w.ndim < 2:
+                continue                     # biases/norm params stay float
+            s = self.config.quant_scales.get(var.name)
+            if s is None:
+                s = np.max(np.abs(w), axis=tuple(range(1, w.ndim)))
+            s = np.maximum(np.asarray(s, np.float32).reshape(-1), 1e-8)
+            s_b = s.reshape((-1,) + (1,) * (w.ndim - 1))
+            w_q = np.clip(np.round(w / s_b * qmax), -qmax, qmax) \
+                .astype(np.int8)
+            qname, sname = var.name + '@INT8', var.name + '@SCALE'
+            block.create_var(name=qname, shape=list(w_q.shape), dtype='int8',
+                             persistable=True, stop_gradient=True)
+            block.create_var(name=sname, shape=list(s.shape),
+                             dtype='float32', persistable=True,
+                             stop_gradient=True)
+            scope.set(qname, jnp.asarray(w_q))
+            scope.set(sname, jnp.asarray(s))
+            var.persistable = False          # now produced by dequant op
+            block.prepend_op(type='dequantize_linear',
+                             inputs={'x': qname, 'scale': sname},
+                             outputs={'Out': var.name},
+                             attrs={'bit_length': bits, 'quant_axis': 0})
+            self.quantized_params[var.name] = s
 
     def get_input_names(self):
         return list(self.feed_names)

@@ -1,0 +1,91 @@
+"""Sharded/async checkpointing (SURVEY 2.7, VERDICT r1 #7): per-shard save
+from a dp×tp-sharded state, background write, resume with shardings
+preserved, rolling CheckpointManager.
+
+ref analogue: python/paddle/fluid/io.py save_persistables scaled to pod
+state (each host writes its shards; async overlaps IO with compute).
+"""
+import os
+import pathlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.parallel import make_mesh, shard_params
+from paddle_tpu.checkpoint import (save_checkpoint, load_checkpoint,
+                                   latest_step, CheckpointManager)
+
+
+@pytest.fixture
+def mesh():
+    return make_mesh({'dp': 4, 'tp': 2})
+
+
+def _bert_like_state(mesh, rng):
+    """Small dp×tp-sharded transformer-block state (megatron shardings)."""
+    raw = {
+        'block.q_proj.w': rng.randn(16, 32).astype('float32'),
+        'block.out_proj.w': rng.randn(32, 16).astype('float32'),
+        'block.ln.scale': rng.randn(16).astype('float32'),
+    }
+    return shard_params(raw, mesh=mesh, axis='tp')
+
+
+def test_async_sharded_roundtrip_preserves_shardings(tmp_path, mesh):
+    rng = np.random.RandomState(0)
+    state = _bert_like_state(mesh, rng)
+    state['step'] = jnp.int32(3)
+
+    ck = save_checkpoint(state, str(tmp_path), step=3, use_async=True)
+    ck.wait_until_finished()                      # background write completed
+    # per-shard layout on disk (not one monolithic npz)
+    files = [p for p in pathlib.Path(tmp_path).rglob('*') if p.is_file()]
+    assert len(files) > 1
+
+    restored = load_checkpoint(str(tmp_path), step=3, target=state)
+    for n in state:
+        np.testing.assert_allclose(np.asarray(restored[n]),
+                                   np.asarray(state[n]), rtol=0, atol=0)
+    # shardings survive the round trip
+    assert restored['block.q_proj.w'].sharding.spec == P(None, 'tp')
+    assert restored['block.out_proj.w'].sharding.spec == P('tp', None)
+
+
+def test_manager_rolling_and_resume(tmp_path, mesh):
+    rng = np.random.RandomState(1)
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2, use_async=True)
+
+    # tiny sharded training loop: w <- w - 0.1 * grad, checkpoint each step
+    w = jax.device_put(jnp.asarray(rng.randn(8, 4).astype('float32')),
+                       NamedSharding(mesh, P('dp', None)))
+    x = jnp.asarray(rng.randn(4, 8).astype('float32'))
+
+    @jax.jit
+    def step(w):
+        g = jax.grad(lambda w_: jnp.sum((x @ w_) ** 2))(w)
+        return w - 0.1 * g
+
+    history = {}
+    for s in range(4):
+        w = step(w)
+        mgr.save(s, {'w': w})
+        history[s] = np.asarray(w).copy()
+    mgr.wait()
+
+    # keep-last-2: steps 0/1 gone, 2/3 present
+    assert latest_step(str(tmp_path)) == 3
+    steps_on_disk = sorted(int(d) for d in os.listdir(tmp_path)
+                           if d.isdigit())
+    assert steps_on_disk == [2, 3]
+
+    # resume from step 2 and recompute step 3 → identical trajectory
+    restored = mgr.restore(step=2, target={'w': w})
+    w2 = step(restored['w'])
+    np.testing.assert_allclose(np.asarray(w2), history[3], rtol=1e-6)
+    # restore(None) picks the latest
+    latest = mgr.restore(target={'w': w})
+    np.testing.assert_allclose(np.asarray(latest['w']), history[3],
+                               rtol=1e-6)

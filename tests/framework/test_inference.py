@@ -1,0 +1,109 @@
+"""Inference stack (SURVEY 2.9, VERDICT r1 #5/#10): save/load_inference_model
+round-trip, Predictor fp32/bf16/int8, StableHLO export.
+
+ref: python/paddle/fluid/io.py save/load_inference_model +
+paddle/fluid/inference AnalysisPredictor + slim int8 deploy flow.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.inference import (Config, Predictor, create_paddle_predictor,
+                                  export_stablehlo, export_program_stablehlo)
+
+
+@pytest.fixture
+def saved_model(tmp_path):
+    """Train-ish tiny model, save as inference model, return (dir, ref_out,
+    X)."""
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[8], dtype='float32')
+        h = layers.fc(x, 16, act='relu',
+                      param_attr=fluid.ParamAttr(name='inf_w1'))
+        out = layers.fc(h, 4, act='softmax',
+                        param_attr=fluid.ParamAttr(name='inf_w2'))
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 8).astype('float32')
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(start)
+        ref, = exe.run(main, feed={'x': X}, fetch_list=[out])
+        fluid.io.save_inference_model(str(tmp_path / 'model'), ['x'], [out],
+                                      exe, main)
+    return str(tmp_path / 'model'), ref, X
+
+
+def test_save_load_predictor_roundtrip(saved_model):
+    model_dir, ref, X = saved_model
+    pred = Predictor(model_dir)
+    assert pred.get_input_names() == ['x']
+    out, = pred.run([X])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # dict feed form
+    out2, = pred.run({'x': X})
+    np.testing.assert_allclose(out2, ref, rtol=1e-5)
+
+
+def test_predictor_bf16(saved_model):
+    model_dir, ref, X = saved_model
+    pred = create_paddle_predictor(Config(model_dir).enable_bf16())
+    out, = pred.run([X])
+    # bf16 ~3 decimal digits; softmax output stays close
+    np.testing.assert_allclose(out, ref, rtol=0.1, atol=0.02)
+
+
+def test_predictor_int8_accuracy_drop_small(saved_model):
+    model_dir, ref, X = saved_model
+    pred = create_paddle_predictor(Config(model_dir).enable_int8())
+    assert 'inf_w1' in pred.quantized_params     # weights really quantized
+    assert 'inf_w2' in pred.quantized_params
+    out, = pred.run([X])
+    # int8 per-channel weight quant: small but non-zero degradation
+    err = np.max(np.abs(out - ref))
+    assert err < 0.05, f"int8 accuracy drop too large: {err}"
+    assert not np.allclose(out, ref, rtol=0, atol=0), \
+        "outputs bit-identical — quantization did not take effect"
+    # argmax (top-1 class) preserved on every row
+    np.testing.assert_array_equal(np.argmax(out, 1), np.argmax(ref, 1))
+
+
+def test_predictor_int8_with_slim_scales(saved_model):
+    """Scales from slim-style calibration (abs-max per out-channel) are
+    consumed when provided explicitly."""
+    model_dir, ref, X = saved_model
+    base = Predictor(model_dir)
+    with fluid.scope_guard(base._scope):
+        w1 = np.asarray(base._scope.find('inf_w1'))
+    scales = {'inf_w1': np.max(np.abs(w1), axis=1)}
+    pred = Predictor(Config(model_dir).enable_int8(quant_scales=scales))
+    np.testing.assert_allclose(pred.quantized_params['inf_w1'],
+                               np.maximum(scales['inf_w1'], 1e-8), rtol=1e-6)
+    out, = pred.run([X])
+    assert np.max(np.abs(out - ref)) < 0.05
+
+
+def test_stablehlo_export_program(saved_model, tmp_path):
+    model_dir, ref, X = saved_model
+    pred = Predictor(model_dir)
+    path = str(tmp_path / 'model.stablehlo')
+    text = export_program_stablehlo(pred.program, {'x': (8, 8)},
+                                    pred.fetch_vars, path=path,
+                                    scope=pred._scope)
+    assert 'stablehlo' in text or 'func.func' in text
+    assert 'dot' in text or 'dot_general' in text   # the matmuls are there
+    import os
+    assert os.path.exists(path)
+
+
+def test_stablehlo_export_fn():
+    import jax.numpy as jnp
+
+    def f(a, b):
+        return jnp.tanh(a @ b)
+
+    text = export_stablehlo(f, (np.ones((2, 3), np.float32),
+                                np.ones((3, 4), np.float32)))
+    assert 'func.func' in text
