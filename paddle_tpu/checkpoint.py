@@ -96,10 +96,22 @@ class CheckpointManager:
 
     def _gc(self):
         import shutil
-        drop, self._steps = (self._steps[:-self.max_to_keep],
-                             self._steps[-self.max_to_keep:])
-        for s in drop:
-            # only fully-written steps are dropped: the newest (possibly
-            # in-flight) save is always within the keep window
-            shutil.rmtree(os.path.join(self.directory, str(s)),
-                          ignore_errors=True)
+        # merge with a fresh listdir so step dirs created after construction
+        # (another process / second manager on the same dir) are collected
+        # too, instead of being retained forever
+        on_disk = set()
+        if os.path.isdir(self.directory):
+            on_disk = {int(d) for d in os.listdir(self.directory)
+                       if d.isdigit()}
+        newest = self._steps[-1] if self._steps else None
+        merged = sorted(set(self._steps) | on_disk)
+        keep = set(merged[-self.max_to_keep:])
+        if newest is not None:
+            # this manager's latest (possibly in-flight async) save is never
+            # dropped, even if another writer raced ahead of it
+            keep.add(newest)
+        for s in merged:
+            if s not in keep:
+                shutil.rmtree(os.path.join(self.directory, str(s)),
+                              ignore_errors=True)
+        self._steps = sorted(keep)

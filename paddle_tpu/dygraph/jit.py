@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import weakref
 
 import numpy as np
 import jax
@@ -191,6 +192,11 @@ class StaticFunction:
         self._input_spec = input_spec
         self._instance = None
         self._cache = {}
+        # per-instance caches keyed by the instance object itself via
+        # weakref: id() reuse after GC can't resurrect a stale entry whose
+        # closure captures a dead instance's parameters, and entries die
+        # with their instance instead of leaking
+        self._instance_caches = weakref.WeakKeyDictionary()
         # shared mutable cell: bound copies made by __get__ must increment
         # the same counter the descriptor exposes
         self._stats = {'compiles': 0}
@@ -256,7 +262,12 @@ class StaticFunction:
                 all_params[f'{li}.{n}'] = p
             for n, b in layer.named_buffers():
                 all_buffers[f'{li}.{n}'] = b
-        fn, instance = self._fn, self._instance
+        fn = self._fn
+        # hold the instance only weakly: cache entries live in a
+        # WeakKeyDictionary keyed by the instance, so a strong capture here
+        # would pin the key and the entry could never be collected
+        inst_ref = (weakref.ref(self._instance)
+                    if self._instance is not None else None)
 
         def make_run(params, buffers, pnames, bnames):
             def run(pvals, bvals, key, arr):
@@ -266,8 +277,8 @@ class StaticFunction:
                         _bind(bts, dict(zip(bnames, bvals))), \
                         default_generator.bind_base(key), no_grad_guard():
                     pos, kw = rebuild(_tensorize_keep(arr))
-                    if instance is not None:
-                        out = fn(instance, *pos, **kw)
+                    if inst_ref is not None:
+                        out = fn(inst_ref(), *pos, **kw)
                     else:
                         out = fn(*pos, **kw)
                     new_b = [buffers[n].value for n in bnames]
@@ -360,13 +371,19 @@ class StaticFunction:
         # (Rebinding a module global to a NEW Layer instance mid-training is
         # not retraced — same staleness semantics as the reference's program
         # cache, which also keys on function identity + input spec.)
-        key = (sig, grad_flag, arg_req, id(self._instance))
-        entry = self._cache.get(key)
+        if self._instance is None:
+            cache = self._cache
+        else:
+            cache = self._instance_caches.get(self._instance)
+            if cache is None:
+                cache = self._instance_caches.setdefault(self._instance, {})
+        key = (sig, grad_flag, arg_req)
+        entry = cache.get(key)
         if entry is None:
             layers = _find_layers(self._fn, self._instance, args, kwargs)
             entry = self._compile(layers, arr_vals, rebuild, grad_flag,
                                   any(arg_req))
-            self._cache[key] = entry
+            cache[key] = entry
             self._stats['compiles'] += 1  # one trace+compile per signature
         mode, compiled, pnames, bnames, treedef, n_out, params, buffers = entry
         pvals = [params[n].value for n in pnames]

@@ -59,6 +59,32 @@ def prepare_context(strategy=None):
     return ParallelEnv()
 
 
+_REDUCER = None
+
+
+def _cross_process_reducer():
+    """(shard_sharding, own_device, jitted_sum) over a 1-device-per-process
+    mesh, built once: reuse keeps the jit cache warm (one compile per grad
+    shape for the whole run), and picking each process's FIRST local device
+    — grouped by process_index, never by raw device id order, which JAX
+    does not guarantee to be process-contiguous — means every mesh row is
+    owned by exactly the process whose grad shard it carries."""
+    global _REDUCER
+    if _REDUCER is None:
+        import numpy as _np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        devs = [per_proc[i] for i in sorted(per_proc)]
+        mesh = Mesh(_np.array(devs), ('proc',))
+        _REDUCER = (NamedSharding(mesh, P('proc')),
+                    per_proc[jax.process_index()],
+                    jax.jit(lambda g: jnp.sum(g, axis=0),
+                            out_shardings=NamedSharding(mesh, P())))
+    return _REDUCER
+
+
 class DataParallel(Layer):
     """Wraps a Layer for data-parallel training (ref semantics: each rank
     computes a LOCAL loss; scale_loss divides by nranks before backward and
@@ -94,18 +120,21 @@ class DataParallel(Layer):
     def apply_collective_grads(self):
         """Sum gradients across host processes (each holds grads from its
         local batch). Single-process: grads are already the global sum —
-        identity. Multi-host: psum over all processes' devices."""
+        identity. Multi-host: a compiled XLA all-reduce (sum along a
+        process-sharded axis), O(shape) per device — never materializes the
+        (nranks, *shape) allgather the naive formulation would."""
         n = self._nranks
         if n <= 1:
             return
-        from jax.experimental import multihost_utils
+        shard_s, own_dev, reduce = _cross_process_reducer()
         for p in self._layers.parameters():
-            if p.grad is not None:
-                # global-sum across processes: allgather (nranks, *shape)
-                # then sum — scale_loss already divided by nranks
-                gathered = multihost_utils.process_allgather(
-                    jnp.asarray(p.grad))
-                p.grad = jnp.sum(gathered, axis=0)
+            if p.grad is None:
+                continue
+            local = jnp.asarray(p.grad)[None]  # this process's (1,*s) shard
+            garr = jax.make_array_from_single_device_arrays(
+                (n,) + tuple(local.shape[1:]), shard_s,
+                [jax.device_put(local, own_dev)])
+            p.grad = reduce(garr).addressable_data(0)
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)

@@ -317,6 +317,11 @@ def run_backward(loss: Tensor, retain_graph=False):
 
 def _rebuild_ct(node, flat):
     """Reshape flat cotangent list back into the op's output structure."""
+    if node.op_type == 'grad':
+        # a grad(create_graph=True) node wraps jax.vjp(grad_fn, ...) where
+        # grad_fn always returns a TUPLE of cotangents (even for a single
+        # input), so its vjp demands a tuple — never a bare array
+        return tuple(flat)
     try:
         opdef = get_op(node.op_type)
     except KeyError:
@@ -346,7 +351,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     double-backward: the recorded subgraph between `inputs` and `outputs` is
     replayed as a pure jax function (each tape Node keeps its primal
     `call_fn`) and differentiated with jax.vjp — the grads' own node holds
-    the vjp of THAT gradient function, so any order composes."""
+    the vjp of THAT gradient function, so any order composes.
+
+    `retain_graph` is accepted for API parity but has no effect: this engine
+    replays primals instead of consuming vjp residuals, so grad() never
+    frees the tape (a later backward()/grad() through the same graph always
+    works)."""
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
     if not outputs or not inputs:
@@ -379,15 +389,39 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 
     node_order = {id(n): i for i, n in enumerate(topo)}
 
+    # unused-input detection (ref: allow_unused in partial_grad_engine):
+    # an input participates iff some node in the ancestor subgraph reads it
+    used = set()
+    for n in topo:
+        for t in n.inputs:
+            if id(t) in input_pos:
+                used.add(id(t))
+    used |= {id(o) for o in outputs if id(o) in input_pos}
+    for i, t in enumerate(inputs):
+        if id(t) not in used and not allow_unused:
+            raise ValueError(
+                f"grad(): input {i} ({t.name}) is not reachable from "
+                f"outputs; set allow_unused=True to get None for it")
+
+    nogv_ids = set()
+    if no_grad_vars:
+        ngv = [no_grad_vars] if isinstance(no_grad_vars, Tensor) \
+            else list(no_grad_vars)
+        nogv_ids = {id(t) for t in ngv}
+
     def replay(*in_vals):
         produced = {}
 
         def val(t):
             if id(t) in input_pos:
-                return in_vals[input_pos[id(t)]]
-            if t._node is not None and id(t._node) in node_order:
-                return produced[(id(t._node), t._out_index)]
-            return t.value
+                v = in_vals[input_pos[id(t)]]
+            elif t._node is not None and id(t._node) in node_order:
+                v = produced[(id(t._node), t._out_index)]
+            else:
+                v = t.value
+            if id(t) in nogv_ids:
+                v = jax.lax.stop_gradient(v)
+            return v
 
         for node in topo:
             res = node.call_fn(*[val(t) for t in node.inputs])
@@ -410,7 +444,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 
     if not create_graph:
         gvals = grad_fn(*in_vals)
-        return [Tensor(g, stop_gradient=True) for g in gvals]
+        return [None if id(t) not in used and allow_unused
+                else Tensor(g, stop_gradient=True)
+                for t, g in zip(inputs, gvals)]
 
     gvals, vjp2 = jax.vjp(grad_fn, *in_vals)
     node = Node(vjp2, inputs, len(gvals),
@@ -418,6 +454,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                 call_fn=grad_fn)
     outs = []
     for i, g in enumerate(gvals):
+        if id(inputs[i]) not in used and allow_unused:
+            outs.append(None)
+            continue
         t = Tensor(g)
         t._node = node
         t._out_index = i

@@ -58,6 +58,45 @@ def test_double_grad_matmul_chain():
                                    atol=1e-5)
 
 
+def test_backward_through_grad_result():
+    """ADVICE r2 (high): backward() through a grad(create_graph=True)
+    result — the gradient-penalty training pattern. g = dy/dx = 3x^2;
+    L = sum(g); dL/dx = 6x must land in x.grad via backward()."""
+    with dygraph.guard():
+        x = dygraph.Parameter(np.array([2.0, -1.0], np.float32))
+        y = x * x * x
+        (g,) = dygraph.grad(y, x, create_graph=True)
+        loss = dygraph.dispatch_op('reduce_sum', {'x': g}, {})
+        loss.backward()
+        np.testing.assert_allclose(x.gradient(), [12.0, -6.0], rtol=1e-6)
+
+
+def test_grad_allow_unused():
+    with dygraph.guard():
+        x = dygraph.Parameter(np.array([2.0], np.float32))
+        z = dygraph.Parameter(np.array([5.0], np.float32))  # unused
+        y = dygraph.dispatch_op('reduce_sum', {'x': x * x}, {})
+        with pytest.raises(ValueError, match='allow_unused'):
+            dygraph.grad(y, [x, z])
+        gx, gz = dygraph.grad(y, [x, z], allow_unused=True)
+        np.testing.assert_allclose(np.asarray(gx.value), [4.0])
+        assert gz is None
+
+
+def test_grad_no_grad_vars():
+    """no_grad_vars blocks gradient flow through the listed tensors."""
+    with dygraph.guard():
+        x = dygraph.Parameter(np.array([3.0], np.float32))
+        h = x * x          # dh/dx = 6
+        y = h * x          # y = x^3
+        # blocking h: y is treated as const(h) * x → dy/dx = h = 9
+        (g,) = dygraph.grad(y, x, no_grad_vars=[h])
+        np.testing.assert_allclose(np.asarray(g.value), [9.0], rtol=1e-6)
+        # unblocked: dy/dx = 3x^2 = 27
+        (g2,) = dygraph.grad(y, x)
+        np.testing.assert_allclose(np.asarray(g2.value), [27.0], rtol=1e-6)
+
+
 def test_second_backward_raises_without_retain():
     with dygraph.guard():
         x = dygraph.Parameter(np.array([1.0], np.float32))

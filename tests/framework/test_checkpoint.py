@@ -89,3 +89,18 @@ def test_manager_rolling_and_resume(tmp_path, mesh):
     latest = mgr.restore(target={'w': w})
     np.testing.assert_allclose(np.asarray(latest['w']), history[3],
                                rtol=1e-6)
+
+
+def test_manager_gc_sees_foreign_steps(tmp_path):
+    """ADVICE r2: steps written by ANOTHER manager/process after this
+    manager's construction must still be garbage-collected."""
+    state = {'w': jnp.ones((2, 2), jnp.float32)}
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    other = CheckpointManager(str(tmp_path), max_to_keep=2)
+    other.save(0, state)
+    other.save(1, state)
+    # mgr never saw 0/1 at construction; its saves must still evict them
+    mgr.save(2, state)
+    mgr.save(3, state)
+    steps = sorted(int(d) for d in os.listdir(tmp_path) if d.isdigit())
+    assert steps == [2, 3]
