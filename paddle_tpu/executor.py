@@ -179,7 +179,23 @@ def _run_while(op, read, write, key):
             _check_carry(v, c0, n)
             for v, c0, n in zip(new, c[1:], carry_names))
 
-    res = jax.lax.while_loop(cond_fun, body_fun, carry0)
+    max_trips = op.attrs.get('max_trip_count')
+    if max_trips is not None:
+        # Reverse-differentiable lowering (ref WhileGradOp parity,
+        # /root/reference/paddle/fluid/operators/controlflow/while_op.cc:154):
+        # XLA's while has no reverse-mode rule, so with a static trip bound
+        # the loop becomes a lax.scan of `max_trip_count` masked steps — an
+        # inactive step keeps the previous carry via jnp.where (select is
+        # differentiable; the dead branch's cotangent is zeroed).
+        def scan_step(c, _):
+            active = cond_fun(c)
+            new = body_fun(c)
+            kept = tuple(
+                jnp.where(active, nv, cv) for nv, cv in zip(new, c))
+            return kept, None
+        res, _ = jax.lax.scan(scan_step, carry0, None, length=int(max_trips))
+    else:
+        res = jax.lax.while_loop(cond_fun, body_fun, carry0)
     for n, v in zip(op.outputs['Out'], res[1:]):
         write(n, v)
 
@@ -258,6 +274,41 @@ _CONTROL_FLOW_OPS = {
 }
 
 
+def _op_read_names(op):
+    """All var names an op may read, including reads made by its sub-blocks
+    (control-flow branches chain onto the outer env, so their reads are not
+    declared in op.inputs)."""
+    names = set(op.input_names())
+    program = op.block.program
+    sub_blocks = []
+    for attr in ('true_block', 'false_block', 'cond_block', 'body_block',
+                 'block'):
+        if attr in op.attrs:
+            sub_blocks.append(op.attrs[attr])
+    sub_blocks.extend(op.attrs.get('blocks', []))
+    for bi in sub_blocks:
+        for o in program.block(bi).ops:
+            names |= _op_read_names(o)
+    return names
+
+
+def _remat_segments(fwd_ops, checkpoints):
+    """Split the forward op list at checkpoint-producing ops. Returns a list
+    of (lo, hi) index ranges; each range becomes one jax.checkpoint segment
+    (RecomputeOptimizer parity, ref python/paddle/fluid/optimizer.py:3705)."""
+    ckpt = set(checkpoints)
+    bounds = sorted({i + 1 for i, o in enumerate(fwd_ops)
+                     if set(o.output_names()) & ckpt})
+    segs, prev = [], 0
+    for b in bounds:
+        if b > prev:
+            segs.append((prev, b))
+            prev = b
+    if prev < len(fwd_ops):
+        segs.append((prev, len(fwd_ops)))
+    return segs
+
+
 def _lower(program: Program, feed_names, fetch_names, state_names):
     """Build the pure step function for `program`."""
     ops = list(program.global_block().ops)
@@ -289,12 +340,55 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
             marker = ops[bwd_idx]
             loss_name = marker.attrs['loss']
             param_names = marker.attrs['params']
-            params = {n: state[n] for n in param_names}
+            checkpoints = list(marker.attrs.get('checkpoints') or [])
+            # diff targets come from state (parameters) or from the feeds
+            # (fluid.gradients w.r.t. data inputs, ref backward.py:1672)
+            params = {}
+            for n in param_names:
+                if n in state_set:
+                    params[n] = state[n]
+                elif n in feeds:
+                    params[n] = feeds[n]
+                else:
+                    raise KeyError(
+                        f"gradient target '{n}' is neither a persistable "
+                        f"parameter nor a fed variable")
             fwd_ops = ops[:bwd_idx]
+            segs = (_remat_segments(fwd_ops, checkpoints)
+                    if checkpoints else [(0, len(fwd_ops))])
+
+            # names each segment boundary must carry forward: reads of later
+            # ops + loss/fetches/state-writes. Everything else is dropped at
+            # the boundary so jax.checkpoint only saves the live set and
+            # remats the rest during the backward pass.
+            live_after = []
+            downstream = (set().union(*(_op_read_names(o)
+                                        for o in ops[bwd_idx + 1:]))
+                          if bwd_idx + 1 < len(ops) else set())
+            downstream |= {loss_name, *fetch_names, *state_set, *checkpoints}
+            for _, hi in segs:
+                live = set(downstream)
+                for o in fwd_ops[hi:]:
+                    live |= _op_read_names(o)
+                live_after.append(live)
+
+            def make_segment(lo, hi):
+                def seg(e_in, pvals):
+                    e = dict(e_in)
+                    run_seq(fwd_ops[lo:hi], lo, make_read(e, pvals, state),
+                            e.__setitem__)
+                    return e
+                return seg
 
             def fwd(pvals):
-                e = dict(feeds)
-                run_seq(fwd_ops, 0, make_read(e, pvals, state), e.__setitem__)
+                e = {k: pvals.get(k, v) for k, v in feeds.items()}
+                for (lo, hi), live in zip(segs, live_after):
+                    seg = make_segment(lo, hi)
+                    if checkpoints:
+                        seg = jax.checkpoint(seg)
+                    e = seg(e, pvals)
+                    if checkpoints:
+                        e = {n: v for n, v in e.items() if n in live}
                 loss = e[loss_name]
                 return jnp.sum(loss), e
 

@@ -9,8 +9,9 @@ ONE structured-control-flow XLA op (`lax.cond`, `lax.while_loop`,
 `lax.switch`, `lax.scan`) inside the fused jitted step — no host round-trips.
 
 Note on autodiff: `lax.while_loop` is forward-only (XLA's while has no
-reverse-mode rule); differentiable recurrences should use StaticRNN /
-layers.rnn (lax.scan), matching the TPU design rule of static trip counts.
+reverse-mode rule). Differentiable loops either use StaticRNN / layers.rnn
+(lax.scan) or pass `maximum_trip_count` to `while_loop`, which lowers to a
+masked lax.scan — the TPU parity path for the reference's WhileGradOp.
 """
 from __future__ import annotations
 
@@ -268,9 +269,16 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 # ---------------------------------------------------------------------------
 
 
-def while_loop(cond, body, loop_vars, is_test=False, name=None):
+def while_loop(cond, body, loop_vars, is_test=False, name=None,
+               maximum_trip_count=None):
     """ref: fluid.layers.while_loop (control_flow.py:1054). Lowers to
-    lax.while_loop; carry = loop_vars. Forward-only (see module docstring)."""
+    lax.while_loop; carry = loop_vars.
+
+    `maximum_trip_count` (TPU extension): with a static trip bound the loop
+    lowers to a masked lax.scan instead, which IS reverse-differentiable —
+    the parity path for the reference's WhileGradOp
+    (/root/reference/paddle/fluid/operators/controlflow/while_op.cc:154).
+    Without it the loop is forward-only (see module docstring)."""
     if in_dygraph_mode():
         import numpy as np
         args = list(loop_vars)
@@ -306,7 +314,9 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
         outputs={'Out': [o.name for o in outs] + writes},
         attrs={'cond_block': cond_blk.idx, 'body_block': body_blk.idx,
                'cond_out': c.name, 'body_outs': [v.name for v in b_flat],
-               'loop_vars': loop_names, 'writes': writes})
+               'loop_vars': loop_names, 'writes': writes,
+               'max_trip_count': (None if maximum_trip_count is None
+                                  else int(maximum_trip_count))})
     return _pack_like(b_out if isinstance(b_out, (list, tuple)) else loop_vars,
                       outs)
 

@@ -1,0 +1,157 @@
+"""Static autodiff depth: fluid.gradients w.r.t. data inputs, Recompute
+(remat) lowering, and backward-through-While (bounded scan).
+
+Ref parity targets: python/paddle/fluid/backward.py:1672 (gradients),
+python/paddle/fluid/optimizer.py:3705 (RecomputeOptimizer),
+paddle/fluid/operators/controlflow/while_op.cc:154 (WhileGradOp).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_gradients_wrt_data_input():
+    """fluid.gradients([y], [x]) for a FED variable (the round-3 KeyError
+    repro): dy/dx of y = sum(3*x^2) is 6x."""
+    x = layers.data('x', [4], dtype='float32')
+    y = layers.reduce_sum(layers.scale(layers.square(x), scale=3.0))
+    gx, = fluid.gradients([y], [x])
+    exe = fluid.Executor()
+    xv = np.arange(8, dtype=np.float32).reshape(2, 4)
+    out, = exe.run(feed={'x': xv}, fetch_list=[gx])
+    np.testing.assert_allclose(out, 6.0 * xv, rtol=1e-5)
+
+
+def test_gradients_wrt_param_and_input_mixed():
+    x = layers.data('x', [3], dtype='float32')
+    y = layers.fc(x, size=1, bias_attr=False)
+    loss = layers.reduce_sum(y)
+    gx, = fluid.gradients([loss], [x])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.ones((2, 3), np.float32)
+    w_name = fluid.default_main_program().all_parameters()[0].name
+    wv = np.asarray(fluid.global_scope().find(w_name))
+    out, = exe.run(feed={'x': xv}, fetch_list=[gx])
+    np.testing.assert_allclose(out, np.tile(wv.sum(axis=1), (2, 1)), rtol=1e-5)
+
+
+def _deep_mlp_with_checkpoints(n_blocks=3):
+    x = layers.data('x', [8], dtype='float32')
+    label = layers.data('y', [1], dtype='float32')
+    h = x
+    ckpts = []
+    for _ in range(n_blocks):
+        h = layers.fc(h, size=8, act='tanh')
+        ckpts.append(h)
+    pred = layers.fc(h, size=1)
+    loss = layers.reduce_mean(layers.square_error_cost(pred, label))
+    return x, label, loss, ckpts
+
+
+def test_recompute_optimizer_remats():
+    """RecomputeOptimizer must produce `remat` segments in the lowered jaxpr
+    and train identically to plain SGD."""
+    np.random.seed(0)
+    xv = np.random.randn(4, 8).astype(np.float32)
+    yv = np.random.randn(4, 1).astype(np.float32)
+
+    # --- baseline: plain SGD
+    losses_plain = _train(xv, yv, recompute=False)
+    # --- recompute path
+    losses_remat = _train(xv, yv, recompute=True)
+    np.testing.assert_allclose(losses_plain, losses_remat, rtol=1e-5,
+                               atol=1e-6)
+
+
+def _train(xv, yv, recompute, steps=5):
+    import paddle_tpu.framework as fw
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x, label, loss, ckpts = _deep_mlp_with_checkpoints()
+        sgd = fluid.optimizer.SGD(learning_rate=0.1)
+        if recompute:
+            opt = fluid.optimizer.RecomputeOptimizer(sgd)
+            opt._set_checkpoints(ckpts)
+            opt.minimize(loss)
+        else:
+            sgd.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(start)
+    out = []
+    for _ in range(steps):
+        l, = exe.run(main, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+        out.append(float(l))
+    return out
+
+
+def test_recompute_jaxpr_contains_remat():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.executor import _lower
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x, label, loss, ckpts = _deep_mlp_with_checkpoints()
+        opt = fluid.optimizer.RecomputeOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.1))
+        opt._set_checkpoints(ckpts)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(start)
+    state_names = sorted(v.name for v in main.list_vars() if v.persistable)
+    state = {n: jnp.asarray(fluid.global_scope().find(n))
+             for n in state_names}
+    feeds = {'x': jnp.zeros((4, 8), jnp.float32),
+             'y': jnp.zeros((4, 1), jnp.float32)}
+    step = _lower(main, list(feeds), [loss.name], state_names)
+    jaxpr = jax.make_jaxpr(step)(state, feeds, jax.random.PRNGKey(0))
+    assert 'remat' in str(jaxpr), "no remat segments in lowered step"
+
+
+def test_while_loop_backward_bounded():
+    """Differentiating through while_loop(maximum_trip_count=N): loss =
+    sum(x * 2^k) after k doublings; dloss/dx = 2^k."""
+    k = 4
+    x = layers.data('x', [3], dtype='float32')
+    i = layers.fill_constant([1], 'int64', 0)
+    n = layers.fill_constant([1], 'int64', k)
+
+    def cond(i, v):
+        return layers.less_than(i, n)
+
+    def body(i, v):
+        return [layers.increment(i, in_place=False),
+                layers.scale(v, scale=2.0)]
+
+    _, out = layers.while_loop(cond, body, [i, x], maximum_trip_count=8)
+    loss = layers.reduce_sum(out)
+    gx, = fluid.gradients([loss], [x])
+    exe = fluid.Executor()
+    xv = np.array([[1., 2., 3.]], np.float32)
+    lv, gv = exe.run(feed={'x': xv}, fetch_list=[loss, gx])
+    np.testing.assert_allclose(lv, (2.0 ** k) * xv.sum(), rtol=1e-6)
+    np.testing.assert_allclose(gv, np.full_like(xv, 2.0 ** k), rtol=1e-6)
+
+
+def test_while_loop_bounded_forward_matches_unbounded():
+    x = layers.data('x', [2], dtype='float32')
+    i = layers.fill_constant([1], 'int64', 0)
+    n = layers.fill_constant([1], 'int64', 3)
+
+    def cond(i, v):
+        return layers.less_than(i, n)
+
+    def body(i, v):
+        return [layers.increment(i, in_place=False),
+                layers.elementwise_add(v, v)]
+
+    _, a = layers.while_loop(cond, body, [i, x])
+    i2 = layers.fill_constant([1], 'int64', 0)
+    _, b = layers.while_loop(cond, body, [i2, x], maximum_trip_count=10)
+    exe = fluid.Executor()
+    xv = np.array([[1., -2.]], np.float32)
+    av, bv = exe.run(feed={'x': xv}, fetch_list=[a, b])
+    np.testing.assert_allclose(av, bv, rtol=1e-6)
+    np.testing.assert_allclose(av, xv * 8, rtol=1e-6)
