@@ -8,6 +8,7 @@ stay fp32; the cast list mirrors the ref's white/black lists.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..framework import in_dygraph_mode
@@ -44,40 +45,102 @@ class OptimizerWithMixedPrecision:
         self._dtype = dtype
         self._good_steps = 0
         self._bad_steps = 0
+        self._scale_var = None
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
 
     def get_loss_scaling(self):
+        if self._scale_var is not None:
+            from ..core.scope import global_scope
+            val = global_scope().find(self._scale_var.name)
+            if val is not None:
+                import numpy as np
+                return float(np.asarray(val).reshape(())[()])
         return self._loss_scale
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         if in_dygraph_mode():
             return self._dygraph_minimize(loss, parameter_list)
-        # static: bf16 scaling is a no-op numerically; scale loss for fp16
-        # parity then let the optimizer unscale via lr (scale folded in grads)
-        from ..layers.common import apply_op_layer
-        if self._dtype == 'float16' and self._loss_scale != 1.0:
-            scaled = apply_op_layer('scale', {'x': loss},
-                                    {'scale': self._loss_scale})
-            from ..backward import append_backward
-            params_grads = append_backward(scaled, parameter_list)
-            inv = 1.0 / self._loss_scale
-            params_grads = [
-                (p, apply_op_layer('scale', {'x': g}, {'scale': inv}))
-                for p, g in params_grads]
-            self._inner.apply_gradients(params_grads)
-            return None, params_grads
+        # Static AMP graph rewrite (ref fp16_utils.py:156): record the cast
+        # lists on the Program — the Executor's lowering casts white-list op
+        # inputs to the AMP dtype and pins black-list ops to fp32. Master
+        # params stay fp32 in the scope.
+        program = loss.block.program
+        program._amp_config = {
+            'dtype': jnp.float16 if self._dtype == 'float16' else jnp.bfloat16,
+            'white': frozenset(self._amp_lists.white_list),
+            'black': frozenset(self._amp_lists.black_list)}
+        program._bump_version()
+        if self._dtype == 'float16':
+            # fp16 always scales/unscales (constant scale when dynamic
+            # scaling is off — ref decorator.py keeps the multiplier)
+            return self._static_minimize_with_loss_scaling(loss,
+                                                           parameter_list)
+        # bf16 keeps fp32's exponent range — no loss scaling needed
         return self._inner.minimize(loss, startup_program, parameter_list,
                                     no_grad_set)
 
+    def _static_minimize_with_loss_scaling(self, loss, parameter_list):
+        """Dynamic loss scaling fused INTO the jitted step (ref
+        fp16_utils.py:283 update_loss_scaling): scale loss → backward →
+        one fused check_finite_and_unscale over all grads → conditional
+        optimizer apply (lax.cond) → loss-scale state update. Zero host
+        round-trips."""
+        from ..backward import append_backward
+        from ..core import unique_name as un
+        from ..layer_helper import LayerHelper
+        from ..layers import control_flow as cf
+        from ..layers import tensor as T
+        from ..layers.common import apply_op_layer
+
+        scale_var = T.create_global_var(
+            [1], float(self._loss_scale), 'float32', persistable=True,
+            name=un.generate('loss_scaling'))
+        good = T.create_global_var([1], 0, 'int32', persistable=True,
+                                   name=un.generate('loss_scaling_good'))
+        bad = T.create_global_var([1], 0, 'int32', persistable=True,
+                                  name=un.generate('loss_scaling_bad'))
+        self._scale_var = scale_var
+        scaled = apply_op_layer('elementwise_mul',
+                                {'x': loss, 'y': scale_var})
+        params_grads = append_backward(
+            scaled, parameter_list or self._inner._parameter_names())
+
+        helper = LayerHelper('amp')
+        found = helper.create_variable_for_type_inference('bool')
+        found.shape = (1,)
+        gnames = [g.name for _, g in params_grads]
+        helper.append_op(
+            type='check_finite_and_unscale',
+            inputs={'xs': gnames, 'scale': scale_var.name},
+            outputs={'Out': gnames, 'FoundInfinite': found.name})
+        if self._dynamic:
+            helper.append_op(
+                type='update_loss_scaling',
+                inputs={'found_inf': found.name,
+                        'prev_loss_scaling': scale_var.name,
+                        'in_good_steps': good.name, 'in_bad_steps': bad.name},
+                outputs={'LossScaling': scale_var.name,
+                         'OutGoodSteps': good.name, 'OutBadSteps': bad.name},
+                attrs={'incr_every_n_steps': self._incr_every,
+                       'decr_every_n_nan_or_inf': self._decr_every,
+                       'incr_ratio': self._incr_ratio,
+                       'decr_ratio': self._decr_ratio})
+        ok = apply_op_layer('logical_not', {'x': found})
+
+        def apply_block():
+            self._inner.apply_gradients(params_grads)
+
+        cf.cond(ok, apply_block, None)
+        return None, params_grads
+
     def _dygraph_minimize(self, loss, parameter_list):
-        import numpy as np
         params = parameter_list or self._inner._parameter_list
-        grads_finite = all(
-            bool(jnp.all(jnp.isfinite(p.grad))) for p in params
-            if p.grad is not None)
+        grads = [p.grad for p in params if p.grad is not None]
+        # ONE fused all-finite reduction + one host sync (not per-param)
+        grads_finite = bool(_all_finite(grads)) if grads else True
         if not grads_finite and self._dynamic:
             self._bad_steps += 1
             self._good_steps = 0
@@ -88,10 +151,16 @@ class OptimizerWithMixedPrecision:
                 p.clear_gradient()
             return None, []
         self._good_steps += 1
+        self._bad_steps = 0
         if self._dynamic and self._good_steps >= self._incr_every:
             self._loss_scale *= self._incr_ratio
             self._good_steps = 0
         return self._inner.minimize(loss, parameter_list=params)
+
+
+@jax.jit
+def _all_finite(grads):
+    return jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in grads]))
 
 
 def decorate(optimizer, amp_lists=None, init_loss_scaling=2.**15,

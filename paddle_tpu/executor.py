@@ -56,6 +56,9 @@ class _OpRunner:
         attrs = {k: v for k, v in op.attrs.items() if k != 'initializer'}
         if opdef.needs_rng:
             attrs['key'] = key
+        amp = getattr(op.block.program, '_amp_config', None)
+        if amp is not None:
+            args = _amp_cast_args(op.type, args, amp)
         result = opdef.fn(*args, **attrs)
         if opdef.atomic_output:
             write(op.outputs['Out'][0], result)
@@ -71,6 +74,33 @@ class _OpRunner:
             else:
                 for n, r in zip(names, res_list):
                     write(n, r)
+
+
+def _amp_cast_args(op_type, args, amp):
+    """Static AMP graph rewrite (ref: python/paddle/fluid/contrib/
+    mixed_precision/fp16_utils.py:156 rewrite_program): white-list ops
+    consume low-precision inputs (MXU dtype), black-list ops are pinned to
+    fp32. Casts are inserted at trace time, so the lowered HLO carries them;
+    master parameters stay fp32 in the state. jax.vjp differentiates through
+    the casts, so grads come back fp32."""
+    if op_type in amp['white']:
+        target = amp['dtype']
+    elif op_type in amp['black']:
+        target = jnp.float32
+    else:
+        return args
+
+    def cast(a):
+        if a is None:
+            return a
+        if isinstance(a, (list, tuple)):
+            return [cast(x) for x in a]
+        a = jnp.asarray(a)
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            return a.astype(target)
+        return a
+
+    return [cast(a) for a in args]
 
 
 # ---------------------------------------------------------------------------

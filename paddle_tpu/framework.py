@@ -280,6 +280,9 @@ class Program:
             p.blocks.append(nb)
         p.current_block_idx = 0
         p.random_seed = self.random_seed
+        amp = getattr(self, '_amp_config', None)
+        if amp is not None:
+            p._amp_config = amp
         return p
 
     def _prune(self, targets):

@@ -184,3 +184,41 @@ def dgc_momentum(param, grad, velocity, error, lr, *, mu=0.9,
     else:
         p_new = p - lr * v_new
     return p_new, v_new, e_new
+
+
+@register_op('check_finite_and_unscale', outputs=['Out', 'FoundInfinite'],
+             variadic=['xs'])
+def check_finite_and_unscale(xs, scale):
+    """Fused grad finite-check + unscale (ref: paddle/fluid/operators/amp/
+    check_finite_and_unscale_op.*): one reduction over ALL grads inside the
+    jitted step — no per-param host syncs."""
+    inv = 1.0 / jnp.reshape(jnp.asarray(scale), ())
+    outs = [jnp.asarray(x) * inv for x in xs]
+    found = jnp.logical_not(
+        jnp.all(jnp.stack([jnp.all(jnp.isfinite(o)) for o in outs])))
+    return outs, jnp.reshape(found, (1,))
+
+
+@register_op('update_loss_scaling',
+             outputs=['LossScaling', 'OutGoodSteps', 'OutBadSteps'])
+def update_loss_scaling(found_inf, prev_loss_scaling, in_good_steps,
+                        in_bad_steps, *, incr_every_n_steps=1000,
+                        decr_every_n_nan_or_inf=2, incr_ratio=2.0,
+                        decr_ratio=0.8):
+    """Dynamic loss-scale update (ref: paddle/fluid/operators/amp/
+    update_loss_scaling_op.* + contrib/mixed_precision/fp16_utils.py:283),
+    fused into the train step: branchless jnp.where arithmetic."""
+    found = jnp.reshape(jnp.asarray(found_inf), ()).astype(bool)
+    scale = jnp.reshape(jnp.asarray(prev_loss_scaling), ()).astype(jnp.float32)
+    good = jnp.reshape(jnp.asarray(in_good_steps), ()).astype(jnp.int32)
+    bad = jnp.reshape(jnp.asarray(in_bad_steps), ()).astype(jnp.int32)
+    bad_n = jnp.where(found, bad + 1, jnp.zeros_like(bad))
+    good_n = jnp.where(found, jnp.zeros_like(good), good + 1)
+    decr = bad_n >= decr_every_n_nan_or_inf
+    incr = good_n >= incr_every_n_steps
+    scale_n = jnp.where(decr, jnp.maximum(scale * decr_ratio, 1.0),
+                        jnp.where(incr, scale * incr_ratio, scale))
+    bad_n = jnp.where(decr, jnp.zeros_like(bad_n), bad_n)
+    good_n = jnp.where(incr, jnp.zeros_like(good_n), good_n)
+    return (jnp.reshape(scale_n, (1,)), jnp.reshape(good_n, (1,)),
+            jnp.reshape(bad_n, (1,)))

@@ -1,0 +1,135 @@
+"""Static AMP: cast insertion per white/black lists, fused dynamic loss
+scaling, inf-step skipping. Ref parity: python/paddle/fluid/contrib/
+mixed_precision/fp16_utils.py:156 (rewrite_program), :283
+(update_loss_scaling)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import mixed_precision as mp
+
+
+def _build(lr=0.1):
+    x = layers.data('x', [8], dtype='float32')
+    label = layers.data('y', [1], dtype='float32')
+    h = layers.fc(x, size=16, act='relu')
+    pred = layers.fc(h, size=1)
+    loss = layers.reduce_mean(layers.square_error_cost(pred, label))
+    return loss
+
+
+def test_bf16_amp_casts_visible_in_hlo():
+    """White-list ops (mul/matmul behind fc) must run in bf16: the lowered
+    HLO carries bf16 convert/dot ops while master params stay fp32."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.executor import _lower
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        loss = _build()
+        opt = mp.decorate(fluid.optimizer.SGD(learning_rate=0.1),
+                          dtype='bfloat16')
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(start)
+    state_names = sorted(v.name for v in main.list_vars() if v.persistable)
+    state = {n: jnp.asarray(fluid.global_scope().find(n))
+             for n in state_names}
+    for n, v in state.items():
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            assert v.dtype == jnp.float32  # master weights
+    feeds = {'x': jnp.zeros((4, 8), jnp.float32),
+             'y': jnp.zeros((4, 1), jnp.float32)}
+    step = _lower(main, list(feeds), [loss.name], state_names)
+    hlo = jax.jit(step).lower(state, feeds, jax.random.PRNGKey(0)).as_text()
+    assert 'bf16' in hlo, "no bf16 in lowered HLO — AMP casts not applied"
+
+
+def test_bf16_amp_trains_close_to_fp32():
+    np.random.seed(0)
+    xv = np.random.randn(16, 8).astype(np.float32)
+    yv = (xv[:, :1] * 0.5 + 0.1).astype(np.float32)
+
+    def run(amp):
+        main, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, start):
+            fluid.framework.manual_seed(7)
+            loss = _build()
+            sgd = fluid.optimizer.SGD(learning_rate=0.1)
+            (mp.decorate(sgd, dtype='bfloat16') if amp else sgd).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(start)
+        losses = []
+        for _ in range(10):
+            l, = exe.run(main, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+            losses.append(float(l))
+        return losses
+
+    base = run(False)
+    amp = run(True)
+    assert amp[-1] < amp[0] * 0.8                 # it trains
+    assert abs(amp[-1] - base[-1]) < 0.1 * max(abs(base[0]), 1e-3)
+
+
+def test_fp16_dynamic_loss_scaling_skips_inf_steps():
+    """Feed an inf batch: the fused finite-check must skip the update and
+    decrease the loss scale; params stay unchanged."""
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        loss = _build()
+        opt = mp.decorate(fluid.optimizer.SGD(learning_rate=0.1),
+                          dtype='float16', init_loss_scaling=2.**10,
+                          decr_every_n_nan_or_inf=1)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(start)
+    pname = main.all_parameters()[0].name
+    w0 = np.asarray(fluid.global_scope().find(pname)).copy()
+    scale0 = float(np.asarray(
+        fluid.global_scope().find(opt._scale_var.name)).reshape(())[()])
+    bad = np.full((4, 8), np.inf, np.float32)
+    yv = np.zeros((4, 1), np.float32)
+    exe.run(main, feed={'x': bad, 'y': yv}, fetch_list=[loss])
+    w1 = np.asarray(fluid.global_scope().find(pname))
+    scale1 = float(np.asarray(
+        fluid.global_scope().find(opt._scale_var.name)).reshape(())[()])
+    np.testing.assert_array_equal(w0, w1)        # step skipped
+    assert scale1 < scale0                       # scale decreased
+
+    # a good batch then updates params and the step trains
+    good = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    exe.run(main, feed={'x': good, 'y': yv}, fetch_list=[loss])
+    w2 = np.asarray(fluid.global_scope().find(pname))
+    assert np.abs(w2 - w1).max() > 0
+
+
+def test_fp16_loss_scaling_matches_unscaled_trajectory():
+    """With finite grads, scaling then unscaling must reproduce the plain
+    fp32 SGD trajectory (modulo fp16 cast noise on white ops)."""
+    np.random.seed(1)
+    xv = np.random.randn(8, 8).astype(np.float32)
+    yv = np.random.randn(8, 1).astype(np.float32)
+
+    def run(amp):
+        main, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, start):
+            fluid.framework.manual_seed(3)
+            loss = _build()
+            sgd = fluid.optimizer.SGD(learning_rate=0.05)
+            if amp:
+                mp.decorate(sgd, dtype='float16',
+                            init_loss_scaling=2.**8).minimize(loss)
+            else:
+                sgd.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(start)
+        out = []
+        for _ in range(8):
+            l, = exe.run(main, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+            out.append(float(l))
+        return out
+
+    base, amp = run(False), run(True)
+    assert amp[-1] < amp[0]
+    np.testing.assert_allclose(amp, base, rtol=0.1, atol=0.05)
