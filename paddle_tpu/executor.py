@@ -322,6 +322,109 @@ def _op_read_names(op):
     return names
 
 
+def _pipeline_plan(program, fwd_ops, marker, feed_names, state_names,
+                   fetch_names=()):
+    """Static analysis for PipelineOptimizer lowering (ref optimizer.py:3405):
+    split the forward at the cut vars into stages + a loss tail. If the
+    stages are isomorphic (same op/attr sequence, same param shapes, single
+    chained activation) and the default mesh has a matching 'pp' axis, the
+    step runs the real SPMD GPipe schedule (parallel/pipeline.gpipe);
+    otherwise it falls back to a microbatched lax.scan with gradient
+    accumulation — same numerics, per-microbatch activation memory."""
+    pipe = marker.attrs.get('pipeline')
+    if not pipe or not pipe.get('cut_vars'):
+        return None
+    cut_vars = list(pipe['cut_vars'])
+    m = int(pipe['num_microbatches'])
+    # microbatch-combine rule for the loss: mean-reduced losses average
+    # across microbatches, sum-reduced losses add — anything else cannot be
+    # reassembled exactly from per-microbatch values (scan_fwd raises)
+    loss_producer = next((o.type for o in reversed(fwd_ops)
+                          if marker.attrs['loss'] in o.output_names()), None)
+    combine = ('mean' if loss_producer in ('mean', 'reduce_mean')
+               else 'sum' if loss_producer in ('reduce_sum', 'sum')
+               else None)
+    fallback = {'mode': 'scan', 'm': m, 'combine': combine}
+    producer = {}
+    for i, op in enumerate(fwd_ops):
+        for n in op.output_names():
+            producer[n] = i
+    if any(c not in producer for c in cut_vars):
+        return fallback
+    bounds = [producer[c] + 1 for c in cut_vars]
+    if bounds != sorted(bounds) or len(set(bounds)) != len(bounds):
+        return fallback
+    stages, prev = [], 0
+    for b in bounds:
+        stages.append((prev, b))
+        prev = b
+    tail = (prev, len(fwd_ops))
+    param_set = set(marker.attrs['params'])
+    state_set = set(state_names)
+
+    def op_sig(op):
+        attrs = tuple(sorted((k, repr(v)) for k, v in op.attrs.items()
+                             if k != 'initializer'))
+        return (op.type, attrs)
+
+    template_sig = [op_sig(o) for o in fwd_ops[stages[0][0]:stages[0][1]]]
+    if any([op_sig(o) for o in fwd_ops[lo:hi]] != template_sig
+           for lo, hi in stages[1:]):
+        return fallback
+
+    def stage_params(lo, hi):
+        seen = []
+        for op in fwd_ops[lo:hi]:
+            for n in op.input_names():
+                if n in param_set and n not in seen:
+                    seen.append(n)
+        return seen
+
+    spn = [stage_params(lo, hi) for lo, hi in stages]
+    if any(len(s) != len(spn[0]) for s in spn):
+        return fallback
+    blk = program.global_block()
+    for s in spn[1:]:
+        for a, b in zip(spn[0], s):
+            if tuple(blk.var(a).shape or ()) != tuple(blk.var(b).shape or ()):
+                return fallback
+
+    def external_reads(lo, hi):
+        produced, reads = set(), []
+        for op in fwd_ops[lo:hi]:
+            for n in _op_read_names(op):
+                if (n not in produced and n not in param_set
+                        and n not in reads):
+                    reads.append(n)
+            produced |= set(op.output_names())
+        return reads
+
+    ext = [external_reads(lo, hi) for lo, hi in stages]
+    # stage 0 consumes exactly one feed; stage i consumes only cut i-1; no
+    # stage reads mutable state (BN stats etc. would break the template map)
+    if (len(ext[0]) != 1 or ext[0][0] not in feed_names
+            or any(e != [cut_vars[i - 1]] for i, e in enumerate(ext)
+                   if i > 0)
+            or any(n in state_set for e in ext for n in e)):
+        return fallback
+    # fetches of stage-internal vars are only reachable in scan mode (the
+    # gpipe stages run under shard_map and expose only the cut activations)
+    tail_outs = set()
+    for o in fwd_ops[tail[0]:tail[1]]:
+        tail_outs |= set(o.output_names())
+    reachable = tail_outs | set(cut_vars) | set(feed_names) | set(state_names)
+    if any(f not in reachable for f in fetch_names):
+        return fallback
+    from .parallel.mesh import get_default_mesh
+    mesh = get_default_mesh()
+    if mesh is None or 'pp' not in mesh.shape or \
+            mesh.shape['pp'] != len(stages):
+        return fallback
+    return {'mode': 'gpipe', 'm': m, 'stages': stages, 'tail': tail,
+            'spn': spn, 'x_name': ext[0][0], 'out_name': cut_vars[0],
+            'cut_out': cut_vars[-1], 'mesh': mesh}
+
+
 def _remat_segments(fwd_ops, checkpoints):
     """Split the forward op list at checkpoint-producing ops. Returns a list
     of (lo, hi) index ranges; each range becomes one jax.checkpoint segment
@@ -346,6 +449,44 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                     if op.type == BACKWARD_OP_TYPE), None)
     state_set = frozenset(state_names)
 
+    # ---- static backward-plan analysis (trace-independent) ----
+    if bwd_idx is not None:
+        marker = ops[bwd_idx]
+        loss_name = marker.attrs['loss']
+        param_names = marker.attrs['params']
+        checkpoints = list(marker.attrs.get('checkpoints') or [])
+        fwd_ops = ops[:bwd_idx]
+        pplan = _pipeline_plan(program, fwd_ops, marker, feed_names,
+                               state_names, fetch_names)
+        loss_var_shape = None
+        blk0 = program.global_block()
+        if blk0.has_var(loss_name):
+            shp = blk0.var(loss_name).shape
+            if shp is not None and int(np.prod(shp or (1,))) == 1:
+                loss_var_shape = tuple(shp)
+        if pplan is not None:
+            checkpoints = []       # pipeline owns the memory schedule
+        segs = (_remat_segments(fwd_ops, checkpoints)
+                if checkpoints else [(0, len(fwd_ops))])
+        # names each segment boundary must carry forward: reads of later
+        # ops + loss/fetches/state-writes. Everything else is dropped at
+        # the boundary so jax.checkpoint only saves the live set and
+        # remats the rest during the backward pass.
+        live_after = []
+        downstream = (set().union(*(_op_read_names(o)
+                                    for o in ops[bwd_idx + 1:]))
+                      if bwd_idx + 1 < len(ops) else set())
+        downstream |= {loss_name, *fetch_names, *state_set, *checkpoints}
+        for _, hi in segs:
+            live = set(downstream)
+            for o in fwd_ops[hi:]:
+                live |= _op_read_names(o)
+            live_after.append(live)
+        # state vars written during the forward (BN stats etc.) — the scan
+        # fallback threads them through the microbatch loop carry
+        written_state = [n for n in state_names
+                        if any(n in o.output_names() for o in fwd_ops)]
+
     def step(state, feeds, base_key):
         env: Dict[str, object] = dict(feeds)
 
@@ -359,18 +500,15 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                     f"scope (did you run the startup program?)")
             return read
 
-        def run_seq(op_list, offset, read, write):
+        def run_seq(op_list, offset, read, write, key=None):
+            k = base_key if key is None else key
             for i, op in enumerate(op_list):
                 _OpRunner.run(op, read, write,
-                              jax.random.fold_in(base_key, offset + i))
+                              jax.random.fold_in(k, offset + i))
 
         if bwd_idx is None:
             run_seq(ops, 0, make_read(env, state), env.__setitem__)
         else:
-            marker = ops[bwd_idx]
-            loss_name = marker.attrs['loss']
-            param_names = marker.attrs['params']
-            checkpoints = list(marker.attrs.get('checkpoints') or [])
             # diff targets come from state (parameters) or from the feeds
             # (fluid.gradients w.r.t. data inputs, ref backward.py:1672)
             params = {}
@@ -383,24 +521,6 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                     raise KeyError(
                         f"gradient target '{n}' is neither a persistable "
                         f"parameter nor a fed variable")
-            fwd_ops = ops[:bwd_idx]
-            segs = (_remat_segments(fwd_ops, checkpoints)
-                    if checkpoints else [(0, len(fwd_ops))])
-
-            # names each segment boundary must carry forward: reads of later
-            # ops + loss/fetches/state-writes. Everything else is dropped at
-            # the boundary so jax.checkpoint only saves the live set and
-            # remats the rest during the backward pass.
-            live_after = []
-            downstream = (set().union(*(_op_read_names(o)
-                                        for o in ops[bwd_idx + 1:]))
-                          if bwd_idx + 1 < len(ops) else set())
-            downstream |= {loss_name, *fetch_names, *state_set, *checkpoints}
-            for _, hi in segs:
-                live = set(downstream)
-                for o in fwd_ops[hi:]:
-                    live |= _op_read_names(o)
-                live_after.append(live)
 
             def make_segment(lo, hi):
                 def seg(e_in, pvals):
@@ -410,7 +530,7 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                     return e
                 return seg
 
-            def fwd(pvals):
+            def plain_fwd(pvals):
                 e = {k: pvals.get(k, v) for k, v in feeds.items()}
                 for (lo, hi), live in zip(segs, live_after):
                     seg = make_segment(lo, hi)
@@ -422,6 +542,121 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                 loss = e[loss_name]
                 return jnp.sum(loss), e
 
+            def gpipe_fwd(pvals):
+                """Real SPMD GPipe: stage params stacked over 'pp', scan +
+                ppermute schedule (parallel/pipeline.gpipe), loss tail on
+                the reassembled full batch."""
+                from .parallel.pipeline import gpipe
+                e = {k: pvals.get(k, v) for k, v in feeds.items()}
+                spn = pplan['spn']
+
+                def getp(n):
+                    return pvals[n] if n in pvals else state[n]
+
+                stacked = {t: jnp.stack([getp(s[j]) for s in spn])
+                           for j, t in enumerate(spn[0])}
+                lo0, hi0 = pplan['stages'][0]
+                x = e[pplan['x_name']]
+                mm = pplan['m']
+                xm = x.reshape((mm, x.shape[0] // mm) + x.shape[1:])
+
+                def stage_fn(pstage, xs):
+                    e2 = {pplan['x_name']: xs}
+                    read2 = make_read(e2, pstage, state)
+                    # per-stage RNG stream (microbatches within a stage
+                    # share one — documented dropout caveat of gpipe mode)
+                    ks = jax.random.fold_in(
+                        base_key, jax.lax.axis_index('pp') + 1)
+                    for i, op in enumerate(fwd_ops[lo0:hi0]):
+                        _OpRunner.run(op, read2, e2.__setitem__,
+                                      jax.random.fold_in(ks, lo0 + i))
+                    return e2[pplan['out_name']]
+
+                ym = gpipe(stage_fn, stacked, xm, mesh=pplan['mesh'])
+                e[pplan['cut_out']] = ym.reshape(
+                    (ym.shape[0] * ym.shape[1],) + ym.shape[2:])
+                tlo, thi = pplan['tail']
+                run_seq(fwd_ops[tlo:thi], tlo, make_read(e, pvals, state),
+                        e.__setitem__)
+                return jnp.sum(e[loss_name]), e
+
+            def scan_fwd(pvals):
+                """GPipe-numerics fallback: microbatched lax.scan with loss
+                (and grad, via autodiff of the scan) accumulation; state
+                writes thread through the carry in microbatch order."""
+                mm = pplan['m']
+                if pplan['combine'] is None:
+                    raise ValueError(
+                        "pipeline microbatching requires a mean- or "
+                        "sum-reduced scalar loss (loss producer must be "
+                        "mean/reduce_mean/reduce_sum); restructure the loss "
+                        "or remove cut_list")
+                fv = {k: pvals.get(k, v) for k, v in feeds.items()}
+                dims = {v.shape[0] for v in fv.values()
+                        if getattr(v, 'ndim', 0) >= 1}
+                if len(dims) != 1:
+                    raise ValueError(
+                        f"pipeline microbatching requires all batch-major "
+                        f"feeds to share one leading dim; got {sorted(dims)}")
+                batch = dims.pop() if dims else 0
+                if batch == 0 or batch % mm != 0:
+                    raise ValueError(
+                        f"pipeline: batch {batch} not divisible by "
+                        f"num_microbatches {mm}")
+                mb = batch // mm
+                split, rest = {}, {}
+                for kf, v in fv.items():
+                    if getattr(v, 'ndim', 0) >= 1:
+                        split[kf] = v.reshape((mm, mb) + v.shape[1:])
+                    else:
+                        rest[kf] = v
+                sw0 = {n: state[n] for n in written_state}
+                # fetches of forward intermediates: collected per microbatch
+                # and reassembled after the scan (grad fetches are bound
+                # after fwd by the marker, so only fwd-produced names count)
+                fwd_produced = {n for o in fwd_ops for n in o.output_names()}
+                micro_fetch = [n for n in fetch_names
+                               if n in fwd_produced and n not in state_set
+                               and n != loss_name]
+
+                def body(carry, xs):
+                    loss_acc, sw = carry
+                    mb_idx, xslices = xs
+                    e = dict(rest)
+                    e.update(xslices)
+                    e.update(sw)
+                    run_seq(fwd_ops, 0, make_read(e, pvals, state),
+                            e.__setitem__,
+                            key=jax.random.fold_in(base_key, 7919 + mb_idx))
+                    new_sw = {n: e[n] for n in written_state}
+                    outs = tuple(jnp.asarray(e[n]) for n in micro_fetch)
+                    return (loss_acc + jnp.sum(e[loss_name]), new_sw), outs
+
+                (loss_tot, sw_fin), ys = jax.lax.scan(
+                    body, (jnp.zeros((), jnp.float32), sw0),
+                    (jnp.arange(mm), split))
+                loss = loss_tot / mm if pplan['combine'] == 'mean' \
+                    else loss_tot
+                e = dict(rest)
+                e.update(sw_fin)
+                e[loss_name] = (jnp.reshape(loss, loss_var_shape)
+                                if loss_var_shape is not None else loss)
+                for n, v in zip(micro_fetch, ys):
+                    if v.ndim >= 2 and v.shape[1] == mb:
+                        # batch-major intermediate: stitch microbatches back
+                        e[n] = v.reshape((mm * mb,) + v.shape[2:])
+                    else:
+                        # per-microbatch scalar/metric: average (exact for
+                        # mean-type metrics over equal microbatches)
+                        e[n] = jnp.mean(v, axis=0)
+                return jnp.reshape(loss, ()), e
+
+            if pplan is None:
+                fwd = plain_fwd
+            elif pplan['mode'] == 'gpipe':
+                fwd = gpipe_fwd
+            else:
+                fwd = scan_fwd
             (_, env), grads = jax.value_and_grad(fwd, has_aux=True)(params)
             for n, gname in zip(param_names, marker.outputs['Grads']):
                 env[gname] = grads[n]
@@ -446,6 +681,7 @@ class Executor:
         self.place = _get_paddle_place(place)
         self._cache = {}
         self._step_counter = 0
+        self._fsdp_placed = set()
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -471,6 +707,17 @@ class Executor:
         # persistable vars = training state
         state_names = sorted(v.name for v in program.list_vars()
                              if v.persistable)
+        fsdp_axis = getattr(program, '_fsdp_axis', None)
+        fsdp_mesh = None
+        # place once per (program, scope): step outputs keep the sharding,
+        # so re-placing every run would only add host-side dispatch cost
+        fsdp_key = (id(program), id(scope))
+        if fsdp_axis is not None and fsdp_key not in self._fsdp_placed:
+            from .parallel.mesh import get_default_mesh
+            mesh = get_default_mesh()
+            if mesh is not None and fsdp_axis in mesh.shape:
+                fsdp_mesh = mesh
+                self._fsdp_placed.add(fsdp_key)
         state = {}
         for n in state_names:
             val = scope.find(n)
@@ -478,6 +725,10 @@ class Executor:
                 raise RuntimeError(
                     f"persistable var '{n}' is uninitialized; run the startup "
                     f"program first (exe.run(fluid.default_startup_program()))")
+            if fsdp_mesh is not None and hasattr(val, 'shape'):
+                from .parallel.fsdp import fsdp_sharding
+                val = jax.device_put(
+                    val, fsdp_sharding(val.shape, fsdp_mesh, fsdp_axis))
             state[n] = val
 
         feed_vals = {}

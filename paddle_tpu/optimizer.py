@@ -16,7 +16,8 @@ import jax.numpy as jnp
 from .backward import append_backward
 from .clip import append_gradient_clip_ops
 from .core import unique_name
-from .framework import Variable, default_main_program, in_dygraph_mode
+from .framework import (BACKWARD_OP_TYPE, Variable, default_main_program,
+                        in_dygraph_mode)
 from .initializer import ConstantInitializer
 from .layer_helper import LayerHelper
 from .layers.common import apply_op_layer
@@ -626,13 +627,15 @@ class GradientMergeOptimizer(Optimizer):
 
 
 class PipelineOptimizer:
-    """ref: optimizer.py:PipelineOptimizer — the reference splits the
-    Program at cut points and streams batches through per-device section
-    workers. The TPU-native pipeline is the SPMD GPipe schedule in
-    paddle_tpu.parallel.pipeline (mesh axis 'pp', lax.scan + ppermute);
-    this class keeps the reference's constructor surface and delegates the
-    optimization step to the wrapped optimizer, recording the microbatch
-    config for the functional pipeline path."""
+    """ref: optimizer.py:3405 PipelineOptimizer — the reference splits the
+    Program at `cut_list` points and streams batches through per-device
+    section workers. The TPU lowering (executor.py `_lower`): the Program is
+    split at the cut vars into stages; isomorphic stages stack their
+    parameters over the 'pp' mesh axis and run the SPMD GPipe schedule
+    (paddle_tpu.parallel.pipeline: lax.scan + ppermute over ICI);
+    non-uniform stages fall back to a microbatched lax.scan with gradient
+    accumulation — the same GPipe numerics (mean-of-microbatch grads) and
+    per-microbatch activation memory, without cross-device placement."""
 
     def __init__(self, optimizer, cut_list=None, place_list=None,
                  concurrency_list=None, queue_size=30, sync_steps=1,
@@ -647,5 +650,18 @@ class PipelineOptimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        return self._inner.minimize(loss, startup_program, parameter_list,
-                                    no_grad_set)
+        if in_dygraph_mode():
+            raise RuntimeError("PipelineOptimizer is a static-graph "
+                               "construct (use parallel.pipeline.gpipe for "
+                               "the functional path)")
+        params_grads = self._inner.backward(loss, startup_program,
+                                            parameter_list, no_grad_set)
+        block = loss.block.program.global_block()
+        marker = next(op for op in reversed(block.ops)
+                      if op.type == BACKWARD_OP_TYPE)
+        marker._set_attr('pipeline', {
+            'cut_vars': [v.name if hasattr(v, 'name') else v
+                         for v in (self.cut_list or [])],
+            'num_microbatches': int(self.num_microbatches)})
+        self._inner.apply_gradients(params_grads)
+        return None, params_grads

@@ -145,6 +145,10 @@ class DistributedStrategy:
         self.amp_loss_scale = 2. ** 15
         self.exec_strategy = None
         self.forward_recompute = False
+        # FSDP (SURVEY §2.8): shard params + optimizer slots over the
+        # 'fsdp' mesh axis via GSPMD (parallel/fsdp.py)
+        self.sharding = False
+        self.sharding_axis = 'fsdp'
 
 
 class DistributedOptimizer:
@@ -186,8 +190,13 @@ class DistributedOptimizer:
         if merge_k > 1:
             from ..optimizer import GradientMergeOptimizer
             inner = GradientMergeOptimizer(inner, k_steps=merge_k, avg=True)
-        return inner.minimize(loss, startup_program, parameter_list,
-                              no_grad_set)
+        result = inner.minimize(loss, startup_program, parameter_list,
+                                no_grad_set)
+        if strat.sharding:
+            # Executor.run places persistable state with FSDP shardings
+            # before each jitted step (a no-op once placed)
+            loss.block.program._fsdp_axis = strat.sharding_axis
+        return result
 
 
 class Role:

@@ -1,0 +1,176 @@
+"""PipelineOptimizer lowering (ref python/paddle/fluid/optimizer.py:3405):
+isomorphic stages → real SPMD GPipe over the 'pp' mesh axis; non-uniform
+stages → microbatched scan with gradient accumulation. Both must match the
+single-device loss trajectory."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.parallel.mesh import make_mesh, mesh_guard
+
+
+def _build_uniform(cut=True):
+    """Two isomorphic fc blocks (16→16) + loss tail."""
+    x = layers.data('x', [16], dtype='float32')
+    y = layers.data('y', [1], dtype='float32')
+    h1 = layers.fc(x, size=16, act='tanh')
+    h2 = layers.fc(h1, size=16, act='tanh')
+    s = layers.reduce_sum(h2, dim=1, keep_dim=True)
+    loss = layers.reduce_mean(layers.square_error_cost(s, y))
+    return loss, [h1, h2]
+
+
+def _trajectory(pipelined, uniform, n_micro=4, steps=6, mesh=None):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        fluid.framework.manual_seed(11)
+        if uniform:
+            loss, cuts = _build_uniform()
+        else:
+            x = layers.data('x', [16], dtype='float32')
+            y = layers.data('y', [1], dtype='float32')
+            h1 = layers.fc(x, size=32, act='tanh')
+            h2 = layers.fc(h1, size=8, act='tanh')
+            s = layers.reduce_sum(h2, dim=1, keep_dim=True)
+            loss = layers.reduce_mean(layers.square_error_cost(s, y))
+            cuts = [h1]
+        sgd = fluid.optimizer.SGD(learning_rate=0.05)
+        if pipelined:
+            opt = fluid.optimizer.PipelineOptimizer(
+                sgd, cut_list=cuts, num_microbatches=n_micro)
+            opt.minimize(loss)
+        else:
+            sgd.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(start)
+    rng = np.random.RandomState(0)
+    out = []
+
+    def run_steps():
+        for _ in range(steps):
+            xv = rng.standard_normal((8, 16)).astype(np.float32)
+            yv = xv[:, :1].astype(np.float32)
+            l, = exe.run(main, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+            out.append(float(np.asarray(l).reshape(())[()]))
+
+    if mesh is not None:
+        with mesh_guard(mesh):
+            run_steps()
+    else:
+        run_steps()
+    return out
+
+
+def test_gpipe_mode_selected_for_uniform_stages():
+    from paddle_tpu.executor import _pipeline_plan
+    from paddle_tpu.framework import BACKWARD_OP_TYPE
+    mesh = make_mesh({'pp': 2})
+    with mesh_guard(mesh):
+        main, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, start):
+            loss, cuts = _build_uniform()
+            opt = fluid.optimizer.PipelineOptimizer(
+                fluid.optimizer.SGD(learning_rate=0.05), cut_list=cuts,
+                num_microbatches=4)
+            opt.minimize(loss)
+        ops = main.global_block().ops
+        bwd = next(i for i, o in enumerate(ops)
+                   if o.type == BACKWARD_OP_TYPE)
+        state_names = [v.name for v in main.list_vars() if v.persistable]
+        plan = _pipeline_plan(main, ops[:bwd], ops[bwd], ['x', 'y'],
+                              state_names)
+        assert plan is not None and plan['mode'] == 'gpipe', plan
+
+
+def test_pipeline_gpipe_matches_single_device():
+    base = _trajectory(pipelined=False, uniform=True)
+    mesh = make_mesh({'pp': 2})
+    pp = _trajectory(pipelined=True, uniform=True, mesh=mesh)
+    np.testing.assert_allclose(pp, base, rtol=2e-4, atol=1e-5)
+    assert pp[-1] < pp[0]
+
+
+def test_pipeline_scan_fallback_matches_single_device():
+    base = _trajectory(pipelined=False, uniform=False)
+    pp = _trajectory(pipelined=True, uniform=False)   # no pp mesh → scan
+    np.testing.assert_allclose(pp, base, rtol=2e-4, atol=1e-5)
+    assert pp[-1] < pp[0]
+
+
+def _sum_loss_program(pipelined):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        fluid.framework.manual_seed(2)
+        x = layers.data('x', [16], dtype='float32')
+        y = layers.data('y', [1], dtype='float32')
+        h1 = layers.fc(x, size=8, act='tanh')
+        pred = layers.fc(h1, size=1)
+        loss = layers.reduce_sum(layers.square_error_cost(pred, y))
+        sgd = fluid.optimizer.SGD(learning_rate=0.001)
+        if pipelined:
+            fluid.optimizer.PipelineOptimizer(
+                sgd, cut_list=[h1], num_microbatches=4).minimize(loss)
+        else:
+            sgd.minimize(loss)
+    return main, start, loss, pred
+
+
+def test_pipeline_scan_sum_reduced_loss_parity():
+    """Sum-reduced losses must NOT be divided by num_microbatches."""
+    rng = np.random.RandomState(3)
+    xv = rng.standard_normal((8, 16)).astype(np.float32)
+    yv = xv[:, :1].astype(np.float32)
+
+    def run(pipelined):
+        main, start, loss, _ = _sum_loss_program(pipelined)
+        exe = fluid.Executor()
+        exe.run(start)
+        out = []
+        for _ in range(4):
+            l, = exe.run(main, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+            out.append(float(np.asarray(l).reshape(())[()]))
+        return out
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-4, atol=1e-5)
+
+
+def test_pipeline_scan_fetches_forward_intermediate():
+    """Fetching a batch-major intermediate reassembles the microbatches."""
+    main, start, loss, pred = _sum_loss_program(True)
+    exe = fluid.Executor()
+    exe.run(start)
+    xv = np.random.RandomState(4).standard_normal((8, 16)).astype(np.float32)
+    yv = xv[:, :1].astype(np.float32)
+    pv, lv = exe.run(main, feed={'x': xv, 'y': yv},
+                     fetch_list=[pred, loss])
+    assert pv.shape == (8, 1)
+    # parity with the unpipelined forward
+    main2, start2, loss2, pred2 = _sum_loss_program(False)
+    exe2 = fluid.Executor()
+    exe2.run(start2)
+    pv2, = exe2.run(main2, feed={'x': xv, 'y': yv}, fetch_list=[pred2])
+    np.testing.assert_allclose(pv, pv2, rtol=2e-4, atol=1e-5)
+
+
+def test_pipeline_mismatched_feed_dims_raise():
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', [16], dtype='float32')
+        t = layers.data('table', [16], dtype='float32')
+        y = layers.data('y', [1], dtype='float32')
+        h1 = layers.fc(x, size=8, act='tanh')
+        h1b = layers.elementwise_add(h1, layers.fc(t, size=8))
+        pred = layers.fc(h1b, size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.01), cut_list=[h1b],
+            num_microbatches=4).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(start)
+    xv = np.zeros((8, 16), np.float32)
+    tv = np.zeros((128, 16), np.float32)   # non-batch leading dim
+    yv = np.zeros((8, 1), np.float32)
+    with pytest.raises(Exception, match="leading dim"):
+        exe.run(main, feed={'x': xv, 'table': tv, 'y': yv},
+                fetch_list=[loss])
