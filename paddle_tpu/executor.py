@@ -407,13 +407,18 @@ def _pipeline_plan(program, fwd_ops, marker, feed_names, state_names,
                    if i > 0)
             or any(n in state_set for e in ext for n in e)):
         return fallback
-    # fetches of stage-internal vars are only reachable in scan mode (the
-    # gpipe stages run under shard_map and expose only the cut activations)
+    # gpipe_fwd materializes ONLY the final cut activation (stage-internal
+    # vars and earlier cuts live inside the shard_map): the loss tail must
+    # read nothing else, and fetches must be reachable — otherwise scan mode
     tail_outs = set()
     for o in fwd_ops[tail[0]:tail[1]]:
         tail_outs |= set(o.output_names())
-    reachable = tail_outs | set(cut_vars) | set(feed_names) | set(state_names)
+    reachable = (tail_outs | {cut_vars[-1]} | set(feed_names)
+                 | set(state_names))
     if any(f not in reachable for f in fetch_names):
+        return fallback
+    tail_reads = external_reads(*tail)
+    if any(n not in reachable and n not in param_set for n in tail_reads):
         return fallback
     from .parallel.mesh import get_default_mesh
     mesh = get_default_mesh()
@@ -558,6 +563,10 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                 lo0, hi0 = pplan['stages'][0]
                 x = e[pplan['x_name']]
                 mm = pplan['m']
+                if x.shape[0] % mm != 0:
+                    raise ValueError(
+                        f"pipeline: batch {x.shape[0]} not divisible by "
+                        f"num_microbatches {mm}")
                 xm = x.reshape((mm, x.shape[0] // mm) + x.shape[1:])
 
                 def stage_fn(pstage, xs):
@@ -637,7 +646,7 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                     (jnp.arange(mm), split))
                 loss = loss_tot / mm if pplan['combine'] == 'mean' \
                     else loss_tot
-                e = dict(rest)
+                e = dict(fv)          # all feeds stay fetchable
                 e.update(sw_fin)
                 e[loss_name] = (jnp.reshape(loss, loss_var_shape)
                                 if loss_var_shape is not None else loss)
@@ -710,8 +719,9 @@ class Executor:
         fsdp_axis = getattr(program, '_fsdp_axis', None)
         fsdp_mesh = None
         # place once per (program, scope): step outputs keep the sharding,
-        # so re-placing every run would only add host-side dispatch cost
-        fsdp_key = (id(program), id(scope))
+        # so re-placing every run would only add host-side dispatch cost.
+        # program._id is a never-recycled counter (unlike id())
+        fsdp_key = (program._id, id(scope))
         if fsdp_axis is not None and fsdp_key not in self._fsdp_placed:
             from .parallel.mesh import get_default_mesh
             mesh = get_default_mesh()

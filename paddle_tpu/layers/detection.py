@@ -11,6 +11,7 @@ from . import nn as nn_layers
 from . import tensor as tensor_layers
 
 __all__ = ['prior_box', 'density_prior_box', 'multi_box_head',
+           'detection_map',
            'bipartite_match', 'target_assign', 'detection_output', 'ssd_loss',
            'rpn_target_assign', 'retinanet_target_assign',
            'sigmoid_focal_loss', 'anchor_generator',
@@ -401,3 +402,37 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     boxes = tensor_layers.concat(boxes_l, axis=0)
     variances = tensor_layers.concat(vars_l, axis=0)
     return mbox_locs, mbox_confs, boxes, variances
+
+
+def detection_map(detect_res, gt_label, gt_box, gt_difficult=None,
+                  class_num=None, background_label=0, overlap_threshold=0.5,
+                  evaluate_difficult=True, ap_version='integral',
+                  has_state=None, input_states=None, out_states=None):
+    """ref: fluid.layers.detection.detection_map (detection.py:1028) over
+    operators/detection_map_op.cc. Returns (cur_map, accum_map): per-batch
+    mAP plus a running mean held in persistable state (the TPU-state form
+    of the reference's accumulated pos/true/false-positive tensors)."""
+    from ..core import unique_name as un
+    from ..layer_helper import LayerHelper
+    from .tensor import create_global_var
+    cur = apply_op_layer(
+        'detection_map',
+        {'det': detect_res, 'gt_label': gt_label, 'gt_box': gt_box,
+         'gt_difficult': gt_difficult},
+        {'class_num': class_num, 'overlap_threshold': overlap_threshold,
+         'background_label': background_label,
+         'evaluate_difficult': evaluate_difficult, 'ap_type': ap_version})
+    accum = create_global_var([1], 0.0, 'float32', persistable=True,
+                              name=un.generate('accum_map'))
+    count = create_global_var([1], 0.0, 'float32', persistable=True,
+                              name=un.generate('accum_map_count'))
+    helper = LayerHelper('detection_map')
+    helper.append_op(type='increment', inputs={'x': count.name},
+                     outputs={'Out': count.name}, attrs={'value': 1.0})
+    # accum += (cur - accum) / count  (running mean, fused into the step)
+    diff = apply_op_layer('elementwise_sub', {'x': cur, 'y': accum})
+    step = apply_op_layer('elementwise_div', {'x': diff, 'y': count})
+    helper.append_op(type='elementwise_add',
+                     inputs={'x': accum.name, 'y': step.name},
+                     outputs={'Out': accum.name}, attrs={})
+    return cur, accum

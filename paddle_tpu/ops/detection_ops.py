@@ -1018,3 +1018,78 @@ def box_encode_per_row(boxes, gt, *, weights=(0.1, 0.1, 0.2, 0.2)):
     enc = _encode_per_anchor(jnp.asarray(boxes).reshape(-1, 4),
                              jnp.asarray(gt).reshape(-1, 4))
     return enc / jnp.asarray(weights, enc.dtype)
+
+
+@register_op('detection_map')
+def detection_map(det, gt_label, gt_box, gt_difficult=None, *, class_num,
+                  overlap_threshold=0.5, background_label=0,
+                  evaluate_difficult=True, ap_type='integral'):
+    """Single-batch mAP (ref: paddle/fluid/operators/detection_map_op.cc).
+    det (M, 6): [label, score, x1, y1, x2, y2], rows with score<=0 are
+    padding; gt_label (G, 1), gt_box (G, 4), rows with all-zero boxes are
+    padding; gt_difficult (G,) optional 0/1. Greedy IoU matching per class
+    (fori_loop over score-ranked detections with a matched-gt mask carry),
+    then integral/11point AP. With evaluate_difficult=False (VOC protocol),
+    difficult GTs are excluded from the recall denominator and detections
+    matched to them are ignored (neither tp nor fp). Fixed shapes
+    throughout — no dynamic gather."""
+    det = jnp.asarray(det)
+    gtl = jnp.asarray(gt_label).reshape(-1)
+    gtb = jnp.asarray(gt_box).reshape(-1, 4)
+    difficult = (jnp.zeros_like(gtl, dtype=bool) if gt_difficult is None
+                 else jnp.asarray(gt_difficult).reshape(-1).astype(bool))
+    if evaluate_difficult:
+        difficult = jnp.zeros_like(difficult)
+    M = det.shape[0]
+    d_label = det[:, 0].astype(jnp.int32)
+    d_score = det[:, 1]
+    d_box = det[:, 2:6]
+    d_valid = d_score > 0
+    g_valid = jnp.any(gtb != 0, axis=1)
+    iou = _pairwise_iou(d_box, gtb)                  # (M, G)
+
+    order = jnp.argsort(-jnp.where(d_valid, d_score, -jnp.inf))
+    aps = []
+    for c in range(class_num):
+        if c == background_label:
+            continue
+        dc = d_valid & (d_label == c)
+        gc = g_valid & (gtl == c)
+        n_gt = jnp.sum(gc & (~difficult))
+
+        def body(i, carry):
+            g_matched, tp, fp = carry
+            di = order[i]
+            active = dc[di]
+            cand = jnp.where(gc & (~g_matched), iou[di], -1.0)
+            best = jnp.argmax(cand)
+            ok = active & (cand[best] >= overlap_threshold)
+            ignored = ok & difficult[best]     # matched a difficult GT
+            g_matched = g_matched.at[best].set(g_matched[best] | ok)
+            tp = tp.at[i].set(jnp.where(active & ok & (~ignored), 1.0, 0.0))
+            fp = fp.at[i].set(jnp.where(active & (~ok), 1.0, 0.0))
+            return g_matched, tp, fp
+
+        g0 = jnp.zeros_like(gc)
+        tp0 = jnp.zeros((M,), det.dtype)
+        fp0 = jnp.zeros((M,), det.dtype)
+        _, tp, fp = jax.lax.fori_loop(0, M, body, (g0, tp0, fp0))
+        ctp = jnp.cumsum(tp)
+        cfp = jnp.cumsum(fp)
+        recall = ctp / jnp.maximum(n_gt.astype(det.dtype), 1.0)
+        precision = ctp / jnp.maximum(ctp + cfp, 1.0)
+        if ap_type == '11point':
+            pts = jnp.linspace(0.0, 1.0, 11)
+            ap = jnp.mean(jax.vmap(
+                lambda t: jnp.max(jnp.where(recall >= t, precision, 0.0))
+            )(pts))
+        else:  # integral
+            d_rec = jnp.diff(recall, prepend=0.0)
+            ap = jnp.sum(precision * d_rec)
+        aps.append(jnp.where(n_gt > 0, ap, jnp.nan))
+    aps = jnp.stack(aps)
+    present = ~jnp.isnan(aps)
+    n_present = jnp.maximum(jnp.sum(present), 1)
+    return jnp.reshape(
+        jnp.sum(jnp.where(present, aps, 0.0)) / n_present.astype(det.dtype),
+        (1,))

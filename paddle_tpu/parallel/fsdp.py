@@ -47,9 +47,12 @@ def fsdp_sharding(shape, mesh: Mesh = None, axis: str = 'fsdp'):
 
 
 def fsdp_shardings(params, mesh: Mesh = None, axis: str = 'fsdp'):
-    """Pytree of params → pytree of NamedShardings."""
+    """Pytree of params → pytree of NamedShardings (None without a mesh,
+    like fsdp_sharding)."""
     from .mesh import get_default_mesh
     mesh = mesh or get_default_mesh()
+    if mesh is None:
+        return None
     return jax.tree_util.tree_map(
         lambda a: NamedSharding(mesh, fsdp_spec(np.shape(a), mesh, axis)),
         params)
@@ -57,8 +60,11 @@ def fsdp_shardings(params, mesh: Mesh = None, axis: str = 'fsdp'):
 
 def shard_params(params, mesh: Mesh = None, axis: str = 'fsdp'):
     """device_put the pytree with FSDP shardings (no-op copies when already
-    placed). Per-device bytes for a sharded param ≈ total/axis_size."""
+    placed). Per-device bytes for a sharded param ≈ total/axis_size.
+    Without a mesh, returns the params unchanged."""
     shardings = fsdp_shardings(params, mesh, axis)
+    if shardings is None:
+        return params
     return jax.tree_util.tree_map(jax.device_put, params, shardings)
 
 

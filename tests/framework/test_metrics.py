@@ -1,0 +1,144 @@
+"""metrics.py coverage (ref python/paddle/fluid/metrics.py tests) + reader
+decorator behavior (ref python/paddle/reader/tests/decorator_test.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, metrics
+
+
+def test_accuracy_metric():
+    m = metrics.Accuracy()
+    m.update(0.8, 10)
+    m.update(0.6, 30)
+    assert abs(m.eval() - (0.8 * 10 + 0.6 * 30) / 40) < 1e-9
+    m.reset()
+    m.update(1.0, 5)
+    assert m.eval() == 1.0
+
+
+def test_precision_recall():
+    p = metrics.Precision()
+    preds = np.array([0.9, 0.2, 0.8, 0.1])
+    labels = np.array([1, 0, 0, 1])
+    p.update(preds, labels)
+    # predicted positive: idx 0, 2 → tp=1, fp=1
+    assert abs(p.eval() - 0.5) < 1e-9
+    r = metrics.Recall()
+    r.update(preds, labels)
+    # actual positive: idx 0, 3 → tp=1, fn=1
+    assert abs(r.eval() - 0.5) < 1e-9
+
+
+def test_chunk_evaluator():
+    m = metrics.ChunkEvaluator()
+    m.update(np.array([10]), np.array([8]), np.array([6]))
+    prec, rec, f1 = m.eval()
+    assert abs(prec - 0.6) < 1e-9
+    assert abs(rec - 0.75) < 1e-9
+    assert abs(f1 - 2 * 0.6 * 0.75 / 1.35) < 1e-9
+
+
+def test_edit_distance_metric():
+    m = metrics.EditDistance()
+    m.update(np.array([1.0, 0.0, 2.0]), np.array([3]))
+    avg, err = m.eval()
+    assert abs(avg - 1.0) < 1e-9
+    assert abs(err - 2 / 3) < 1e-9
+
+
+def test_auc_metric_perfect_classifier():
+    m = metrics.Auc(num_thresholds=255)
+    preds = np.array([[0.1, 0.9]] * 50 + [[0.9, 0.1]] * 50)
+    labels = np.array([1] * 50 + [0] * 50)
+    m.update(preds, labels)
+    assert m.eval() > 0.99
+    m2 = metrics.Auc(num_thresholds=255)
+    rng = np.random.RandomState(0)
+    m2.update(rng.rand(400, 2), rng.randint(0, 2, 400))
+    assert 0.35 < m2.eval() < 0.65   # random classifier ≈ 0.5
+
+
+def test_composite_metric():
+    c = metrics.CompositeMetric()
+    c.add_metric(metrics.Precision())
+    c.add_metric(metrics.Recall())
+    preds = np.array([0.9, 0.2])
+    labels = np.array([1, 1])
+    c.update(preds, labels)
+    prec, rec = c.eval()
+    assert abs(prec - 1.0) < 1e-9
+    assert abs(rec - 0.5) < 1e-9
+
+
+def test_detection_map_builds_and_runs():
+    x = layers.data('det', [7], dtype='float32')
+    gl = layers.data('gl', [1], dtype='int64')
+    gb = layers.data('gb', [4], dtype='float32')
+    m = metrics.DetectionMAP(x, gl, gb, class_num=3)
+    cur, accum = m.get_map_var()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    # one detection of class 1 exactly on the one class-1 gt → mAP = 1
+    det = np.array([[1, 0.9, 1, 1, 3, 3, 0]], np.float32)
+    cv, av = exe.run(feed={'det': det,
+                           'gl': np.array([[1]], np.int64),
+                           'gb': np.array([[1, 1, 3, 3]], np.float32)},
+                     fetch_list=[cur, accum])
+    np.testing.assert_allclose(cv, [1.0], rtol=1e-5)
+    np.testing.assert_allclose(av, [1.0], rtol=1e-5)
+    # a miss (wrong class) halves the running mean
+    cv, av = exe.run(feed={'det': det,
+                           'gl': np.array([[2]], np.int64),
+                           'gb': np.array([[1, 1, 3, 3]], np.float32)},
+                     fetch_list=[cur, accum])
+    np.testing.assert_allclose(cv, [0.0], atol=1e-6)
+    np.testing.assert_allclose(av, [0.5], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# reader decorators (SURVEY §2.7)
+# ---------------------------------------------------------------------------
+def _range_reader(n):
+    def r():
+        for i in range(n):
+            yield i
+    return r
+
+
+def test_reader_batch_and_drop_last():
+    from paddle_tpu import reader
+    out = list(reader.batch(_range_reader(7), 3)())
+    assert out == [[0, 1, 2], [3, 4, 5], [6]]
+    out = list(reader.batch(_range_reader(7), 3, drop_last=True)())
+    assert out == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_reader_shuffle_preserves_items():
+    from paddle_tpu import reader
+    out = list(reader.shuffle(_range_reader(20), 10)())
+    assert sorted(out) == list(range(20))
+
+
+def test_reader_buffered_and_firstn():
+    from paddle_tpu import reader
+    assert list(reader.buffered(_range_reader(5), 2)()) == list(range(5))
+    assert list(reader.firstn(_range_reader(100), 4)()) == [0, 1, 2, 3]
+
+
+def test_reader_map_chain_compose():
+    from paddle_tpu import reader
+    doubled = list(reader.map_readers(lambda a: a * 2, _range_reader(3))())
+    assert doubled == [0, 2, 4]
+    chained = list(reader.chain(_range_reader(2), _range_reader(2))())
+    assert chained == [0, 1, 0, 1]
+    composed = list(reader.compose(_range_reader(3), _range_reader(3))())
+    assert composed == [(0, 0), (1, 1), (2, 2)]
+
+
+def test_reader_xmap_order():
+    from paddle_tpu import reader
+    out = list(reader.xmap_readers(lambda a: a + 1, _range_reader(8),
+                                   process_num=2, buffer_size=4,
+                                   order=True)())
+    assert out == list(range(1, 9))

@@ -1,0 +1,324 @@
+"""Golden single-step tests for every base optimizer update rule (ref:
+tests/unittests/test_*_op.py per optimizer) plus EMA / ModelAverage /
+Lookahead apply-restore semantics. Each op's update is checked against a
+hand-computed numpy reference on small shapes."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.ops.registry import get_op
+
+RS = np.random.RandomState
+
+
+def _pgl(rng, shape=(3, 4)):
+    p = rng.standard_normal(shape).astype(np.float32)
+    g = rng.standard_normal(shape).astype(np.float32)
+    return p, g, np.float32(0.1)
+
+
+def test_sgd_golden():
+    p, g, lr = _pgl(RS(0))
+    out = np.asarray(get_op('sgd').fn(p, g, lr))
+    np.testing.assert_allclose(out, p - lr * g, rtol=1e-6)
+
+
+def test_momentum_golden():
+    p, g, lr = _pgl(RS(1))
+    v = RS(2).standard_normal(p.shape).astype(np.float32)
+    mu = 0.9
+    pn, vn = get_op('momentum').fn(p, g, v, lr, mu=mu)
+    v_ref = mu * v + g
+    np.testing.assert_allclose(np.asarray(vn), v_ref, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pn), p - lr * v_ref, rtol=1e-6)
+    # nesterov
+    pn2, vn2 = get_op('momentum').fn(p, g, v, lr, mu=mu, use_nesterov=True)
+    np.testing.assert_allclose(np.asarray(pn2), p - lr * (g + mu * v_ref),
+                               rtol=1e-6)
+
+
+def test_adam_golden():
+    rng = RS(3)
+    p, g, lr = _pgl(rng)
+    m1 = np.zeros_like(p)
+    m2 = np.zeros_like(p)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    b1p = np.float32([b1])
+    b2p = np.float32([b2])
+    pn, m1n, m2n, b1n, b2n = get_op('adam').fn(
+        p, g, m1, m2, b1p, b2p, lr, beta1=b1, beta2=b2, epsilon=eps)
+    m1_ref = (1 - b1) * g
+    m2_ref = (1 - b2) * g * g
+    lr_t = lr * np.sqrt(1 - b2p) / (1 - b1p)
+    p_ref = p - lr_t * m1_ref / (np.sqrt(m2_ref) + eps)
+    np.testing.assert_allclose(np.asarray(pn), p_ref, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(b1n), b1p * b1, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(b2n), b2p * b2, rtol=1e-6)
+
+
+def test_adamax_golden():
+    rng = RS(4)
+    p, g, lr = _pgl(rng)
+    m = np.zeros_like(p)
+    inf = np.zeros_like(p)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    b1p = np.float32([b1])
+    pn, mn, infn, _ = get_op('adamax').fn(p, g, m, inf, b1p, lr,
+                                          beta1=b1, beta2=b2, epsilon=eps)
+    m_ref = (1 - b1) * g
+    inf_ref = np.maximum(b2 * inf, np.abs(g))
+    p_ref = p - (lr / (1 - b1p)) * m_ref / (inf_ref + eps)
+    np.testing.assert_allclose(np.asarray(mn), m_ref, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(infn), inf_ref, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pn), p_ref, rtol=1e-5)
+
+
+def test_adagrad_golden():
+    p, g, lr = _pgl(RS(5))
+    mom = np.abs(RS(6).standard_normal(p.shape)).astype(np.float32)
+    eps = 1e-6
+    pn, mn = get_op('adagrad').fn(p, g, mom, lr, epsilon=eps)
+    m_ref = mom + g * g
+    np.testing.assert_allclose(np.asarray(mn), m_ref, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pn),
+                               p - lr * g / (np.sqrt(m_ref) + eps),
+                               rtol=1e-5)
+
+
+def test_decayed_adagrad_golden():
+    p, g, lr = _pgl(RS(7))
+    mom = np.abs(RS(8).standard_normal(p.shape)).astype(np.float32)
+    decay, eps = 0.95, 1e-6
+    pn, mn = get_op('decayed_adagrad').fn(p, g, mom, lr, decay=decay,
+                                          epsilon=eps)
+    m_ref = decay * mom + (1 - decay) * g * g
+    np.testing.assert_allclose(np.asarray(mn), m_ref, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pn),
+                               p - lr * g / (np.sqrt(m_ref) + eps),
+                               rtol=1e-5)
+
+
+def test_rmsprop_golden():
+    p, g, lr = _pgl(RS(9))
+    ms = np.abs(RS(10).standard_normal(p.shape)).astype(np.float32)
+    mom = RS(11).standard_normal(p.shape).astype(np.float32)
+    mg = np.zeros_like(p)
+    rho, eps, mu = 0.95, 1e-6, 0.9
+    pn, msn, momn, _ = get_op('rmsprop').fn(p, g, ms, mom, mg, lr, rho=rho,
+                                            epsilon=eps, momentum=mu)
+    ms_ref = rho * ms + (1 - rho) * g * g
+    mom_ref = mu * mom + lr * g / np.sqrt(ms_ref + eps)
+    np.testing.assert_allclose(np.asarray(msn), ms_ref, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(momn), mom_ref, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pn), p - mom_ref, rtol=1e-5)
+
+
+def test_adadelta_golden():
+    p, g, _ = _pgl(RS(12))
+    asg = np.abs(RS(13).standard_normal(p.shape)).astype(np.float32)
+    asu = np.abs(RS(14).standard_normal(p.shape)).astype(np.float32)
+    rho, eps = 0.95, 1e-6
+    pn, asgn, asun = get_op('adadelta').fn(p, g, asg, asu, rho=rho,
+                                           epsilon=eps)
+    asg_ref = rho * asg + (1 - rho) * g * g
+    upd = np.sqrt(asu + eps) / np.sqrt(asg_ref + eps) * g
+    asu_ref = rho * asu + (1 - rho) * upd * upd
+    np.testing.assert_allclose(np.asarray(asgn), asg_ref, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(asun), asu_ref, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pn), p - upd, rtol=1e-5)
+
+
+def test_ftrl_golden():
+    p, g, lr = _pgl(RS(15))
+    sq = np.abs(RS(16).standard_normal(p.shape)).astype(np.float32)
+    lin = RS(17).standard_normal(p.shape).astype(np.float32)
+    l1, l2, lr_pow = 0.1, 0.2, -0.5
+    pn, sqn, linn = get_op('ftrl').fn(p, g, sq, lin, lr, l1=l1, l2=l2,
+                                      lr_power=lr_pow)
+    new_acc = sq + g * g
+    sigma = (new_acc ** (-lr_pow) - sq ** (-lr_pow)) / lr
+    lin_ref = lin + g - sigma * p
+    x = l1 * np.sign(lin_ref) - lin_ref
+    y = new_acc ** (-lr_pow) / lr + 2 * l2
+    p_ref = np.where(np.abs(lin_ref) > l1, x / y, 0.0)
+    np.testing.assert_allclose(np.asarray(sqn), new_acc, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(linn), lin_ref, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(pn), p_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_lamb_golden():
+    rng = RS(18)
+    p, g, lr = _pgl(rng)
+    m1 = np.zeros_like(p)
+    m2 = np.zeros_like(p)
+    b1, b2, eps, wd = 0.9, 0.999, 1e-6, 0.01
+    b1p = np.float32([b1])
+    b2p = np.float32([b2])
+    pn, m1n, m2n, _, _ = get_op('lamb').fn(
+        p, g, m1, m2, b1p, b2p, lr, weight_decay=wd, beta1=b1, beta2=b2,
+        epsilon=eps)
+    m1_ref = (1 - b1) * g
+    m2_ref = (1 - b2) * g * g
+    m1h = m1_ref / (1 - b1p)
+    m2h = m2_ref / (1 - b2p)
+    r = m1h / (np.sqrt(m2h) + eps) + wd * p
+    pnorm = np.sqrt((p * p).sum())
+    rnorm = np.sqrt((r * r).sum())
+    trust = pnorm / rnorm if pnorm > 0 and rnorm > 0 else 1.0
+    np.testing.assert_allclose(np.asarray(pn), p - lr * trust * r,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_lars_momentum_golden():
+    p, g, lr = _pgl(RS(19))
+    v = RS(20).standard_normal(p.shape).astype(np.float32)
+    mu, coeff, wd = 0.9, 0.001, 0.0005
+    pn, vn = get_op('lars_momentum').fn(p, g, v, lr, mu=mu, lars_coeff=coeff,
+                                        lars_weight_decay=wd)
+    pnorm = np.sqrt((p * p).sum())
+    gnorm = np.sqrt((g * g).sum())
+    local_lr = lr * coeff * pnorm / (gnorm + wd * pnorm)
+    v_ref = mu * v + local_lr * (g + wd * p)
+    np.testing.assert_allclose(np.asarray(vn), v_ref, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pn), p - v_ref, rtol=1e-5)
+
+
+def test_dpsgd_updates_with_clipped_noisy_grad():
+    import jax
+    p, g, lr = _pgl(RS(21))
+    out = np.asarray(get_op('dpsgd').fn(p, g, lr, clip=1.0, batch_size=4.0,
+                                        sigma=0.1, key=jax.random.PRNGKey(0)))
+    assert out.shape == p.shape
+    assert np.abs(out - p).max() > 0
+    # clipped: the applied gradient norm can't exceed clip + noise bound
+    gn = np.sqrt((g * g).sum())
+    applied = (p - out) / lr
+    assert np.sqrt((applied * applied).sum()) < gn + 5.0
+
+
+def test_dgc_momentum_golden_sparsity():
+    p, g, lr = _pgl(RS(22))
+    v = np.zeros_like(p)
+    e = np.zeros_like(p)
+    pn, vn, en = get_op('dgc_momentum').fn(p, g, v, e, lr, mu=0.9,
+                                           sparsity=0.75)
+    # 25% of 12 = 3 entries survive; error feedback keeps the rest
+    acc = e + g
+    k = max(1, int(acc.size * 0.25))
+    thresh = np.sort(np.abs(acc).ravel())[-k]
+    mask = np.abs(acc) >= thresh
+    sparse = acc * mask
+    np.testing.assert_allclose(np.asarray(en), acc - sparse, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(vn), 0.9 * v + sparse, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pn), p - lr * np.asarray(vn),
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# static-graph integration: each optimizer class trains a tiny regression
+# ---------------------------------------------------------------------------
+OPTIMIZER_FACTORIES = [
+    lambda: fluid.optimizer.SGD(learning_rate=0.1),
+    lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9),
+    lambda: fluid.optimizer.LarsMomentum(learning_rate=50.0, momentum=0.5),
+    lambda: fluid.optimizer.Adagrad(learning_rate=0.3),
+    lambda: fluid.optimizer.Adam(learning_rate=0.1),
+    lambda: fluid.optimizer.Adamax(learning_rate=0.1),
+    lambda: fluid.optimizer.DecayedAdagrad(learning_rate=0.3),
+    lambda: fluid.optimizer.Adadelta(learning_rate=1.0),
+    lambda: fluid.optimizer.RMSProp(learning_rate=0.05),
+    lambda: fluid.optimizer.Ftrl(learning_rate=0.5),
+    lambda: fluid.optimizer.Lamb(learning_rate=0.1),
+    lambda: fluid.optimizer.Dpsgd(learning_rate=0.05, clip=100.0, sigma=0.0),
+]
+
+
+@pytest.mark.parametrize('factory', OPTIMIZER_FACTORIES,
+                         ids=lambda f: type(f()).__name__)
+def test_optimizer_trains_static(factory):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        fluid.framework.manual_seed(0)
+        x = layers.data('x', [4], dtype='float32')
+        y = layers.data('y', [1], dtype='float32')
+        pred = layers.fc(x, size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        factory().minimize(loss)
+    exe = fluid.Executor()
+    exe.run(start)
+    rng = RS(0)
+    w = rng.standard_normal((4, 1)).astype(np.float32)
+    losses = []
+    for _ in range(100):
+        xv = rng.standard_normal((16, 4)).astype(np.float32)
+        l, = exe.run(main, feed={'x': xv, 'y': xv @ w}, fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(())[()]))
+    assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+
+# ---------------------------------------------------------------------------
+# EMA / ModelAverage / Lookahead (dygraph apply/restore semantics)
+# ---------------------------------------------------------------------------
+def test_exponential_moving_average_apply_restore():
+    from paddle_tpu import dygraph
+    import jax.numpy as jnp
+    with dygraph.guard():
+        fc = dygraph.nn.Linear(3, 2)
+        ema = fluid.optimizer.ExponentialMovingAverage(decay=0.5)
+        params = list(fc.parameters())
+        orig = [np.asarray(p.value).copy() for p in params]
+        ema.update(params)
+        for p in params:
+            p.value = p.value + 1.0
+        moved = [np.asarray(p.value).copy() for p in params]
+        ema.update(params)
+        ema.apply(params)
+        for p, o, m in zip(params, orig, moved):
+            cur = np.asarray(p.value)
+            assert not np.allclose(cur, m)     # averaged, not last value
+        ema.restore(params)
+        for p, m in zip(params, moved):
+            np.testing.assert_allclose(np.asarray(p.value), m, rtol=1e-6)
+
+
+def test_model_average_apply_restore():
+    from paddle_tpu import dygraph
+    with dygraph.guard():
+        fc = dygraph.nn.Linear(3, 2)
+        ma = fluid.optimizer.ModelAverage(0.15)
+        params = list(fc.parameters())
+        v0 = [np.asarray(p.value).copy() for p in params]
+        ma.accumulate(params)
+        for p in params:
+            p.value = p.value + 2.0
+        v1 = [np.asarray(p.value).copy() for p in params]
+        ma.accumulate(params)
+        ma.apply_params(params)
+        for p, a, b in zip(params, v0, v1):
+            np.testing.assert_allclose(np.asarray(p.value), (a + b) / 2,
+                                       rtol=1e-5)
+        ma.restore_params(params)
+        for p, b in zip(params, v1):
+            np.testing.assert_allclose(np.asarray(p.value), b, rtol=1e-6)
+
+
+def test_lookahead_slow_weights():
+    from paddle_tpu import dygraph
+    with dygraph.guard():
+        fc = dygraph.nn.Linear(2, 1)
+        inner = fluid.optimizer.SGD(learning_rate=0.1,
+                                    parameter_list=fc.parameters())
+        look = fluid.optimizer.LookaheadOptimizer(inner, alpha=0.5, k=2)
+        x = dygraph.to_variable(np.ones((4, 2), np.float32))
+        w0 = np.asarray(fc.parameters()[0].value).copy()
+        for i in range(2):
+            out = fc(x)
+            loss = layers.reduce_mean(out)
+            loss.backward()
+            look.minimize(loss, parameter_list=fc.parameters())
+            inner.clear_gradients()
+        # after k=2 steps, params are slow weights: w0 + alpha*(fast - w0)
+        w_now = np.asarray(fc.parameters()[0].value)
+        assert not np.allclose(w_now, w0)
