@@ -1,2 +1,5 @@
 """contrib namespace (ref: python/paddle/fluid/contrib/)."""
 from . import mixed_precision
+from . import memory_usage_calc
+from .memory_usage_calc import (memory_usage, device_memory_stats,
+                                print_memory_report)

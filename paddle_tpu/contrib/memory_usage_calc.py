@@ -1,0 +1,84 @@
+"""Memory introspection (SURVEY §2.11).
+
+- memory_usage(program, batch_size): static estimate from the program's var
+  shapes — parity with ref python/paddle/fluid/contrib/memory_usage_calc.py:46
+  (same (lower, upper, unit) contract).
+- device_memory_stats(): LIVE HBM arena report from jax.Device.memory_stats()
+  — the TPU replacement for the reference's allocator counters
+  (paddle/fluid/memory/allocation/*).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Program
+
+_DTYPE_BYTES = {
+    'float16': 2, 'bfloat16': 2, 'float32': 4, 'float64': 8,
+    'int8': 1, 'uint8': 1, 'int16': 2, 'int32': 4, 'int64': 8, 'bool': 1,
+}
+
+# upper bound factor for activation workspace / fragmentation — mirrors the
+# reference's two-sided estimate rather than claiming exactness
+_UPPER_FACTOR = 1.7
+
+
+def memory_usage(program, batch_size):
+    """Estimate (lower, upper, unit) memory usage of `program` at
+    `batch_size` (ref memory_usage_calc.py:46). -1/None dims are read as the
+    batch dim and replaced by batch_size."""
+    if not isinstance(program, Program):
+        raise TypeError(
+            "Calculating Memory Usage requires Program as its Parameter. "
+            "But you passed in %s" % type(program))
+    if batch_size <= 0:
+        raise ValueError("The batch size need to be positive.")
+
+    total = 0.0
+    for var in program.list_vars():
+        shape = var.shape
+        if shape is None:
+            continue
+        numel = 1
+        for s in shape:
+            numel *= batch_size if s in (-1, None) else int(s)
+        total += numel * _DTYPE_BYTES.get(str(var.dtype), 4)
+
+    lower, upper = total, total * _UPPER_FACTOR
+    for unit in ('B', 'KB', 'MB', 'GB'):
+        if upper < 1024 or unit == 'GB':
+            return lower, upper, unit
+        lower /= 1024.0
+        upper /= 1024.0
+
+
+def device_memory_stats(device=None):
+    """Live HBM stats per device: {device: {bytes_in_use, peak_bytes_in_use,
+    bytes_limit, ...}}. Returns {} for backends without allocator stats
+    (e.g. the CPU test mesh)."""
+    import jax
+    devices = [device] if device is not None else jax.devices()
+    report = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            report[str(d)] = dict(stats)
+    return report
+
+
+def print_memory_report():
+    """Human-readable HBM live-arena report (one line per device)."""
+    report = device_memory_stats()
+    if not report:
+        print("[paddle_tpu.memory] no allocator stats on this backend")
+        return report
+    for dev, st in report.items():
+        in_use = st.get('bytes_in_use', 0) / 2**20
+        peak = st.get('peak_bytes_in_use', 0) / 2**20
+        limit = st.get('bytes_limit', 0) / 2**20
+        print(f"[paddle_tpu.memory] {dev}: in_use={in_use:.1f}MB "
+              f"peak={peak:.1f}MB limit={limit:.1f}MB")
+    return report

@@ -1,0 +1,78 @@
+"""Program debugger: graphviz drawing + pretty printer.
+
+Parity with reference python/paddle/fluid/debugger.py — draw_block_graphviz
+(:229) emits a .dot file of the op/var graph; pprint_program_codes (:112)
+renders the program as readable pseudo-code. No graphviz binary required:
+the .dot text is self-contained (render with `dot -Tpng` or any viewer).
+"""
+from __future__ import annotations
+
+from .framework import BACKWARD_OP_TYPE, Parameter, Program
+
+__all__ = ['draw_block_graphviz', 'pprint_program_codes', 'pprint_block_codes']
+
+
+def _esc(s):
+    return str(s).replace('"', '\\"')
+
+
+def draw_block_graphviz(block, highlights=None, path='./temp.dot'):
+    """Write a graphviz dot file for `block` (ref debugger.py:229): ellipse
+    nodes for vars (bold for Parameters), box nodes for ops, edges for
+    dataflow. `highlights` is an iterable of var names drawn filled red."""
+    highlights = set(highlights or ())
+    lines = ['digraph G {', '  rankdir=TB;']
+    var_ids, op_ids = {}, {}
+    for i, (name, var) in enumerate(sorted(block.vars.items())):
+        var_ids[name] = f'var_{i}'
+        style = 'style=filled, fillcolor=red,' if name in highlights else (
+            'style=bold,' if isinstance(var, Parameter) else '')
+        shape = getattr(var, 'shape', None)
+        lines.append(
+            f'  var_{i} [shape=ellipse, {style} '
+            f'label="{_esc(name)}\\n{_esc(shape)}"];')
+    for j, op in enumerate(block.ops):
+        op_ids[j] = f'op_{j}'
+        color = 'fillcolor=lightblue, style=filled' \
+            if op.type != BACKWARD_OP_TYPE else \
+            'fillcolor=orange, style=filled'
+        lines.append(f'  op_{j} [shape=box, {color}, '
+                     f'label="{_esc(op.type)}"];')
+        for n in op.input_names():
+            if n in var_ids:
+                lines.append(f'  {var_ids[n]} -> op_{j};')
+        for n in op.output_names():
+            if n in var_ids:
+                lines.append(f'  op_{j} -> {var_ids[n]};')
+    lines.append('}')
+    text = '\n'.join(lines)
+    with open(path, 'w') as f:
+        f.write(text)
+    return text
+
+
+def pprint_block_codes(block, show_backward=True):
+    """Readable pseudo-code for one block (ref debugger.py:112)."""
+    out = [f"# block {block.idx} (parent {block.parent_idx})"]
+    for name, var in sorted(block.vars.items()):
+        kind = 'param' if isinstance(var, Parameter) else (
+            'data' if var.is_data else 'var')
+        out.append(f"{kind} {name}: {var.dtype}{list(var.shape or [])}"
+                   f"{' persistable' if var.persistable else ''}")
+    for op in block.ops:
+        if not show_backward and op.type == BACKWARD_OP_TYPE:
+            continue
+        outs = ', '.join(op.output_names()) or '_'
+        ins = ', '.join(op.input_names())
+        attrs = {k: v for k, v in op.attrs.items() if k != 'initializer'}
+        out.append(f"{outs} = {op.type}({ins})"
+                   f"{'  # ' + repr(attrs) if attrs else ''}")
+    return '\n'.join(out)
+
+
+def pprint_program_codes(program, show_backward=True):
+    assert isinstance(program, Program)
+    text = '\n\n'.join(pprint_block_codes(b, show_backward)
+                       for b in program.blocks)
+    print(text)
+    return text

@@ -293,19 +293,19 @@ class Program:
         target_names = {t.name if isinstance(t, Variable) else t for t in targets}
         blk = self.global_block()
         needed = set(target_names)
-        kept = []
-        for op in reversed(blk.ops):
+        kept_idx = set()
+        for i in range(len(blk.ops) - 1, -1, -1):
+            op = blk.ops[i]
             if op.type == BACKWARD_OP_TYPE:
                 continue
             if set(op.output_names()) & needed:
-                kept.append(op)
+                kept_idx.add(i)
                 needed |= set(op.input_names())
-        kept.reverse()
         p = self.clone()
         nb = p.global_block()
-        keep_keys = {(op.type, tuple(sorted(op.output_names()))) for op in kept}
-        nb.ops = [op for op in nb.ops
-                  if (op.type, tuple(sorted(op.output_names()))) in keep_keys]
+        # clone() preserves op order 1:1, so positional indices identify the
+        # kept ops exactly (keying by (type, outputs) aliased reassignments)
+        nb.ops = [op for i, op in enumerate(nb.ops) if i in kept_idx]
         # drop vars not referenced
         used = set()
         for op in nb.ops:

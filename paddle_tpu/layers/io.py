@@ -5,7 +5,7 @@ from __future__ import annotations
 from ..core.dtypes import convert_dtype
 from ..framework import default_main_program, default_startup_program
 
-__all__ = ['data']
+__all__ = ['data', 'read_file', 'double_buffer', 'py_reader', 'load']
 
 
 def data(name, shape, dtype='float32', lod_level=0, append_batch_size=True,
@@ -25,3 +25,57 @@ def fluid_data(name, shape, dtype='float32', lod_level=0):
     """fluid.data parity: shape used as-is (may contain None/-1)."""
     shape = [-1 if s is None else s for s in shape]
     return data(name, shape, dtype, lod_level, append_batch_size=False)
+
+
+def read_file(reader):
+    """ref: fluid.layers.io.read_file (io.py:827): with DataLoader-backed
+    readers the feed vars ARE the read results — return them."""
+    for attr in ('_feed_vars', '_feed_list'):
+        vars_ = getattr(reader, attr, None)
+        if vars_ is not None:
+            return vars_
+    raise TypeError(
+        f"read_file expects a py_reader/DataLoader with feed vars, got "
+        f"{type(reader).__name__}")
+
+
+def double_buffer(reader, place=None, name=None):
+    """ref: fluid.layers.io.double_buffer (io.py:549). The DataLoader's
+    background device_put ring already double-buffers host→HBM; this is the
+    identity on TPU."""
+    return reader
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """ref: fluid.layers.io.py_reader (io.py:549) — thin shim over
+    DataLoader.from_generator: returns an object with decorate_* methods,
+    start()/reset(), and feed vars recoverable via read_file()."""
+    from ..core import unique_name
+    from ..reader import DataLoader
+
+    base = name or unique_name.generate('_py_reader')
+    feed_vars = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        full = [-1 if s is None else int(s) for s in shape]
+        feed_vars.append(data(f"{base}_{i}", full, dtype=dtype,
+                              append_batch_size=False))
+    loader = DataLoader.from_generator(feed_list=feed_vars,
+                                       capacity=capacity,
+                                       use_double_buffer=use_double_buffer)
+    loader._feed_vars = feed_vars
+    return loader
+
+
+def load(out, file_path, load_as_fp16=False):
+    """ref: fluid.layers.io.load — load one saved var into `out`'s slot."""
+    import os
+    import numpy as np
+    from ..core.scope import global_scope
+    arr = np.load(file_path if file_path.endswith('.npy')
+                  else file_path + '.npy', allow_pickle=False)
+    if load_as_fp16:
+        arr = arr.astype(np.float16)
+    import jax.numpy as jnp
+    global_scope().set(out.name, jnp.asarray(arr))
+    return out

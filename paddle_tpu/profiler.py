@@ -26,6 +26,31 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
     jax.profiler.stop_trace()
     print(f"[paddle_tpu.profiler] trace written to {_trace_dir} "
           f"(open with TensorBoard or ui.perfetto.dev)")
+    if _op_times:
+        print(summary_table(sorted_key))
+        _op_times.clear()     # per-session table, like the reference
+
+
+def summary_table(sorted_key=None):
+    """Per-event summary like the reference's profiler table
+    (ref python/paddle/fluid/profiler.py:196 — Event/Calls/Total/Min/Max/Ave
+    sorted by `sorted_key` in {'calls','total','max','min','ave'})."""
+    rows = []
+    for name, ts in _op_times.items():
+        n = len(ts)
+        tot = sum(ts)
+        rows.append((name, n, tot, min(ts), max(ts), tot / n))
+    key_idx = {'calls': 1, 'total': 2, 'min': 3, 'max': 4, 'ave': 5}
+    if sorted_key in key_idx:
+        rows.sort(key=lambda r: -r[key_idx[sorted_key]])
+    head = f"{'Event':<32}{'Calls':>8}{'Total(ms)':>12}" \
+           f"{'Min(ms)':>10}{'Max(ms)':>10}{'Ave(ms)':>10}"
+    lines = ['-' * len(head), head, '-' * len(head)]
+    for name, n, tot, mn, mx, ave in rows:
+        lines.append(f"{name[:32]:<32}{n:>8}{tot * 1e3:>12.3f}"
+                     f"{mn * 1e3:>10.3f}{mx * 1e3:>10.3f}{ave * 1e3:>10.3f}")
+    lines.append('-' * len(head))
+    return '\n'.join(lines)
 
 
 @contextlib.contextmanager

@@ -215,6 +215,13 @@ class _GeneratorLoader:
         return self
 
     # -- iteration: background prefetch of device arrays --
+    # py_reader-era method names (ref layers/io.py:549 decorate_*)
+    decorate_sample_generator = set_sample_generator
+    decorate_sample_list_generator = set_sample_list_generator
+    decorate_batch_generator = set_batch_generator
+    decorate_tensor_provider = set_batch_generator
+    decorate_paddle_reader = set_sample_list_generator
+
     def __iter__(self):
         q = queue.Queue(maxsize=self._capacity)
         end = object()

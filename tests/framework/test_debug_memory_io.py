@@ -1,0 +1,137 @@
+"""Aux subsystems (SURVEY §2.11): memory_usage estimate, HBM stats report,
+graphviz program debugger, profiler per-op table, Program._prune index
+keying, and the legacy reader shims."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_memory_usage_estimate():
+    from paddle_tpu.contrib.memory_usage_calc import memory_usage
+    x = layers.data('x', [128], dtype='float32')
+    y = layers.fc(x, size=256)
+    lower, upper, unit = memory_usage(fluid.default_main_program(),
+                                      batch_size=32)
+    assert unit in ('B', 'KB', 'MB', 'GB')
+    assert 0 < lower <= upper
+    # weight (128x256) + bias + x/y at bs=32: > 128KB worth of fp32
+    lo2, up2, unit2 = memory_usage(fluid.default_main_program(),
+                                   batch_size=64)
+    # bigger batch → bigger estimate (compare in bytes)
+    scale = {'B': 1, 'KB': 2**10, 'MB': 2**20, 'GB': 2**30}
+    assert lo2 * scale[unit2] > lower * scale[unit]
+    with pytest.raises(ValueError):
+        memory_usage(fluid.default_main_program(), batch_size=0)
+    with pytest.raises(TypeError):
+        memory_usage('not a program', batch_size=4)
+
+
+def test_device_memory_stats_shape():
+    from paddle_tpu.contrib.memory_usage_calc import (device_memory_stats,
+                                                      print_memory_report)
+    report = device_memory_stats()
+    assert isinstance(report, dict)     # may be {} on the CPU test backend
+    print_memory_report()
+
+
+def test_draw_block_graphviz(tmp_path):
+    from paddle_tpu.debugger import draw_block_graphviz
+    x = layers.data('x', [4], dtype='float32')
+    y = layers.fc(x, size=2)
+    loss = layers.reduce_mean(y)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    path = str(tmp_path / 'g.dot')
+    text = draw_block_graphviz(fluid.default_main_program().global_block(),
+                               highlights=[loss.name], path=path)
+    assert os.path.exists(path)
+    assert text.startswith('digraph G {') and text.rstrip().endswith('}')
+    assert 'fillcolor=red' in text          # highlighted loss var
+    assert 'shape=box' in text and '->' in text
+
+
+def test_pprint_program_codes(capsys):
+    from paddle_tpu.debugger import pprint_program_codes
+    x = layers.data('x', [4], dtype='float32')
+    y = layers.scale(x, scale=2.0)
+    text = pprint_program_codes(fluid.default_main_program())
+    assert 'scale(' in text and 'data x' in text
+
+
+def test_profiler_summary_table():
+    import time
+    from paddle_tpu import profiler
+    profiler.reset_profiler()
+    with profiler.record_event('fast'):
+        time.sleep(0.001)
+    for _ in range(3):
+        with profiler.record_event('slow'):
+            time.sleep(0.003)
+    table = profiler.summary_table(sorted_key='total')
+    lines = [l for l in table.splitlines() if l and not l.startswith('-')]
+    assert lines[0].startswith('Event')
+    # 'slow' has the larger total → first data row
+    assert lines[1].split()[0] == 'slow'
+    assert int(lines[1].split()[1]) == 3     # calls
+    counts = profiler.get_op_times()
+    assert counts['slow'][0] == 3
+
+
+def test_prune_keeps_ops_by_index_not_signature():
+    """Regression for the (type, outputs) aliasing: a later same-type op
+    rewriting the same var must not survive pruning when it is dead."""
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data('x', [4], dtype='float32')
+        blk = main.global_block()
+        a = blk.create_var(name='a', shape=[-1, 4], dtype='float32')
+        b = blk.create_var(name='b', shape=[-1, 4], dtype='float32')
+        blk.append_op('scale', inputs={'x': 'x'}, outputs={'Out': 'a'},
+                      attrs={'scale': 2.0})
+        blk.append_op('scale', inputs={'x': 'a'}, outputs={'Out': 'b'},
+                      attrs={'scale': 3.0})
+        # dead reassignment of 'a' AFTER b is computed — same (type, outputs)
+        blk.append_op('scale', inputs={'x': 'x'}, outputs={'Out': 'a'},
+                      attrs={'scale': 100.0})
+    pruned = main._prune(['b'])
+    kept = pruned.global_block().ops
+    assert len(kept) == 2, [repr(o) for o in kept]
+    assert [o.attrs['scale'] for o in kept] == [2.0, 3.0]
+
+
+def test_py_reader_shim_roundtrip():
+    cap = 4
+    r = layers.io.py_reader(capacity=cap, shapes=[(-1, 3), (-1, 1)],
+                            dtypes=['float32', 'int64'], name='pr')
+    feed_vars = layers.io.read_file(r)
+    assert len(feed_vars) == 2
+    y = layers.scale(feed_vars[0], scale=2.0)
+    exe = fluid.Executor()
+
+    def gen():
+        for i in range(3):
+            yield (np.full((2, 3), i, np.float32),
+                   np.zeros((2, 1), np.int64))
+
+    r.decorate_batch_generator(gen)
+    seen = []
+    for feed in r():          # loader yields feed dicts keyed by var name
+        out, = exe.run(feed=feed, fetch_list=[y])
+        seen.append(float(out[0, 0]))
+    assert seen == [0.0, 2.0, 4.0]
+
+
+def test_double_buffer_identity_and_load(tmp_path):
+    r = object()
+    assert layers.io.double_buffer(r) is r
+    x = layers.data('xl', [3], dtype='float32')
+    v = fluid.default_main_program().global_block().create_var(
+        name='loaded_w', shape=[3], dtype='float32', persistable=True)
+    arr = np.arange(3, dtype=np.float32)
+    np.save(str(tmp_path / 'w.npy'), arr)
+    layers.io.load(v, str(tmp_path / 'w'))
+    np.testing.assert_allclose(
+        np.asarray(fluid.global_scope().find('loaded_w')), arr)
