@@ -1,8 +1,15 @@
-"""Benchmark driver: ResNet-50 fwd+bwd+update images/sec/chip (bf16 compute).
+"""Benchmark driver: ResNet-50 fwd+bwd+update images/sec/chip (bf16 compute)
+plus BERT-base pretrain seq/s and MFU for both (SURVEY §5 metrics).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu",
+"bert_base_seq_per_sec", "bert_mfu", "chip", ...}.
 Baseline (BASELINE.json north star): CUDA V100 ResNet-50 ≈ 383 img/s fp32
 (PaddlePaddle's published reference-class number for the 1.x benchmark suite).
+
+MFU = delivered FLOP/s ÷ chip peak bf16 FLOP/s, with analytic model FLOPs:
+- ResNet-50 @224: ≈ 4.09 GFLOP fwd/img (2×MACs) → ×3 for fwd+bwd ≈ 12.3 GF.
+- BERT: 6·P FLOP per token (P = non-embedding params, train fwd+bwd)
+  + 12·L·h·S per token of attention score/context work (see PERF.md).
 """
 from __future__ import annotations
 
@@ -14,9 +21,24 @@ import time
 import numpy as np
 
 V100_BASELINE_IMG_S = 383.0
+RESNET50_TRAIN_GFLOP_PER_IMG = 12.3
+
+# chip peak bf16 TFLOP/s by device_kind substring (dense, no sparsity)
+_CHIP_PEAK_TFLOPS = [
+    ('v6', 918.0), ('v5p', 459.0), ('v5 lite', 197.0), ('v5e', 197.0),
+    ('v4', 275.0), ('v3', 123.0), ('v2', 45.0),
+]
 
 
-def main():
+def chip_peak_tflops(device):
+    kind = getattr(device, 'device_kind', '').lower()
+    for sub, peak in _CHIP_PEAK_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def bench_resnet(on_tpu):
     import jax
     import jax.numpy as jnp
 
@@ -26,13 +48,15 @@ def main():
     from paddle_tpu.dygraph.jit import TrainStep
     from paddle_tpu.dygraph.tape import dispatch_op
 
-    on_tpu = jax.default_backend() != 'cpu'
-    batch = 256 if on_tpu else 8
+    batch = 128 if on_tpu else 8
     img = 224 if on_tpu else 32
     iters = 20 if on_tpu else 3
+    # NHWC on TPU: convs lower without layout transposes — measured ~6%
+    # faster end-to-end than NCHW on v5e (PERF.md §2)
+    fmt = 'NHWC' if on_tpu else 'NCHW'
 
     with dygraph.guard():
-        model = ResNet50(class_dim=1000)
+        model = ResNet50(class_dim=1000, data_format=fmt)
         opt = fluid.optimizer.Momentum(0.1, momentum=0.9,
                                        parameter_list=model.parameters())
 
@@ -47,8 +71,9 @@ def main():
         # stay fp32 across steps so the fused step compiles exactly once
         step = TrainStep(model, loss_fn, opt,
                          amp_dtype=jnp.bfloat16 if on_tpu else None)
-        dtype = np.float32
-        x = np.random.randn(batch, 3, img, img).astype(dtype)
+        xshape = (batch, 3, img, img) if fmt == 'NCHW' \
+            else (batch, img, img, 3)
+        x = np.random.randn(*xshape).astype(np.float32)
         y = np.random.randint(0, 1000, (batch, 1)).astype(np.int64)
         if on_tpu:
             x = jnp.asarray(x, jnp.bfloat16)
@@ -63,13 +88,93 @@ def main():
             l = step(x, y)
         float(l)
         dt = time.perf_counter() - t0
-        img_per_sec = batch * iters / dt
+    return batch * iters / dt
+
+
+def bench_bert(on_tpu):
+    """BERT-base MLM+NSP pretrain step, bf16, XLA attention —
+    sequences/sec on one chip (SURVEY §5 'BERT-base seq/s')."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import dygraph
+    from paddle_tpu.dygraph.jit import TrainStep
+    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                        pretrain_loss)
+
+    if on_tpu:
+        # XLA attention, not the pallas flash path: measured faster at
+        # S=128 on v5e (PERF.md §3 — scores fit on-chip at this size)
+        cfg = BertConfig(attention_probs_dropout_prob=0.0,
+                         hidden_dropout_prob=0.0,
+                         max_position_embeddings=128)
+        batch, seq, iters = 64, 128, 20
+    else:
+        cfg = BertConfig.tiny()
+        batch, seq, iters = 4, 32, 2
+
+    with dygraph.guard():
+        model = BertForPretraining(cfg)
+        opt = fluid.optimizer.Adam(1e-4, parameter_list=model.parameters())
+
+        def loss_fn(m, ids, tt, mlm, nsp):
+            return pretrain_loss(m, ids, tt, mlm, nsp)
+
+        step = TrainStep(model, loss_fn, opt,
+                         amp_dtype=jnp.bfloat16 if on_tpu else None)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+        tt = np.zeros((batch, seq), np.int64)
+        mlm = np.where(rng.rand(batch, seq) < 0.15,
+                       rng.randint(0, cfg.vocab_size, (batch, seq)),
+                       -1).astype(np.int64)
+        nsp = rng.randint(0, 2, (batch, 1)).astype(np.int64)
+
+        l = step(ids, tt, mlm, nsp)
+        float(l)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            l = step(ids, tt, mlm, nsp)
+        float(l)
+        dt = time.perf_counter() - t0
+
+    seq_per_sec = batch * iters / dt
+    # analytic train FLOPs/seq (fwd+bwd = 3× fwd, 2 FLOPs per MAC):
+    #   block matmuls: 6 · 12·L·h²  per token  (QKVO 4h² + FFN 8h²)
+    #   attention scores+context: 12·L·h·S per token (QKᵀ and PV, 2·S²·h
+    #   each per layer fwd)
+    #   MLM head: 6·h·V per token
+    h, L, V = cfg.hidden_size, cfg.num_hidden_layers, cfg.vocab_size
+    flops_per_seq = seq * (72.0 * L * h * h + 12.0 * L * h * seq
+                           + 6.0 * h * V)
+    return seq_per_sec, flops_per_seq
+
+
+def main():
+    import jax
+    on_tpu = jax.default_backend() != 'cpu'
+    dev = jax.devices()[0]
+    peak = chip_peak_tflops(dev) if on_tpu else None
+
+    img_per_sec = bench_resnet(on_tpu)
+    resnet_mfu = (img_per_sec * RESNET50_TRAIN_GFLOP_PER_IMG / 1e3
+                  / peak) if peak else None
+
+    bert_seq_s, bert_flops_per_seq = bench_bert(on_tpu)
+    bert_mfu = (bert_seq_s * bert_flops_per_seq / 1e12 / peak) \
+        if peak else None
 
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_per_sec / V100_BASELINE_IMG_S, 3),
+        "mfu": round(resnet_mfu, 4) if resnet_mfu else None,
+        "bert_base_seq_per_sec": round(bert_seq_s, 2),
+        "bert_mfu": round(bert_mfu, 4) if bert_mfu else None,
+        "chip": getattr(dev, 'device_kind', str(dev)),
+        "chip_peak_bf16_tflops": peak,
     }))
 
 

@@ -18,18 +18,25 @@ from .tape import Tensor, dispatch_op
 class Conv2D(Layer):
     def __init__(self, num_channels, num_filters, filter_size, stride=1,
                  padding=0, dilation=1, groups=1, param_attr=None,
-                 bias_attr=None, use_cudnn=True, act=None, dtype='float32'):
+                 bias_attr=None, use_cudnn=True, act=None, dtype='float32',
+                 data_format='NCHW'):
         super().__init__()
         fs = filter_size if isinstance(filter_size, (list, tuple)) \
             else (filter_size, filter_size)
         std = math.sqrt(2.0 / (fs[0] * fs[1] * num_channels))
+        # NHWC keeps HWIO weights so the conv lowers with no layout
+        # transposes (PERF.md §2: NHWC end-to-end is ~6% faster on v5e)
+        wshape = ([num_filters, num_channels // groups, fs[0], fs[1]]
+                  if data_format == 'NCHW'
+                  else [fs[0], fs[1], num_channels // groups, num_filters])
         self.weight = self.create_parameter(
-            [num_filters, num_channels // groups, fs[0], fs[1]],
-            param_attr, dtype, default_initializer=NormalInitializer(0.0, std))
+            wshape, param_attr, dtype,
+            default_initializer=NormalInitializer(0.0, std))
         self.bias = self.create_parameter([num_filters], bias_attr, dtype,
                                           is_bias=True)
         self._attrs = dict(stride=stride, padding=padding, dilation=dilation,
-                           groups=groups)
+                           groups=groups, data_format=data_format)
+        self._bias_axis = 1 if data_format == 'NCHW' else -1
         self._act = act
 
     def forward(self, x):
@@ -37,7 +44,8 @@ class Conv2D(Layer):
                           self._attrs)
         if self.bias is not None:
             out = dispatch_op('elementwise_add',
-                              {'x': out, 'y': self.bias}, {'axis': 1})
+                              {'x': out, 'y': self.bias},
+                              {'axis': self._bias_axis})
         if self._act:
             out = dispatch_op(self._act, {'x': out}, {})
         return out

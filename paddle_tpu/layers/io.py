@@ -30,13 +30,12 @@ def fluid_data(name, shape, dtype='float32', lod_level=0):
 def read_file(reader):
     """ref: fluid.layers.io.read_file (io.py:827): with DataLoader-backed
     readers the feed vars ARE the read results — return them."""
-    for attr in ('_feed_vars', '_feed_list'):
-        vars_ = getattr(reader, attr, None)
-        if vars_ is not None:
-            return vars_
-    raise TypeError(
-        f"read_file expects a py_reader/DataLoader with feed vars, got "
-        f"{type(reader).__name__}")
+    vars_ = getattr(reader, '_feed_list', None)
+    if not vars_:
+        raise TypeError(
+            f"read_file expects a py_reader/DataLoader created with a "
+            f"feed list, got {type(reader).__name__}")
+    return vars_
 
 
 def double_buffer(reader, place=None, name=None):
@@ -60,16 +59,13 @@ def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
         full = [-1 if s is None else int(s) for s in shape]
         feed_vars.append(data(f"{base}_{i}", full, dtype=dtype,
                               append_batch_size=False))
-    loader = DataLoader.from_generator(feed_list=feed_vars,
-                                       capacity=capacity,
-                                       use_double_buffer=use_double_buffer)
-    loader._feed_vars = feed_vars
-    return loader
+    return DataLoader.from_generator(feed_list=feed_vars,
+                                     capacity=capacity,
+                                     use_double_buffer=use_double_buffer)
 
 
 def load(out, file_path, load_as_fp16=False):
     """ref: fluid.layers.io.load — load one saved var into `out`'s slot."""
-    import os
     import numpy as np
     from ..core.scope import global_scope
     arr = np.load(file_path if file_path.endswith('.npy')

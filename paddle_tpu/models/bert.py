@@ -25,7 +25,7 @@ class BertConfig:
                  intermediate_size=3072, hidden_act='gelu',
                  hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
                  max_position_embeddings=512, type_vocab_size=2,
-                 initializer_range=0.02):
+                 initializer_range=0.02, use_fused_attention=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -37,6 +37,9 @@ class BertConfig:
         self.max_position_embeddings = max_position_embeddings
         self.type_vocab_size = type_vocab_size
         self.initializer_range = initializer_range
+        # lower attention to the fused op (pallas flash kernel on TPU);
+        # bypasses attention-prob dropout, so use for p_drop=0 or eval
+        self.use_fused_attention = use_fused_attention
 
     @staticmethod
     def base():
@@ -72,6 +75,7 @@ class MultiHeadAttention(Layer):
                             dropout_implementation='upscale_in_train')
         self.n_heads = cfg.num_attention_heads
         self.d_head = h // cfg.num_attention_heads
+        self._fused = cfg.use_fused_attention
 
     def forward(self, x, attn_bias=None):
         b, s, h = x.shape
@@ -84,14 +88,21 @@ class MultiHeadAttention(Layer):
         q = heads(self.q(x))
         k = heads(self.k(x))
         v = heads(self.v(x))
-        scores = dispatch_op('matmul', {'x': q, 'y': k},
-                             {'transpose_y': True,
-                              'alpha': 1.0 / math.sqrt(self.d_head)})
-        if attn_bias is not None:
-            scores = scores + attn_bias
-        probs = dispatch_op('softmax', {'x': scores}, {})
-        probs = self.drop(probs)
-        ctx = dispatch_op('matmul', {'x': probs, 'y': v}, {})
+        if self._fused:
+            # one fused kernel (ops/nn_ops.py:fused_attention — pallas
+            # flash attention on TPU); attention-prob dropout is skipped
+            ctx = dispatch_op('fused_attention',
+                              {'q': q, 'k': k, 'v': v, 'bias': attn_bias},
+                              {'sm_scale': 1.0 / math.sqrt(self.d_head)})
+        else:
+            scores = dispatch_op('matmul', {'x': q, 'y': k},
+                                 {'transpose_y': True,
+                                  'alpha': 1.0 / math.sqrt(self.d_head)})
+            if attn_bias is not None:
+                scores = scores + attn_bias
+            probs = dispatch_op('softmax', {'x': scores}, {})
+            probs = self.drop(probs)
+            ctx = dispatch_op('matmul', {'x': probs, 'y': v}, {})
         ctx = dispatch_op('transpose', {'x': ctx}, {'perm': [0, 2, 1, 3]})
         ctx = dispatch_op('reshape', {'x': ctx}, {'shape': [b, s, h]})
         return self.out(ctx)

@@ -17,12 +17,13 @@ import math
 
 class ConvBNLayer(Layer):
     def __init__(self, num_channels, num_filters, filter_size, stride=1,
-                 groups=1, act=None):
+                 groups=1, act=None, data_format='NCHW'):
         super().__init__()
         self._conv = Conv2D(num_channels, num_filters, filter_size,
                             stride=stride, padding=(filter_size - 1) // 2,
-                            groups=groups, bias_attr=False)
-        self._bn = BatchNorm(num_filters, act=None)
+                            groups=groups, bias_attr=False,
+                            data_format=data_format)
+        self._bn = BatchNorm(num_filters, act=None, data_layout=data_format)
         self._act = act
 
     def forward(self, x):
@@ -33,15 +34,19 @@ class ConvBNLayer(Layer):
 
 
 class BottleneckBlock(Layer):
-    def __init__(self, num_channels, num_filters, stride, shortcut=True):
+    def __init__(self, num_channels, num_filters, stride, shortcut=True,
+                 data_format='NCHW'):
         super().__init__()
-        self.conv0 = ConvBNLayer(num_channels, num_filters, 1, act='relu')
+        df = data_format
+        self.conv0 = ConvBNLayer(num_channels, num_filters, 1, act='relu',
+                                 data_format=df)
         self.conv1 = ConvBNLayer(num_filters, num_filters, 3, stride=stride,
-                                 act='relu')
-        self.conv2 = ConvBNLayer(num_filters, num_filters * 4, 1, act=None)
+                                 act='relu', data_format=df)
+        self.conv2 = ConvBNLayer(num_filters, num_filters * 4, 1, act=None,
+                                 data_format=df)
         if not shortcut:
             self.short = ConvBNLayer(num_channels, num_filters * 4, 1,
-                                     stride=stride, act=None)
+                                     stride=stride, act=None, data_format=df)
         self.shortcut = shortcut
 
     def forward(self, x):
@@ -51,14 +56,17 @@ class BottleneckBlock(Layer):
 
 
 class BasicBlock(Layer):
-    def __init__(self, num_channels, num_filters, stride, shortcut=True):
+    def __init__(self, num_channels, num_filters, stride, shortcut=True,
+                 data_format='NCHW'):
         super().__init__()
+        df = data_format
         self.conv0 = ConvBNLayer(num_channels, num_filters, 3, stride=stride,
-                                 act='relu')
-        self.conv1 = ConvBNLayer(num_filters, num_filters, 3, act=None)
+                                 act='relu', data_format=df)
+        self.conv1 = ConvBNLayer(num_filters, num_filters, 3, act=None,
+                                 data_format=df)
         if not shortcut:
             self.short = ConvBNLayer(num_channels, num_filters, 1,
-                                     stride=stride, act=None)
+                                     stride=stride, act=None, data_format=df)
         self.shortcut = shortcut
 
     def forward(self, x):
@@ -77,12 +85,14 @@ _DEPTH_CFG = {
 
 
 class ResNet(Layer):
-    def __init__(self, layers_depth=50, class_dim=1000):
+    def __init__(self, layers_depth=50, class_dim=1000, data_format='NCHW'):
         super().__init__()
         depth, block_cls, expansion = _DEPTH_CFG[layers_depth]
         num_filters = [64, 128, 256, 512]
-        self.conv = ConvBNLayer(3, 64, 7, stride=2, act='relu')
-        self.pool = Pool2D(3, 'max', 2, 1)
+        df = data_format
+        self.conv = ConvBNLayer(3, 64, 7, stride=2, act='relu',
+                                data_format=df)
+        self.pool = Pool2D(3, 'max', 2, 1, data_format=df)
         from ..dygraph import LayerList
         self.blocks = LayerList()
         num_channels = 64
@@ -90,10 +100,12 @@ class ResNet(Layer):
             for b in range(n):
                 shortcut = not (b == 0)
                 stride = 2 if b == 0 and i != 0 else 1
-                blk = block_cls(num_channels, num_filters[i], stride, shortcut)
+                blk = block_cls(num_channels, num_filters[i], stride,
+                                shortcut, data_format=df)
                 num_channels = num_filters[i] * expansion
                 self.blocks.append(blk)
-        self.global_pool = Pool2D(pool_type='avg', global_pooling=True)
+        self.global_pool = Pool2D(pool_type='avg', global_pooling=True,
+                                  data_format=df)
         stdv = 1.0 / math.sqrt(num_channels)
         self.out = Linear(
             num_channels, class_dim,
@@ -109,8 +121,8 @@ class ResNet(Layer):
         return self.out(y)
 
 
-def ResNet50(class_dim=1000):
-    return ResNet(50, class_dim)
+def ResNet50(class_dim=1000, data_format='NCHW'):
+    return ResNet(50, class_dim, data_format=data_format)
 
 
 def ResNet18(class_dim=1000):

@@ -142,6 +142,11 @@ GRAD_SPECS = {
                    f32(r.standard_normal((5, 3, 4)) * 0.3), None],
         diff=(0, 1, 2)),
     'prelu': S(lambda r: [away(r, (3, 4)), f32([0.25])], diff=(0, 1)),
+    'fused_attention': S(
+        lambda r: [f32(r.standard_normal((1, 2, 4, 8)) * 0.3),
+                   f32(r.standard_normal((1, 2, 4, 8)) * 0.3),
+                   f32(r.standard_normal((1, 2, 4, 8)) * 0.3), None],
+        diff=(0, 1, 2), attrs={'sm_scale': 0.35}),
     # --- reductions ---
     'reduce_sum': S(_std((3, 4))),
     'reduce_mean': S(_std((3, 4))),

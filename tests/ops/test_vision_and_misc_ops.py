@@ -257,3 +257,34 @@ def test_chunk_eval_masks_padding():
     assert int(full[3]) == 3      # unmasked: 3 inferred chunks
     assert int(masked[3]) == 1    # masked to length 2: just the B-0 I-0 chunk
     assert float(masked[0]) == 1.0 and float(masked[1]) == 1.0
+
+
+def test_fused_attention_matches_reference():
+    """fused_attention (XLA fallback on CPU) == explicit softmax(QK^T)V,
+    with bias and causal masking."""
+    import numpy as np
+    import jax.numpy as jnp
+    import jax
+    from paddle_tpu.ops.registry import get_op
+    rng = np.random.RandomState(0)
+    b, h, s, d = 2, 3, 8, 16
+    q, k, v = (rng.standard_normal((b, h, s, d)).astype(np.float32) * 0.5
+               for _ in range(3))
+    bias = rng.standard_normal((b, h, s, s)).astype(np.float32)
+    scale = 1.0 / np.sqrt(d)
+    out = np.asarray(get_op('fused_attention').fn(q, k, v, bias,
+                                                  sm_scale=scale))
+    scores = np.einsum('bhqd,bhkd->bhqk', q, k) * scale + bias
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(scores), axis=-1))
+    ref = np.einsum('bhqk,bhkd->bhqd', probs, v)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    # causal: upper-triangle keys must not contribute
+    outc = np.asarray(get_op('fused_attention').fn(q, k, v, None,
+                                                   sm_scale=scale,
+                                                   causal=True))
+    scores2 = np.einsum('bhqd,bhkd->bhqk', q, k) * scale
+    mask = np.tril(np.ones((s, s), bool))
+    scores2 = np.where(mask, scores2, -1e30)
+    probs2 = np.asarray(jax.nn.softmax(jnp.asarray(scores2), axis=-1))
+    ref2 = np.einsum('bhqk,bhkd->bhqd', probs2, v)
+    np.testing.assert_allclose(outc, ref2, rtol=1e-5, atol=1e-5)
