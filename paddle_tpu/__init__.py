@@ -15,6 +15,8 @@ from .core import (CPUPlace, TPUPlace, CUDAPlace, XLAPlace, CUDAPinnedPlace,
 from .core import unique_name
 from .core.random import seed
 from . import framework
+from .core.lod import (LoDTensor, create_lod_tensor,
+                       create_random_int_lodtensor)
 from .framework import (Program, Variable, default_main_program,
                         default_startup_program, program_guard,
                         in_dygraph_mode, manual_seed)

@@ -1,0 +1,139 @@
+"""LoDTensor: the unified ragged-sequence container (SURVEY §2.1).
+
+Parity with the reference's LoDTensor
+(/root/reference/paddle/fluid/framework/lod_tensor.h and the pybind surface
+python/paddle/fluid/lod_tensor.py: create_lod_tensor,
+create_random_int_lodtensor, recursive_sequence_lengths). The TPU
+formulation is the (padded data, lengths) pair the masked sequence ops
+already consume — this class packages it with the reference's LoD
+accessors so ragged batches travel as ONE object:
+
+    t = fluid.create_lod_tensor([[1, 2], [3, 4, 5]], [[2, 3]], place)
+    exe.run(feed={'words': t}, ...)         # Executor unpacks data+lengths
+
+Level-1 LoD (batch of sequences) maps exactly; deeper nesting is stored as
+the reference does (recursive lengths) with the innermost level padded.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ['LoDTensor', 'create_lod_tensor', 'create_random_int_lodtensor']
+
+
+class LoDTensor:
+    """Padded dense data + per-row valid lengths (+ full recursive lengths
+    for API parity). `data` is (B, T, ...) with rows padded to T."""
+
+    def __init__(self, data=None, recursive_seq_lens=None):
+        self._data = None if data is None else np.asarray(data)
+        self._recursive_seq_lens: List[List[int]] = \
+            [list(l) for l in (recursive_seq_lens or [])]
+
+    # ---- reference API surface ----
+    def set(self, data, place=None):
+        self._data = np.asarray(data)
+
+    def set_recursive_sequence_lengths(self, lengths):
+        self._recursive_seq_lens = [list(l) for l in lengths]
+
+    def recursive_sequence_lengths(self):
+        return [list(l) for l in self._recursive_seq_lens]
+
+    def set_lod(self, lod):
+        """Legacy offset-style LoD ([[0, 2, 5]] ≡ lengths [[2, 3]])."""
+        self._recursive_seq_lens = [
+            [int(level[i + 1] - level[i]) for i in range(len(level) - 1)]
+            for level in lod]
+
+    def lod(self):
+        out = []
+        for lengths in self._recursive_seq_lens:
+            offs = [0]
+            for n in lengths:
+                offs.append(offs[-1] + int(n))
+            out.append(offs)
+        return out
+
+    def has_valid_recursive_sequence_lengths(self):
+        if not self._recursive_seq_lens:
+            return self._data is not None
+        n = sum(self._recursive_seq_lens[-1])
+        flat_rows = int(np.prod(self._data.shape[:2])) \
+            if self._data is not None and self._data.ndim >= 2 else None
+        return flat_rows is None or n <= flat_rows
+
+    def shape(self):
+        return tuple(self._data.shape) if self._data is not None else ()
+
+    # ---- TPU pair view ----
+    @property
+    def data(self):
+        """Padded (B, T, ...) array."""
+        return self._data
+
+    @property
+    def lengths(self):
+        """(B,) int64 valid lengths of the innermost level."""
+        if not self._recursive_seq_lens:
+            if self._data is None:
+                return np.zeros((0,), np.int64)
+            return np.full((self._data.shape[0],), self._data.shape[1],
+                           np.int64)
+        return np.asarray(self._recursive_seq_lens[-1], np.int64)
+
+    def to_rows(self):
+        """Back to a python list of per-sequence arrays (unpadded)."""
+        return [np.asarray(self._data[i, :n])
+                for i, n in enumerate(self.lengths)]
+
+    def __array__(self, dtype=None):
+        a = self._data
+        return a if dtype is None else a.astype(dtype)
+
+    def __repr__(self):
+        return (f"LoDTensor(shape={self.shape()}, "
+                f"recursive_seq_lens={self._recursive_seq_lens})")
+
+
+def _pad_rows(rows, dtype=None):
+    rows = [np.atleast_1d(np.asarray(r, dtype)) for r in rows]
+    maxlen = max((r.shape[0] for r in rows), default=0)
+    tail = rows[0].shape[1:] if rows else ()
+    out = np.zeros((len(rows), maxlen) + tail,
+                   rows[0].dtype if rows else np.float32)
+    for i, r in enumerate(rows):
+        out[i, :r.shape[0]] = r
+    return out
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """ref: python/paddle/fluid/lod_tensor.py:create_lod_tensor. Accepts a
+    list of per-sequence rows, a flat (sum_len, ...) array + lengths, or an
+    existing LoDTensor (copied with new lengths)."""
+    if isinstance(data, LoDTensor):
+        return LoDTensor(data.data, recursive_seq_lens)
+    lengths = list(recursive_seq_lens[-1]) if recursive_seq_lens else []
+    if isinstance(data, (list, tuple)):
+        return LoDTensor(_pad_rows(list(data)), recursive_seq_lens)
+    arr = np.asarray(data)
+    if lengths and arr.shape[0] == int(np.sum(lengths)):
+        # flat ragged layout (the reference's storage): split + pad
+        rows, off = [], 0
+        for n in lengths:
+            rows.append(arr[off:off + int(n)])
+            off += int(n)
+        return LoDTensor(_pad_rows(rows), recursive_seq_lens)
+    return LoDTensor(arr, recursive_seq_lens)
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=10):
+    """ref: lod_tensor.py:create_random_int_lodtensor."""
+    lengths = list(recursive_seq_lens[-1])
+    rows = [np.random.randint(low, high + 1,
+                              (int(n),) + tuple(base_shape)).astype(np.int64)
+            for n in lengths]
+    return LoDTensor(_pad_rows(rows), recursive_seq_lens)

@@ -36,7 +36,16 @@ class DataFeeder:
         out = {}
         for var, col in zip(self.feed_vars, columns or []):
             name = var.name if isinstance(var, Variable) else var
-            out[name] = self._convert(var, np.stack(col))
+            ragged = (isinstance(var, Variable) and var.lod_level
+                      and len({c.shape[:1] for c in col}) > 1)
+            if ragged:
+                # lod_level>0 var with varying row lengths → LoDTensor
+                # (Executor unpacks to padded data + '@LEN' lengths)
+                from .core.lod import create_lod_tensor
+                out[name] = create_lod_tensor(
+                    col, [[int(c.shape[0]) for c in col]])
+            else:
+                out[name] = self._convert(var, np.stack(col))
         return out
 
 

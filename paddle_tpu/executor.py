@@ -682,6 +682,20 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
     return step
 
 
+def _default_len_feeds(block, feed_vals):
+    """Plain-array feeds to lod_level>0 vars: the companion '@LEN' var
+    defaults to full lengths (every row spans the padded time dim) so
+    non-ragged feeds keep the pre-LoDTensor semantics."""
+    for name in list(feed_vals):
+        ln = name + '@LEN'
+        if (not name.endswith('@LEN') and ln not in feed_vals
+                and block.has_var(ln) and block.var(ln).is_data):
+            arr = feed_vals[name]
+            if getattr(arr, 'ndim', 0) >= 2:
+                feed_vals[ln] = jnp.full((arr.shape[0],), arr.shape[1],
+                                         jnp.int32)
+
+
 class Executor:
     """fluid.Executor parity. `place` is accepted for compat; execution always
     targets the default XLA backend."""
@@ -741,13 +755,21 @@ class Executor:
                     val, fsdp_sharding(val.shape, fsdp_mesh, fsdp_axis))
             state[n] = val
 
+        from .core.lod import LoDTensor
         feed_vals = {}
         for name, value in feed.items():
+            if isinstance(value, LoDTensor):
+                # ragged feed: bind the padded data plus the companion
+                # length var that data(lod_level>0) declared
+                if block.has_var(name + '@LEN'):
+                    feed_vals[name + '@LEN'] = jnp.asarray(value.lengths)
+                value = value.data
             dtype = block.var(name).dtype if block.has_var(name) else None
             arr = jnp.asarray(value, to_jax_dtype(dtype) if dtype else None)
             if sharding is not None:
                 arr = jax.device_put(arr, sharding)
             feed_vals[name] = arr
+        _default_len_feeds(block, feed_vals)
 
         feed_sig = tuple(sorted((n, v.shape, str(v.dtype))
                                 for n, v in feed_vals.items()))
@@ -777,6 +799,22 @@ class Executor:
         scope = scope if scope is not None else global_scope()
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in fetch_list]
+        from .core.lod import LoDTensor
+        feed = dict(feed)
+        block0 = program.global_block()
+        for n in list(feed):
+            if isinstance(feed[n], LoDTensor):
+                if block0.has_var(n + '@LEN'):
+                    feed[n + '@LEN'] = feed[n].lengths
+                feed[n] = feed[n].data
+        for n in list(feed):
+            ln = n + '@LEN'
+            if (not n.endswith('@LEN') and ln not in feed
+                    and block0.has_var(ln) and block0.var(ln).is_data):
+                arr = np.asarray(feed[n])
+                if arr.ndim >= 2:
+                    feed[ln] = np.full((arr.shape[0],), arr.shape[1],
+                                       np.int32)
         feed_names = sorted(feed)
         state_names = sorted(v.name for v in program.list_vars()
                              if v.persistable)

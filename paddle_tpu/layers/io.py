@@ -18,6 +18,14 @@ def data(name, shape, dtype='float32', lod_level=0, append_batch_size=True,
         v = prog.global_block().create_var(
             name=name, shape=shape, dtype=convert_dtype(dtype),
             is_data=True, stop_gradient=stop_gradient, lod_level=lod_level)
+        if lod_level and lod_level > 0:
+            # ragged feed: a LoDTensor feed binds this companion var with
+            # the per-row valid lengths (core/lod.py); sequence layers pick
+            # it up implicitly via _seq_len
+            lv = prog.global_block().create_var(
+                name=name + '@LEN', shape=[-1], dtype='int64',
+                is_data=True, stop_gradient=True)
+            v._length_var = lv
     return v
 
 

@@ -11,6 +11,24 @@ from ..layer_helper import LayerHelper
 from ..initializer import XavierInitializer
 from .common import apply_op_layer
 
+
+def _seq_len(input, sequence_length):
+    """Explicit sequence_length wins; otherwise the length var a
+    lod_level>0 data() attached travels with the tensor (LoDTensor
+    unification, core/lod.py)."""
+    if sequence_length is not None:
+        return sequence_length
+    return getattr(input, '_length_var', None)
+
+
+def _carry_len(out, input, sequence_length):
+    """Tag a length-preserving result so chained sequence layers keep
+    resolving the ragged structure implicitly."""
+    lv = _seq_len(input, sequence_length)
+    if lv is not None:
+        out._length_var = lv
+    return out
+
 __all__ = ['sequence_conv', 'sequence_softmax', 'sequence_pool',
            'sequence_concat', 'sequence_first_step', 'sequence_last_step',
            'sequence_slice', 'sequence_expand', 'sequence_expand_as',
@@ -32,22 +50,26 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
                                 is_bias=True)
     out = apply_op_layer(
         'sequence_conv',
-        {'x': input, 'w': w, 'bias': b, 'length': sequence_length},
+        {'x': input, 'w': w, 'bias': b,
+         'length': _seq_len(input, sequence_length)},
         {'context_length': filter_size, 'context_start': padding_start,
          'padding': padding})
-    return helper.append_activation(out) if act else out
+    out = helper.append_activation(out) if act else out
+    return _carry_len(out, input, sequence_length)
 
 
 def sequence_softmax(input, use_cudnn=False, name=None, sequence_length=None):
-    return apply_op_layer('sequence_softmax',
-                          {'x': input, 'length': sequence_length}, {},
-                          name=name)
+    out = apply_op_layer('sequence_softmax',
+                         {'x': input,
+                          'length': _seq_len(input, sequence_length)}, {},
+                         name=name)
+    return _carry_len(out, input, sequence_length)
 
 
 def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
                   sequence_length=None):
     out, _ = apply_op_layer('sequence_pool',
-                            {'x': input, 'length': sequence_length},
+                            {'x': input, 'length': _seq_len(input, sequence_length)},
                             {'pool_type': pool_type, 'pad_value': pad_value})
     return out
 
@@ -72,7 +94,7 @@ def sequence_slice(input, offset, length, name=None, sequence_length=None):
     out, _ = apply_op_layer(
         'sequence_slice',
         {'x': input, 'offset': offset, 'slice_length': length,
-         'length': sequence_length}, {}, name=name)
+         'length': _seq_len(input, sequence_length)}, {}, name=name)
     return out
 
 
@@ -91,7 +113,8 @@ def sequence_expand_as(x, y, name=None, y_length=None):
 def sequence_pad(x, pad_value, maxlen=None, name=None, sequence_length=None):
     out, lens = apply_op_layer(
         'sequence_pad',
-        {'x': x, 'pad_value': pad_value, 'length': sequence_length},
+        {'x': x, 'pad_value': pad_value,
+         'length': _seq_len(x, sequence_length)},
         {'maxlen': -1 if maxlen is None else maxlen}, name=name)
     return out, lens
 
@@ -103,7 +126,7 @@ def sequence_unpad(x, length, name=None):
 
 def sequence_reshape(input, new_dim, sequence_length=None):
     out, _ = apply_op_layer('sequence_reshape',
-                            {'x': input, 'length': sequence_length},
+                            {'x': input, 'length': _seq_len(input, sequence_length)},
                             {'new_dim': new_dim})
     return out
 
@@ -112,13 +135,13 @@ def sequence_scatter(input, index, updates, name=None, sequence_length=None):
     return apply_op_layer(
         'sequence_scatter',
         {'x': input, 'index': index, 'updates': updates,
-         'length': sequence_length}, {}, name=name)
+         'length': _seq_len(input, sequence_length)}, {}, name=name)
 
 
 def sequence_enumerate(input, win_size, pad_value=0, name=None,
                        sequence_length=None):
     return apply_op_layer('sequence_enumerate',
-                          {'x': input, 'length': sequence_length},
+                          {'x': input, 'length': _seq_len(input, sequence_length)},
                           {'win_size': win_size, 'pad_value': pad_value},
                           name=name)
 
@@ -133,5 +156,7 @@ def sequence_mask(x, maxlen=None, dtype='int64', name=None):
 
 
 def sequence_reverse(x, name=None, sequence_length=None):
-    return apply_op_layer('sequence_reverse',
-                          {'x': x, 'length': sequence_length}, {}, name=name)
+    out = apply_op_layer('sequence_reverse',
+                         {'x': x, 'length': _seq_len(x, sequence_length)},
+                         {}, name=name)
+    return _carry_len(out, x, sequence_length)

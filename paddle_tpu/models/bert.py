@@ -76,6 +76,13 @@ class MultiHeadAttention(Layer):
         self.n_heads = cfg.num_attention_heads
         self.d_head = h // cfg.num_attention_heads
         self._fused = cfg.use_fused_attention
+        if self._fused and cfg.attention_probs_dropout_prob > 0:
+            import warnings
+            warnings.warn(
+                "use_fused_attention bypasses attention-probability "
+                "dropout (attention_probs_dropout_prob="
+                f"{cfg.attention_probs_dropout_prob} is ignored); set it "
+                "to 0 to silence this warning", stacklevel=2)
 
     def forward(self, x, attn_bias=None):
         b, s, h = x.shape

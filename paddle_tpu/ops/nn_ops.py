@@ -566,6 +566,9 @@ def norm(x, *, axis=-1, epsilon=1e-10):
     return x / n, n
 
 
+_FLASH_FALLBACK_WARNED = False
+
+
 @register_op('fused_attention')
 def fused_attention(q, k, v, bias=None, *, sm_scale=1.0, causal=False):
     """Fused multi-head attention, (B, H, S, D) layout. On TPU this lowers
@@ -590,8 +593,16 @@ def fused_attention(q, k, v, bias=None, *, sm_scale=1.0, causal=False):
                 q.shape[:3] + (k.shape[2],))
             return flash_attention(q, k, v, ab=ab, causal=causal,
                                    sm_scale=float(sm_scale))
-        except Exception:
-            pass
+        except Exception as e:   # kernel shape rejection → XLA fallback
+            global _FLASH_FALLBACK_WARNED
+            if not _FLASH_FALLBACK_WARNED:
+                _FLASH_FALLBACK_WARNED = True
+                import logging
+                logging.getLogger(__name__).warning(
+                    "fused_attention: pallas flash kernel unavailable for "
+                    "q%s (%s: %s); falling back to XLA attention, which "
+                    "materializes the SxS score tensor",
+                    tuple(q.shape), type(e).__name__, str(e)[:200])
     scores = jnp.einsum('bhqd,bhkd->bhqk', q, k) * sm_scale
     if bias is not None:
         scores = scores + jnp.asarray(bias)

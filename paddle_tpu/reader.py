@@ -228,9 +228,13 @@ class _GeneratorLoader:
         err_box = []
 
         def producer():
+            from .core.lod import LoDTensor
             try:
                 for feed in self._batch_reader():
-                    staged = {k: jax.device_put(np.ascontiguousarray(v))
+                    # LoDTensors pass through intact (the Executor unpacks
+                    # data + lengths); dense arrays stage onto the device
+                    staged = {k: (v if isinstance(v, LoDTensor) else
+                                  jax.device_put(np.ascontiguousarray(v)))
                               for k, v in feed.items()}
                     q.put(staged)
             except BaseException as e:   # surface in the consumer, not stderr

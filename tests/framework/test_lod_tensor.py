@@ -1,0 +1,92 @@
+"""LoDTensor unified ragged container (SURVEY §2.1; ref
+python/paddle/fluid/lod_tensor.py + framework/lod_tensor.h): creation
+APIs, LoD/length accessors, implicit length threading through sequence
+layers via the Executor feed path, and DataFeeder ragged batching."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_create_lod_tensor_from_rows():
+    t = fluid.create_lod_tensor([[1.0, 2.0], [3.0, 4.0, 5.0]], [[2, 3]],
+                                fluid.CPUPlace())
+    assert t.shape() == (2, 3)
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+    assert t.lod() == [[0, 2, 5]]
+    np.testing.assert_array_equal(t.lengths, [2, 3])
+    rows = t.to_rows()
+    np.testing.assert_allclose(rows[0], [1.0, 2.0])
+    np.testing.assert_allclose(rows[1], [3.0, 4.0, 5.0])
+    assert t.has_valid_recursive_sequence_lengths()
+
+
+def test_create_lod_tensor_from_flat_array():
+    flat = np.arange(10, dtype=np.float32).reshape(5, 2)
+    t = fluid.create_lod_tensor(flat, [[2, 3]])
+    assert t.shape() == (2, 3, 2)
+    np.testing.assert_allclose(t.data[0, :2], flat[:2])
+    np.testing.assert_allclose(t.data[1, :3], flat[2:])
+    assert np.all(t.data[0, 2] == 0)      # padding
+
+
+def test_set_lod_offsets_roundtrip():
+    t = fluid.LoDTensor(np.zeros((3, 4), np.float32))
+    t.set_lod([[0, 1, 3, 4]])
+    assert t.recursive_sequence_lengths() == [[1, 2, 1]]
+
+
+def test_create_random_int_lodtensor():
+    t = fluid.create_random_int_lodtensor([[2, 4]], [1], None, 0, 7)
+    assert t.shape() == (2, 4, 1)
+    assert t.data.dtype == np.int64
+    assert t.data.max() <= 7
+
+
+def test_lod_tensor_feeds_sequence_layers_implicitly():
+    """data(lod_level=1) + LoDTensor feed: sequence_pool sees the true
+    lengths with no explicit sequence_length arg anywhere."""
+    x = layers.data('seq', [4, 3], dtype='float32', lod_level=1,
+                    append_batch_size=False)
+    x.shape = (-1, 4, 3)
+    pooled = layers.sequence_pool(x, 'average')
+    exe = fluid.Executor()
+    rows = [np.ones((2, 3), np.float32) * 2.0,
+            np.ones((4, 3), np.float32) * 3.0]
+    t = fluid.create_lod_tensor(rows, [[2, 4]])
+    out, = exe.run(feed={'seq': t}, fetch_list=[pooled])
+    # averages over the VALID prefix only: 2.0 and 3.0 (not diluted by pad)
+    np.testing.assert_allclose(out[0], np.full(3, 2.0), rtol=1e-6)
+    np.testing.assert_allclose(out[1], np.full(3, 3.0), rtol=1e-6)
+
+
+def test_lod_length_carries_through_chained_layers():
+    x = layers.data('s2', [4, 1], dtype='float32', lod_level=1,
+                    append_batch_size=False)
+    x.shape = (-1, 4, 1)
+    sm = layers.sequence_softmax(x)
+    last = layers.sequence_last_step(sm)
+    exe = fluid.Executor()
+    rows = [np.array([[1.], [2.]], np.float32),
+            np.array([[1.], [1.], [1.], [1.]], np.float32)]
+    t = fluid.create_lod_tensor(rows, [[2, 4]])
+    sv, lv = exe.run(feed={'s2': t}, fetch_list=[sm, last])
+    # row 0 softmax over 2 valid steps; padding stays 0
+    np.testing.assert_allclose(sv[0, :2, 0].sum(), 1.0, rtol=1e-5)
+    assert sv[0, 2:, 0].max() == 0.0
+    # last VALID step of row 0 is index 1
+    np.testing.assert_allclose(lv[0], sv[0, 1], rtol=1e-6)
+
+
+def test_data_feeder_builds_lod_tensor_for_ragged():
+    x = layers.data('rag', [5, 2], dtype='float32', lod_level=1,
+                    append_batch_size=False)
+    feeder = fluid.DataFeeder(feed_list=[x])
+    batch = [(np.ones((2, 2), np.float32),),
+             (np.ones((5, 2), np.float32),)]
+    feed = feeder.feed(batch)
+    t = feed['rag']
+    assert isinstance(t, fluid.LoDTensor)
+    np.testing.assert_array_equal(t.lengths, [2, 5])
+    assert t.data.shape == (2, 5, 2)
