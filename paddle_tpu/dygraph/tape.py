@@ -110,6 +110,14 @@ class Tensor:
     def numpy(self):
         return np.asarray(self.value)
 
+    def __array__(self, dtype=None, copy=None):
+        # without this, np.asarray falls back to the sequence protocol and
+        # dispatches one traced slice op PER ELEMENT (minutes for a matrix)
+        a = np.asarray(self.value)
+        if dtype is not None:
+            a = a.astype(dtype)
+        return np.array(a, copy=True) if copy else a
+
     def item(self):
         return self.value.item()
 

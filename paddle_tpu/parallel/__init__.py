@@ -1,8 +1,11 @@
 """Distribution: mesh, collectives, fleet, model/pipeline/sequence
 parallelism (SURVEY §2.8)."""
 from . import mesh
-from .mesh import (make_mesh, set_default_mesh, get_default_mesh, mesh_guard,
-                   data_sharding, replicated, topology)
+from .mesh import (make_mesh, make_hybrid_mesh, set_default_mesh,
+                   get_default_mesh, mesh_guard, data_sharding, replicated,
+                   topology)
+from . import fsdp
+from .fsdp import fsdp_shardings, fsdp_sharding, fsdp_spec
 from . import collective
 from .fleet import (fleet, Fleet, DistributedStrategy, DistributedOptimizer,
                     PaddleCloudRoleMaker, UserDefinedRoleMaker)

@@ -35,6 +35,41 @@ def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
     return Mesh(dev_array, tuple(axes.keys()))
 
 
+def make_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
+                     devices=None) -> Mesh:
+    """Multi-slice/pod mesh: `dcn_axes` span the data-center network
+    (slices), `ici_axes` the in-slice interconnect. This is the TPU
+    analogue of the reference's hierarchical allreduce
+    (ref: incubate/fleet DistributedStrategy.use_hierarchical_allreduce +
+    NCCL hierarchical comms): laying dp over DCN and tp/fsdp over ICI makes
+    XLA emit the two-level collective automatically. Uses
+    mesh_utils.create_hybrid_device_mesh when slice topology is available;
+    otherwise (single slice / CPU test mesh) falls back to a flat
+    ICI-ordered mesh with the same named axes."""
+    devices = devices if devices is not None else jax.devices()
+    dcn_shape = tuple(dcn_axes.values())
+    ici_shape = tuple(ici_axes.values())
+    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    n = int(np.prod(dcn_shape) * np.prod(ici_shape))
+    if n > len(devices):
+        raise ValueError(
+            f"hybrid mesh {dcn_axes}x{ici_axes} needs {n} devices, "
+            f"have {len(devices)}")
+    multi_slice = len({getattr(d, 'slice_index', 0)
+                       for d in devices[:n]}) > 1
+    if multi_slice:
+        # create_hybrid_device_mesh wants same-rank shapes and returns
+        # their ELEMENTWISE product; padding with 1s yields exactly
+        # dcn_shape + ici_shape in (dcn..., ici...) order
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            (1,) * len(dcn_shape) + ici_shape,
+            dcn_shape + (1,) * len(ici_shape), devices[:n])
+        return Mesh(dev_array, names)
+    # single slice / CPU test mesh: flat ICI-ordered mesh, same named axes
+    return make_mesh({**dcn_axes, **ici_axes}, devices[:n])
+
+
 def set_default_mesh(mesh: Optional[Mesh]):
     global _default_mesh
     _default_mesh = mesh

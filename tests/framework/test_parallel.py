@@ -175,3 +175,27 @@ def test_dgc_momentum_optimizer():
         losses = [float(exe.run(main, feed={'x': X, 'y': Y},
                                 fetch_list=[loss])[0]) for _ in range(30)]
     assert losses[-1] < losses[0] * 0.6
+
+
+def test_hybrid_mesh_axes_and_collective():
+    """make_hybrid_mesh: dcn axes lead, ici axes trail; a dp-over-dcn ×
+    tp-over-ici psum works (hierarchical allreduce parity, SURVEY §2.8)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.parallel.mesh import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh({'tp': 2}, {'dp': 4})
+    assert mesh.axis_names == ('dp', 'tp')
+    assert mesh.shape['dp'] == 4 and mesh.shape['tp'] == 2
+
+    x = jnp.arange(16.0).reshape(8, 2)
+
+    def f(xs):
+        total = jax.lax.psum(jax.lax.psum(jnp.sum(xs), 'tp'), 'dp')
+        return jnp.full_like(xs, total)
+
+    out = jax.shard_map(f, mesh=mesh, in_specs=P('dp', 'tp'),
+                        out_specs=P('dp', 'tp'))(x)
+    np.testing.assert_allclose(np.asarray(out)[0, 0], float(x.sum()))
