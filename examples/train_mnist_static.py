@@ -1,0 +1,57 @@
+"""MNIST LeNet, static graph (the reference's canonical first script).
+
+Usage: python examples/train_mnist_static.py [--epochs N]
+Runs on whatever backend jax selects (TPU chip or CPU)."""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, nets
+from paddle_tpu.datasets import mnist_train
+
+
+def build():
+    img = layers.data('img', [1, 28, 28])
+    label = layers.data('label', [1], dtype='int64')
+    conv1 = nets.simple_img_conv_pool(img, 20, 5, 2, 2, act='relu')
+    conv2 = nets.simple_img_conv_pool(conv1, 50, 5, 2, 2, act='relu')
+    pred = layers.fc(conv2, size=10, act='softmax')
+    loss = layers.reduce_mean(layers.cross_entropy(pred, label))
+    acc = layers.accuracy(pred, label)
+    return loss, acc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=1)
+    ap.add_argument('--batch', type=int, default=128)
+    ap.add_argument('--steps', type=int, default=None,
+                    help='cap steps per epoch (smoke runs)')
+    args = ap.parse_args()
+
+    loss, acc = build()
+    fluid.optimizer.Adam(1e-3).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    from paddle_tpu import reader as R
+    train = R.batch(R.shuffle(mnist_train(), 1024), args.batch,
+                    drop_last=True)
+    for epoch in range(args.epochs):
+        for i, batch in enumerate(train()):
+            if args.steps and i >= args.steps:
+                break
+            imgs = np.stack([b[0].reshape(1, 28, 28) for b in batch])
+            labels = np.stack([[b[1]] for b in batch]).astype(np.int64)
+            l, a = exe.run(feed={'img': imgs, 'label': labels},
+                           fetch_list=[loss, acc])
+            if i % 50 == 0:
+                print(f"epoch {epoch} step {i}: loss "
+                      f"{float(np.ravel(l)[0]):.4f} acc "
+                      f"{float(np.ravel(a)[0]):.3f}", flush=True)
+    print("done")
+
+
+if __name__ == '__main__':
+    main()

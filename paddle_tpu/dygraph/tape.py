@@ -113,6 +113,12 @@ class Tensor:
     def __array__(self, dtype=None, copy=None):
         # without this, np.asarray falls back to the sequence protocol and
         # dispatches one traced slice op PER ELEMENT (minutes for a matrix)
+        if copy is False:
+            # device memory cannot be exposed as a writable host view
+            raise ValueError(
+                "converting a paddle_tpu Tensor to numpy always copies "
+                "from device memory; np.asarray(t, copy=False) cannot "
+                "return a view")
         a = np.asarray(self.value)
         if dtype is not None:
             a = a.astype(dtype)

@@ -47,27 +47,44 @@ def make_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
     otherwise (single slice / CPU test mesh) falls back to a flat
     ICI-ordered mesh with the same named axes."""
     devices = devices if devices is not None else jax.devices()
+    overlap = set(dcn_axes) & set(ici_axes)
+    if overlap:
+        raise ValueError(
+            f"axis names {sorted(overlap)} appear in both dcn_axes and "
+            f"ici_axes")
     dcn_shape = tuple(dcn_axes.values())
     ici_shape = tuple(ici_axes.values())
     names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
-    n = int(np.prod(dcn_shape) * np.prod(ici_shape))
-    if n > len(devices):
+    n_dcn = int(np.prod(dcn_shape))
+    n_ici = int(np.prod(ici_shape))
+    if n_dcn * n_ici > len(devices):
         raise ValueError(
-            f"hybrid mesh {dcn_axes}x{ici_axes} needs {n} devices, "
-            f"have {len(devices)}")
-    multi_slice = len({getattr(d, 'slice_index', 0)
-                       for d in devices[:n]}) > 1
-    if multi_slice:
+            f"hybrid mesh {dcn_axes}x{ici_axes} needs {n_dcn * n_ici} "
+            f"devices, have {len(devices)}")
+    by_slice: Dict[int, list] = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, 'slice_index', 0), []).append(d)
+    if len(by_slice) > 1:
+        # pick WHOLE slices (n_dcn of them × n_ici devices each) so the
+        # dcn axes really span DCN — a flat device prefix could land
+        # entirely inside one slice
+        usable = [ds[:n_ici] for ds in by_slice.values()
+                  if len(ds) >= n_ici]
+        if len(usable) < n_dcn:
+            raise ValueError(
+                f"hybrid mesh needs {n_dcn} slices with ≥{n_ici} devices "
+                f"each; have {[len(v) for v in by_slice.values()]}")
+        chosen = [d for ds in usable[:n_dcn] for d in ds]
         # create_hybrid_device_mesh wants same-rank shapes and returns
         # their ELEMENTWISE product; padding with 1s yields exactly
         # dcn_shape + ici_shape in (dcn..., ici...) order
         from jax.experimental import mesh_utils
         dev_array = mesh_utils.create_hybrid_device_mesh(
             (1,) * len(dcn_shape) + ici_shape,
-            dcn_shape + (1,) * len(ici_shape), devices[:n])
+            dcn_shape + (1,) * len(ici_shape), chosen)
         return Mesh(dev_array, names)
     # single slice / CPU test mesh: flat ICI-ordered mesh, same named axes
-    return make_mesh({**dcn_axes, **ici_axes}, devices[:n])
+    return make_mesh({**dcn_axes, **ici_axes}, devices[:n_dcn * n_ici])
 
 
 def set_default_mesh(mesh: Optional[Mesh]):

@@ -1,0 +1,47 @@
+"""debugging.py: NaN/Inf detection, device report, install_check
+(SURVEY §2.11 failure handling; ref nan_inf_utils + install_check)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import debugging
+
+
+def test_check_numerics_passes_and_raises():
+    debugging.check_numerics(np.ones((3, 3), np.float32))
+    debugging.check_numerics({'a': np.zeros(2), 'b': np.ones(2)})
+    bad = np.array([1.0, np.nan, np.inf], np.float32)
+    with pytest.raises(FloatingPointError, match='1 NaN, 1 Inf'):
+        debugging.check_numerics(bad, 'grads')
+    with pytest.raises(FloatingPointError):
+        debugging.check_numerics({'ok': np.ones(2), 'bad': bad})
+
+
+def test_assert_all_finite_poisons():
+    import jax.numpy as jnp
+    x = jnp.asarray([1.0, 2.0])
+    np.testing.assert_allclose(
+        np.asarray(debugging.assert_all_finite(x)), [1.0, 2.0])
+    y = jnp.asarray([1.0, jnp.inf])
+    out = np.asarray(debugging.assert_all_finite(y))
+    assert np.isnan(out).all()     # whole tensor poisoned, unmissable
+
+
+def test_enable_check_nan_inf_toggles():
+    import jax
+    debugging.enable_check_nan_inf(True)
+    assert debugging.check_nan_inf_enabled()
+    assert jax.config.jax_debug_nans
+    debugging.enable_check_nan_inf(False)
+    assert not debugging.check_nan_inf_enabled()
+    assert not jax.config.jax_debug_nans
+
+
+def test_device_report_contents():
+    rep = debugging.device_report()
+    assert 'jax' in rep and 'backend' in rep and 'devices' in rep
+
+
+def test_install_check_end_to_end(capsys):
+    assert debugging.install_check() is True
+    assert 'install check passed' in capsys.readouterr().out
