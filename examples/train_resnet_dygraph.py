@@ -42,12 +42,14 @@ def main():
         shape = (batch, img, img, 3) if fmt == 'NHWC' else (batch, 3, img, img)
         x = np.random.randn(*shape).astype(np.float32)
         y = np.random.randint(0, 1000, (batch, 1)).astype(np.int64)
-        float(step(x, y))                     # compile
+        l = step(x, y)                        # compile
+        float(l)
         t0 = time.perf_counter()
         for i in range(args.steps):
             l = step(x, y)
         print(f"loss {float(l):.4f}  "
-              f"{batch * args.steps / (time.perf_counter() - t0):.1f} img/s")
+              f"{batch * max(args.steps, 1) / (time.perf_counter() - t0):.1f}"
+              f" img/s")
 
 
 if __name__ == '__main__':

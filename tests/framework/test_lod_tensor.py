@@ -79,6 +79,22 @@ def test_lod_length_carries_through_chained_layers():
     np.testing.assert_allclose(lv[0], sv[0, 1], rtol=1e-6)
 
 
+def test_lod_program_exports_with_plain_example_feed():
+    """lower_to_callable (the inference-export surface) on a lod_level>0
+    program: the export path must synthesize full lengths for a plain
+    example array."""
+    x = layers.data('sx', [4, 3], dtype='float32', lod_level=1,
+                    append_batch_size=False)
+    x.shape = (-1, 4, 3)
+    pooled = layers.sequence_pool(x, 'average')
+    exe = fluid.Executor()
+    fn, args = exe.lower_to_callable(
+        fluid.default_main_program(),
+        {'sx': np.ones((2, 4, 3), np.float32)}, [pooled])
+    out = fn(*args)
+    assert np.asarray(out[0]).shape == (2, 3)
+
+
 def test_data_feeder_builds_lod_tensor_for_ragged():
     x = layers.data('rag', [5, 2], dtype='float32', lod_level=1,
                     append_batch_size=False)
