@@ -4,7 +4,8 @@ from .nn import *  # noqa
 from .tensor import *  # noqa
 from .loss import *  # noqa
 from .control_flow import *  # noqa
-from .io import data
+from .io import data, py_reader, double_buffer, read_file, load
+from .io import create_py_reader_by_data
 from . import nn, tensor, loss, io, control_flow
 from .rnn import *  # noqa — exports the rnn() function over the module name
 from .sequence_lod import *  # noqa
@@ -12,6 +13,7 @@ from . import sequence_lod
 from .learning_rate_scheduler import *  # noqa
 from . import learning_rate_scheduler
 from . import distributions
+from .distributions import Categorical, MultivariateNormalDiag, Normal, Uniform
 from .detection import *  # noqa
 from . import detection
 from .math_op_patch import monkey_patch_variable
@@ -20,6 +22,43 @@ monkey_patch_variable()
 
 # accuracy / auc live in layers namespace in the reference too
 from .common import apply_op_layer as _apply
+from .common import generate_layer_fn
+from .common import generate_layer_fn as generate_activation_fn
+
+
+def autodoc(comment=''):
+    """ref: layer_function_generator.autodoc — docstring passthrough."""
+    def deco(fn):
+        fn.__doc__ = (fn.__doc__ or '') + comment
+        return fn
+    return deco
+
+
+def templatedoc(op_type=None):
+    """ref: layer_function_generator.templatedoc — docstring passthrough
+    (there are no C++ OpProto comments to template from)."""
+    def deco(fn):
+        return fn
+    return deco
+
+
+def deprecated(since='', instead='', extra_message=''):
+    """ref: fluid.layers.deprecated decorator — warns on call."""
+    def deco(fn):
+        import functools
+        import warnings
+
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            warnings.warn(
+                f"{fn.__name__} is deprecated"
+                + (f" since {since}" if since else '')
+                + (f"; use {instead}" if instead else '')
+                + (f". {extra_message}" if extra_message else ''),
+                DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+        return wrapped
+    return deco
 
 
 def accuracy(input, label, k=1, correct=None, total=None):

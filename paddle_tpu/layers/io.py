@@ -5,7 +5,8 @@ from __future__ import annotations
 from ..core.dtypes import convert_dtype
 from ..framework import default_main_program, default_startup_program
 
-__all__ = ['data', 'read_file', 'double_buffer', 'py_reader', 'load']
+__all__ = ['data', 'read_file', 'double_buffer', 'py_reader', 'load',
+           'create_py_reader_by_data']
 
 
 def data(name, shape, dtype='float32', lod_level=0, append_batch_size=True,
@@ -62,11 +63,12 @@ def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
     from ..reader import DataLoader
 
     base = name or unique_name.generate('_py_reader')
+    lod_levels = lod_levels or [0] * len(shapes)
     feed_vars = []
-    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+    for i, (shape, dtype, lod) in enumerate(zip(shapes, dtypes, lod_levels)):
         full = [-1 if s is None else int(s) for s in shape]
         feed_vars.append(data(f"{base}_{i}", full, dtype=dtype,
-                              append_batch_size=False))
+                              lod_level=lod, append_batch_size=False))
     return DataLoader.from_generator(feed_list=feed_vars,
                                      capacity=capacity,
                                      use_double_buffer=use_double_buffer)
@@ -83,3 +85,13 @@ def load(out, file_path, load_as_fp16=False):
     import jax.numpy as jnp
     global_scope().set(out.name, jnp.asarray(arr))
     return out
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """ref: fluid.layers.io.create_py_reader_by_data (io.py:730): like
+    py_reader but reuses existing feed vars instead of declaring new ones."""
+    from ..reader import DataLoader
+    return DataLoader.from_generator(feed_list=list(feed_list),
+                                     capacity=capacity,
+                                     use_double_buffer=use_double_buffer)

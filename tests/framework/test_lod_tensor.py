@@ -47,9 +47,7 @@ def test_create_random_int_lodtensor():
 def test_lod_tensor_feeds_sequence_layers_implicitly():
     """data(lod_level=1) + LoDTensor feed: sequence_pool sees the true
     lengths with no explicit sequence_length arg anywhere."""
-    x = layers.data('seq', [4, 3], dtype='float32', lod_level=1,
-                    append_batch_size=False)
-    x.shape = (-1, 4, 3)
+    x = layers.data('seq', [4, 3], dtype='float32', lod_level=1)
     pooled = layers.sequence_pool(x, 'average')
     exe = fluid.Executor()
     rows = [np.ones((2, 3), np.float32) * 2.0,
@@ -62,9 +60,7 @@ def test_lod_tensor_feeds_sequence_layers_implicitly():
 
 
 def test_lod_length_carries_through_chained_layers():
-    x = layers.data('s2', [4, 1], dtype='float32', lod_level=1,
-                    append_batch_size=False)
-    x.shape = (-1, 4, 1)
+    x = layers.data('s2', [4, 1], dtype='float32', lod_level=1)
     sm = layers.sequence_softmax(x)
     last = layers.sequence_last_step(sm)
     exe = fluid.Executor()
@@ -83,9 +79,7 @@ def test_lod_program_exports_with_plain_example_feed():
     """lower_to_callable (the inference-export surface) on a lod_level>0
     program: the export path must synthesize full lengths for a plain
     example array."""
-    x = layers.data('sx', [4, 3], dtype='float32', lod_level=1,
-                    append_batch_size=False)
-    x.shape = (-1, 4, 3)
+    x = layers.data('sx', [4, 3], dtype='float32', lod_level=1)
     pooled = layers.sequence_pool(x, 'average')
     exe = fluid.Executor()
     fn, args = exe.lower_to_callable(
@@ -96,8 +90,7 @@ def test_lod_program_exports_with_plain_example_feed():
 
 
 def test_data_feeder_builds_lod_tensor_for_ragged():
-    x = layers.data('rag', [5, 2], dtype='float32', lod_level=1,
-                    append_batch_size=False)
+    x = layers.data('rag', [5, 2], dtype='float32', lod_level=1)
     feeder = fluid.DataFeeder(feed_list=[x])
     batch = [(np.ones((2, 2), np.float32),),
              (np.ones((5, 2), np.float32),)]
