@@ -17,6 +17,9 @@ from .core.random import seed
 from . import framework
 from .core.lod import (LoDTensor, create_lod_tensor,
                        create_random_int_lodtensor)
+from .core.places import cuda_pinned_places
+from .framework import (name_scope, device_guard, load_op_library,
+                        require_version)
 from .framework import (Program, Variable, default_main_program,
                         default_startup_program, program_guard,
                         in_dygraph_mode, manual_seed)
@@ -57,7 +60,7 @@ import sys as _sys
 fluid = _sys.modules[__name__]
 _sys.modules[__name__ + '.fluid'] = fluid
 
-__version__ = '0.1.0'
+__version__ = '1.7.0'  # fluid API level this framework tracks (scripts gate on it)
 
 
 def install_check():

@@ -110,3 +110,10 @@ def _get_paddle_place(place):
                 rest = s[len(prefix):].lstrip(':')
                 return TPUPlace(int(rest) if rest else 0)
     raise ValueError(f"unknown place: {place!r}")
+
+
+def cuda_pinned_places(device_count=None):
+    """ref: fluid.cuda_pinned_places — pinned host staging areas; on TPU
+    the DataLoader ring stages via device_put, so these are CPU places."""
+    n = 1 if device_count is None else int(device_count)
+    return [CUDAPinnedPlace() for _ in range(n)]

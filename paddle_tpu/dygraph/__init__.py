@@ -25,3 +25,19 @@ class BackwardStrategy:
 
     def __init__(self):
         self.sort_sum_gradient = False
+
+
+# legacy to_static aliases (ref dygraph/jit.py 1.x names)
+from .jit import to_static as dygraph_to_static_graph          # noqa: E402
+from .jit import to_static as dygraph_to_static_output         # noqa: E402
+
+
+def start_gperf_profiler():
+    """ref: dygraph.start_gperf_profiler — lowered to jax.profiler."""
+    from ..profiler import start_profiler
+    start_profiler()
+
+
+def stop_gperf_profiler():
+    from ..profiler import stop_profiler
+    stop_profiler()

@@ -53,7 +53,8 @@ class _OpRunner:
                 args.append([read(n) for n in names])
             else:
                 args.append(read(names[0]))
-        attrs = {k: v for k, v in op.attrs.items() if k != 'initializer'}
+        attrs = {k: v for k, v in op.attrs.items()
+                 if k not in ('initializer', 'op_device')}
         if opdef.needs_rng:
             attrs['key'] = key
         amp = getattr(op.block.program, '_amp_config', None)

@@ -114,6 +114,8 @@ class Operator:
             k: ([v] if isinstance(v, str) else list(v))
             for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
+        if _DEVICE_GUARD is not None and 'op_device' not in self.attrs:
+            self.attrs['op_device'] = _DEVICE_GUARD
 
     def input_names(self):
         return [n for vs in self.inputs.values() for n in vs]
@@ -391,3 +393,81 @@ def shape_to_concrete(shape):
 def shape_from_concrete(shape):
     """Map sentinel-derived dims back to -1 for display parity."""
     return tuple(-1 if s == _DYNAMIC_DIM_SENTINEL else s for s in shape)
+
+
+# ---------------------------------------------------------------------------
+# misc fluid.framework API parity
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """ref: fluid.name_scope (framework.py:name_scope). Records a debugging
+    scope; the active path is readable via `_current_name_scope()` (op/var
+    names in the op-list IR are already unique, so no renaming happens)."""
+    if prefix:
+        _NAME_SCOPE.append(str(prefix))
+    try:
+        yield
+    finally:
+        if prefix:
+            _NAME_SCOPE.pop()
+
+
+_NAME_SCOPE: list = []
+
+
+def _current_name_scope():
+    return '/'.join(_NAME_SCOPE)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """ref: fluid.device_guard (framework.py:device_guard): annotates ops
+    appended inside with `op_device`. On TPU this is a placement HINT — the
+    compiled step runs on the XLA device; PipelineOptimizer-style program
+    splitting uses cut_list, not device annotations — so the attr is
+    recorded for program inspection and otherwise inert."""
+    global _DEVICE_GUARD
+    old = _DEVICE_GUARD
+    _DEVICE_GUARD = device
+    try:
+        yield
+    finally:
+        _DEVICE_GUARD = old
+
+
+_DEVICE_GUARD = None
+
+
+def load_op_library(lib_path):
+    """ref: fluid.load_op_library — loads a custom C++ op .so. The TPU
+    path for custom ops is ops.registry.register_op (jax functional) or
+    layers.py_func; native code plugs in via ctypes like
+    paddle_tpu/native. Accepted and ignored with a warning."""
+    import warnings
+    warnings.warn(
+        f"load_op_library({lib_path!r}): CUDA custom-op libraries do not "
+        f"apply on TPU; register a jax functional via "
+        f"paddle_tpu.ops.registry.register_op or use layers.py_func",
+        stacklevel=2)
+    return None
+
+
+def require_version(min_version, max_version=None):
+    """ref: fluid.require_version — version gate for scripts."""
+    import paddle_tpu
+
+    def parse(v, width):
+        parts = [int(x) for x in str(v).split('.') if x.isdigit()]
+        return tuple(parts + [0] * (width - len(parts)))
+
+    cur_str = getattr(paddle_tpu, '__version__', '1.7.0')
+    width = max(len(str(v).split('.'))
+                for v in (cur_str, min_version, max_version or '0'))
+    cur = parse(cur_str, width)
+    if parse(min_version, width) > cur:
+        raise Exception(
+            f"installed version {cur_str} is below required {min_version}")
+    if max_version is not None and parse(max_version, width) < cur:
+        raise Exception(
+            f"installed version {cur_str} is above allowed {max_version}")

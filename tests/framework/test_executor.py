@@ -95,3 +95,39 @@ def test_batch_norm_updates_running_stats():
     after = np.asarray(fluid.global_scope().find(mean_name))
     assert not np.allclose(before, after)
     assert np.all(after > 0.1)  # moved toward batch mean ≈ 5
+
+
+def test_framework_misc_api_parity():
+    """name_scope / device_guard / require_version / cuda_pinned_places /
+    load_op_library (ref fluid.framework misc surface)."""
+    import warnings
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    with fluid.name_scope('stage1'):
+        assert fluid.framework._current_name_scope() == 'stage1'
+        with fluid.name_scope('block'):
+            assert fluid.framework._current_name_scope() == 'stage1/block'
+    assert fluid.framework._current_name_scope() == ''
+
+    x = layers.data('dgx', [4])
+    with fluid.device_guard('gpu:1'):
+        y = layers.scale(x, scale=2.0)
+    op = fluid.default_main_program().global_block().ops[-1]
+    assert op.attrs.get('op_device') == 'gpu:1'
+    # annotated ops still execute (the attr must not leak into the kernel)
+    exe = fluid.Executor()
+    out, = exe.run(feed={'dgx': np.ones((2, 4), np.float32)},
+                   fetch_list=[y])
+    np.testing.assert_allclose(out, 2.0 * np.ones((2, 4)), rtol=1e-6)
+
+    fluid.require_version('1.0.0')
+    fluid.require_version('1.0', '1.7')     # prefix max admits 1.7.x
+    with pytest.raises(Exception):
+        fluid.require_version('99.0')
+    assert fluid.cuda_pinned_places(0) == []
+
+    assert len(fluid.cuda_pinned_places(3)) == 3
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        fluid.load_op_library('/tmp/libfoo.so')
+        assert any('TPU' in str(x.message) for x in w)
