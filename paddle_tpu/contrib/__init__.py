@@ -3,3 +3,11 @@ from . import mixed_precision
 from . import memory_usage_calc
 from .memory_usage_calc import (memory_usage, device_memory_stats,
                                 print_memory_report)
+from . import slim
+from .slim import PostTrainingQuantization, WeightQuantization
+from .mixed_precision import decorate, AutoMixedPrecisionLists
+from . import extra
+from .extra import (extend_with_decoupled_weight_decay, BasicLSTMUnit,
+                    BasicGRUUnit, basic_lstm, basic_gru,
+                    fused_elemwise_activation, partial_concat, partial_sum,
+                    shuffle_batch, tree_conv, multiclass_nms2)

@@ -1,0 +1,288 @@
+"""High-value contrib surface (ref: python/paddle/fluid/contrib/):
+decoupled weight decay (AdamW), basic_lstm/basic_gru helpers, and the
+contrib layer functions that map onto existing TPU ops. The legacy
+NAS/pruning/distillation Compressor framework, MKLDNN passes, and
+HDFSClient are out of scope for the TPU build (see docs/MIGRATION.md).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..layers.common import apply_op_layer
+
+__all__ = [
+    'extend_with_decoupled_weight_decay',
+    'BasicLSTMUnit', 'BasicGRUUnit', 'basic_lstm', 'basic_gru',
+    'fused_elemwise_activation', 'partial_concat', 'partial_sum',
+    'shuffle_batch', 'tree_conv', 'multiclass_nms2',
+]
+
+
+def extend_with_decoupled_weight_decay(base_optimizer_cls):
+    """ref: contrib/extend_optimizer/extend_optimizer_with_weight_decay.py.
+    Returns a subclass applying DECOUPLED weight decay (AdamW-style:
+    p *= 1 - lr*coeff before the inner rule, not folded into the
+    gradient)."""
+
+    class DecoupledWeightDecay(base_optimizer_cls):
+        def __init__(self, weight_decay=0.01, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._decoupled_wd = float(weight_decay)
+
+        # -- dygraph: decay the PRE-update weights (the torch-AdamW form;
+        # the inner step's donated buffers make a post-hoc subtraction of
+        # the old weights unsafe) --
+        def _dygraph_minimize(self, loss, parameter_list=None):
+            params = parameter_list or self._parameter_list
+            coeff = self._decoupled_wd * self._current_lr()
+            for p in (params or []):
+                if getattr(p, 'trainable', True) and p.grad is not None:
+                    p.value = p.value * (1.0 - coeff)
+            return super()._dygraph_minimize(loss, parameter_list)
+
+        # -- static: p -= wd * lr * p before the inner update op, with the
+        # LIVE lr var so scheduled learning rates scale the decay too --
+        def _append_optimize_op(self, param, grad, lr):
+            decay = apply_op_layer(
+                'scale', {'x': lr}, {'scale': self._decoupled_wd})
+            shrink = apply_op_layer('elementwise_mul',
+                                    {'x': param, 'y': decay})
+            from ..layer_helper import LayerHelper
+            helper = LayerHelper('decoupled_wd')
+            helper.main_program.current_block().append_op(
+                type='elementwise_sub',
+                inputs={'x': param.name, 'y': shrink.name},
+                outputs={'Out': param.name}, attrs={})
+            super()._append_optimize_op(param, grad, lr)
+
+    DecoupledWeightDecay.__name__ = \
+        base_optimizer_cls.__name__ + 'WithDecoupledWeightDecay'
+    return DecoupledWeightDecay
+
+
+def _lazy_layer_base():
+    from ..dygraph import Layer
+    return Layer
+
+
+class BasicLSTMUnit:
+    """ref: contrib/layers/rnn_impl.py:BasicLSTMUnit — one LSTM step. A
+    dygraph Layer (weights are real trainable parameters on the tape)."""
+
+    def __new__(cls, name_scope=None, hidden_size=None, forget_bias=1.0,
+                dtype='float32', **kw):
+        from ..dygraph import Layer
+        from ..dygraph.tape import dispatch_op
+
+        class _Unit(Layer):
+            def __init__(self):
+                super().__init__()
+                self._hidden = hidden_size
+                self._forget_bias = float(forget_bias)
+                self._built = False
+
+            def _ensure(self, in_dim):
+                if not self._built:
+                    self.weight = self.create_parameter(
+                        [in_dim + self._hidden, 4 * self._hidden], None,
+                        dtype)
+                    self.bias = self.create_parameter(
+                        [4 * self._hidden], None, dtype, is_bias=True)
+                    self._built = True
+
+            def forward(self, x, pre_hidden, pre_cell):
+                self._ensure(x.shape[-1])
+                xh = dispatch_op('concat', {'xs': [x, pre_hidden]},
+                                 {'axis': -1})
+                gates = dispatch_op('matmul', {'x': xh, 'y': self.weight},
+                                    {})
+                gates = dispatch_op('elementwise_add',
+                                    {'x': gates, 'y': self.bias},
+                                    {'axis': -1})
+                h, c = dispatch_op('lstm_unit',
+                                   {'x': gates, 'cell': pre_cell},
+                                   {'forget_bias': self._forget_bias})
+                return h, c
+
+        return _Unit()
+
+
+class BasicGRUUnit:
+    """ref: contrib/layers/rnn_impl.py:BasicGRUUnit (dygraph Layer)."""
+
+    def __new__(cls, name_scope=None, hidden_size=None, dtype='float32',
+                **kw):
+        from ..dygraph import Layer
+        from ..dygraph.tape import dispatch_op
+
+        class _Unit(Layer):
+            def __init__(self):
+                super().__init__()
+                self._hidden = hidden_size
+                self._built = False
+
+            def _ensure(self, in_dim):
+                if not self._built:
+                    self.wx = self.create_parameter(
+                        [in_dim, 3 * self._hidden], None, dtype)
+                    self.wh = self.create_parameter(
+                        [self._hidden, 3 * self._hidden], None, dtype)
+                    self._built = True
+
+            def forward(self, x, pre_hidden):
+                self._ensure(x.shape[-1])
+                proj = dispatch_op('matmul', {'x': x, 'y': self.wx}, {})
+                h, _, _ = dispatch_op(
+                    'gru_unit',
+                    {'x': proj, 'hidden': pre_hidden, 'weight': self.wh},
+                    {})
+                return h
+
+        return _Unit()
+
+
+def _check_rnn_config(num_layers, bidirectional, dropout_prob):
+    if num_layers != 1 or bidirectional or dropout_prob:
+        raise NotImplementedError(
+            "basic_lstm/basic_gru support single-layer unidirectional "
+            "without dropout (the ref model configs); got "
+            f"num_layers={num_layers}, bidirectional={bidirectional}, "
+            f"dropout_prob={dropout_prob}")
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0, bidirectional=False,
+               batch_first=True, forget_bias=1.0, dtype='float32',
+               name=None):
+    """ref: contrib/layers/rnn_impl.py:basic_lstm — static-graph layer over
+    the scan-based `lstm` op; weights are trainable parameters."""
+    _check_rnn_config(num_layers, bidirectional, dropout_prob)
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper('basic_lstm', name=name)
+    x = input
+    if not batch_first:
+        x = apply_op_layer('transpose_batch_time', {'x': x}, {})
+    D = x.shape[-1]
+    wx = helper.create_parameter(None, [D, 4 * hidden_size], dtype)
+    wh = helper.create_parameter(None, [hidden_size, 4 * hidden_size], dtype)
+    b = helper.create_parameter(None, [4 * hidden_size], dtype, is_bias=True)
+    proj = apply_op_layer('matmul', {'x': x, 'y': wx}, {})
+    hidden, cell = apply_op_layer(
+        'lstm', {'x': proj, 'h0': init_hidden, 'c0': init_cell, 'w_h': wh,
+                 'bias': b, 'seq_len': sequence_length}, {})
+    last_h = apply_op_layer('slice', {'x': hidden},
+                            {'axes': [1], 'starts': [-1], 'ends': [2 ** 30]})
+    last_c = apply_op_layer('slice', {'x': cell},
+                            {'axes': [1], 'starts': [-1], 'ends': [2 ** 30]})
+    if not batch_first:
+        hidden = apply_op_layer('transpose_batch_time', {'x': hidden}, {})
+    return hidden, last_h, last_c
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, dtype='float32', name=None):
+    """ref: contrib/layers/rnn_impl.py:basic_gru (same contract notes as
+    basic_lstm)."""
+    _check_rnn_config(num_layers, bidirectional, dropout_prob)
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper('basic_gru', name=name)
+    x = input
+    if not batch_first:
+        x = apply_op_layer('transpose_batch_time', {'x': x}, {})
+    D = x.shape[-1]
+    wx = helper.create_parameter(None, [D, 3 * hidden_size], dtype)
+    gate_w = helper.create_parameter(None, [hidden_size, 2 * hidden_size],
+                                     dtype)
+    cand_w = helper.create_parameter(None, [hidden_size, hidden_size], dtype)
+    proj = apply_op_layer('matmul', {'x': x, 'y': wx}, {})
+    out = apply_op_layer(
+        'gru', {'x': proj, 'h0': init_hidden, 'gate_w': gate_w,
+                'cand_w': cand_w, 'seq_len': sequence_length}, {})
+    last = apply_op_layer('slice', {'x': out},
+                          {'axes': [1], 'starts': [-1], 'ends': [2 ** 30]})
+    if not batch_first:
+        out = apply_op_layer('transpose_batch_time', {'x': out}, {})
+    return out, last
+
+
+# ---- contrib layer functions over existing ops (apply_op_layer already
+# dispatches eagerly in dygraph mode) ----
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """ref: contrib/layers/nn.py:fused_elemwise_activation. On TPU the
+    'fusion' is XLA's job — compose the named ops directly."""
+    out = None
+    for f in functor_list:
+        f = f.strip()
+        cur = x if out is None else out
+        if f.startswith('elementwise_'):
+            out = apply_op_layer(f, {'x': cur, 'y': y}, {'axis': axis})
+        elif f == 'scale':
+            out = apply_op_layer('scale', {'x': cur}, {'scale': scale})
+        else:
+            out = apply_op_layer(f, {'x': cur}, {})
+    return out
+
+
+def _col_slice(x, start_index, length):
+    dim = x.shape[-1]
+    end = dim if length == -1 else start_index + length
+    return apply_op_layer('slice', {'x': x},
+                          {'axes': [1], 'starts': [start_index],
+                           'ends': [end]})
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """ref: contrib partial_concat_op: concat column slices of each input."""
+    parts = [_col_slice(x, start_index, length) for x in input]
+    return apply_op_layer('concat', {'xs': parts}, {'axis': 1})
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """ref: contrib partial_sum_op: sum the column slices of the inputs."""
+    parts = [_col_slice(x, start_index, length) for x in input]
+    return apply_op_layer('sum', {'xs': parts}, {})
+
+
+def shuffle_batch(x, seed=None):
+    """ref: contrib shuffle_batch_op (uses the registered rng op)."""
+    return apply_op_layer('shuffle_batch', {'x': x},
+                          {'seed': int(seed or 0)})
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act='tanh', param_attr=None, bias_attr=None,
+              name=None):
+    """ref: contrib/layers/nn.py:tree_conv over the registered op."""
+    from ..layer_helper import LayerHelper
+    from ..initializer import XavierInitializer
+    helper = LayerHelper('tree_conv', param_attr=param_attr, name=name)
+    feat = nodes_vector.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                [feat, 3, output_size, num_filters],
+                                'float32',
+                                default_initializer=XavierInitializer())
+    out = apply_op_layer('tree_conv',
+                         {'nodes': nodes_vector, 'edges': edge_set,
+                          'weight': w}, {'max_depth': max_depth})
+    if act:
+        out = apply_op_layer(act, {'x': out}, {})
+    return out
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False, name=None):
+    """ref: contrib multiclass_nms2 — NMS that can also return indices."""
+    out, idx, _ = apply_op_layer(
+        'multiclass_nms', {'bboxes': bboxes, 'scores': scores},
+        {'background_label': background_label,
+         'score_threshold': score_threshold, 'nms_top_k': nms_top_k,
+         'nms_threshold': nms_threshold, 'nms_eta': nms_eta,
+         'keep_top_k': keep_top_k, 'normalized': normalized}, name=name)
+    if return_index:
+        return out, idx
+    return out

@@ -149,3 +149,82 @@ def quant_post(model, calib_reader, num_batches=10, activation_bits=8,
                 'weight': np.max(np.abs(w), axis=axes),
             }
     return scales
+
+
+class PostTrainingQuantization:
+    """ref: contrib/slim/quantization/post_training_quantization.py —
+    class-form wrapper over quant_post: calibrate a float model, return
+    scales, and save_quantized_model persists the float state + scales for
+    the int8 Predictor path (inference.py Config.enable_int8)."""
+
+    def __init__(self, model=None, sample_generator=None, batch_nums=10,
+                 activation_bits=8, weight_bits=8, algo='abs_max', **kw):
+        if model is None:
+            raise ValueError(
+                "PostTrainingQuantization needs a dygraph `model=` Layer; "
+                "the reference's executor/model_dir loading form is not "
+                "supported — load the model first (load_dygraph + "
+                "set_dict), then pass it here"
+                + (f" (got unsupported kwargs {sorted(kw)})" if kw else ""))
+        self._model = model
+        self._reader = sample_generator
+        self._batches = batch_nums
+        self._abits = activation_bits
+        self._wbits = weight_bits
+        self._scales = None
+
+    def quantize(self):
+        self._scales = quant_post(self._model, self._reader,
+                                  num_batches=self._batches,
+                                  activation_bits=self._abits,
+                                  weight_bits=self._wbits)
+        return self._scales
+
+    @property
+    def scales(self):
+        return self._scales
+
+    def save_quantized_model(self, save_model_path):
+        """Persist the calibrated model: float state_dict (npz) + per-layer
+        activation/weight scales, consumable by the int8 Predictor."""
+        import os
+        if self._scales is None:
+            self.quantize()
+        os.makedirs(save_model_path, exist_ok=True)
+        from ..dygraph.checkpoint import save_dygraph
+        save_dygraph(self._model.state_dict(),
+                     os.path.join(save_model_path, 'model'))
+        flat = {}
+        for name, info in self._scales.items():
+            flat[f'{name}.activation'] = np.asarray([info['activation']])
+            flat[f'{name}.weight'] = np.asarray(info['weight'])
+        np.savez(os.path.join(save_model_path, 'quant_scales.npz'), **flat)
+        return save_model_path
+
+
+class WeightQuantization:
+    """ref: contrib/slim/quantization/quantization_pass.py:
+    WeightQuantization — channel-wise abs-max weight scales for a dygraph
+    model (weight-only int8; raw abs-max, directly consumable by
+    inference Config.enable_int8)."""
+
+    def __init__(self, model=None, weight_bits=8):
+        self._model = model
+        self._bits = weight_bits
+
+    def quantize_weight_to_int(self, quantizable_op_type=None):
+        """Returns per-layer channel-wise abs-max scales (the SAME raw
+        abs-max convention as quant_post and the int8 Predictor's
+        calibration — inference.py Config.enable_int8)."""
+        type_map = {'conv2d': Conv2D, 'mul': Linear, 'matmul': Linear,
+                    'linear': Linear}
+        wanted = (QUANTIZABLE if quantizable_op_type is None else
+                  tuple({type_map[t] for t in quantizable_op_type
+                         if t in type_map}))
+        scales = {}
+        for name, sub in self._model.named_sublayers():
+            if isinstance(sub, wanted):
+                w = np.asarray(sub.weight.numpy())
+                axes = tuple(range(1, w.ndim))
+                scales[name] = np.max(np.abs(w), axis=axes)
+        return scales

@@ -64,7 +64,9 @@ def pprint_block_codes(block, show_backward=True):
             continue
         outs = ', '.join(op.output_names()) or '_'
         ins = ', '.join(op.input_names())
-        attrs = {k: v for k, v in op.attrs.items() if k != 'initializer'}
+        from .ops.registry import NON_KERNEL_ATTRS
+        attrs = {k: v for k, v in op.attrs.items()
+                 if k not in NON_KERNEL_ATTRS}
         out.append(f"{outs} = {op.type}({ins})"
                    f"{'  # ' + repr(attrs) if attrs else ''}")
     return '\n'.join(out)

@@ -22,7 +22,7 @@ from .core.scope import global_scope
 from .core.random import default_generator
 from .framework import (BACKWARD_OP_TYPE, Program, Variable,
                         default_main_program)
-from .ops.registry import get_op
+from .ops.registry import NON_KERNEL_ATTRS, get_op
 
 
 class _OpRunner:
@@ -54,7 +54,7 @@ class _OpRunner:
             else:
                 args.append(read(names[0]))
         attrs = {k: v for k, v in op.attrs.items()
-                 if k not in ('initializer', 'op_device')}
+                 if k not in NON_KERNEL_ATTRS}
         if opdef.needs_rng:
             attrs['key'] = key
         amp = getattr(op.block.program, '_amp_config', None)
@@ -364,8 +364,10 @@ def _pipeline_plan(program, fwd_ops, marker, feed_names, state_names,
     state_set = set(state_names)
 
     def op_sig(op):
+        # op_device annotations must not break stage isomorphism — per-stage
+        # device_guard is the canonical fluid PipelineOptimizer idiom
         attrs = tuple(sorted((k, repr(v)) for k, v in op.attrs.items()
-                             if k != 'initializer'))
+                             if k not in NON_KERNEL_ATTRS))
         return (op.type, attrs)
 
     template_sig = [op_sig(o) for o in fwd_ops[stages[0][0]:stages[0][1]]]

@@ -121,8 +121,9 @@ class LayerHelper:
                 if s is None:
                     return
                 args.append(s)
-        attrs = dict(op.attrs)
-        attrs.pop('initializer', None)
+        from .ops.registry import NON_KERNEL_ATTRS
+        attrs = {k: v for k, v in op.attrs.items()
+                 if k not in NON_KERNEL_ATTRS}
         try:
             if opdef.needs_rng:
                 key_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)

@@ -20,6 +20,11 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 _REGISTRY: Dict[str, 'OpDef'] = {}
 
+# program-level bookkeeping attrs that must NEVER reach an op kernel's
+# kwargs (filtered by the executor run path, shape inference, the pipeline
+# isomorphism signature, and the debugger printer alike)
+NON_KERNEL_ATTRS = frozenset({'initializer', 'op_device'})
+
 
 class OpDef:
     def __init__(self, name: str, fn: Callable, input_slots: List[str],

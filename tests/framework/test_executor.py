@@ -114,8 +114,11 @@ def test_framework_misc_api_parity():
         y = layers.scale(x, scale=2.0)
     op = fluid.default_main_program().global_block().ops[-1]
     assert op.attrs.get('op_device') == 'gpu:1'
+    assert y.shape is not None        # shape inference survives the attr
+    layers.fc(y, size=3)              # downstream layers can size weights
     # annotated ops still execute (the attr must not leak into the kernel)
     exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())   # init the fc params above
     out, = exe.run(feed={'dgx': np.ones((2, 4), np.float32)},
                    fetch_list=[y])
     np.testing.assert_allclose(out, 2.0 * np.ones((2, 4)), rtol=1e-6)
