@@ -61,85 +61,66 @@ def extend_with_decoupled_weight_decay(base_optimizer_cls):
     return DecoupledWeightDecay
 
 
-def _lazy_layer_base():
-    from ..dygraph import Layer
-    return Layer
+from ..dygraph.layers import Layer as _Layer
+from ..dygraph.tape import dispatch_op as _dispatch
 
 
-class BasicLSTMUnit:
+class BasicLSTMUnit(_Layer):
     """ref: contrib/layers/rnn_impl.py:BasicLSTMUnit — one LSTM step. A
     dygraph Layer (weights are real trainable parameters on the tape)."""
 
-    def __new__(cls, name_scope=None, hidden_size=None, forget_bias=1.0,
-                dtype='float32', **kw):
-        from ..dygraph import Layer
-        from ..dygraph.tape import dispatch_op
+    def __init__(self, name_scope=None, hidden_size=None, forget_bias=1.0,
+                 dtype='float32', **kw):
+        super().__init__()
+        self._hidden = hidden_size
+        self._forget_bias = float(forget_bias)
+        self._dtype = dtype
+        self._built = False
 
-        class _Unit(Layer):
-            def __init__(self):
-                super().__init__()
-                self._hidden = hidden_size
-                self._forget_bias = float(forget_bias)
-                self._built = False
+    def _ensure(self, in_dim):
+        if not self._built:
+            self.weight = self.create_parameter(
+                [in_dim + self._hidden, 4 * self._hidden], None, self._dtype)
+            self.bias = self.create_parameter(
+                [4 * self._hidden], None, self._dtype, is_bias=True)
+            self._built = True
 
-            def _ensure(self, in_dim):
-                if not self._built:
-                    self.weight = self.create_parameter(
-                        [in_dim + self._hidden, 4 * self._hidden], None,
-                        dtype)
-                    self.bias = self.create_parameter(
-                        [4 * self._hidden], None, dtype, is_bias=True)
-                    self._built = True
-
-            def forward(self, x, pre_hidden, pre_cell):
-                self._ensure(x.shape[-1])
-                xh = dispatch_op('concat', {'xs': [x, pre_hidden]},
-                                 {'axis': -1})
-                gates = dispatch_op('matmul', {'x': xh, 'y': self.weight},
-                                    {})
-                gates = dispatch_op('elementwise_add',
-                                    {'x': gates, 'y': self.bias},
-                                    {'axis': -1})
-                h, c = dispatch_op('lstm_unit',
-                                   {'x': gates, 'cell': pre_cell},
-                                   {'forget_bias': self._forget_bias})
-                return h, c
-
-        return _Unit()
+    def forward(self, x, pre_hidden, pre_cell):
+        self._ensure(x.shape[-1])
+        xh = _dispatch('concat', {'xs': [x, pre_hidden]}, {'axis': -1})
+        gates = _dispatch('matmul', {'x': xh, 'y': self.weight}, {})
+        gates = _dispatch('elementwise_add', {'x': gates, 'y': self.bias},
+                          {'axis': -1})
+        h, c = _dispatch('lstm_unit', {'x': gates, 'cell': pre_cell},
+                         {'forget_bias': self._forget_bias})
+        return h, c
 
 
-class BasicGRUUnit:
+class BasicGRUUnit(_Layer):
     """ref: contrib/layers/rnn_impl.py:BasicGRUUnit (dygraph Layer)."""
 
-    def __new__(cls, name_scope=None, hidden_size=None, dtype='float32',
-                **kw):
-        from ..dygraph import Layer
-        from ..dygraph.tape import dispatch_op
+    def __init__(self, name_scope=None, hidden_size=None, dtype='float32',
+                 **kw):
+        super().__init__()
+        self._hidden = hidden_size
+        self._dtype = dtype
+        self._built = False
 
-        class _Unit(Layer):
-            def __init__(self):
-                super().__init__()
-                self._hidden = hidden_size
-                self._built = False
+    def _ensure(self, in_dim):
+        if not self._built:
+            self.wx = self.create_parameter(
+                [in_dim, 3 * self._hidden], None, self._dtype)
+            self.wh = self.create_parameter(
+                [self._hidden, 3 * self._hidden], None, self._dtype)
+            self._built = True
 
-            def _ensure(self, in_dim):
-                if not self._built:
-                    self.wx = self.create_parameter(
-                        [in_dim, 3 * self._hidden], None, dtype)
-                    self.wh = self.create_parameter(
-                        [self._hidden, 3 * self._hidden], None, dtype)
-                    self._built = True
-
-            def forward(self, x, pre_hidden):
-                self._ensure(x.shape[-1])
-                proj = dispatch_op('matmul', {'x': x, 'y': self.wx}, {})
-                h, _, _ = dispatch_op(
-                    'gru_unit',
-                    {'x': proj, 'hidden': pre_hidden, 'weight': self.wh},
-                    {})
-                return h
-
-        return _Unit()
+    def forward(self, x, pre_hidden):
+        self._ensure(x.shape[-1])
+        proj = _dispatch('matmul', {'x': x, 'y': self.wx}, {})
+        h, _, _ = _dispatch(
+            'gru_unit', {'x': proj, 'hidden': pre_hidden, 'weight': self.wh},
+            {})
+        return h
 
 
 def _check_rnn_config(num_layers, bidirectional, dropout_prob):
@@ -156,7 +137,9 @@ def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
                batch_first=True, forget_bias=1.0, dtype='float32',
                name=None):
     """ref: contrib/layers/rnn_impl.py:basic_lstm — static-graph layer over
-    the scan-based `lstm` op; weights are trainable parameters."""
+    the scan-based `lstm` op; weights are trainable parameters. Returns
+    (hidden, last_hidden (1, B, H), last_cell (1, B, H)); last states can
+    feed back as init_hidden/init_cell."""
     _check_rnn_config(num_layers, bidirectional, dropout_prob)
     from ..layer_helper import LayerHelper
     helper = LayerHelper('basic_lstm', name=name)
@@ -164,17 +147,38 @@ def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
     if not batch_first:
         x = apply_op_layer('transpose_batch_time', {'x': x}, {})
     D = x.shape[-1]
+    from ..initializer import NumpyArrayInitializer
     wx = helper.create_parameter(None, [D, 4 * hidden_size], dtype)
     wh = helper.create_parameter(None, [hidden_size, 4 * hidden_size], dtype)
-    b = helper.create_parameter(None, [4 * hidden_size], dtype, is_bias=True)
+    # gate order i,f,c,o (ops/rnn_ops.py): the forget slice starts at the
+    # standard forget_bias so gates open (~sigmoid(1)) at init
+    b_init = np.zeros((4 * hidden_size,), np.float32)
+    b_init[hidden_size:2 * hidden_size] = float(forget_bias)
+    b = helper.create_parameter(None, [4 * hidden_size], dtype, is_bias=True,
+                                default_initializer=NumpyArrayInitializer(
+                                    b_init))
     proj = apply_op_layer('matmul', {'x': x, 'y': wx}, {})
+
+    def _flat_state(t):
+        # accept both (B, H) and the returned (1, B, H) stateful form
+        if t is not None and t.shape is not None and len(t.shape) == 3:
+            t = apply_op_layer('reshape', {'x': t},
+                               {'shape': [-1, hidden_size]})
+        return t
+
     hidden, cell = apply_op_layer(
-        'lstm', {'x': proj, 'h0': init_hidden, 'c0': init_cell, 'w_h': wh,
+        'lstm', {'x': proj, 'h0': _flat_state(init_hidden),
+                 'c0': _flat_state(init_cell), 'w_h': wh,
                  'bias': b, 'seq_len': sequence_length}, {})
-    last_h = apply_op_layer('slice', {'x': hidden},
-                            {'axes': [1], 'starts': [-1], 'ends': [2 ** 30]})
-    last_c = apply_op_layer('slice', {'x': cell},
-                            {'axes': [1], 'starts': [-1], 'ends': [2 ** 30]})
+
+    def _last(t):
+        # (B, T, H) → (num_layers=1, B, H), the reference's stateful-RNN
+        # shape so last_h feeds back as the next init_hidden
+        s = apply_op_layer('slice', {'x': t},
+                           {'axes': [1], 'starts': [-1], 'ends': [2 ** 30]})
+        return apply_op_layer('transpose', {'x': s}, {'perm': [1, 0, 2]})
+
+    last_h, last_c = _last(hidden), _last(cell)
     if not batch_first:
         hidden = apply_op_layer('transpose_batch_time', {'x': hidden}, {})
     return hidden, last_h, last_c
@@ -197,11 +201,16 @@ def basic_gru(input, init_hidden, hidden_size, num_layers=1,
                                      dtype)
     cand_w = helper.create_parameter(None, [hidden_size, hidden_size], dtype)
     proj = apply_op_layer('matmul', {'x': x, 'y': wx}, {})
+    if init_hidden is not None and init_hidden.shape is not None \
+            and len(init_hidden.shape) == 3:
+        init_hidden = apply_op_layer('reshape', {'x': init_hidden},
+                                     {'shape': [-1, hidden_size]})
     out = apply_op_layer(
         'gru', {'x': proj, 'h0': init_hidden, 'gate_w': gate_w,
                 'cand_w': cand_w, 'seq_len': sequence_length}, {})
     last = apply_op_layer('slice', {'x': out},
                           {'axes': [1], 'starts': [-1], 'ends': [2 ** 30]})
+    last = apply_op_layer('transpose', {'x': last}, {'perm': [1, 0, 2]})
     if not batch_first:
         out = apply_op_layer('transpose_batch_time', {'x': out}, {})
     return out, last
@@ -212,27 +221,35 @@ def basic_gru(input, init_hidden, hidden_size, num_layers=1,
 
 def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
                               save_intermediate_out=True):
-    """ref: contrib/layers/nn.py:fused_elemwise_activation. On TPU the
-    'fusion' is XLA's job — compose the named ops directly."""
-    out = None
-    for f in functor_list:
-        f = f.strip()
-        cur = x if out is None else out
-        if f.startswith('elementwise_'):
-            out = apply_op_layer(f, {'x': cur, 'y': y}, {'axis': axis})
-        elif f == 'scale':
-            out = apply_op_layer('scale', {'x': cur}, {'scale': scale})
-        else:
-            out = apply_op_layer(f, {'x': cur}, {})
-    return out
+    """ref: contrib/layers/nn.py:fused_elemwise_activation over
+    operators/fused/fused_elemwise_activation_op.cc. The reference
+    contract for [binary, unary] is Binary(X, Unary(Y)); for
+    [unary, binary] it is Unary(Binary(X, Y)). On TPU the fusion itself
+    is XLA's job — only the composition order matters here."""
+    if len(functor_list) != 2:
+        raise ValueError(
+            f"functor_list must hold exactly one binary and one unary "
+            f"functor, got {functor_list}")
+    f0, f1 = (f.strip() for f in functor_list)
+
+    def unary(f, t):
+        if f == 'scale':
+            return apply_op_layer('scale', {'x': t}, {'scale': scale})
+        return apply_op_layer(f, {'x': t}, {})
+
+    if f0.startswith('elementwise_'):     # Binary(X, Unary(Y))
+        return apply_op_layer(f0, {'x': x, 'y': unary(f1, y)},
+                              {'axis': axis})
+    # Unary(Binary(X, Y))
+    return unary(f0, apply_op_layer(f1, {'x': x, 'y': y}, {'axis': axis}))
 
 
 def _col_slice(x, start_index, length):
-    dim = x.shape[-1]
-    end = dim if length == -1 else start_index + length
+    dim = int(x.shape[-1])
+    start = start_index + dim if start_index < 0 else start_index
+    end = dim if length == -1 else start + length
     return apply_op_layer('slice', {'x': x},
-                          {'axes': [1], 'starts': [start_index],
-                           'ends': [end]})
+                          {'axes': [1], 'starts': [start], 'ends': [end]})
 
 
 def partial_concat(input, start_index=0, length=-1):

@@ -208,7 +208,13 @@ class WeightQuantization:
     model (weight-only int8; raw abs-max, directly consumable by
     inference Config.enable_int8)."""
 
-    def __init__(self, model=None, weight_bits=8):
+    def __init__(self, model=None, weight_bits=8, **kw):
+        if model is None:
+            raise ValueError(
+                "WeightQuantization needs a dygraph `model=` Layer; the "
+                "reference's model_dir form is not supported — load the "
+                "model first, then pass it here"
+                + (f" (got unsupported kwargs {sorted(kw)})" if kw else ""))
         self._model = model
         self._bits = weight_bits
 

@@ -233,7 +233,9 @@ def tree_conv(nodes, edges, weight, *, max_depth=8):
     agg_self = jnp.einsum('bnf,fok->bnok', nodes, w[:, 0])
     agg_l = jnp.einsum('bnf,fok->bnok', nodes, w[:, 1])
     agg_r = jnp.einsum('bnf,fok->bnok', nodes, w[:, 2])
-    return jnp.tanh(agg_self + 0.5 * (agg_l + agg_r))
+    # linear output — the layer wrapper owns the activation (double-tanh
+    # otherwise; ref applies act outside the kernel too)
+    return agg_self + 0.5 * (agg_l + agg_r)
 
 
 @register_op('auc')

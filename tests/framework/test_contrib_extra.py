@@ -62,6 +62,20 @@ def test_basic_lstm_and_gru_train_static():
     assert hv.shape == (2, 5, 6)
     assert losses[-1] < losses[0]              # weights actually train
 
+    # stateful round-trip: last states feed back as init states
+    main2, start2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, start2):
+        x3 = layers.data('x3', [5, 8], dtype='float32')
+        h1, lh, lc = extra.basic_lstm(x3, None, None, hidden_size=6)
+        h2, lh2, lc2 = extra.basic_lstm(x3, lh, lc, hidden_size=6)
+        assert lh.shape[0] == 1 and h2.shape[-1] == 6
+    exe2 = fluid.Executor()
+    exe2.run(start2)
+    out2, = exe2.run(main2,
+                     feed={'x3': np.zeros((2, 5, 8), np.float32)},
+                     fetch_list=[h2])
+    assert out2.shape == (2, 5, 6)
+
     with pytest.raises(NotImplementedError):
         with fluid.program_guard(fluid.Program(), fluid.Program()):
             x2 = layers.data('x2', [5, 8], dtype='float32')
