@@ -4,6 +4,12 @@ Usage: python examples/train_bert_fleet.py [--steps N]
 Uses all local devices as the 'dp' mesh axis (8 virtual CPU devices under
 XLA_FLAGS=--xla_force_host_platform_device_count=8)."""
 import argparse
+import os
+import sys
+
+# runnable from anywhere: put the repo root on sys.path
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
 
 import numpy as np
 

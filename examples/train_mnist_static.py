@@ -3,6 +3,12 @@
 Usage: python examples/train_mnist_static.py [--epochs N]
 Runs on whatever backend jax selects (TPU chip or CPU)."""
 import argparse
+import os
+import sys
+
+# runnable from anywhere: put the repo root on sys.path
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
 
 import numpy as np
 

@@ -123,6 +123,21 @@ class BasicGRUUnit(_Layer):
         return h
 
 
+def _flat_state(t, hidden_size):
+    """Accept both (B, H) and the returned (1, B, H) stateful form."""
+    if t is not None and t.shape is not None and len(t.shape) == 3:
+        t = apply_op_layer('reshape', {'x': t}, {'shape': [-1, hidden_size]})
+    return t
+
+
+def _last_state(t):
+    """(B, T, H) → (num_layers=1, B, H): the reference's stateful-RNN
+    shape, so last states feed back as the next init states."""
+    s = apply_op_layer('slice', {'x': t},
+                       {'axes': [1], 'starts': [-1], 'ends': [2 ** 30]})
+    return apply_op_layer('transpose', {'x': s}, {'perm': [1, 0, 2]})
+
+
 def _check_rnn_config(num_layers, bidirectional, dropout_prob):
     if num_layers != 1 or bidirectional or dropout_prob:
         raise NotImplementedError(
@@ -158,27 +173,11 @@ def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
                                 default_initializer=NumpyArrayInitializer(
                                     b_init))
     proj = apply_op_layer('matmul', {'x': x, 'y': wx}, {})
-
-    def _flat_state(t):
-        # accept both (B, H) and the returned (1, B, H) stateful form
-        if t is not None and t.shape is not None and len(t.shape) == 3:
-            t = apply_op_layer('reshape', {'x': t},
-                               {'shape': [-1, hidden_size]})
-        return t
-
     hidden, cell = apply_op_layer(
-        'lstm', {'x': proj, 'h0': _flat_state(init_hidden),
-                 'c0': _flat_state(init_cell), 'w_h': wh,
+        'lstm', {'x': proj, 'h0': _flat_state(init_hidden, hidden_size),
+                 'c0': _flat_state(init_cell, hidden_size), 'w_h': wh,
                  'bias': b, 'seq_len': sequence_length}, {})
-
-    def _last(t):
-        # (B, T, H) → (num_layers=1, B, H), the reference's stateful-RNN
-        # shape so last_h feeds back as the next init_hidden
-        s = apply_op_layer('slice', {'x': t},
-                           {'axes': [1], 'starts': [-1], 'ends': [2 ** 30]})
-        return apply_op_layer('transpose', {'x': s}, {'perm': [1, 0, 2]})
-
-    last_h, last_c = _last(hidden), _last(cell)
+    last_h, last_c = _last_state(hidden), _last_state(cell)
     if not batch_first:
         hidden = apply_op_layer('transpose_batch_time', {'x': hidden}, {})
     return hidden, last_h, last_c
@@ -201,16 +200,11 @@ def basic_gru(input, init_hidden, hidden_size, num_layers=1,
                                      dtype)
     cand_w = helper.create_parameter(None, [hidden_size, hidden_size], dtype)
     proj = apply_op_layer('matmul', {'x': x, 'y': wx}, {})
-    if init_hidden is not None and init_hidden.shape is not None \
-            and len(init_hidden.shape) == 3:
-        init_hidden = apply_op_layer('reshape', {'x': init_hidden},
-                                     {'shape': [-1, hidden_size]})
     out = apply_op_layer(
-        'gru', {'x': proj, 'h0': init_hidden, 'gate_w': gate_w,
-                'cand_w': cand_w, 'seq_len': sequence_length}, {})
-    last = apply_op_layer('slice', {'x': out},
-                          {'axes': [1], 'starts': [-1], 'ends': [2 ** 30]})
-    last = apply_op_layer('transpose', {'x': last}, {'perm': [1, 0, 2]})
+        'gru', {'x': proj, 'h0': _flat_state(init_hidden, hidden_size),
+                'gate_w': gate_w, 'cand_w': cand_w,
+                'seq_len': sequence_length}, {})
+    last = _last_state(out)
     if not batch_first:
         out = apply_op_layer('transpose_batch_time', {'x': out}, {})
     return out, last
@@ -226,10 +220,11 @@ def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
     contract for [binary, unary] is Binary(X, Unary(Y)); for
     [unary, binary] it is Unary(Binary(X, Y)). On TPU the fusion itself
     is XLA's job — only the composition order matters here."""
-    if len(functor_list) != 2:
+    if len(functor_list) != 2 or sum(
+            f.strip().startswith('elementwise_') for f in functor_list) != 1:
         raise ValueError(
-            f"functor_list must hold exactly one binary and one unary "
-            f"functor, got {functor_list}")
+            f"functor_list must hold exactly one binary (elementwise_*) and "
+            f"one unary functor, got {functor_list}")
     f0, f1 = (f.strip() for f in functor_list)
 
     def unary(f, t):

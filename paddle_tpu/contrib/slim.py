@@ -159,7 +159,7 @@ class PostTrainingQuantization:
 
     def __init__(self, model=None, sample_generator=None, batch_nums=10,
                  activation_bits=8, weight_bits=8, algo='abs_max', **kw):
-        if model is None:
+        if model is None or isinstance(model, str):
             raise ValueError(
                 "PostTrainingQuantization needs a dygraph `model=` Layer; "
                 "the reference's executor/model_dir loading form is not "
@@ -209,7 +209,7 @@ class WeightQuantization:
     inference Config.enable_int8)."""
 
     def __init__(self, model=None, weight_bits=8, **kw):
-        if model is None:
+        if model is None or isinstance(model, str):
             raise ValueError(
                 "WeightQuantization needs a dygraph `model=` Layer; the "
                 "reference's model_dir form is not supported — load the "

@@ -495,6 +495,10 @@ class TrainStep:
 
         def forward(pvals, bvals, batch):
             if amp_dtype is not None:
+                # params cast to the compute dtype; fp32 FEEDS meet the
+                # low-precision weights at conv/matmul, which harmonize the
+                # activation onto the weight dtype (ops/nn_ops.py) — labels
+                # and loss targets are never touched
                 pvals = {n: (v.astype(amp_dtype)
                              if jnp.issubdtype(v.dtype, jnp.floating) else v)
                          for n, v in pvals.items()}

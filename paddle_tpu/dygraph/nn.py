@@ -370,6 +370,8 @@ class TreeConv(Layer):
                           {'nodes': nodes_vector, 'edges': edge_set,
                            'weight': self.weight},
                           {'max_depth': self._max_depth})
+        if self._act:
+            out = dispatch_op(self._act, {'x': out}, {})
         return out
 
 

@@ -24,6 +24,17 @@ def _pair(v, n=2):
     return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
 
 
+def _match_weight_dtype(x, w):
+    """AMP harmonization: an fp32 activation meeting a low-precision
+    weight computes in the WEIGHT's dtype (the master-weight design casts
+    params to the compute dtype; feeds may still arrive fp32)."""
+    if (jnp.issubdtype(x.dtype, jnp.floating)
+            and jnp.issubdtype(w.dtype, jnp.floating)
+            and x.dtype != w.dtype):
+        return x.astype(w.dtype)
+    return x
+
+
 def _conv_dims(data_format, nd):
     if nd == 2:
         return ('NCHW', 'OIHW', 'NCHW') if data_format == 'NCHW' else ('NHWC', 'HWIO', 'NHWC')
@@ -36,6 +47,7 @@ def conv2d(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
     """ref: paddle/fluid/operators/conv_op.cc (weights always OIHW)."""
     x = jnp.asarray(x)
     w = jnp.asarray(weight)
+    x = _match_weight_dtype(x, w)
     stride = _pair(stride)
     dilation = _pair(dilation)
     if isinstance(padding, str):
@@ -56,6 +68,7 @@ def conv3d(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
            data_format='NCDHW'):
     x = jnp.asarray(x)
     w = jnp.asarray(weight)
+    x = _match_weight_dtype(x, w)
     stride = _pair(stride, 3)
     dilation = _pair(dilation, 3)
     p = _pair(padding, 3)
@@ -71,6 +84,7 @@ def conv2d_transpose(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
     """ref: paddle/fluid/operators/conv_transpose_op.cc. Weight layout IOHW."""
     x = jnp.asarray(x)
     w = jnp.asarray(weight)
+    x = _match_weight_dtype(x, w)
     stride = _pair(stride)
     p = _pair(padding)
     # grad-of-conv formulation: lhs_dilation = stride
@@ -99,6 +113,7 @@ def conv3d_transpose(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
                      data_format='NCDHW'):
     x = jnp.asarray(x)
     w = jnp.asarray(weight)
+    x = _match_weight_dtype(x, w)
     stride = _pair(stride, 3)
     p = _pair(padding, 3)
     d = _pair(dilation, 3)
