@@ -1,0 +1,33 @@
+"""Async parameter-server Communicator (ref: python/paddle/fluid/
+communicator.py).
+
+The reference runs C++ send/recv threads against remote pservers. On TPU
+pods there are no parameter servers — dense state is sharded/replicated by
+GSPMD and synchronized by XLA collectives inside the step — so the
+communicator's lifecycle API is preserved while transfer itself is a no-op
+(mirrors the PS-mode lowering in incubate/fleet/parameter_server).
+"""
+
+__all__ = ['Communicator']
+
+
+class Communicator:
+    def __init__(self, program, mode=None, kwargs=None, envs=None):
+        """ref communicator.py — bind to a (transpiled) program."""
+        self.program = program
+        self.mode = mode
+        self.envs = dict(envs or {})
+        self._running = False
+
+    def start(self):
+        """ref :start — begin async communication (no-op on TPU: XLA
+        collectives run in-step)."""
+        self._running = True
+
+    def stop(self):
+        """ref :stop."""
+        self._running = False
+
+    def is_running(self):
+        """ref :is_running."""
+        return self._running

@@ -1,0 +1,112 @@
+"""DataFeedDesc (ref: python/paddle/fluid/data_feed_desc.py) — describes the
+MultiSlot text format consumed by fluid.dataset readers.
+
+The reference wraps a data_feed.proto message; here the same fields live in
+a plain dict parsed from the protobuf TEXT format (a small indentation-free
+`key: value` / `block { }` grammar), so existing .proto text files work
+unchanged. `fluid.dataset` uses the slot list to parse data files.
+"""
+
+__all__ = ['DataFeedDesc']
+
+
+def _parse_text_proto(text):
+    """Minimal text-format protobuf reader → nested dict (repeated fields
+    become lists)."""
+    root = {}
+    stack = [root]
+    for raw in text.splitlines():
+        line = raw.split('#', 1)[0].strip()
+        if not line:
+            continue
+        if line.endswith('{'):
+            child = {}
+            key = line[:-1].strip()
+            cur = stack[-1]
+            if key in cur:
+                if not isinstance(cur[key], list):
+                    cur[key] = [cur[key]]
+                cur[key].append(child)
+            else:
+                cur[key] = child
+            stack.append(child)
+        elif line == '}':
+            stack.pop()
+        elif ':' in line:
+            key, val = (s.strip() for s in line.split(':', 1))
+            if val.startswith('"') and val.endswith('"'):
+                val = val[1:-1]
+            elif val in ('true', 'false'):
+                val = val == 'true'
+            else:
+                try:
+                    val = int(val)
+                except ValueError:
+                    try:
+                        val = float(val)
+                    except ValueError:
+                        pass
+            cur = stack[-1]
+            if key in cur:
+                if not isinstance(cur[key], list):
+                    cur[key] = [cur[key]]
+                cur[key].append(val)
+            else:
+                cur[key] = val
+    return root
+
+
+def _to_text_proto(d, indent=0):
+    pad = '  ' * indent
+    out = []
+    for k, v in d.items():
+        vals = v if isinstance(v, list) else [v]
+        for item in vals:
+            if isinstance(item, dict):
+                out.append(f'{pad}{k} {{')
+                out.append(_to_text_proto(item, indent + 1))
+                out.append(f'{pad}}}')
+            elif isinstance(item, bool):
+                out.append(f'{pad}{k}: {"true" if item else "false"}')
+            elif isinstance(item, str):
+                out.append(f'{pad}{k}: "{item}"')
+            else:
+                out.append(f'{pad}{k}: {item}')
+    return '\n'.join(out)
+
+
+class DataFeedDesc:
+    """ref data_feed_desc.py:DataFeedDesc — load from a text-proto file."""
+
+    def __init__(self, proto_file):
+        with open(proto_file) as f:
+            self.proto_desc = _parse_text_proto(f.read())
+        self.proto_desc.setdefault('pipe_command', 'cat')
+        self._name_to_idx = {}
+        for i, slot in enumerate(self._slots()):
+            self._name_to_idx[slot.get('name')] = i
+
+    def _slots(self):
+        msd = self.proto_desc.get('multi_slot_desc', {})
+        slots = msd.get('slots', [])
+        return slots if isinstance(slots, list) else [slots]
+
+    def set_batch_size(self, batch_size):
+        """ref :set_batch_size."""
+        self.proto_desc['batch_size'] = int(batch_size)
+
+    def set_dense_slots(self, dense_slots_name):
+        """ref :set_dense_slots — mark named slots dense."""
+        slots = self._slots()
+        for name in dense_slots_name:
+            slots[self._name_to_idx[name]]['is_dense'] = True
+
+    def set_use_slots(self, use_slots_name):
+        """ref :set_use_slots — mark named slots used (fed to the model)."""
+        slots = self._slots()
+        for name in use_slots_name:
+            slots[self._name_to_idx[name]]['is_used'] = True
+
+    def desc(self):
+        """ref :desc — text-proto string of the current description."""
+        return _to_text_proto(self.proto_desc)

@@ -1,0 +1,37 @@
+"""Merged dataset namespace.
+
+Two reference surfaces live here (paddle and fluid share one namespace in
+this build — `paddle_tpu.fluid is paddle_tpu`):
+
+- `paddle.dataset.*` zoo (ref: python/paddle/dataset/): mnist, cifar,
+  uci_housing, imdb, imikolov, movielens, mq2007, sentiment, conll05,
+  flowers, voc2012, wmt14, wmt16, image, common. Real files when staged
+  under the local cache (no network egress here), deterministic synthetic
+  corpora with identical sample structure otherwise (readers carry
+  `.is_synthetic`).
+- `fluid.dataset` (ref: python/paddle/fluid/dataset.py): DatasetFactory /
+  InMemoryDataset / QueueDataset — MultiSlot-file training input for
+  Executor.train_from_dataset.
+"""
+from .fluid_dataset import (DatasetFactory, InMemoryDataset, QueueDataset,
+                            FileInstantDataset, DatasetBase)
+from . import common
+from . import image
+from . import mnist
+from . import cifar
+from . import uci_housing
+from . import imdb
+from . import imikolov
+from . import movielens
+from . import mq2007
+from . import sentiment
+from . import conll05
+from . import flowers
+from . import voc2012
+from . import wmt14
+from . import wmt16
+
+__all__ = ['DatasetFactory', 'InMemoryDataset', 'QueueDataset',
+           'FileInstantDataset', 'common', 'image', 'mnist', 'cifar',
+           'uci_housing', 'imdb', 'imikolov', 'movielens', 'mq2007',
+           'sentiment', 'conll05', 'flowers', 'voc2012', 'wmt14', 'wmt16']

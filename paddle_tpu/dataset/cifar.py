@@ -1,0 +1,44 @@
+"""paddle.dataset.cifar parity (ref: python/paddle/dataset/cifar.py).
+Samples are (3072-float32 in [-1,1], int label)."""
+import os
+
+from .common import DATA_HOME
+from ..datasets import _cifar_reader
+
+__all__ = ['train100', 'test100', 'train10', 'test10']
+
+
+def _flat(reader_chw):
+    def reader():
+        for img, lab in reader_chw():
+            yield img.reshape(-1), lab
+    reader.is_synthetic = getattr(reader_chw, 'is_synthetic', False)
+    return reader
+
+
+def _path(name):
+    return os.path.join(DATA_HOME, 'cifar', name)
+
+
+def train10():
+    """ref cifar.py:train10."""
+    return _flat(_cifar_reader(_path('cifar-10-python.tar.gz'),
+                               'data_batch', b'labels', 1024, 2))
+
+
+def test10():
+    """ref cifar.py:test10."""
+    return _flat(_cifar_reader(_path('cifar-10-python.tar.gz'),
+                               'test_batch', b'labels', 256, 3))
+
+
+def train100():
+    """ref cifar.py:train100 — fine labels (100 classes)."""
+    return _flat(_cifar_reader(_path('cifar-100-python.tar.gz'),
+                               'train', b'fine_labels', 1024, 4))
+
+
+def test100():
+    """ref cifar.py:test100."""
+    return _flat(_cifar_reader(_path('cifar-100-python.tar.gz'),
+                               'test', b'fine_labels', 256, 5))

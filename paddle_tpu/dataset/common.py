@@ -1,0 +1,119 @@
+"""paddle.dataset.common parity (ref: python/paddle/dataset/common.py):
+DATA_HOME, download, md5file, split, cluster_files_reader.
+
+This environment has no network egress, so `download` resolves against the
+local cache (DATA_HOME, same layout as the reference) and raises a clear
+error when the file is absent instead of fetching.
+"""
+import glob
+import hashlib
+import os
+import pickle
+
+import numpy as np
+
+__all__ = ['DATA_HOME', 'download', 'md5file', 'split',
+           'cluster_files_reader']
+
+DATA_HOME = os.environ.get('PADDLE_TPU_DATA_HOME',
+                           os.path.expanduser('~/.cache/paddle/dataset'))
+
+
+def must_mkdirs(path):
+    os.makedirs(path, exist_ok=True)
+
+
+def md5file(fname):
+    """ref common.py:md5file."""
+    hash_md5 = hashlib.md5()
+    with open(fname, 'rb') as f:
+        for chunk in iter(lambda: f.read(4096), b''):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """ref common.py:download — here: locate the file in the local cache
+    (~/.cache/paddle/dataset/<module_name>/<filename>); no egress."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, url.split('/')[-1] if save_name is None else save_name)
+    if os.path.exists(filename):
+        if md5sum and md5file(filename) != md5sum:
+            raise IOError(
+                f'{filename} exists but its md5 does not match {md5sum}; '
+                'delete the corrupt file and re-stage it')
+        return filename
+    raise IOError(
+        f'dataset file for {url} not found at {filename} and this '
+        'environment has no network egress; stage the file there manually '
+        '(or rely on the dataset module\'s synthetic fallback readers)')
+
+
+def split(reader, line_count, suffix='%05d.pickle', dumper=pickle.dump):
+    """ref common.py:split — chunk a reader into pickled files."""
+    indx_f = 0
+    lines = []
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if (i + 1) % line_count == 0:
+            with open(suffix % indx_f, 'wb') as f:
+                dumper(lines, f)
+            lines = []
+            indx_f += 1
+    if lines:
+        with open(suffix % indx_f, 'wb') as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=pickle.load):
+    """ref common.py:cluster_files_reader — round-robin shard of pickled
+    chunk files across trainers."""
+
+    def reader():
+        file_list = sorted(glob.glob(files_pattern))
+        my_files = [f for i, f in enumerate(file_list)
+                    if i % trainer_count == trainer_id]
+        for fn in my_files:
+            with open(fn, 'rb') as f:
+                for line in loader(f):
+                    yield line
+    return reader
+
+
+# shared synthetic-corpus helpers for the zero-egress fallbacks ------------
+
+def synthetic_warn(module, missing):
+    import logging
+    logging.getLogger('paddle_tpu.dataset').warning(
+        'paddle_tpu.dataset.%s: cache files missing (%s) — serving a '
+        'deterministic SYNTHETIC corpus (reader.is_synthetic=True). '
+        'Accuracy numbers are meaningless; stage real files under %s.',
+        module, missing, DATA_HOME)
+
+
+def synthetic_text_corpus(vocab, n_sentences, seed, min_len=3, max_len=12):
+    """Deterministic fake sentences over `vocab` (a list of words) — used
+    by the text datasets so build_dict/train/test stay mutually
+    consistent."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_sentences):
+        n = rng.randint(min_len, max_len + 1)
+        out.append([vocab[j] for j in rng.randint(0, len(vocab), n)])
+    return out
+
+
+WORDS = [
+    'the', 'of', 'and', 'a', 'to', 'in', 'is', 'you', 'that', 'it', 'he',
+    'was', 'for', 'on', 'are', 'as', 'with', 'his', 'they', 'I', 'at',
+    'be', 'this', 'have', 'from', 'or', 'one', 'had', 'by', 'word', 'but',
+    'not', 'what', 'all', 'were', 'we', 'when', 'your', 'can', 'said',
+    'there', 'use', 'an', 'each', 'which', 'she', 'do', 'how', 'their',
+    'if', 'will', 'up', 'other', 'about', 'out', 'many', 'then', 'them',
+    'these', 'so', 'some', 'her', 'would', 'make', 'like', 'him', 'into',
+    'time', 'has', 'look', 'two', 'more', 'write', 'go', 'see', 'number',
+    'no', 'way', 'could', 'people', 'my', 'than', 'first', 'water', 'been',
+    'call', 'who', 'oil', 'its', 'now', 'find', 'long', 'down', 'day',
+    'did', 'get', 'come', 'made', 'may', 'part']

@@ -1,0 +1,190 @@
+"""paddle.dataset.conll05 parity (ref: python/paddle/dataset/conll05.py) —
+CoNLL-2005 semantic role labeling. Each sample is the 9-feature SRL tuple
+(words, 5 predicate-context features, predicate, mark, labels). Real
+conll05st files when cached; synthetic tagged sentences otherwise."""
+import gzip
+import os
+import tarfile
+
+import numpy as np
+
+from .common import DATA_HOME, WORDS, synthetic_text_corpus, synthetic_warn
+
+__all__ = ['test', 'get_dict', 'get_embedding']
+
+UNK_IDX = 0
+
+_DIR = os.path.join(DATA_HOME, 'conll05st')
+_TAR = os.path.join(_DIR, 'conll05st-tests.tar.gz')
+_LABELS = ['B-A0', 'I-A0', 'B-A1', 'I-A1', 'B-A2', 'I-A2', 'B-V', 'O']
+
+
+def load_dict(filename):
+    """ref conll05.py:68 — one token per line → {token: idx}."""
+    d = {}
+    opener = gzip.open if filename.endswith('.gz') else open
+    with opener(filename, 'rt') as f:
+        for i, line in enumerate(f):
+            d[line.strip()] = i
+    return d
+
+
+def load_label_dict(filename):
+    """ref conll05.py:48 — expand B-/I- prefixed argument labels."""
+    d = {}
+    tag_dict = set()
+    opener = gzip.open if filename.endswith('.gz') else open
+    with opener(filename, 'rt') as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith('B-'):
+                tag_dict.add(line[2:])
+            elif line.startswith('I-'):
+                tag_dict.add(line[2:])
+    index = 0
+    for tag in sorted(tag_dict):
+        d['B-' + tag] = index
+        index += 1
+        d['I-' + tag] = index
+        index += 1
+    d['O'] = index
+    return d
+
+
+def _synthetic_corpus(seed=61, n=120):
+    """(sentence tokens, predicate, labels) triples with one B-V verb."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for sent in synthetic_text_corpus(WORDS, n, seed, min_len=5, max_len=9):
+        vi = rng.randint(1, len(sent) - 1)
+        labels = []
+        for i in range(len(sent)):
+            if i == vi:
+                labels.append('B-V')
+            elif i == vi - 1:
+                labels.append('B-A0')
+            elif i == vi + 1:
+                labels.append('B-A1')
+            else:
+                labels.append('O')
+        out.append((sent, sent[vi], labels))
+    return out
+
+
+def get_dict():
+    """ref conll05.py:205 — (word_dict, verb_dict, label_dict)."""
+    wd_path = os.path.join(_DIR, 'wordDict.txt')
+    vd_path = os.path.join(_DIR, 'verbDict.txt')
+    td_path = os.path.join(_DIR, 'targetDict.txt')
+    if all(os.path.exists(p) for p in (wd_path, vd_path, td_path)):
+        return (load_dict(wd_path), load_dict(vd_path),
+                load_label_dict(td_path))
+    corpus = _synthetic_corpus()
+    words = sorted({w for sent, _, _ in corpus for w in sent}
+                   | {'bos', 'eos'})
+    verbs = sorted({v for _, v, _ in corpus})
+    word_dict = {w: i for i, w in enumerate(words)}
+    verb_dict = {v: i for i, v in enumerate(verbs)}
+    label_dict = {l: i for i, l in enumerate(_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """ref conll05.py:218 — path to the pretrained embedding table; a
+    deterministic table is generated when the download cache is empty."""
+    path = os.path.join(_DIR, 'emb')
+    if not os.path.exists(path):
+        os.makedirs(_DIR, exist_ok=True)
+        word_dict, _, _ = get_dict()
+        rng = np.random.RandomState(62)
+        emb = rng.uniform(-1, 1, (len(word_dict), 32)).astype('float32')
+        np.savetxt(path, emb)
+    return path
+
+
+def corpus_reader(data_path, words_name, props_name):
+    """ref conll05.py:76 — yields (sentence, predicate, labels)."""
+    if not os.path.exists(data_path):
+        synthetic_warn('conll05', data_path)
+
+        def reader():
+            yield from _synthetic_corpus()
+        return reader
+
+    def reader():
+        with tarfile.open(data_path) as tf:
+            words = gzip.decompress(
+                tf.extractfile(words_name).read()).decode().splitlines()
+            props = gzip.decompress(
+                tf.extractfile(props_name).read()).decode().splitlines()
+        sentence, labels_rows = [], []
+        for w, p in zip(words, props):
+            w, p = w.strip(), p.strip()
+            if w == '':
+                cols = list(zip(*labels_rows)) if labels_rows else []
+                for col in cols[1:]:
+                    lbls, cur = [], None
+                    for t in col:
+                        if t.startswith('('):
+                            cur = t.strip('()*').rstrip(')')
+                            lbls.append('B-' + cur)
+                            if t.endswith(')'):
+                                cur = None
+                        elif cur is not None:
+                            lbls.append('I-' + cur)
+                            if t.endswith(')'):
+                                cur = None
+                        else:
+                            lbls.append('O')
+                    if 'B-V' in lbls:
+                        verb = sentence[lbls.index('B-V')]
+                        yield sentence, verb, lbls
+                sentence, labels_rows = [], []
+            else:
+                sentence.append(w)
+                labels_rows.append(p.split())
+    return reader
+
+
+def reader_creator(corpus_reader, word_dict=None, predicate_dict=None,
+                   label_dict=None):
+    """ref conll05.py:150 — build the 9-feature SRL sample."""
+
+    def reader():
+        for sentence, predicate, labels in corpus_reader():
+            sen_len = len(sentence)
+            if 'B-V' not in labels or predicate not in predicate_dict:
+                continue
+            verb_index = labels.index('B-V')
+            mark = [0] * len(labels)
+            ctx_n2 = sentence[verb_index - 2] if verb_index > 1 else 'bos'
+            ctx_n1 = sentence[verb_index - 1] if verb_index > 0 else 'bos'
+            ctx_0 = sentence[verb_index]
+            ctx_p1 = sentence[verb_index + 1] \
+                if verb_index < len(labels) - 1 else 'eos'
+            ctx_p2 = sentence[verb_index + 2] \
+                if verb_index < len(labels) - 2 else 'eos'
+            for i in range(max(0, verb_index - 2),
+                           min(len(labels), verb_index + 3)):
+                mark[i] = 1
+            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
+            ctx = [[word_dict.get(c, UNK_IDX)] * sen_len
+                   for c in (ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2)]
+            pred_idx = [predicate_dict[predicate]] * sen_len
+            label_idx = [label_dict.get(l, label_dict.get('O'))
+                         for l in labels]
+            yield (word_idx, ctx[0], ctx[1], ctx[2], ctx[3], ctx[4],
+                   pred_idx, mark, label_idx)
+    return reader
+
+
+def test():
+    """ref conll05.py:225 — the (free) test split used for training."""
+    word_dict, verb_dict, label_dict = get_dict()
+    reader = corpus_reader(
+        _TAR,
+        words_name='conll05st-release/test.wsj/words/test.wsj.words.gz',
+        props_name='conll05st-release/test.wsj/props/test.wsj.props.gz')
+    r = reader_creator(reader, word_dict, verb_dict, label_dict)
+    r.is_synthetic = not os.path.exists(_TAR)
+    return r

@@ -1,0 +1,77 @@
+"""paddle.dataset.flowers parity (ref: python/paddle/dataset/flowers.py) —
+Oxford 102 flowers. Yields (CHW float32 image, int label). Real
+102flowers.tgz + setid.mat/imagelabels.mat when cached (scipy ships in
+this image for .mat), synthetic stream otherwise."""
+import os
+import tarfile
+
+import numpy as np
+
+from .common import DATA_HOME, synthetic_warn
+from .image import load_image_bytes, simple_transform
+
+__all__ = ['train', 'test', 'valid']
+
+_DIR = os.path.join(DATA_HOME, 'flowers')
+_TAR = os.path.join(_DIR, '102flowers.tgz')
+_LABELS = os.path.join(_DIR, 'imagelabels.mat')
+_SETID = os.path.join(_DIR, 'setid.mat')
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for _ in range(n):
+            yield rng.rand(3, 224, 224).astype('float32'), \
+                int(rng.randint(0, 102))
+    reader.is_synthetic = True
+    return reader
+
+
+def _real_reader(set_key, mapper=None):
+    from scipy.io import loadmat
+    labels = loadmat(_LABELS)['labels'][0]
+    ids = loadmat(_SETID)[set_key][0]
+    id_set = {int(i) for i in ids}
+
+    def reader():
+        with tarfile.open(_TAR) as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if not base.startswith('image_'):
+                    continue
+                img_id = int(base[6:11])
+                if img_id not in id_set:
+                    continue
+                data = tf.extractfile(m).read()
+                img = load_image_bytes(data)
+                img = simple_transform(img, 256, 224, is_train=False)
+                yield img.astype('float32'), int(labels[img_id - 1]) - 1
+    reader.is_synthetic = False
+    return reader
+
+
+def _creator(set_key, n_synth, seed):
+    if all(os.path.exists(p) for p in (_TAR, _LABELS, _SETID)):
+        try:
+            return _real_reader(set_key)
+        except Exception:
+            pass
+    synthetic_warn('flowers', _TAR)
+    return _synthetic(n_synth, seed)
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    """ref flowers.py:train (trnid split)."""
+    return _creator('trnid', 256, 71)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    """ref flowers.py:test (tstid split)."""
+    return _creator('tstid', 64, 72)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    """ref flowers.py:valid (valid split)."""
+    return _creator('valid', 64, 73)

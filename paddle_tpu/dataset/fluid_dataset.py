@@ -1,0 +1,279 @@
+"""fluid.dataset — high-performance file-backed training input
+(ref: python/paddle/fluid/dataset.py).
+
+The reference feeds MultiSlot-format text files through C++ DataFeed
+readers into per-thread scopes. The TPU formulation parses the same
+MultiSlot format in Python/numpy, batches on host, and hands batches to
+the jitted executor step (Executor.train_from_dataset); `pipe_command`
+preprocessing runs for real via a subprocess pipe, matching the
+reference's semantics of piping each file through a shell command.
+
+MultiSlot line format (one sample per line, slots in `set_use_var` order):
+    <n1> v1 ... vn1  <n2> v1 ... vn2  ...
+Each slot starts with its value count. Dense slots (lod_level==0) must
+have count == prod(var.shape[1:]); sparse slots batch as LoDTensors.
+"""
+from __future__ import annotations
+
+import subprocess
+
+import numpy as np
+
+from ..core.lod import LoDTensor
+
+__all__ = ['DatasetFactory', 'InMemoryDataset', 'QueueDataset',
+           'FileInstantDataset', 'DatasetBase']
+
+
+class DatasetFactory:
+    """ref dataset.py:23 — create a dataset by class name."""
+
+    def create_dataset(self, datafeed_class='QueueDataset'):
+        try:
+            return globals()[datafeed_class]()
+        except KeyError:
+            raise ValueError(
+                f'datafeed class {datafeed_class} does not exist')
+
+
+class DatasetBase:
+    """ref dataset.py:64 — shared config surface."""
+
+    def __init__(self):
+        self.proto_desc = {'name': 'MultiSlotDataFeed', 'batch_size': 1,
+                           'pipe_command': 'cat'}
+        self.filelist = []
+        self.use_vars = []
+        self.thread_num = 1
+        self.queue_num = None
+        self.fleet_send_batch_size = 1024
+        self.merge_size = -1
+        self.parse_ins_id = False
+        self.parse_content = False
+
+    # -- config setters (ref dataset.py:77-254) --
+    def set_pipe_command(self, pipe_command):
+        """Shell command each data file is piped through before parsing."""
+        self.proto_desc['pipe_command'] = pipe_command
+
+    def set_batch_size(self, batch_size):
+        self.proto_desc['batch_size'] = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self.thread_num = max(1, int(thread_num))
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self.use_vars = list(var_list)
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        """Accepted for API parity; TPU pods read from mounted/GCS paths, so
+        there is no HDFS client to configure."""
+        self.hdfs_config = (fs_name, fs_ugi)
+
+    def set_download_cmd(self, download_cmd):
+        self.download_cmd = download_cmd
+
+    def set_fea_eval(self, record_candidate_size, fea_eval=True):
+        self.fea_eval = (record_candidate_size, fea_eval)
+
+    def desc(self):
+        """ref dataset.py:269 — text-proto description."""
+        from ..data_feed_desc import _to_text_proto
+        d = dict(self.proto_desc)
+        d['multi_slot_desc'] = {'slots': [
+            {'name': v.name, 'type': str(v.dtype),
+             'is_dense': getattr(v, 'lod_level', 0) == 0, 'is_used': True}
+            for v in self.use_vars]}
+        return _to_text_proto(d)
+
+    # -- parsing core --
+    def _read_lines(self, path):
+        cmd = self.proto_desc.get('pipe_command', 'cat')
+        if cmd and cmd != 'cat':
+            with open(path, 'rb') as f:
+                out = subprocess.run(cmd, shell=True, stdin=f,
+                                     capture_output=True, check=True)
+            return out.stdout.decode().splitlines()
+        with open(path) as f:
+            return f.read().splitlines()
+
+    def _parse_line(self, line):
+        """One MultiSlot line → list of 1-D numpy arrays (slot order)."""
+        toks = line.split()
+        vals, i = [], 0
+        for v in self.use_vars:
+            if i >= len(toks):
+                raise ValueError(
+                    f'line has too few slots for {len(self.use_vars)} vars: '
+                    f'{line[:80]!r}')
+            n = int(toks[i]); i += 1
+            dtype = np.int64 if 'int' in str(v.dtype) else np.float32
+            vals.append(np.array(toks[i:i + n], dtype=dtype))
+            i += n
+        return vals
+
+    def _records(self):
+        """Iterate parsed samples over the filelist."""
+        for path in self.filelist:
+            for line in self._read_lines(path):
+                if line.strip():
+                    yield self._parse_line(line)
+
+    def _batches(self, records=None):
+        """Yield {var_name: ndarray|LoDTensor} feed dicts of batch_size."""
+        bs = self.proto_desc['batch_size']
+        buf = []
+        for rec in (records if records is not None else self._records()):
+            buf.append(rec)
+            if len(buf) == bs:
+                yield self._pack(buf)
+                buf = []
+        if buf:
+            yield self._pack(buf)
+
+    def _pack(self, rows):
+        feed = {}
+        for si, v in enumerate(self.use_vars):
+            cols = [r[si] for r in rows]
+            if getattr(v, 'lod_level', 0) == 0:
+                tail = list((v.shape or [])[1:])
+                if tail and -1 not in tail:
+                    want = int(np.prod(tail))
+                    bad = [len(c) for c in cols if len(c) != want]
+                    if bad:
+                        raise ValueError(
+                            f'dense slot {v.name} expects {want} values '
+                            f'per sample (shape {tail}), got {bad[0]}')
+                    feed[v.name] = np.stack([c.reshape(tail) for c in cols])
+                else:
+                    feed[v.name] = np.stack(cols)
+            else:
+                lens = [len(c) for c in cols]
+                t = max(lens) if lens else 1
+                pad = np.zeros((len(cols), max(t, 1)), cols[0].dtype)
+                for i, c in enumerate(cols):
+                    pad[i, :len(c)] = c
+                feed[v.name] = LoDTensor(pad, [lens])
+        return feed
+
+
+class QueueDataset(DatasetBase):
+    """ref dataset.py:684 — streaming dataset: files are read and parsed
+    on the fly at train time; nothing is materialized."""
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            'QueueDataset does not support local shuffle; '
+            'use InMemoryDataset')
+
+    def global_shuffle(self, fleet=None):
+        raise NotImplementedError(
+            'QueueDataset does not support global shuffle; '
+            'use InMemoryDataset')
+
+
+class InMemoryDataset(DatasetBase):
+    """ref dataset.py:302 — load_into_memory + local/global shuffle."""
+
+    def __init__(self):
+        super().__init__()
+        self.memory = None
+        self._rng = np.random.RandomState(0)
+
+    def set_queue_num(self, queue_num):
+        self.queue_num = int(queue_num)
+
+    def set_parse_ins_id(self, parse_ins_id):
+        self.parse_ins_id = bool(parse_ins_id)
+
+    def set_parse_content(self, parse_content):
+        self.parse_content = bool(parse_content)
+
+    def set_fleet_send_batch_size(self, fleet_send_batch_size=1024):
+        self.fleet_send_batch_size = int(fleet_send_batch_size)
+
+    def set_fleet_send_sleep_seconds(self, seconds=0):
+        self.fleet_send_sleep_seconds = seconds
+
+    def set_merge_by_lineid(self, merge_size=2):
+        self.merge_size = int(merge_size)
+
+    def load_into_memory(self):
+        """ref dataset.py:457 — parse every file into host memory."""
+        self.memory = list(self._records())
+
+    def preload_into_memory(self, thread_num=None):
+        """ref dataset.py:473 — same as load (no async host threads needed:
+        parsing is not on the device-step critical path)."""
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        if self.memory is None:
+            self.load_into_memory()
+
+    def local_shuffle(self):
+        """ref dataset.py:514."""
+        if self.memory is None:
+            raise RuntimeError('call load_into_memory() before local_shuffle')
+        self._rng.shuffle(self.memory)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """ref dataset.py:530 — shard by sample hash across workers, then
+        shuffle locally. With fleet=None this equals local_shuffle."""
+        if self.memory is None:
+            raise RuntimeError('call load_into_memory() before global_shuffle')
+        if fleet is not None:
+            n = max(1, fleet.worker_num())
+            i = fleet.worker_index()
+            self.memory = [r for k, r in enumerate(self.memory)
+                           if k % n == i]
+        self._rng.shuffle(self.memory)
+
+    def release_memory(self):
+        """ref dataset.py:575."""
+        self.memory = None
+
+    def get_memory_data_size(self, fleet=None):
+        """ref dataset.py:597 — total sample count (summed over workers)."""
+        local = len(self.memory or ())
+        if fleet is not None:
+            return local * max(1, fleet.worker_num())
+        return local
+
+    def get_shuffle_data_size(self, fleet=None):
+        """ref dataset.py:633."""
+        return self.get_memory_data_size(fleet)
+
+    def slots_shuffle(self, slots):
+        """ref dataset.py:118 — permute the values of named slots across
+        samples (feature-importance evaluation)."""
+        if self.memory is None:
+            raise RuntimeError('call load_into_memory() before slots_shuffle')
+        name_to_idx = {v.name: i for i, v in enumerate(self.use_vars)}
+        for name in slots:
+            si = name_to_idx[name]
+            perm = self._rng.permutation(len(self.memory))
+            vals = [self.memory[p][si] for p in perm]
+            for r, val in zip(self.memory, vals):
+                r[si] = val
+
+    def _batches(self, records=None):
+        if records is None and self.memory is not None:
+            records = self.memory
+        return super()._batches(records)
+
+
+class FileInstantDataset(DatasetBase):
+    """ref dataset.py:766 — file-instant variant (streams like
+    QueueDataset on TPU)."""
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            'FileInstantDataset does not support local shuffle')
+
+    def global_shuffle(self, fleet=None):
+        raise NotImplementedError(
+            'FileInstantDataset does not support global shuffle')

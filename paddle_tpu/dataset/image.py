@@ -1,0 +1,168 @@
+"""paddle.dataset.image parity (ref: python/paddle/dataset/image.py).
+
+The reference shells into cv2 for decode/resize; this build is
+numpy-native: .npy/.npz images load directly, raw encoded bytes decode via
+PIL when available (torch ships it in this image), and the geometric
+transforms (resize_short, crops, flips, CHW) are pure numpy, so the
+augmentation pipeline runs anywhere without an OpenCV dependency.
+"""
+import tarfile
+
+import numpy as np
+
+__all__ = ['load_image_bytes', 'load_image', 'resize_short', 'to_chw',
+           'center_crop', 'random_crop', 'left_right_flip',
+           'simple_transform', 'load_and_transform', 'batch_images_from_tar']
+
+
+def _decode_bytes(data, is_color):
+    import io
+    try:
+        from PIL import Image
+    except ImportError:
+        raise RuntimeError(
+            'decoding encoded image bytes needs PIL, which is unavailable; '
+            'pre-decode to .npy arrays instead')
+    img = Image.open(io.BytesIO(data))
+    img = img.convert('RGB' if is_color else 'L')
+    arr = np.asarray(img)
+    return arr if is_color else arr[..., None]
+
+
+def load_image_bytes(data, is_color=True):
+    """ref image.py:load_image_bytes — decode encoded bytes to HWC uint8."""
+    return _decode_bytes(data, is_color)
+
+
+def load_image(file, is_color=True):
+    """ref image.py:load_image — load from file (.npy/.npz or encoded)."""
+    if file.endswith('.npy'):
+        arr = np.load(file)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr
+    with open(file, 'rb') as f:
+        return _decode_bytes(f.read(), is_color)
+
+
+def _resize_bilinear(img, h, w):
+    """Pure-numpy bilinear resize of an HWC array."""
+    H, W = img.shape[:2]
+    if (H, W) == (h, w):
+        return img
+    ys = (np.arange(h) + 0.5) * H / h - 0.5
+    xs = (np.arange(w) + 0.5) * W / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, H - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, W - 1)
+    y1 = np.clip(y0 + 1, 0, H - 1)
+    x1 = np.clip(x0 + 1, 0, W - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    img = img.astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out
+
+
+def resize_short(im, size):
+    """ref image.py:resize_short — scale so the short side equals size."""
+    h, w = im.shape[:2]
+    if h > w:
+        h = int(round(h * size / w))
+        w = size
+    else:
+        w = int(round(w * size / h))
+        h = size
+    return _resize_bilinear(im, h, w)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """ref image.py:to_chw."""
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    """ref image.py:center_crop."""
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True):
+    """ref image.py:random_crop."""
+    h, w = im.shape[:2]
+    h_start = np.random.randint(0, h - size + 1)
+    w_start = np.random.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im, is_color=True):
+    """ref image.py:left_right_flip."""
+    return im[:, ::-1, :] if im.ndim == 3 else im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """ref image.py:simple_transform — resize-short, crop (+flip when
+    training), CHW, mean-subtract."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    """ref image.py:load_and_transform."""
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """ref image.py:batch_images_from_tar — pre-batch tar members into
+    pickled (data, label) block files; returns the meta file path."""
+    import os
+    import pickle
+    out_path = f'{data_file}_{dataset_name}_batch'
+    meta = os.path.join(out_path, 'batch_meta')
+    if os.path.exists(meta):
+        return meta
+    os.makedirs(out_path, exist_ok=True)
+    data, labels, file_id, names = [], [], 0, []
+    with tarfile.open(data_file) as tf:
+        for m in tf.getmembers():
+            if m.name not in img2label:
+                continue
+            data.append(tf.extractfile(m).read())
+            labels.append(img2label[m.name])
+            if len(data) == num_per_batch:
+                name = os.path.join(out_path, f'batch_{file_id}')
+                with open(name, 'wb') as f:
+                    pickle.dump({'data': data, 'label': labels}, f,
+                                protocol=2)
+                names.append(name)
+                data, labels, file_id = [], [], file_id + 1
+    if data:
+        name = os.path.join(out_path, f'batch_{file_id}')
+        with open(name, 'wb') as f:
+            pickle.dump({'data': data, 'label': labels}, f, protocol=2)
+        names.append(name)
+    with open(meta, 'w') as f:
+        f.write('\n'.join(names))
+    return meta

@@ -1,0 +1,94 @@
+"""paddle.dataset.imdb parity (ref: python/paddle/dataset/imdb.py).
+build_dict → {word: idx}; train/test readers yield ([word ids], 0|1).
+Real aclImdb tarball when cached; deterministic synthetic corpus with a
+sentiment-correlated signal word otherwise (so models can actually fit)."""
+import collections
+import os
+import re
+import string
+import tarfile
+
+from .common import DATA_HOME, WORDS, synthetic_text_corpus, synthetic_warn
+
+__all__ = ['build_dict', 'train', 'test']
+
+_TAR = os.path.join(DATA_HOME, 'imdb', 'aclImdb_v1.tar.gz')
+
+
+def _synth_docs(is_test):
+    """(tokens, label) pairs; 'good'/'bad' marker words carry the label."""
+    base = synthetic_text_corpus(WORDS, 400 if not is_test else 100,
+                                 11 if not is_test else 12)
+    out = []
+    for i, sent in enumerate(base):
+        label = i % 2
+        sent = sent + (['good', 'like'] if label == 0 else ['bad', 'not'])
+        out.append((sent, label))
+    return out
+
+
+def tokenize(pattern):
+    """ref imdb.py:tokenize — lowercased, punctuation-stripped token
+    streams from tar members matching `pattern`."""
+    if not os.path.exists(_TAR):
+        synthetic_warn('imdb', _TAR)
+        is_test = 'test' in pattern.pattern if hasattr(pattern, 'pattern') \
+            else 'test' in str(pattern)
+        for sent, _ in _synth_docs(is_test):
+            yield sent
+        return
+    pattern = re.compile(pattern) if isinstance(pattern, str) else pattern
+    with tarfile.open(_TAR) as tf:
+        for m in tf.getmembers():
+            if bool(pattern.match(m.name)):
+                data = tf.extractfile(m).read().decode('latin-1')
+                yield data.translate(
+                    str.maketrans('', '', string.punctuation)).lower().split()
+
+
+def build_dict(pattern, cutoff):
+    """ref imdb.py:build_dict — frequency-cutoff vocab + <unk>."""
+    word_freq = collections.defaultdict(int)
+    for doc in tokenize(pattern):
+        for word in doc:
+            word_freq[word] += 1
+    word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+    dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+    words, _ = list(zip(*dictionary)) if dictionary else ((), ())
+    word_idx = dict(list(zip(words, range(len(words)))))
+    word_idx['<unk>'] = len(words)
+    return word_idx
+
+
+def _reader_creator(pos_pattern, neg_pattern, word_idx, is_test):
+    unk = word_idx['<unk>']
+
+    def reader():
+        if not os.path.exists(_TAR):
+            for sent, label in _synth_docs(is_test):
+                yield [word_idx.get(w, unk) for w in sent], label
+            return
+        for label, pattern in ((0, pos_pattern), (1, neg_pattern)):
+            for doc in tokenize(pattern):
+                yield [word_idx.get(w, unk) for w in doc], label
+    reader.is_synthetic = not os.path.exists(_TAR)
+    return reader
+
+
+def train(word_idx):
+    """ref imdb.py:train — label 0 = positive, 1 = negative."""
+    return _reader_creator(
+        re.compile(r'aclImdb/train/pos/.*\.txt$'),
+        re.compile(r'aclImdb/train/neg/.*\.txt$'), word_idx, False)
+
+
+def test(word_idx):
+    """ref imdb.py:test."""
+    return _reader_creator(
+        re.compile(r'aclImdb/test/pos/.*\.txt$'),
+        re.compile(r'aclImdb/test/neg/.*\.txt$'), word_idx, True)
+
+
+def word_dict():
+    """ref imdb.py:word_dict (used by some ref configs)."""
+    return build_dict(re.compile(r'aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$'), 150)

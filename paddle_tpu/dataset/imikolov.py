@@ -1,0 +1,94 @@
+"""paddle.dataset.imikolov parity (ref: python/paddle/dataset/imikolov.py).
+PTB language-model data: build_dict + N-gram / sequence readers."""
+import collections
+import os
+import tarfile
+
+from .common import DATA_HOME, WORDS, synthetic_text_corpus, synthetic_warn
+
+__all__ = ['train', 'test', 'build_dict']
+
+_TAR = os.path.join(DATA_HOME, 'imikolov', 'simple-examples.tgz')
+_TRAIN_MEMBER = './simple-examples/data/ptb.train.txt'
+_TEST_MEMBER = './simple-examples/data/ptb.valid.txt'
+
+
+class DataType:
+    """ref imikolov.py:DataType."""
+    NGRAM = 1
+    SEQ = 2
+
+
+def _sentences(member, n_synth, seed):
+    if os.path.exists(_TAR):
+        with tarfile.open(_TAR) as tf:
+            for line in tf.extractfile(member).read().decode().splitlines():
+                yield line.strip().split()
+    else:
+        synthetic_warn('imikolov', _TAR)
+        for sent in synthetic_text_corpus(WORDS, n_synth, seed):
+            yield sent
+
+
+def word_count(sents, word_freq=None):
+    """ref imikolov.py:word_count."""
+    if word_freq is None:
+        word_freq = collections.defaultdict(int)
+    for words in sents:
+        for w in words:
+            word_freq[w] += 1
+        word_freq['<s>'] += 1
+        word_freq['<e>'] += 1
+    return word_freq
+
+
+def build_dict(min_word_freq=50):
+    """ref imikolov.py:build_dict — train∪test vocab above the frequency
+    floor, plus <unk>."""
+    word_freq = word_count(_sentences(_TEST_MEMBER, 100, 21),
+                           word_count(_sentences(_TRAIN_MEMBER, 400, 20)))
+    if '<unk>' in word_freq:
+        del word_freq['<unk>']
+    # synthetic corpora are small — scale the floor so the dict is non-empty
+    if not os.path.exists(_TAR):
+        min_word_freq = min(min_word_freq, 1)
+    word_freq = [x for x in word_freq.items() if x[1] >= min_word_freq]
+    word_freq_sorted = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+    words, _ = list(zip(*word_freq_sorted))
+    word_idx = dict(list(zip(words, range(len(words)))))
+    word_idx['<unk>'] = len(words)
+    return word_idx
+
+
+def reader_creator(member, word_idx, n, data_type, n_synth, seed):
+    def reader():
+        UNK = word_idx['<unk>']
+        for sent in _sentences(member, n_synth, seed):
+            if DataType.NGRAM == data_type:
+                assert n > -1, 'Invalid gram length'
+                sent = ['<s>'] + sent + ['<e>']
+                if len(sent) >= n:
+                    sent = [word_idx.get(w, UNK) for w in sent]
+                    for i in range(n, len(sent) + 1):
+                        yield tuple(sent[i - n:i])
+            elif DataType.SEQ == data_type:
+                sent = [word_idx.get(w, UNK) for w in sent]
+                src_seq = [word_idx['<s>']] + sent
+                trg_seq = sent + [word_idx['<e>']]
+                if n > 0 and len(sent) > n:
+                    continue
+                yield src_seq, trg_seq
+            else:
+                assert False, 'Unknown data type'
+    reader.is_synthetic = not os.path.exists(_TAR)
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    """ref imikolov.py:train."""
+    return reader_creator(_TRAIN_MEMBER, word_idx, n, data_type, 400, 20)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    """ref imikolov.py:test."""
+    return reader_creator(_TEST_MEMBER, word_idx, n, data_type, 100, 21)

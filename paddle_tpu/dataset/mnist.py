@@ -1,0 +1,34 @@
+"""paddle.dataset.mnist parity (ref: python/paddle/dataset/mnist.py).
+Samples are (784-float32 in [-1,1], int label); real IDX files when cached
+(shared loader with paddle_tpu.datasets), deterministic synthetic stream
+otherwise."""
+import os
+
+from .common import DATA_HOME
+from ..datasets import _mnist_reader
+
+__all__ = ['train', 'test']
+
+
+def _flat(reader28):
+    def reader():
+        for img, lab in reader28():
+            yield img.reshape(-1), lab
+    reader.is_synthetic = getattr(reader28, 'is_synthetic', False)
+    return reader
+
+
+def train():
+    """ref mnist.py:train — 784-dim image, label in [0,9]."""
+    d = os.path.join(DATA_HOME, 'mnist')
+    return _flat(_mnist_reader(
+        os.path.join(d, 'train-images-idx3-ubyte.gz'),
+        os.path.join(d, 'train-labels-idx1-ubyte.gz'), 1024, 0))
+
+
+def test():
+    """ref mnist.py:test."""
+    d = os.path.join(DATA_HOME, 'mnist')
+    return _flat(_mnist_reader(
+        os.path.join(d, 't10k-images-idx3-ubyte.gz'),
+        os.path.join(d, 't10k-labels-idx1-ubyte.gz'), 256, 1))

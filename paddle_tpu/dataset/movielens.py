@@ -1,0 +1,198 @@
+"""paddle.dataset.movielens parity (ref: python/paddle/dataset/
+movielens.py — ML-1M). Yields per-rating feature rows
+[user_id, gender, age, job, movie_id, title ids, category ids, score].
+Real ml-1m.zip when cached; a deterministic synthetic catalogue
+otherwise."""
+import os
+import re
+import zipfile
+
+import numpy as np
+
+from .common import DATA_HOME, synthetic_warn
+
+__all__ = ['train', 'test', 'get_movie_title_dict', 'max_movie_id',
+           'max_user_id', 'age_table', 'movie_categories', 'max_job_id',
+           'user_info', 'movie_info']
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_ZIP = os.path.join(DATA_HOME, 'movielens', 'ml-1m.zip')
+
+
+class MovieInfo:
+    """ref movielens.py:48."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        """[movie_id, [category ids], [title word ids]]"""
+        return [self.index,
+                [CATEGORIES_DICT[c] for c in self.categories],
+                [MOVIE_TITLE_DICT[w.lower()] for w in self.title.split()]]
+
+    def __str__(self):
+        return (f'<MovieInfo id({self.index}), title({self.title}), '
+                f'categories({self.categories})>')
+
+    __repr__ = __str__
+
+
+class UserInfo:
+    """ref movielens.py:75."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == 'M'
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        """[user_id, gender, age bucket, job]"""
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+    def __str__(self):
+        return (f'<UserInfo id({self.index}), '
+                f'gender({"M" if self.is_male else "F"}), '
+                f'age({age_table[self.age]}), job({self.job_id})>')
+
+    __repr__ = __str__
+
+
+MOVIE_INFO = None
+MOVIE_TITLE_DICT = None
+CATEGORIES_DICT = None
+USER_INFO = None
+RATINGS = None
+_IS_SYNTHETIC = False
+
+
+def _init():
+    global MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT, USER_INFO, \
+        RATINGS, _IS_SYNTHETIC
+    if MOVIE_INFO is not None:
+        return
+    categories, titles = set(), set()
+    MOVIE_INFO, USER_INFO, RATINGS = {}, {}, []
+    if os.path.exists(_ZIP):
+        pat = re.compile(r'^(.*)\((\d+)\)$')
+        with zipfile.ZipFile(_ZIP) as z:
+            with z.open('ml-1m/movies.dat') as f:
+                for line in f.read().decode('latin-1').splitlines():
+                    mid, title, cats = line.strip().split('::')
+                    cats = cats.split('|')
+                    title = pat.match(title).group(1).strip()
+                    MOVIE_INFO[int(mid)] = MovieInfo.__new__(MovieInfo)
+                    MOVIE_INFO[int(mid)].__dict__.update(
+                        index=int(mid), categories=cats, title=title)
+                    categories.update(cats)
+                    titles.update(w.lower() for w in title.split())
+            with z.open('ml-1m/users.dat') as f:
+                for line in f.read().decode('latin-1').splitlines():
+                    uid, gender, age, job, _ = line.strip().split('::')
+                    USER_INFO[int(uid)] = UserInfo(uid, gender, age, job)
+            with z.open('ml-1m/ratings.dat') as f:
+                for line in f.read().decode('latin-1').splitlines():
+                    uid, mid, rating, _ = line.strip().split('::')
+                    RATINGS.append((int(uid), int(mid), float(rating)))
+    else:
+        synthetic_warn('movielens', _ZIP)
+        _IS_SYNTHETIC = True
+        rng = np.random.RandomState(41)
+        cat_names = ['Action', 'Comedy', 'Drama', 'Horror', 'Romance']
+        title_words = ['the', 'movie', 'of', 'night', 'day', 'star', 'love',
+                       'war', 'king', 'girl']
+        for mid in range(1, 201):
+            cats = [cat_names[j]
+                    for j in rng.choice(len(cat_names),
+                                        rng.randint(1, 3), replace=False)]
+            title = ' '.join(title_words[j]
+                             for j in rng.randint(0, len(title_words), 3))
+            MOVIE_INFO[mid] = MovieInfo.__new__(MovieInfo)
+            MOVIE_INFO[mid].__dict__.update(index=mid, categories=cats,
+                                            title=title)
+            categories.update(cats)
+            titles.update(title.split())
+        for uid in range(1, 101):
+            USER_INFO[uid] = UserInfo(
+                uid, 'M' if rng.randint(2) else 'F',
+                age_table[rng.randint(len(age_table))], rng.randint(0, 21))
+        for _ in range(4000):
+            RATINGS.append((int(rng.randint(1, 101)),
+                            int(rng.randint(1, 201)),
+                            float(rng.randint(1, 6))))
+    CATEGORIES_DICT = {c: i for i, c in enumerate(sorted(categories))}
+    MOVIE_TITLE_DICT = {w: i for i, w in enumerate(sorted(titles))}
+
+
+def _reader(rand_seed=0, test_ratio=0.1, is_test=False):
+    _init()
+    rng = np.random.RandomState(rand_seed)
+    for uid, mid, rating in RATINGS:
+        if (rng.rand() < test_ratio) == is_test:
+            if uid in USER_INFO and mid in MOVIE_INFO:
+                yield USER_INFO[uid].value() + MOVIE_INFO[mid].value() + \
+                    [[rating]]
+
+
+def _creator(**kw):
+    def reader():
+        yield from _reader(**kw)
+    _init()
+    reader.is_synthetic = _IS_SYNTHETIC
+    return reader
+
+
+def train():
+    """ref movielens.py:train."""
+    return _creator(is_test=False)
+
+
+def test():
+    """ref movielens.py:test."""
+    return _creator(is_test=True)
+
+
+def get_movie_title_dict():
+    """ref movielens.py:178."""
+    _init()
+    return MOVIE_TITLE_DICT
+
+
+def max_movie_id():
+    """ref movielens.py:193."""
+    _init()
+    return max(MOVIE_INFO)
+
+
+def max_user_id():
+    """ref movielens.py:201."""
+    _init()
+    return max(USER_INFO)
+
+
+def max_job_id():
+    """ref movielens.py:216."""
+    _init()
+    return max(u.job_id for u in USER_INFO.values())
+
+
+def movie_categories():
+    """ref movielens.py:225."""
+    _init()
+    return CATEGORIES_DICT
+
+
+def user_info():
+    """ref movielens.py:233."""
+    _init()
+    return USER_INFO
+
+
+def movie_info():
+    """ref movielens.py:241."""
+    _init()
+    return MOVIE_INFO

@@ -1,0 +1,174 @@
+"""paddle.dataset.mq2007 parity (ref: python/paddle/dataset/mq2007.py) —
+LETOR learning-to-rank data. Query/QueryList containers + pointwise /
+pairwise / listwise generators; real Fold files when present, synthetic
+ranked lists otherwise."""
+import functools
+import os
+import random
+
+import numpy as np
+
+from .common import DATA_HOME, synthetic_warn
+
+__all__ = ['Query', 'QueryList', 'gen_plain_txt', 'gen_point', 'gen_pair',
+           'gen_list', 'query_filter', 'load_from_text', 'train', 'test']
+
+FEATURE_DIM = 46
+
+
+class Query:
+    """ref mq2007.py:50 — one judged (query, document) row."""
+
+    def __init__(self, query_id=-1, relevance_score=-1, feature_vector=None,
+                 description=""):
+        self.query_id = query_id
+        self.relevance_score = relevance_score
+        self.feature_vector = list(feature_vector or [])
+        self.description = description
+
+    def __str__(self):
+        feats = ' '.join(f'{i + 1}:{v}'
+                         for i, v in enumerate(self.feature_vector))
+        return f'{self.relevance_score} qid:{self.query_id} {feats}'
+
+    __repr__ = __str__
+
+    def _parse_line(self, raw, fill_missing=-1):
+        parts = raw.split('#')[0].strip().split()
+        self.relevance_score = int(parts[0])
+        self.query_id = int(parts[1].split(':')[1])
+        fv = {}
+        for tok in parts[2:]:
+            k, v = tok.split(':')
+            fv[int(k)] = float(v) if v else fill_missing
+        self.feature_vector = [fv.get(i + 1, fill_missing)
+                               for i in range(max(fv) if fv else 0)]
+        return self
+
+
+class QueryList:
+    """ref mq2007.py:106 — all judged docs of one query id."""
+
+    def __init__(self, querylist=None):
+        self.query_list = list(querylist or [])
+
+    def __iter__(self):
+        return iter(self.query_list)
+
+    def __len__(self):
+        return len(self.query_list)
+
+    def __getitem__(self, i):
+        return self.query_list[i]
+
+    def _correct_ranking_(self):
+        self.query_list.sort(key=lambda q: -q.relevance_score)
+
+    def _add_query(self, query):
+        self.query_list.append(query)
+
+
+def load_from_text(filepath, shuffle=False, fill_missing=-1):
+    """ref mq2007.py:269 — parse a LETOR text file into QueryLists."""
+    lists = {}
+    with open(filepath) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            q = Query()._parse_line(line, fill_missing)
+            lists.setdefault(q.query_id, QueryList())._add_query(q)
+    out = list(lists.values())
+    if shuffle:
+        random.shuffle(out)
+    return out
+
+
+def query_filter(querylists):
+    """ref mq2007.py:251 — drop queries whose docs all share one score."""
+    out = []
+    for ql in querylists:
+        scores = {q.relevance_score for q in ql}
+        if len(scores) > 1:
+            out.append(ql)
+    return out
+
+
+def gen_plain_txt(querylist):
+    """ref mq2007.py:148 — (query_id, score, feature_vector) rows."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    for q in querylist:
+        yield q.query_id, q.relevance_score, np.array(q.feature_vector)
+
+
+def gen_point(querylist):
+    """ref mq2007.py:169 — pointwise (score, feature_vector)."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    for q in querylist:
+        yield q.relevance_score, np.array(q.feature_vector)
+
+
+def gen_pair(querylist, partial_order='full'):
+    """ref mq2007.py:188 — pairwise (1, better_vec, worse_vec)."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    qs = sorted(querylist, key=lambda q: -q.relevance_score)
+    for i, a in enumerate(qs):
+        for b in qs[i + 1:]:
+            if a.relevance_score > b.relevance_score:
+                yield 1, np.array(a.feature_vector), \
+                    np.array(b.feature_vector)
+
+
+def gen_list(querylist):
+    """ref mq2007.py:231 — listwise (all scores, all feature vectors)."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    labels = [q.relevance_score for q in querylist]
+    features = [q.feature_vector for q in querylist]
+    yield np.array(labels), np.array(features)
+
+
+def _synthetic_querylists(n_queries, seed):
+    rng = np.random.RandomState(seed)
+    out = []
+    for qid in range(n_queries):
+        ql = QueryList()
+        for _ in range(rng.randint(4, 10)):
+            ql._add_query(Query(qid, int(rng.randint(0, 3)),
+                                rng.rand(FEATURE_DIM).tolist()))
+        out.append(ql)
+    return out
+
+
+def __reader__(filepath, format='pairwise', shuffle=False, fill_missing=-1):
+    """ref mq2007.py:294."""
+    if os.path.exists(filepath):
+        querylists = query_filter(
+            load_from_text(filepath, shuffle=shuffle,
+                           fill_missing=fill_missing))
+    else:
+        synthetic_warn('mq2007', filepath)
+        querylists = query_filter(_synthetic_querylists(
+            50, 51 if 'train' in filepath else 52))
+    for querylist in querylists:
+        if format == 'plain_txt':
+            yield next(gen_plain_txt(querylist))
+        elif format == 'pointwise':
+            yield next(gen_point(querylist))
+        elif format == 'pairwise':
+            yield from gen_pair(querylist)
+        elif format == 'listwise':
+            yield next(gen_list(querylist))
+
+
+train = functools.partial(
+    __reader__,
+    filepath=os.path.join(DATA_HOME, 'MQ2007', 'MQ2007', 'Fold1',
+                          'train.txt'))
+test = functools.partial(
+    __reader__,
+    filepath=os.path.join(DATA_HOME, 'MQ2007', 'MQ2007', 'Fold1',
+                          'test.txt'))

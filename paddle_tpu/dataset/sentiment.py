@@ -1,0 +1,78 @@
+"""paddle.dataset.sentiment parity (ref: python/paddle/dataset/
+sentiment.py — NLTK movie_reviews). get_word_dict + train/test readers
+yielding ([word ids], 0|1). NLTK corpora can't be fetched offline, so a
+cached `movie_reviews` directory under DATA_HOME is used when present
+(pos/ and neg/ subdirs of .txt files) and the deterministic synthetic
+corpus otherwise."""
+import os
+
+from .common import DATA_HOME, WORDS, synthetic_text_corpus, synthetic_warn
+
+__all__ = ['train', 'test', 'get_word_dict']
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+_DIR = os.path.join(DATA_HOME, 'sentiment', 'movie_reviews')
+
+
+def _docs():
+    """All (tokens, label) docs, pos first then neg (ref ordering), then
+    interleaved for the train/test split the ref applies."""
+    docs = []
+    if os.path.isdir(_DIR):
+        for label, sub in ((0, 'pos'), (1, 'neg')):
+            d = os.path.join(_DIR, sub)
+            for fn in sorted(os.listdir(d)):
+                with open(os.path.join(d, fn), errors='ignore') as f:
+                    docs.append((f.read().lower().split(), label))
+    else:
+        synthetic_warn('sentiment', _DIR)
+        base = synthetic_text_corpus(WORDS, NUM_TOTAL_INSTANCES, 31)
+        for i, sent in enumerate(base):
+            label = i % 2
+            docs.append((sent + (['good'] if label == 0 else ['bad']),
+                         label))
+    # ref shuffles pos/neg together deterministically; interleave instead
+    pos = [d for d in docs if d[1] == 0]
+    neg = [d for d in docs if d[1] == 1]
+    out = []
+    for p, n in zip(pos, neg):
+        out += [p, n]
+    out += pos[len(neg):] + neg[len(pos):]
+    return out
+
+
+_word_dict = None
+
+
+def get_word_dict():
+    """ref sentiment.py:get_word_dict — frequency-sorted {word: idx}."""
+    global _word_dict
+    if _word_dict is None:
+        freq = {}
+        for tokens, _ in _docs():
+            for w in tokens:
+                freq[w] = freq.get(w, 0) + 1
+        words = sorted(freq.items(), key=lambda x: (-x[1], x[0]))
+        _word_dict = {w: i for i, (w, _) in enumerate(words)}
+    return _word_dict
+
+
+def _reader_creator(lo, hi):
+    def reader():
+        wd = get_word_dict()
+        for tokens, label in _docs()[lo:hi]:
+            yield [wd[w] for w in tokens if w in wd], label
+    reader.is_synthetic = not os.path.isdir(_DIR)
+    return reader
+
+
+def train():
+    """ref sentiment.py:train — first 1600 instances."""
+    return _reader_creator(0, NUM_TRAINING_INSTANCES)
+
+
+def test():
+    """ref sentiment.py:test — last 400 instances."""
+    return _reader_creator(NUM_TRAINING_INSTANCES, NUM_TOTAL_INSTANCES)

@@ -1,0 +1,62 @@
+"""paddle.dataset.uci_housing parity (ref: python/paddle/dataset/
+uci_housing.py). Samples are (13-float32 normalized features,
+[float32 price])."""
+import os
+
+import numpy as np
+
+from .common import DATA_HOME, synthetic_warn
+
+__all__ = ['train', 'test']
+
+feature_names = ['CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS',
+                 'RAD', 'TAX', 'PTRATIO', 'B', 'LSTAT']
+FEATURE_NUM = len(feature_names) + 1   # + target
+UCI_TEST_RATIO = 0.2
+
+_cache = {}
+
+
+def _load():
+    if 'data' in _cache:
+        return _cache['data']
+    path = os.path.join(DATA_HOME, 'uci_housing', 'housing.data')
+    if os.path.exists(path):
+        data = np.fromfile(path, sep=' ').reshape(-1, FEATURE_NUM)
+        synthetic = False
+    else:
+        synthetic_warn('uci_housing', path)
+        rng = np.random.RandomState(7)
+        feats = rng.rand(506, FEATURE_NUM - 1).astype('float64')
+        w = rng.randn(FEATURE_NUM - 1)
+        target = feats @ w + 0.1 * rng.randn(506) + 22.0
+        data = np.concatenate([feats, target[:, None]], axis=1)
+        synthetic = True
+    # ref normalization: per-feature (x - mean) / (max - min)
+    maxs, mins, means = (data.max(0), data.min(0), data.mean(0))
+    for i in range(FEATURE_NUM - 1):
+        data[:, i] = (data[:, i] - means[i]) / (maxs[i] - mins[i])
+    _cache['data'] = (data, synthetic)
+    return _cache['data']
+
+
+def _reader_creator(is_test):
+    def reader():
+        data, _ = _load()
+        n_test = int(len(data) * UCI_TEST_RATIO)
+        rows = data[-n_test:] if is_test else data[:-n_test]
+        for row in rows:
+            yield row[:-1].astype('float32'), \
+                row[-1:].astype('float32')
+    reader.is_synthetic = _load()[1]
+    return reader
+
+
+def train():
+    """ref uci_housing.py:train."""
+    return _reader_creator(is_test=False)
+
+
+def test():
+    """ref uci_housing.py:test."""
+    return _reader_creator(is_test=True)

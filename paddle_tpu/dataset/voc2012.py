@@ -1,0 +1,66 @@
+"""paddle.dataset.voc2012 parity (ref: python/paddle/dataset/voc2012.py) —
+Pascal VOC 2012 segmentation. Yields (CHW float32 image, HW int32 label
+mask). Real VOCtrainval tar when cached, synthetic masks otherwise."""
+import os
+import tarfile
+
+import numpy as np
+
+from .common import DATA_HOME, synthetic_warn
+from .image import load_image_bytes
+
+__all__ = ['train', 'test', 'val']
+
+_TAR = os.path.join(DATA_HOME, 'voc2012',
+                    'VOCtrainval_11-May-2012.tar')
+SET_FILE = 'VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt'
+DATA_FILE = 'VOCdevkit/VOC2012/JPEGImages/{}.jpg'
+LABEL_FILE = 'VOCdevkit/VOC2012/SegmentationClass/{}.png'
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for _ in range(n):
+            img = rng.rand(3, 128, 128).astype('float32')
+            lab = rng.randint(0, 21, (128, 128)).astype('int32')
+            yield img, lab
+    reader.is_synthetic = True
+    return reader
+
+
+def _creator(split, n_synth, seed):
+    if not os.path.exists(_TAR):
+        synthetic_warn('voc2012', _TAR)
+        return _synthetic(n_synth, seed)
+
+    def reader():
+        with tarfile.open(_TAR) as tf:
+            names = tf.extractfile(SET_FILE.format(split)) \
+                .read().decode().split()
+            for name in names:
+                img = load_image_bytes(
+                    tf.extractfile(DATA_FILE.format(name)).read())
+                lab = load_image_bytes(
+                    tf.extractfile(LABEL_FILE.format(name)).read(),
+                    is_color=False)
+                yield img.transpose(2, 0, 1).astype('float32'), \
+                    lab[..., 0].astype('int32')
+    reader.is_synthetic = False
+    return reader
+
+
+def train():
+    """ref voc2012.py:train."""
+    return _creator('trainval', 128, 81)
+
+
+def test():
+    """ref voc2012.py:test."""
+    return _creator('train', 32, 82)
+
+
+def val():
+    """ref voc2012.py:val."""
+    return _creator('val', 32, 83)

@@ -1,0 +1,115 @@
+"""paddle.dataset.wmt14 parity (ref: python/paddle/dataset/wmt14.py) —
+WMT14 en→fr. Readers yield (src ids, trg ids, trg-next ids); get_dict
+returns (src_dict, trg_dict) id→word mappings. Real wmt_shrinked_data
+tarball when cached, a deterministic parallel toy corpus otherwise."""
+import os
+import tarfile
+
+import numpy as np
+
+from .common import DATA_HOME, WORDS, synthetic_text_corpus, synthetic_warn
+
+__all__ = ['train', 'test', 'get_dict']
+
+URL_TRAIN = ('http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz')
+_TAR = os.path.join(DATA_HOME, 'wmt14', 'wmt14.tgz')
+
+START = '<s>'
+END = '<e>'
+UNK = '<unk>'
+UNK_IDX = 2
+
+
+def _synth_pairs(n, seed):
+    """Parallel 'translation' pairs: target = reversed source (a structure
+    a seq2seq model can actually learn)."""
+    src = synthetic_text_corpus(WORDS[:30], n, seed, min_len=3, max_len=8)
+    return [(s, list(reversed(s))) for s in src]
+
+
+def _synth_dict(dict_size):
+    vocab = [START, END, UNK] + WORDS[:30]
+    vocab = vocab[:dict_size] if dict_size > 3 else vocab
+    word_to_id = {w: i for i, w in enumerate(vocab)}
+    return word_to_id
+
+
+def _tar_reader_creator(tar_file, file_name, dict_size):
+    def reader():
+        src_dict, trg_dict = __read_to_dict(tar_file, dict_size)
+        with tarfile.open(tar_file) as f:
+            names = [n for n in f.getnames() if n.endswith(file_name)]
+            for name in names:
+                for line in f.extractfile(name).read().decode().splitlines():
+                    line_split = line.strip().split('\t')
+                    if len(line_split) != 2:
+                        continue
+                    src_words = line_split[0].split()
+                    src_ids = [src_dict.get(START)] + [
+                        src_dict.get(w, UNK_IDX) for w in src_words
+                    ] + [src_dict.get(END)]
+                    trg_words = line_split[1].split()
+                    trg_ids = [trg_dict.get(w, UNK_IDX) for w in trg_words]
+                    trg_ids_next = trg_ids + [trg_dict.get(END)]
+                    trg_ids = [trg_dict.get(START)] + trg_ids
+                    yield src_ids, trg_ids, trg_ids_next
+    reader.is_synthetic = False
+    return reader
+
+
+def __read_to_dict(tar_file, dict_size):
+    def __to_dict(fd, size):
+        out_dict = {}
+        for line_count, line in enumerate(fd.read().decode().splitlines()):
+            if line_count < size:
+                out_dict[line.strip()] = line_count
+            else:
+                break
+        return out_dict
+
+    with tarfile.open(tar_file) as f:
+        src_name = [n for n in f.getnames() if n.endswith('src.dict')][0]
+        trg_name = [n for n in f.getnames() if n.endswith('trg.dict')][0]
+        src_dict = __to_dict(f.extractfile(src_name), dict_size)
+        trg_dict = __to_dict(f.extractfile(trg_name), dict_size)
+    return src_dict, trg_dict
+
+
+def _synth_reader_creator(n, seed, dict_size):
+    def reader():
+        d = _synth_dict(dict_size)
+        for s, t in _synth_pairs(n, seed):
+            src_ids = [d[START]] + [d.get(w, UNK_IDX) for w in s] + [d[END]]
+            trg_ids = [d.get(w, UNK_IDX) for w in t]
+            yield src_ids, [d[START]] + trg_ids, trg_ids + [d[END]]
+    reader.is_synthetic = True
+    return reader
+
+
+def train(dict_size):
+    """ref wmt14.py:train."""
+    if os.path.exists(_TAR):
+        return _tar_reader_creator(_TAR, 'train/train', dict_size)
+    synthetic_warn('wmt14', _TAR)
+    return _synth_reader_creator(300, 91, dict_size)
+
+
+def test(dict_size):
+    """ref wmt14.py:test."""
+    if os.path.exists(_TAR):
+        return _tar_reader_creator(_TAR, 'test/test', dict_size)
+    synthetic_warn('wmt14', _TAR)
+    return _synth_reader_creator(60, 92, dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    """ref wmt14.py:get_dict — (src, trg) id→word (or word→id when
+    reverse=False)."""
+    if os.path.exists(_TAR):
+        src_dict, trg_dict = __read_to_dict(_TAR, dict_size)
+    else:
+        src_dict = trg_dict = _synth_dict(dict_size)
+    if reverse:
+        src_dict = {v: k for k, v in src_dict.items()}
+        trg_dict = {v: k for k, v in trg_dict.items()}
+    return src_dict, trg_dict

@@ -1,0 +1,135 @@
+"""paddle.dataset.wmt16 parity (ref: python/paddle/dataset/wmt16.py) —
+WMT16 en↔de with on-the-fly vocab building. Readers yield
+(src ids, trg ids, trg-next ids)."""
+import collections
+import os
+import tarfile
+
+from .common import DATA_HOME, WORDS, synthetic_text_corpus, synthetic_warn
+
+__all__ = ['train', 'test', 'validation', 'get_dict', 'fetch']
+
+_DIR = os.path.join(DATA_HOME, 'wmt16')
+_TAR = os.path.join(_DIR, 'wmt16.tar.gz')
+
+START_MARK = '<s>'
+END_MARK = '<e>'
+UNK_MARK = '<unk>'
+
+
+def _synth_pairs(n, seed):
+    src = synthetic_text_corpus(WORDS[:40], n, seed, min_len=3, max_len=8)
+    return [(s, list(reversed(s))) for s in src]
+
+
+def __build_dict(tar_file, dict_size, save_path, lang):
+    word_dict = collections.defaultdict(int)
+    with tarfile.open(tar_file) as f:
+        for line in f.extractfile('wmt16/train').read().decode() \
+                .splitlines():
+            line_split = line.strip().split('\t')
+            if len(line_split) != 2:
+                continue
+            sen = line_split[0] if lang == 'en' else line_split[1]
+            for w in sen.split():
+                word_dict[w] += 1
+    with open(save_path, 'w', encoding='utf-8') as fout:
+        fout.write(f'{START_MARK}\n{END_MARK}\n{UNK_MARK}\n')
+        for word, _ in sorted(word_dict.items(),
+                              key=lambda x: x[1], reverse=True)[
+                :dict_size - 3]:
+            fout.write(word + '\n')
+
+
+def __load_dict(tar_file, dict_size, lang, reverse=False):
+    dict_path = os.path.join(_DIR, f'{lang}.dict')
+    if os.path.exists(tar_file) and (not os.path.exists(dict_path) or (
+            len(open(dict_path, 'rb').readlines()) != dict_size)):
+        os.makedirs(_DIR, exist_ok=True)
+        __build_dict(tar_file, dict_size, dict_path, lang)
+    word_dict = {}
+    if os.path.exists(dict_path):
+        with open(dict_path, encoding='utf-8') as fdict:
+            for idx, line in enumerate(fdict):
+                if reverse:
+                    word_dict[idx] = line.strip()
+                else:
+                    word_dict[line.strip()] = idx
+    else:
+        vocab = [START_MARK, END_MARK, UNK_MARK] + WORDS[:40]
+        vocab = vocab[:dict_size] if dict_size > 3 else vocab
+        for i, w in enumerate(vocab):
+            word_dict[i if reverse else w] = w if reverse else i
+    return word_dict
+
+
+def _reader_creator(split, src_dict_size, trg_dict_size, src_lang,
+                    n_synth, seed):
+    src_dict_size = min(src_dict_size, 10**6) if src_dict_size > 0 else 3
+    trg_dict_size = min(trg_dict_size, 10**6) if trg_dict_size > 0 else 3
+
+    def reader():
+        src_dict = __load_dict(_TAR, src_dict_size, src_lang)
+        trg_lang = 'de' if src_lang == 'en' else 'en'
+        trg_dict = __load_dict(_TAR, trg_dict_size, trg_lang)
+        start, end, unk = (src_dict[START_MARK], src_dict[END_MARK],
+                           src_dict[UNK_MARK])
+        t_start, t_end, t_unk = (trg_dict[START_MARK], trg_dict[END_MARK],
+                                 trg_dict[UNK_MARK])
+        if os.path.exists(_TAR):
+            with tarfile.open(_TAR) as f:
+                lines = f.extractfile(f'wmt16/{split}').read().decode() \
+                    .splitlines()
+            pairs = []
+            for line in lines:
+                ls = line.strip().split('\t')
+                if len(ls) == 2:
+                    en, de = ls[0].split(), ls[1].split()
+                    pairs.append((en, de) if src_lang == 'en' else (de, en))
+        else:
+            pairs = _synth_pairs(n_synth, seed)
+        for s, t in pairs:
+            src_ids = [start] + [src_dict.get(w, unk) for w in s] + [end]
+            trg_ids = [trg_dict.get(w, t_unk) for w in t]
+            yield src_ids, [t_start] + trg_ids, trg_ids + [t_end]
+    reader.is_synthetic = not os.path.exists(_TAR)
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang='en'):
+    """ref wmt16.py:train."""
+    if src_lang not in ('en', 'de'):
+        raise ValueError("src_lang must be 'en' or 'de'")
+    if not os.path.exists(_TAR):
+        synthetic_warn('wmt16', _TAR)
+    return _reader_creator('train', src_dict_size, trg_dict_size, src_lang,
+                           300, 95)
+
+
+def test(src_dict_size, trg_dict_size, src_lang='en'):
+    """ref wmt16.py:test."""
+    if src_lang not in ('en', 'de'):
+        raise ValueError("src_lang must be 'en' or 'de'")
+    return _reader_creator('test', src_dict_size, trg_dict_size, src_lang,
+                           60, 96)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang='en'):
+    """ref wmt16.py:validation."""
+    if src_lang not in ('en', 'de'):
+        raise ValueError("src_lang must be 'en' or 'de'")
+    return _reader_creator('val', src_dict_size, trg_dict_size, src_lang,
+                           60, 97)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    """ref wmt16.py:get_dict."""
+    dict_size = min(dict_size, 10**6)
+    return __load_dict(_TAR, dict_size, lang, reverse)
+
+
+def fetch():
+    """ref wmt16.py:fetch — no egress; points at the cache location."""
+    from .common import download
+    return download('http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz',
+                    'wmt16', None)

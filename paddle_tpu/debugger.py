@@ -7,9 +7,71 @@ the .dot text is self-contained (render with `dot -Tpng` or any viewer).
 """
 from __future__ import annotations
 
-from .framework import BACKWARD_OP_TYPE, Parameter, Program
+from .framework import BACKWARD_OP_TYPE, Parameter, Program, Variable
 
-__all__ = ['draw_block_graphviz', 'pprint_program_codes', 'pprint_block_codes']
+__all__ = ['draw_block_graphviz', 'pprint_program_codes',
+           'pprint_block_codes', 'repr_var', 'repr_op', 'repr_attr',
+           'repr_tensor', 'repr_lodtensor', 'repr_selected_rows',
+           'repr_tensor_array', 'repr_data_type',
+           'prepare_fast_nan_inf_debug', 'run_fast_nan_inf_debug']
+
+
+# ---------------------------------------------------------------------------
+# repr helpers (ref debugger.py:53-226) — over the op-list IR instead of
+# framework_pb2 descs
+# ---------------------------------------------------------------------------
+
+def repr_data_type(dtype):
+    """ref debugger.py:53 — dtype → printable name (ours are strings)."""
+    return str(dtype)
+
+
+def repr_tensor(var):
+    """ref debugger.py:57."""
+    return f'tensor(type={var.dtype}, shape={list(var.shape or [])})'
+
+
+def repr_lodtensor(var):
+    """ref debugger.py:65 — ragged vars carry lod_level in the IR."""
+    if not getattr(var, 'lod_level', 0):
+        return None
+    return (f'LoDTensor(lod_level={var.lod_level}, type={var.dtype}, '
+            f'shape={list(var.shape or [])})')
+
+
+def repr_selected_rows(var):
+    """ref debugger.py:77 — sparse rows lower to dense scatter on TPU; the
+    printable form is kept for parity."""
+    return f'SelectedRows(type={var.dtype}, shape={list(var.shape or [])})'
+
+
+def repr_tensor_array(var):
+    """ref debugger.py:87."""
+    return (f'TensorArray(type={var.dtype}, '
+            f'shape={list(var.shape or [])})')
+
+
+def repr_var(var):
+    """ref debugger.py:105 — best printable form for a Variable."""
+    kind = 'param' if isinstance(var, Parameter) else (
+        'data' if var.is_data else 'var')
+    body = repr_lodtensor(var) or repr_tensor(var)
+    return f'{kind} {var.name}: {body}'
+
+
+def repr_attr(name, value):
+    """ref debugger.py:161 — attr → printable (name, value) text."""
+    return f'{name}={value!r}'
+
+
+def repr_op(op):
+    """ref debugger.py:193."""
+    from .ops.registry import NON_KERNEL_ATTRS
+    attrs = ', '.join(repr_attr(k, v) for k, v in sorted(op.attrs.items())
+                      if k not in NON_KERNEL_ATTRS)
+    outs = ', '.join(op.output_names()) or '_'
+    ins = ', '.join(op.input_names())
+    return f'{outs} = {op.type}({ins}{", " + attrs if attrs else ""})'
 
 
 def _esc(s):
@@ -78,3 +140,54 @@ def pprint_program_codes(program, show_backward=True):
                        for b in program.blocks)
     print(text)
     return text
+
+
+# ---------------------------------------------------------------------------
+# fast NaN/Inf localisation (ref debugger.py:285,330)
+# ---------------------------------------------------------------------------
+
+def prepare_fast_nan_inf_debug(_program):
+    """ref debugger.py:285 — mark the program for NaN/Inf localisation.
+
+    The reference appends isfinite ops per var; in the XLA lowering we
+    instead record every op-output var name so run_fast_nan_inf_debug can
+    fetch them all from ONE jitted run and binary-search on host."""
+    names = []
+    for op in _program.global_block().ops:
+        for n in op.output_names():
+            if not n.endswith('@LEN'):
+                names.append(n)
+    _program._nan_inf_watch = names
+    return _program
+
+
+def run_fast_nan_inf_debug(executor, program=None, feed=None,
+                           fetch_list=None, scope=None, return_numpy=True,
+                           use_program_cache=False):
+    """ref debugger.py:330 — run once, report the FIRST op whose output
+    contains NaN/Inf (raises RuntimeError naming op and var), else return
+    the normal fetches."""
+    import numpy as np
+    if program is None:
+        from .framework import default_main_program
+        program = default_main_program()
+    watch = getattr(program, '_nan_inf_watch', None)
+    if watch is None:
+        prepare_fast_nan_inf_debug(program)
+        watch = program._nan_inf_watch
+    fetch_names = [getattr(f, 'name', f) for f in (fetch_list or [])]
+    vals = executor.run(program, feed=feed,
+                        fetch_list=list(watch) + fetch_names,
+                        scope=scope, return_numpy=return_numpy)
+    producer = {}
+    for op in program.global_block().ops:
+        for n in op.output_names():
+            producer.setdefault(n, op)
+    for name, val in zip(watch, vals):
+        arr = np.asarray(val)
+        if arr.dtype.kind in 'fc' and not np.isfinite(arr).all():
+            op = producer[name]
+            raise RuntimeError(
+                f'NaN/Inf first appears in var {name!r} produced by '
+                f'{repr_op(op)}')
+    return vals[len(watch):]

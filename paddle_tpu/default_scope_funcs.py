@@ -1,0 +1,57 @@
+"""Default-scope helpers (ref: python/paddle/fluid/default_scope_funcs.py).
+
+A thread-local stack of Scopes over core.Scope; `scoped_function` runs a
+callable inside a fresh child scope and discards it after.
+"""
+import threading
+
+from .core.scope import Scope, global_scope
+
+__all__ = ['get_cur_scope', 'enter_local_scope', 'leave_local_scope',
+           'var', 'find_var', 'scoped_function']
+
+_local = threading.local()
+
+
+def _stack():
+    if not hasattr(_local, 'stack') or not _local.stack:
+        _local.stack = [global_scope()]
+    return _local.stack
+
+
+def get_cur_scope():
+    """Innermost scope of the current thread (ref :30)."""
+    return _stack()[-1]
+
+
+def enter_local_scope():
+    """Push a child scope (ref :39)."""
+    cur = get_cur_scope()
+    _stack().append(cur.new_scope())
+
+
+def leave_local_scope():
+    """Pop the innermost scope (ref :46)."""
+    stack = _stack()
+    if len(stack) <= 1:
+        raise RuntimeError('cannot leave the global scope')
+    stack.pop()
+
+
+def var(name):
+    """Find-or-create `name` in the current scope (ref :53)."""
+    return get_cur_scope().var(name)
+
+
+def find_var(name):
+    """Find `name` walking outward through parents (ref :60)."""
+    return get_cur_scope().find(name)
+
+
+def scoped_function(func):
+    """Run func() inside a fresh local scope (ref :67)."""
+    enter_local_scope()
+    try:
+        return func()
+    finally:
+        leave_local_scope()

@@ -1,0 +1,73 @@
+"""Device workers (ref: python/paddle/fluid/device_worker.py).
+
+The reference's device workers are C++ per-thread training loops (Hogwild,
+DownpourSGD for PS, Section for pipeline). On TPU the training loop is ONE
+jitted XLA program, so a device worker reduces to the strategy metadata it
+contributes to the TrainerDesc; Executor.train_from_dataset runs the fused
+step regardless of worker class.
+"""
+
+__all__ = ['DeviceWorker', 'Hogwild', 'DownpourSGD', 'DownpourSGDOPT',
+           'Section']
+
+
+class DeviceWorker:
+    """ref device_worker.py:DeviceWorker."""
+
+    def __init__(self):
+        self._program = None
+        self._infer = None
+
+    def _set_infer(self, infer=False):
+        self._infer = infer
+
+    def _set_fleet_desc(self, fleet_desc):
+        self._fleet_desc = fleet_desc
+
+    def _set_program(self, program):
+        self._program = program
+
+    def _gen_worker_desc(self, trainer_desc):
+        raise NotImplementedError(
+            "DeviceWorker should not be used directly; use a subclass")
+
+
+class Hogwild(DeviceWorker):
+    """ref device_worker.py:Hogwild — the default dense worker."""
+
+    def _gen_worker_desc(self, trainer_desc):
+        trainer_desc.proto_desc['device_worker_name'] = 'HogwildWorker'
+        if self._infer:
+            trainer_desc.proto_desc.setdefault('hogwild_param', {})[
+                'skip_ops'] = ['feed', 'fetch']
+
+
+class DownpourSGD(DeviceWorker):
+    """ref device_worker.py:DownpourSGD — PS sparse/dense pull-push worker.
+    On TPU the PS tables lower to collective DP (incubate/fleet PS shims);
+    the desc records the worker name for parity."""
+
+    def _gen_worker_desc(self, trainer_desc):
+        trainer_desc.proto_desc['device_worker_name'] = 'DownpourWorker'
+
+
+class DownpourSGDOPT(DownpourSGD):
+    """ref device_worker.py:DownpourSGDOPT."""
+
+    def _gen_worker_desc(self, trainer_desc):
+        trainer_desc.proto_desc['device_worker_name'] = 'DownpourWorkerOpt'
+
+
+class Section(DeviceWorker):
+    """ref device_worker.py:Section — pipeline-stage worker; the real TPU
+    pipeline schedule is parallel/pipeline.py (GPipe over the pp mesh
+    axis)."""
+
+    def _gen_worker_desc(self, trainer_desc):
+        trainer_desc.proto_desc['device_worker_name'] = 'SectionWorker'
+        pipeline_opt = (self._program._pipeline_opt
+                        if self._program is not None
+                        and hasattr(self._program, '_pipeline_opt') else {})
+        trainer_desc.proto_desc['section_param'] = {
+            'queue_size': pipeline_opt.get('queue_size', 1),
+            'sync_steps': pipeline_opt.get('sync_steps', 1)}

@@ -1,0 +1,45 @@
+"""Distributed lookup-table discovery (ref: python/paddle/fluid/
+distribute_lookup_table.py) — real scans over the op-list IR for
+`lookup_table` ops marked `is_distributed`. Op inputs/outputs are
+slot-name → [var names] (framework.Operator)."""
+
+__all__ = ['find_distributed_lookup_table',
+           'find_distributed_lookup_table_inputs',
+           'find_distributed_lookup_table_outputs']
+
+LOOKUP_TABLE_TYPE = 'lookup_table'
+
+
+def _dist_lookup_ops(program):
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE and op.attrs.get('is_distributed'):
+            yield op
+
+
+def find_distributed_lookup_table(program):
+    """ref :find_distributed_lookup_table — the single distributed table's
+    weight name, or None; multiple distinct tables raise (same as ref)."""
+    table_name = None
+    for op in _dist_lookup_ops(program):
+        name = op.inputs['w'][0]
+        if table_name is None:
+            table_name = name
+        elif table_name != name:
+            raise RuntimeError('all distributed lookup_table ops must '
+                               'share one table')
+    return table_name
+
+
+def find_distributed_lookup_table_inputs(program, table_name):
+    """ref :find_distributed_lookup_table_inputs — ids vars feeding the
+    distributed table."""
+    return [n for op in _dist_lookup_ops(program)
+            if op.inputs['w'][0] == table_name
+            for n in op.inputs.get('ids', [])]
+
+
+def find_distributed_lookup_table_outputs(program, table_name):
+    """ref :find_distributed_lookup_table_outputs."""
+    return [n for op in _dist_lookup_ops(program)
+            if op.inputs['w'][0] == table_name
+            for n in op.outputs.get('Out', [])]

@@ -1,0 +1,71 @@
+"""Dygraph gradient clipping classes (ref: python/paddle/fluid/
+dygraph_grad_clip.py:34-191). Each takes/returns a list of
+(parameter, gradient) pairs; clipping runs as jax ops so it stays on
+device and fuses into a jitted step when traced.
+"""
+import jax.numpy as jnp
+
+__all__ = ['GradClipByValue', 'GradClipByNorm', 'GradClipByGlobalNorm']
+
+
+class GradClipBase:
+    def _clip(self, para_and_grad):
+        raise NotImplementedError
+
+    def __call__(self, para_and_grad):
+        return self._clip(para_and_grad)
+
+
+class GradClipByValue(GradClipBase):
+    """Clamp every gradient element to [min_value, max_value]
+    (ref dygraph_grad_clip.py:46). With one argument, the range is
+    symmetric: [-|v|, |v|]."""
+
+    def __init__(self, min_value, max_value=None):
+        if max_value is None:
+            max_value = abs(min_value)
+            min_value = -max_value
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def _clip(self, para_and_grad):
+        return [(p, None if g is None
+                 else jnp.clip(g, self.min_value, self.max_value))
+                for p, g in para_and_grad]
+
+
+class GradClipByNorm(GradClipBase):
+    """Scale each gradient so its own L2 norm is at most clip_norm
+    (ref dygraph_grad_clip.py:120)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, para_and_grad):
+        out = []
+        for p, g in para_and_grad:
+            if g is None:
+                out.append((p, None))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, g * scale))
+        return out
+
+
+class GradClipByGlobalNorm(GradClipBase):
+    """Scale ALL gradients jointly so the global L2 norm is at most
+    max_global_norm (ref dygraph_grad_clip.py:191)."""
+
+    def __init__(self, max_global_norm):
+        self.max_global_norm = float(max_global_norm)
+
+    def _clip(self, para_and_grad):
+        grads = [g for _, g in para_and_grad if g is not None]
+        if not grads:
+            return para_and_grad
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in grads))
+        scale = jnp.minimum(
+            self.max_global_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        return [(p, None if g is None else g * scale)
+                for p, g in para_and_grad]

@@ -1,0 +1,29 @@
+"""Legacy fluid.evaluator surface (ref: python/paddle/fluid/evaluator.py).
+
+The reference deprecates these in favor of fluid.metrics; here they are
+thin aliases over the metrics implementations so old scripts import-run.
+"""
+import warnings
+
+from .metrics import ChunkEvaluator as _ChunkEvaluator
+from .metrics import EditDistance as _EditDistance
+from .metrics import DetectionMAP as _DetectionMAP
+
+__all__ = ['ChunkEvaluator', 'EditDistance', 'DetectionMAP']
+
+
+def _deprecated(cls, name):
+    class Wrapped(cls):
+        def __init__(self, *args, **kwargs):
+            warnings.warn(
+                f'fluid.evaluator.{name} is deprecated; '
+                f'use fluid.metrics.{name}', DeprecationWarning, stacklevel=2)
+            super().__init__(*args, **kwargs)
+    Wrapped.__name__ = name
+    Wrapped.__qualname__ = name
+    return Wrapped
+
+
+ChunkEvaluator = _deprecated(_ChunkEvaluator, 'ChunkEvaluator')
+EditDistance = _deprecated(_EditDistance, 'EditDistance')
+DetectionMAP = _deprecated(_DetectionMAP, 'DetectionMAP')

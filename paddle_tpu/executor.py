@@ -795,6 +795,56 @@ class Executor:
         return fetches
 
     # ------------------------------------------------------------------
+    def _run_from_dataset(self, program, dataset, scope, debug, fetch_list,
+                          fetch_info, print_period, fetch_handler):
+        if dataset is None:
+            raise RuntimeError('dataset is required for *_from_dataset')
+        if not dataset.use_vars:
+            raise RuntimeError('dataset.set_use_var was never called')
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or [getattr(f, 'name', f) for f in fetch_list]
+        monitor = None
+        if fetch_handler is not None:
+            from .trainer_factory import FetchHandlerMonitor
+            monitor = FetchHandlerMonitor(scope, fetch_handler)
+            monitor.start()
+        try:
+            for step, batch in enumerate(dataset._batches()):
+                fetches = self.run(program, feed=batch,
+                                   fetch_list=fetch_list, scope=scope)
+                if (debug or fetch_list) and step % print_period == 0:
+                    msg = ', '.join(
+                        f'{info}={np.asarray(val).ravel()[:4]}'
+                        for info, val in zip(fetch_info, fetches))
+                    if msg:
+                        print(f'step {step}: {msg}')
+        finally:
+            if monitor is not None:
+                monitor.stop()
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """ref executor.py:train_from_dataset — one pass over a
+        fluid.dataset (QueueDataset/InMemoryDataset), running the jitted
+        step per batch. `thread` is accepted for parity: host-side parsing
+        threads are not the TPU bottleneck (the step is one XLA program)."""
+        self._run_from_dataset(program, dataset, scope, debug, fetch_list,
+                               fetch_info, print_period, fetch_handler)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """ref executor.py:infer_from_dataset — same loop; the program
+        decides whether backward/update ops exist."""
+        self._run_from_dataset(program, dataset, scope, debug, fetch_list,
+                               fetch_info, print_period, fetch_handler)
+
+    # ------------------------------------------------------------------
     def lower_to_callable(self, program, feed, fetch_list, scope=None):
         """(program, example feed dict, fetch_list) → (fn, arg_vals): a pure
         jittable fn over the feed arrays with the scope's parameters closed

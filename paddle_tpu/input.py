@@ -1,0 +1,8 @@
+"""fluid.input (ref: python/paddle/fluid/input.py) — the v1.7 new-style
+`embedding` / `one_hot` entry points. The TPU lowering is shared with the
+layers versions (gather / one-hot are single XLA ops either way); the
+new-style semantics (no trailing [.,1] dim requirement) already hold
+because the underlying ops accept ids of any rank."""
+from .layers.nn import embedding, one_hot
+
+__all__ = ['embedding', 'one_hot']

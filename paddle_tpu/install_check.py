@@ -1,0 +1,12 @@
+"""fluid.install_check (ref: python/paddle/fluid/install_check.py) —
+`run_check()` trains a tiny linear model forward+backward on the local
+device (and, when >1 device is visible, on a data-parallel mesh) to verify
+the installation end to end."""
+from .debugging import install_check as _install_check
+
+__all__ = ['run_check']
+
+
+def run_check():
+    """ref install_check.py:run_check — raises on failure, prints success."""
+    _install_check()

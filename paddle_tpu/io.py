@@ -19,7 +19,61 @@ from .dygraph.checkpoint import save_dygraph, load_dygraph
 
 __all__ = ['save_params', 'save_persistables', 'load_params',
            'load_persistables', 'save_inference_model', 'load_inference_model',
-           'save_dygraph', 'load_dygraph', 'save_vars', 'load_vars']
+           'save_dygraph', 'load_dygraph', 'save_vars', 'load_vars',
+           'is_parameter', 'is_persistable', 'is_belong_to_optimizer',
+           'get_program_parameter', 'get_program_persistable_vars',
+           'get_parameter_value', 'get_parameter_value_by_name',
+           'prepend_feed_ops', 'append_fetch_ops',
+           'save', 'load', 'load_program_state', 'set_program_state']
+
+
+def is_parameter(var):
+    """ref io.py:67 — var is a trainable Parameter."""
+    return isinstance(var, Parameter)
+
+
+def is_persistable(var):
+    """ref io.py:88 — persistable and not a feed/fetch plumbing var."""
+    return bool(var.persistable) and not var.is_data
+
+
+def is_belong_to_optimizer(var):
+    """ref io.py:113 — optimizer slot vars (moments, velocities, steps…).
+
+    The reference keys on ``var.desc.need_check_feed`` absence + persistable
+    non-parameters; our slots are persistable non-Parameter vars created by
+    optimizer ops, named ``<param>@<slot>`` or ``@LR_DECAY_COUNTER@`` etc.
+    """
+    return (bool(var.persistable) and not isinstance(var, Parameter)
+            and ('@' in var.name or var.name.startswith('learning_rate')))
+
+
+def get_program_parameter(program):
+    """ref io.py:120 — all Parameters of the program."""
+    return [v for v in program.list_vars() if is_parameter(v)]
+
+
+def get_program_persistable_vars(program):
+    """ref io.py:142 — all persistable vars of the program."""
+    return [v for v in program.list_vars() if is_persistable(v)]
+
+
+def get_parameter_value(para, executor=None):
+    """ref io.py:1365 — fetch a Parameter's current value as numpy."""
+    val = global_scope().find(para.name if isinstance(para, Variable) else para)
+    if val is None:
+        raise ValueError(f'parameter {para} has no value in the scope; '
+                         'run the startup program first')
+    return np.asarray(val)
+
+
+def get_parameter_value_by_name(name, executor=None, program=None):
+    """ref io.py:1396."""
+    program = program or default_main_program()
+    var = program.global_block().var(name)
+    if not is_parameter(var):
+        raise TypeError(f'{name} is not a Parameter')
+    return get_parameter_value(var, executor)
 
 
 def _collect(program, predicate, scope):
@@ -181,6 +235,103 @@ def load_inference_model(dirname, executor, model_filename=None,
                                               to_jax_dtype(v.dtype)))
     fetch_vars = [program.global_block().var(n) for n in meta['fetch_names']]
     return program, meta['feed_names'], fetch_vars
+
+
+def prepend_feed_ops(inference_program, feed_target_names,
+                     feed_holder_name='feed'):
+    """ref io.py:984 — record the feed interface on the program.
+
+    The reference prepends C++ ``feed`` ops that copy out of a feed-holder
+    LoDTensorArray; our Executor binds feeds directly as jit arguments, so
+    the interface is metadata: the names are stored on the program and
+    validated at run time.
+    """
+    inference_program._feed_names = list(feed_target_names)
+    return inference_program
+
+
+def append_fetch_ops(inference_program, fetch_target_names,
+                     fetch_holder_name='fetch'):
+    """ref io.py:1005 — record the fetch interface (see prepend_feed_ops)."""
+    inference_program._fetch_names = list(fetch_target_names)
+    return inference_program
+
+
+# ---------------------------------------------------------------------------
+# fluid.save / fluid.load single-file checkpoints (ref io.py:1507,1565)
+# ---------------------------------------------------------------------------
+
+def save(program, model_path):
+    """ref io.py:1507 — writes {path}.pdparams / {path}.pdopt / {path}.pdmodel
+    (parameters / optimizer state / program IR)."""
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    scope = global_scope()
+    params = {v.name: np.asarray(scope.find(v.name))
+              for v in get_program_parameter(program)
+              if scope.find(v.name) is not None}
+    opt = {v.name: np.asarray(scope.find(v.name))
+           for v in program.list_vars()
+           if is_persistable(v) and not is_parameter(v)
+           and scope.find(v.name) is not None}
+    np.savez(model_path + '.pdparams', **params)
+    np.savez(model_path + '.pdopt', **opt)
+    with open(model_path + '.pdmodel', 'w') as f:
+        json.dump(_program_to_dict(program), f)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """ref io.py:1565 — restore state saved by `save` into the scope."""
+    state = load_program_state(model_path, var_list)
+    set_program_state(program, state)
+
+
+def load_program_state(model_path, var_list=None):
+    """ref io.py:1731 — {name: ndarray} from {path}.pdparams (+ .pdopt)."""
+    state = {}
+    for ext in ('.pdparams', '.pdopt'):
+        p = model_path + ext
+        if os.path.exists(p + '.npz'):   # np.savez appends .npz
+            p = p + '.npz'
+        if os.path.exists(p):
+            with np.load(p) as data:
+                state.update({k: data[k] for k in data.files})
+    if not state:
+        raise FileNotFoundError(f'no saved state at {model_path}.pdparams')
+    if var_list is not None:
+        want = {v.name if isinstance(v, Variable) else v for v in var_list}
+        missing = want - set(state)
+        if missing:
+            raise ValueError(f'vars not found in {model_path}: {sorted(missing)}')
+        state = {k: v for k, v in state.items() if k in want}
+    return state
+
+
+def set_program_state(program, state_dict):
+    """ref io.py:1861 — write a load_program_state dict into the scope,
+    checking shape/dtype against the program's vars."""
+    scope = global_scope()
+    by_name = {v.name: v for v in program.list_vars() if is_persistable(v)}
+    used = 0
+    for name, arr in state_dict.items():
+        v = by_name.get(name)
+        if v is None:
+            continue
+        if v.shape and -1 not in v.shape \
+                and tuple(np.shape(arr)) != tuple(v.shape):
+            raise ValueError(
+                f'shape mismatch for {name}: program has {tuple(v.shape)}, '
+                f'state has {np.shape(arr)}')
+        want = np.dtype(to_jax_dtype(v.dtype))
+        have = np.asarray(arr).dtype
+        if have.kind != want.kind:
+            raise ValueError(
+                f'dtype mismatch for {name}: program has {want}, '
+                f'state has {have}')
+        scope.set(name, jnp.asarray(arr, to_jax_dtype(v.dtype)))
+        used += 1
+    return used
 
 
 def _save_jit_model(dirname, layer, params, buffers):

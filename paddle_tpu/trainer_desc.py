@@ -1,0 +1,79 @@
+"""TrainerDesc (ref: python/paddle/fluid/trainer_desc.py) — configuration
+record for dataset-driven training (Executor.train_from_dataset).
+
+The reference serializes a trainer_desc.proto consumed by C++ trainers;
+here the same fields live in a dict and the Executor reads them directly
+(fetch config, print period, debug flag).
+"""
+
+__all__ = ['TrainerDesc', 'MultiTrainer', 'DistMultiTrainer',
+           'PipelineTrainer']
+
+
+class TrainerDesc:
+    """ref trainer_desc.py:TrainerDesc."""
+
+    def __init__(self):
+        self.proto_desc = {'class_name': '', 'device_worker_name': '',
+                           'thread_num': 1, 'debug': False,
+                           'fetch_config': {'fetch_var_names': [],
+                                            'fetch_var_str_format': [],
+                                            'print_period': 100}}
+        self._program = None
+        self._device_worker = None
+        self._infer = False
+
+    def _set_fetch_var_and_info(self, fetch_vars, fetch_info, print_period):
+        fc = self.proto_desc['fetch_config']
+        fc['fetch_var_names'] = [getattr(v, 'name', v) for v in fetch_vars]
+        fc['fetch_var_str_format'] = list(fetch_info or [])
+        fc['print_period'] = int(print_period)
+
+    def _set_debug(self, debug):
+        self.proto_desc['debug'] = bool(debug)
+
+    def _set_thread(self, thread_num):
+        self.proto_desc['thread_num'] = int(thread_num)
+
+    def _set_device_worker(self, device_worker):
+        self._device_worker = device_worker
+
+    def _set_program(self, program):
+        self._program = program
+
+    def _set_infer(self, infer):
+        self._infer = bool(infer)
+
+    def _gen_trainer_desc(self):
+        if self._device_worker is not None:
+            self._device_worker._set_program(self._program)
+            self._device_worker._set_infer(self._infer)
+            self._device_worker._gen_worker_desc(self)
+
+    def _desc(self):
+        return self.proto_desc
+
+
+class MultiTrainer(TrainerDesc):
+    """ref trainer_desc.py:MultiTrainer — the default dense trainer."""
+
+    def __init__(self):
+        super().__init__()
+        self.proto_desc['class_name'] = 'MultiTrainer'
+
+
+class DistMultiTrainer(TrainerDesc):
+    """ref trainer_desc.py:DistMultiTrainer — PS-mode trainer."""
+
+    def __init__(self):
+        super().__init__()
+        self.proto_desc['class_name'] = 'DistMultiTrainer'
+
+
+class PipelineTrainer(TrainerDesc):
+    """ref trainer_desc.py:PipelineTrainer — pipeline trainer (the TPU
+    pipeline itself is parallel/pipeline.py)."""
+
+    def __init__(self):
+        super().__init__()
+        self.proto_desc['class_name'] = 'PipelineTrainer'

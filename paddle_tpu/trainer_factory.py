@@ -1,0 +1,86 @@
+"""TrainerFactory + fetch monitoring (ref: python/paddle/fluid/
+trainer_factory.py)."""
+import threading
+
+import numpy as np
+
+from .trainer_desc import (MultiTrainer, DistMultiTrainer, PipelineTrainer)
+from .device_worker import (Hogwild, DownpourSGD, DownpourSGDOPT, Section)
+
+__all__ = ['TrainerFactory', 'FetchHandler', 'FetchHandlerMonitor']
+
+
+class TrainerFactory:
+    """ref trainer_factory.py:TrainerFactory — build (trainer, worker) from
+    a program's _fleet_opt dict; defaults to MultiTrainer + Hogwild."""
+
+    def _create_trainer(self, opt_info=None):
+        if not opt_info:
+            trainer = MultiTrainer()
+            device_worker = Hogwild()
+        else:
+            trainer_name = opt_info.get('trainer', 'MultiTrainer')
+            worker_name = opt_info.get('device_worker', 'Hogwild')
+            trainer = {'MultiTrainer': MultiTrainer,
+                       'DistMultiTrainer': DistMultiTrainer,
+                       'PipelineTrainer': PipelineTrainer}[trainer_name]()
+            device_worker = {'Hogwild': Hogwild,
+                             'DownpourSGD': DownpourSGD,
+                             'DownpourSGDOPT': DownpourSGDOPT,
+                             'Section': Section}[worker_name]()
+            if 'fleet_desc' in opt_info:
+                device_worker._set_fleet_desc(opt_info['fleet_desc'])
+        trainer._set_device_worker(device_worker)
+        return trainer
+
+
+class FetchHandler:
+    """ref trainer_factory.py:FetchHandler — subclass and override
+    `handler(fetch_dict)`; the monitor calls it every `period_secs`."""
+
+    def __init__(self, var_dict=None, period_secs=60):
+        if var_dict is None:
+            raise ValueError('var_dict cannot be None')
+        self.var_dict = var_dict
+        self.period_secs = period_secs
+
+    def handler(self, res_dict):
+        for key in res_dict:
+            if isinstance(res_dict[key], np.ndarray):
+                print(f'{key}[0]: {res_dict[key].ravel()[:1]}')
+
+    @staticmethod
+    def help():
+        print("""class FetchHandlerExample(FetchHandler):
+    def handler(self, res_dict):
+        print(res_dict["var_name"])""")
+
+
+class FetchHandlerMonitor:
+    """ref trainer_factory.py:FetchHandlerMonitor — background thread that
+    reads the handler's vars from a scope on a period."""
+
+    def __init__(self, scope, handler):
+        self.scope = scope
+        self.handler = handler
+        self._stop = threading.Event()
+        self.fetch_thread = threading.Thread(
+            target=self._loop, daemon=True)
+
+    def _loop(self):
+        while not self._stop.wait(self.handler.period_secs):
+            res = {}
+            for key, var in self.handler.var_dict.items():
+                val = self.scope.find(getattr(var, 'name', var))
+                res[key] = None if val is None else np.asarray(val)
+            self.handler.handler(res)
+
+    def start(self):
+        self._stop.clear()
+        if not self.fetch_thread.is_alive():
+            self.fetch_thread = threading.Thread(target=self._loop,
+                                                 daemon=True)
+            self.fetch_thread.start()
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,235 @@
+"""fluid.dataset (MultiSlot files → train_from_dataset) + paddle.dataset
+zoo readers."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _write_multislot(path, rows):
+    """rows: list of (dense3, label1) — MultiSlot: count then values."""
+    with open(path, 'w') as f:
+        for feats, lab in rows:
+            f.write(f"{len(feats)} {' '.join(str(v) for v in feats)} "
+                    f"1 {lab}\n")
+
+
+@pytest.fixture
+def slot_files(tmp_path):
+    rng = np.random.RandomState(0)
+    files = []
+    for i in range(2):
+        rows = [(rng.rand(3).round(3).tolist(), int(rng.randint(0, 2)))
+                for _ in range(6)]
+        p = str(tmp_path / f'part-{i}.txt')
+        _write_multislot(p, rows)
+        files.append(p)
+    return files
+
+
+def _slot_vars():
+    x = fluid.data('ds_x', [-1, 3], 'float32')
+    y = fluid.data('ds_y', [-1, 1], 'int64')
+    return x, y
+
+
+def test_queue_dataset_batches(slot_files):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y = _slot_vars()
+    ds = fluid.DatasetFactory().create_dataset('QueueDataset')
+    ds.set_batch_size(4)
+    ds.set_filelist(slot_files)
+    ds.set_use_var([x, y])
+    batches = list(ds._batches())
+    assert len(batches) == 3            # 12 rows / bs 4
+    assert batches[0]['ds_x'].shape == (4, 3)
+    assert batches[0]['ds_y'].shape == (4, 1)
+    with pytest.raises(NotImplementedError):
+        ds.local_shuffle()
+    assert 'MultiSlotDataFeed' in ds.desc()
+
+
+def test_inmemory_dataset_shuffle_and_size(slot_files):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y = _slot_vars()
+    ds = fluid.DatasetFactory().create_dataset('InMemoryDataset')
+    ds.set_batch_size(3)
+    ds.set_filelist(slot_files)
+    ds.set_use_var([x, y])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 12
+    before = [r[0].copy() for r in ds.memory]
+    ds.local_shuffle()
+    after = [r[0] for r in ds.memory]
+    assert sorted(map(tuple, before)) == sorted(map(tuple, after))
+    ds.release_memory()
+    assert ds.memory is None
+
+
+def test_pipe_command(tmp_path):
+    p = str(tmp_path / 'raw.txt')
+    with open(p, 'w') as f:
+        f.write('SKIP\n3 1.0 2.0 3.0 1 0\n')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y = _slot_vars()
+    ds = fluid.DatasetFactory().create_dataset('QueueDataset')
+    ds.set_batch_size(1)
+    ds.set_filelist([p])
+    ds.set_use_var([x, y])
+    ds.set_pipe_command('grep -v SKIP')
+    b = list(ds._batches())
+    assert len(b) == 1
+    np.testing.assert_allclose(b[0]['ds_x'][0], [1.0, 2.0, 3.0])
+
+
+def test_train_from_dataset(slot_files, capsys):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y = _slot_vars()
+        pred = fluid.layers.fc(x, 2, name='tfd_fc')
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    ds = fluid.DatasetFactory().create_dataset('InMemoryDataset')
+    ds.set_batch_size(4)
+    ds.set_filelist(slot_files)
+    ds.set_use_var([x, y])
+    ds.load_into_memory()
+    w_name = fluid.io.get_program_parameter(main)[0].name
+    w0 = np.asarray(fluid.global_scope().find(w_name)).copy()
+    exe.train_from_dataset(main, ds, fetch_list=[loss], print_period=1)
+    w1 = np.asarray(fluid.global_scope().find(w_name))
+    assert not np.allclose(w0, w1)      # training actually stepped
+    assert 'step 0' in capsys.readouterr().out
+
+
+def test_lod_slot_packs_as_lodtensor(tmp_path):
+    p = str(tmp_path / 'seq.txt')
+    with open(p, 'w') as f:
+        f.write('2 5 6 1 0\n3 7 8 9 1 1\n')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = fluid.data('ds_w', [-1, -1], 'int64', lod_level=1)
+        lab = fluid.data('ds_l', [-1, 1], 'int64')
+    ds = fluid.DatasetFactory().create_dataset('QueueDataset')
+    ds.set_batch_size(2)
+    ds.set_filelist([p])
+    ds.set_use_var([words, lab])
+    (batch,) = list(ds._batches())
+    t = batch['ds_w']
+    from paddle_tpu.core.lod import LoDTensor
+    assert isinstance(t, LoDTensor)
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+
+
+# ------------------------------------------------------------- zoo -----
+
+def test_zoo_readers_yield_consistent_samples():
+    x, y = next(fluid.dataset.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    img, lab = next(fluid.dataset.mnist.train()())
+    assert img.shape == (784,) and 0 <= lab < 10
+    img, lab = next(fluid.dataset.cifar.train10()())
+    assert img.shape == (3072,)
+    img, lab = next(fluid.dataset.cifar.train100()())
+    assert img.shape == (3072,)
+
+
+def test_zoo_imdb_pipeline():
+    wd = fluid.dataset.imdb.build_dict('train', 0)
+    assert '<unk>' in wd
+    ids, label = next(fluid.dataset.imdb.train(wd)())
+    assert label in (0, 1) and all(i < len(wd) for i in ids)
+
+
+def test_zoo_imikolov_ngram_and_seq():
+    wd = fluid.dataset.imikolov.build_dict()
+    gram = next(fluid.dataset.imikolov.train(wd, 5)())
+    assert len(gram) == 5
+    src, trg = next(fluid.dataset.imikolov.train(
+        wd, -1, fluid.dataset.imikolov.DataType.SEQ)())
+    assert src[0] == wd['<s>'] and trg[-1] == wd['<e>']
+    assert src[1:] == trg[:-1]
+
+
+def test_zoo_movielens_consistency():
+    ml = fluid.dataset.movielens
+    sample = next(ml.train()())
+    assert len(sample) == 8
+    uid = sample[0]
+    assert 1 <= uid <= ml.max_user_id()
+    assert sample[4] <= ml.max_movie_id()
+    assert isinstance(ml.movie_info()[sample[4]], ml.MovieInfo)
+    assert len(ml.get_movie_title_dict()) > 0
+
+
+def test_zoo_wmt_translation_pairs():
+    src, trg, trg_next = next(fluid.dataset.wmt14.train(30)())
+    assert trg[1:] == trg_next[:-1]
+    sd, td = fluid.dataset.wmt14.get_dict(30)
+    assert isinstance(next(iter(sd)), int)   # reverse=True → id→word
+    src, trg, trg_next = next(fluid.dataset.wmt16.train(30, 30)())
+    assert trg[1:] == trg_next[:-1]
+    d = fluid.dataset.wmt16.get_dict('en', 30)
+    assert fluid.dataset.wmt16.START_MARK in d
+
+
+def test_zoo_conll05_srl_shapes():
+    r = fluid.dataset.conll05.test()
+    s = next(r())
+    assert len(s) == 9
+    n = len(s[0])
+    assert all(len(f) == n for f in s[1:])
+    wd, vd, ld = fluid.dataset.conll05.get_dict()
+    assert 'B-V' in ld
+    emb_path = fluid.dataset.conll05.get_embedding()
+    assert os.path.exists(emb_path)
+
+
+def test_zoo_mq2007_formats():
+    label, better, worse = next(fluid.dataset.mq2007.train())
+    assert label == 1 and better.shape == worse.shape
+    score, feats = next(fluid.dataset.mq2007.train(format='pointwise'))
+    assert feats.ndim == 1
+    labels, mat = next(fluid.dataset.mq2007.train(format='listwise'))
+    assert mat.shape[0] == labels.shape[0]
+
+
+def test_zoo_sentiment():
+    wd = fluid.dataset.sentiment.get_word_dict()
+    ids, label = next(fluid.dataset.sentiment.train()())
+    assert label in (0, 1) and all(i < len(wd) for i in ids)
+
+
+def test_zoo_image_transforms():
+    img = np.arange(40 * 30 * 3, dtype='uint8').reshape(40, 30, 3)
+    small = fluid.dataset.image.resize_short(img, 20)
+    assert min(small.shape[:2]) == 20
+    crop = fluid.dataset.image.center_crop(small, 16)
+    assert crop.shape[:2] == (16, 16)
+    chw = fluid.dataset.image.to_chw(crop)
+    assert chw.shape[0] == 3
+    out = fluid.dataset.image.simple_transform(img, 24, 16, is_train=True,
+                                               mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 16, 16)
+    flipped = fluid.dataset.image.left_right_flip(img)
+    np.testing.assert_array_equal(flipped[:, 0], img[:, -1])
+
+
+def test_zoo_common_split_and_cluster(tmp_path):
+    def reader():
+        yield from range(10)
+    fluid.dataset.common.split(reader, 4, suffix=str(tmp_path / '%05d.pkl'))
+    r = fluid.dataset.common.cluster_files_reader(
+        str(tmp_path / '*.pkl'), trainer_count=1, trainer_id=0)
+    assert sorted(r()) == list(range(10))
+    two = fluid.dataset.common.cluster_files_reader(
+        str(tmp_path / '*.pkl'), trainer_count=2, trainer_id=0)
+    assert len(list(two())) < 10

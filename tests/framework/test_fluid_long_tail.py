@@ -1,0 +1,290 @@
+"""Long-tail fluid module parity: io save/load/program_state, average,
+evaluator, install_check, dygraph_grad_clip, input, default_scope_funcs,
+op introspection, net_drawer, data_feed_desc, communicator, trainer
+machinery, distribute_lookup_table, debugger repr/nan-inf."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _build_regression(scope_reset=True):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data('x', [4, 3], 'float32')
+        y = fluid.data('y', [4, 1], 'float32')
+        pred = fluid.layers.fc(x, 1, name='fcio')
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.SGD(0.1)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------- io ----
+
+def test_io_predicates_and_program_queries():
+    main, startup, _ = _build_regression()
+    params = fluid.io.get_program_parameter(main)
+    persist = fluid.io.get_program_persistable_vars(main)
+    assert params and all(fluid.io.is_parameter(p) for p in params)
+    assert set(p.name for p in params) <= set(v.name for v in persist)
+    assert all(fluid.io.is_persistable(v) for v in persist)
+
+
+def test_io_save_load_roundtrip(tmp_path):
+    main, startup, loss = _build_regression()
+    exe = fluid.Executor()
+    exe.run(startup)
+    x = np.random.rand(4, 3).astype('float32')
+    y = np.random.rand(4, 1).astype('float32')
+    exe.run(main, feed={'x': x, 'y': y}, fetch_list=[loss])
+    w_name = fluid.io.get_program_parameter(main)[0].name
+    w_before = fluid.io.get_parameter_value_by_name(w_name, exe, main)
+
+    path = str(tmp_path / 'model')
+    fluid.save(main, path)
+
+    # perturb, then restore
+    fluid.global_scope().set(w_name, np.zeros_like(w_before))
+    fluid.load(main, path, exe)
+    np.testing.assert_allclose(
+        fluid.io.get_parameter_value_by_name(w_name, exe, main), w_before)
+
+    state = fluid.io.load_program_state(path)
+    assert w_name in state
+    state[w_name] = state[w_name] + 1.0
+    n = fluid.io.set_program_state(main, state)
+    assert n >= 1
+    np.testing.assert_allclose(
+        fluid.io.get_parameter_value_by_name(w_name, exe, main),
+        w_before + 1.0)
+
+
+def test_set_program_state_shape_mismatch(tmp_path):
+    main, startup, _ = _build_regression()
+    exe = fluid.Executor()
+    exe.run(startup)
+    w_name = fluid.io.get_program_parameter(main)[0].name
+    with pytest.raises(ValueError):
+        fluid.io.set_program_state(main, {w_name: np.zeros((99, 99))})
+
+
+# ----------------------------------------------------------- average ----
+
+def test_weighted_average():
+    wa = fluid.average.WeightedAverage()
+    with pytest.raises(ValueError):
+        wa.eval()
+    wa.add(1.0, 1)
+    wa.add(3.0, 3)
+    assert wa.eval() == pytest.approx(2.5)
+    wa.reset()
+    wa.add(np.array([2.0, 4.0]), 2)
+    assert wa.eval() == pytest.approx(3.0)
+
+
+# --------------------------------------------------------- evaluator ----
+
+def test_evaluator_aliases_warn():
+    with pytest.warns(DeprecationWarning):
+        ed = fluid.evaluator.EditDistance('distance')
+    assert isinstance(ed, fluid.metrics.EditDistance)
+
+
+def test_install_check_run_check():
+    fluid.install_check.run_check()
+
+
+# -------------------------------------------------- dygraph_grad_clip ----
+
+def test_dygraph_grad_clip_classes():
+    import jax.numpy as jnp
+    pg = [(None, jnp.array([3.0, -4.0])), (None, None)]
+    v = fluid.dygraph_grad_clip.GradClipByValue(1.0)(pg)
+    np.testing.assert_allclose(v[0][1], [1.0, -1.0])
+    assert v[1][1] is None
+    n = fluid.dygraph_grad_clip.GradClipByNorm(2.5)(pg)
+    np.testing.assert_allclose(np.linalg.norm(n[0][1]), 2.5, rtol=1e-5)
+    g = fluid.dygraph_grad_clip.GradClipByGlobalNorm(1.0)(
+        [(None, jnp.array([3.0])), (None, jnp.array([4.0]))])
+    total = np.sqrt(sum(float(np.sum(np.square(x[1]))) for x in g))
+    assert total == pytest.approx(1.0, rel=1e-5)
+
+
+# ------------------------------------------------------------- input ----
+
+def test_input_module_embedding_one_hot():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data('ids', [4], 'int64')
+        emb = fluid.input.embedding(ids, size=[10, 8])
+        oh = fluid.input.one_hot(ids, 10)
+    exe = fluid.Executor()
+    exe.run(startup)
+    e, o = exe.run(main, feed={'ids': np.array([1, 2, 3, 0])},
+                   fetch_list=[emb, oh])
+    assert e.shape == (4, 8) and o.shape == (4, 10)
+    np.testing.assert_allclose(o.sum(-1), 1.0)
+
+
+# ----------------------------------------------- default_scope_funcs ----
+
+def test_default_scope_funcs():
+    dsf = fluid.default_scope_funcs
+    base = dsf.get_cur_scope()
+    dsf.enter_local_scope()
+    dsf.var('tmp_x')
+    assert dsf.get_cur_scope() is not base
+    dsf.leave_local_scope()
+    assert dsf.get_cur_scope() is base
+
+    def inner():
+        dsf.var('scoped_y')
+        return 42
+    assert dsf.scoped_function(inner) == 42
+
+
+# ---------------------------------------------------------------- op ----
+
+def test_op_protos_and_factory():
+    protos = fluid.op.get_all_op_protos()
+    assert len(protos) > 250
+    relu = [p for p in protos if p.type == 'relu'][0]
+    assert 'x' in relu.inputs and 'Out' in relu.outputs
+    desc = fluid.op.Operator(type='scale', x='a', Out='b', scale=2.0)
+    assert desc['type'] == 'scale' and desc['attrs']['scale'] == 2.0
+    with pytest.raises(ValueError):
+        fluid.op.OpInfo('definitely_not_an_op')
+
+
+# -------------------------------------------------------- net_drawer ----
+
+def test_net_drawer(tmp_path):
+    main, startup, _ = _build_regression()
+    path = str(tmp_path / 'g.dot')
+    text = fluid.net_drawer.draw_graph(startup, main, path=path)
+    assert os.path.exists(path)
+    assert 'digraph G' in text and 'matmul' in text or 'mul' in text
+
+
+# ----------------------------------------------------- data_feed_desc ----
+
+def test_data_feed_desc_roundtrip(tmp_path):
+    proto = tmp_path / 'feed.proto'
+    proto.write_text('''
+name: "MultiSlotDataFeed"
+batch_size: 2
+multi_slot_desc {
+  slots {
+    name: "words"
+    type: "uint64"
+    is_dense: false
+    is_used: false
+  }
+  slots {
+    name: "label"
+    type: "uint64"
+    is_dense: false
+    is_used: false
+  }
+}''')
+    d = fluid.DataFeedDesc(str(proto))
+    d.set_batch_size(128)
+    d.set_dense_slots(['words'])
+    d.set_use_slots(['words', 'label'])
+    text = d.desc()
+    assert 'batch_size: 128' in text
+    assert d.proto_desc['multi_slot_desc']['slots'][0]['is_dense'] is True
+    assert d.proto_desc['multi_slot_desc']['slots'][1]['is_used'] is True
+
+
+# ------------------------------------------------------ communicator ----
+
+def test_communicator_lifecycle():
+    c = fluid.Communicator(fluid.Program())
+    assert not c.is_running()
+    c.start()
+    assert c.is_running()
+    c.stop()
+    assert not c.is_running()
+
+
+# ------------------------------------------------- trainer machinery ----
+
+def test_trainer_factory_defaults():
+    from paddle_tpu.trainer_factory import TrainerFactory
+    t = TrainerFactory()._create_trainer(None)
+    t._set_program(fluid.Program())
+    t._gen_trainer_desc()
+    assert t.proto_desc['class_name'] == 'MultiTrainer'
+    assert t.proto_desc['device_worker_name'] == 'HogwildWorker'
+
+    t2 = TrainerFactory()._create_trainer(
+        {'trainer': 'DistMultiTrainer', 'device_worker': 'DownpourSGD'})
+    t2._set_program(fluid.Program())
+    t2._gen_trainer_desc()
+    assert t2.proto_desc['class_name'] == 'DistMultiTrainer'
+    assert t2.proto_desc['device_worker_name'] == 'DownpourWorker'
+
+
+def test_fetch_handler_monitor():
+    import time
+    from paddle_tpu.trainer_factory import FetchHandler, FetchHandlerMonitor
+    fluid.global_scope().set('fh_var', np.array([7.0]))
+    seen = []
+
+    class H(FetchHandler):
+        def handler(self, res):
+            seen.append(res['v'])
+    h = H(var_dict={'v': 'fh_var'}, period_secs=0.05)
+    m = FetchHandlerMonitor(fluid.global_scope(), h)
+    m.start()
+    time.sleep(0.2)
+    m.stop()
+    assert seen and np.asarray(seen[-1]) == pytest.approx([7.0])
+
+
+# -------------------------------------- distribute_lookup_table scan ----
+
+def test_find_distributed_lookup_table():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data('dlt_ids', [4], 'int64')
+        emb = fluid.layers.embedding(ids, size=[30, 8], is_distributed=True)
+    name = fluid.distribute_lookup_table.find_distributed_lookup_table(main)
+    assert name is not None
+    ins = fluid.distribute_lookup_table \
+        .find_distributed_lookup_table_inputs(main, name)
+    outs = fluid.distribute_lookup_table \
+        .find_distributed_lookup_table_outputs(main, name)
+    assert 'dlt_ids' in ins and outs
+
+
+# ---------------------------------------------------------- debugger ----
+
+def test_debugger_reprs_and_nan_inf():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data('dx', [2, 2], 'float32')
+        h = fluid.layers.log(x)          # NaN for negative input
+        out = fluid.layers.reduce_sum(h)
+    var = main.global_block().var('dx')
+    assert 'dx' in fluid.debugger.repr_var(var)
+    op = main.global_block().ops[-1]
+    assert 'reduce_sum' in fluid.debugger.repr_op(op)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    fluid.debugger.prepare_fast_nan_inf_debug(main)
+    # clean input -> passes through and returns fetches
+    r = fluid.debugger.run_fast_nan_inf_debug(
+        exe, main, feed={'dx': np.ones((2, 2), 'float32')},
+        fetch_list=[out])
+    assert np.isfinite(r[0]).all()
+    with pytest.raises(RuntimeError, match='NaN/Inf'):
+        fluid.debugger.run_fast_nan_inf_debug(
+            exe, main, feed={'dx': -np.ones((2, 2), 'float32')},
+            fetch_list=[out])
