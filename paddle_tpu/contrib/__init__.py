@@ -4,10 +4,36 @@ from . import memory_usage_calc
 from .memory_usage_calc import (memory_usage, device_memory_stats,
                                 print_memory_report)
 from . import slim
-from .slim import PostTrainingQuantization, WeightQuantization
+from .slim import PostTrainingQuantization, WeightQuantization, Compressor
 from .mixed_precision import decorate, AutoMixedPrecisionLists
 from . import extra
 from .extra import (extend_with_decoupled_weight_decay, BasicLSTMUnit,
                     BasicGRUUnit, basic_lstm, basic_gru,
                     fused_elemwise_activation, partial_concat, partial_sum,
                     shuffle_batch, tree_conv, multiclass_nms2)
+from . import decoder
+from .decoder import (InitState, StateCell, TrainingDecoder,
+                      BeamSearchDecoder)
+from . import layers
+from .layers import (sequence_topk_avg_pooling, var_conv_2d,
+                     match_matrix_tensor, fused_embedding_seq_pool,
+                     search_pyramid_hash, ctr_metric_bundle)
+from . import extend_optimizer
+from . import quantize
+from .quantize import QuantizeTranspiler
+from . import reader
+from .reader import distributed_batch_reader
+from . import utils
+from .utils import (HDFSClient, multi_download, multi_upload,
+                    convert_dist_to_sparse_program,
+                    load_persistables_for_increment,
+                    load_persistables_for_inference)
+from . import model_stat
+from .model_stat import summary
+from . import op_frequence
+from .op_frequence import op_freq_statistic
+from . import trainer
+from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
+                      EndEpochEvent, BeginStepEvent, EndStepEvent)
+from . import inferencer
+from .inferencer import Inferencer

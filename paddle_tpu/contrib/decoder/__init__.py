@@ -1,0 +1,5 @@
+"""contrib.decoder (ref: python/paddle/fluid/contrib/decoder/)."""
+from .beam_search_decoder import (InitState, StateCell, TrainingDecoder,
+                                  BeamSearchDecoder)
+
+__all__ = ['InitState', 'StateCell', 'TrainingDecoder', 'BeamSearchDecoder']

@@ -1,0 +1,409 @@
+"""contrib beam-search decoder API (ref: python/paddle/fluid/contrib/
+decoder/beam_search_decoder.py).
+
+The reference builds these on DynamicRNN + LoDTensorArrays with a dynamic
+While loop. The TPU formulation keeps the same user API — InitState /
+StateCell (with the `state_updater` decorator) / TrainingDecoder /
+BeamSearchDecoder — but lowers to StaticRNN (lax.scan, fixed trip count):
+
+- TrainingDecoder traces the user block once; states become scan carries.
+  Step inputs are batch-major (B, T, ...) padded tensors (the repo-wide
+  LoDTensor convention) and outputs come back batch-major.
+- BeamSearchDecoder.decode() builds the reference's standard search loop
+  (embed prev ids → state update → softmax fc → topk → beam step) in a
+  dense (B*beam, ...) layout over `max_len` masked steps, reordering
+  carried states by parent index each step, and `__call__` backtraces
+  with gather_tree. Custom search bodies override decode() — same
+  extension point the reference documents.
+"""
+import contextlib
+
+from ...core import unique_name
+from ...layer_helper import LayerHelper
+
+__all__ = ['InitState', 'StateCell', 'TrainingDecoder', 'BeamSearchDecoder']
+
+
+class _DecoderType:
+    TRAINING = 1
+    BEAM_SEARCH = 2
+
+
+class InitState:
+    """ref beam_search_decoder.py:InitState — an initial decoder state,
+    either a given Variable (`init`) or a fill shaped like a batch
+    reference (`init_boot` + shape/value)."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype='float32'):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError(
+                'init_boot must be provided to infer the shape of InitState.')
+        else:
+            from ...layers.tensor import fill_constant_batch_size_like
+            self._init = fill_constant_batch_size_like(
+                input=init_boot, value=value, shape=shape, dtype=dtype)
+        self._dtype = dtype
+        self._need_reorder = need_reorder
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell:
+    """ref beam_search_decoder.py:StateCell — named states + step inputs
+    with a user-registered updater:
+
+        state_cell = StateCell(inputs={'x': None}, states={'h': init_h},
+                               out_state='h')
+
+        @state_cell.state_updater
+        def updater(cell):
+            h = cell.get_state('h')
+            x = cell.get_input('x')
+            cell.set_state('h', some_layer(x, h))
+    """
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._helper = LayerHelper('state_cell', name=name)
+        self._cur_states = {}
+        self._init_states = {}
+        self._state_names = []
+        for state_name, state in states.items():
+            if not isinstance(state, InitState):
+                raise ValueError('state must be an InitState object.')
+            self._cur_states[state_name] = state
+            self._init_states[state_name] = state
+            self._state_names.append(state_name)
+        self._inputs = dict(inputs)
+        self._cur_decoder_obj = None
+        self._in_decoder = False
+        self._state_updater = None
+        self._out_state = out_state
+        if out_state not in self._cur_states:
+            raise ValueError('out_state must be one state in states')
+
+    # -- decoder binding --
+    def _enter_decoder(self, decoder_obj):
+        if self._in_decoder or self._cur_decoder_obj is not None:
+            raise ValueError('StateCell has already entered a decoder.')
+        self._in_decoder = True
+        self._cur_decoder_obj = decoder_obj
+        # a fresh decoder starts from the declared InitStates (the ref's
+        # per-decoder _states_holder reset)
+        self._cur_states = dict(self._init_states)
+
+    def _leave_decoder(self, decoder_obj):
+        if not self._in_decoder or self._cur_decoder_obj is not decoder_obj:
+            raise ValueError('Inconsistent decoder object in StateCell.')
+        self._in_decoder = False
+        self._cur_decoder_obj = None
+
+    # -- user API --
+    def state_updater(self, updater):
+        """Decorator registering the per-step update function."""
+        self._state_updater = updater
+
+        def _decorator(state_cell):
+            if state_cell is not self:
+                raise ValueError('updater bound to another StateCell')
+            updater(state_cell)
+        return _decorator
+
+    def get_state(self, state_name):
+        if state_name not in self._cur_states:
+            raise ValueError(f'Unknown state {state_name}')
+        v = self._cur_states[state_name]
+        return v.value if isinstance(v, InitState) else v
+
+    def get_input(self, input_name):
+        if input_name not in self._inputs or self._inputs[input_name] is None:
+            raise ValueError(f'Invalid input {input_name}.')
+        return self._inputs[input_name]
+
+    def set_state(self, state_name, state_value):
+        if state_name not in self._cur_states:
+            raise ValueError(f'Unknown state {state_name}')
+        self._cur_states[state_name] = state_value
+
+    def compute_state(self, inputs):
+        """Bind this step's inputs and run the updater."""
+        if self._state_updater is None:
+            raise ValueError('no state_updater registered')
+        for name, value in inputs.items():
+            if name not in self._inputs:
+                raise ValueError(f'unknown input {name}')
+            self._inputs[name] = value
+        self._state_updater(self)
+
+    def update_states(self):
+        """Commit the current states to the enclosing decoder's carries."""
+        if self._cur_decoder_obj is None:
+            raise ValueError('StateCell must be inside a decoder block')
+        self._cur_decoder_obj._commit_states(self)
+
+    def out_state(self):
+        return self.get_state(self._out_state)
+
+
+class TrainingDecoder:
+    """ref beam_search_decoder.py:TrainingDecoder — teacher-forced decoder
+    over (B, T, ...) step inputs:
+
+        decoder = TrainingDecoder(state_cell)
+        with decoder.block():
+            w = decoder.step_input(trg_embedding)   # (B, T, D) → (B, D)
+            decoder.state_cell.compute_state(inputs={'x': w})
+            decoder.state_cell.update_states()
+            decoder.output(decoder.state_cell.get_state('h'))
+        outputs = decoder()                          # (B, T, H)
+    """
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
+
+    type = _DecoderType.TRAINING
+
+    def __init__(self, state_cell, name=None):
+        from ...layers.control_flow import StaticRNN
+        self._helper = LayerHelper('training_decoder', name=name)
+        self._srnn = StaticRNN()
+        self._status = TrainingDecoder.BEFORE_DECODER
+        self.state_cell = state_cell
+        self.state_cell._enter_decoder(self)
+        self._pre = {}          # state name → memory pre-var
+
+    @contextlib.contextmanager
+    def block(self):
+        if self._status != TrainingDecoder.BEFORE_DECODER:
+            raise ValueError('decoder.block() can only be invoked once')
+        self._status = TrainingDecoder.IN_DECODER
+        with self._srnn.step():
+            for name in self.state_cell._state_names:
+                init = self.state_cell._cur_states[name]
+                pre = self._srnn.memory(init=init.value)
+                self._pre[name] = pre
+                self.state_cell.set_state(name, pre)
+            yield self
+        self._status = TrainingDecoder.AFTER_DECODER
+        self.state_cell._leave_decoder(self)
+
+    def _in_parent_block(self):
+        """Build ops in the block surrounding the StaticRNN step block."""
+        from ...framework import default_main_program
+
+        @contextlib.contextmanager
+        def guard():
+            program = default_main_program()
+            cur = program.current_block_idx
+            program.current_block_idx = self._srnn._block.parent_idx
+            try:
+                yield
+            finally:
+                program.current_block_idx = cur
+        return guard()
+
+    def step_input(self, x):
+        """(B, T, ...) batch-major sequence → this step's (B, ...) slice."""
+        from ...layers.common import apply_op_layer
+        if self._status != TrainingDecoder.IN_DECODER:
+            raise ValueError('step_input must be invoked inside block()')
+        if x.shape is None:
+            raise ValueError('step_input needs a statically-shaped input')
+        with self._in_parent_block():
+            xt = apply_op_layer('transpose_batch_time', {'x': x})
+            xt.shape = (x.shape[1], x.shape[0]) + tuple(x.shape[2:])
+        return self._srnn.step_input(xt)
+
+    def static_input(self, x):
+        """A per-batch input visible unchanged at every step (sub-blocks
+        read enclosing-block vars directly in the scan lowering)."""
+        return x
+
+    def _commit_states(self, state_cell):
+        for name, pre in self._pre.items():
+            new = state_cell._cur_states[name]
+            if new is not pre:
+                self._srnn.update_memory(pre, new)
+
+    def output(self, *outputs):
+        if self._status != TrainingDecoder.IN_DECODER:
+            raise ValueError('output must be invoked inside block()')
+        for o in outputs:
+            self._srnn.step_output(o)
+
+    def __call__(self, *args, **kwargs):
+        from ...layers.common import apply_op_layer
+        if self._status != TrainingDecoder.AFTER_DECODER:
+            raise ValueError('call TrainingDecoder after its block finishes')
+        outs = self._srnn()
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        res = []
+        for o in outs:   # (T, B, ...) → (B, T, ...)
+            ot = apply_op_layer('transpose_batch_time', {'x': o})
+            if o.shape is not None:
+                ot.shape = (o.shape[1], o.shape[0]) + tuple(o.shape[2:])
+            res.append(ot)
+        return res[0] if len(res) == 1 else res
+
+
+class BeamSearchDecoder:
+    """ref beam_search_decoder.py:BeamSearchDecoder — inference-time beam
+    search driven by the same StateCell:
+
+        decoder = BeamSearchDecoder(state_cell, init_ids, init_scores,
+                                    target_dict_dim, word_dim,
+                                    topk_size=50, max_len=T, beam_size=W,
+                                    end_id=1)
+        decoder.decode()
+        translation_ids, translation_scores = decoder()
+
+    Dense layout: every tensor carries (B*beam) rows; states are expanded
+    to the beam on entry and reordered by parent index after each
+    selection (the reference's sequence_expand-by-score-LoD reordering).
+    Returns ids (B, beam, max_len) int64 and final scores (B, beam).
+    Custom loops: override decode() (the reference's extension point).
+    """
+    type = _DecoderType.BEAM_SEARCH
+
+    def __init__(self, state_cell, init_ids, init_scores, target_dict_dim,
+                 word_dim, input_var_dict=None, topk_size=50,
+                 sparse_emb=True, max_len=100, beam_size=1, end_id=1,
+                 name=None):
+        self._helper = LayerHelper('beam_search_decoder', name=name)
+        self.state_cell = state_cell
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = target_dict_dim
+        self._word_dim = word_dim
+        self._input_var_dict = dict(input_var_dict or {})
+        self._topk_size = min(int(topk_size), int(target_dict_dim))
+        self._sparse_emb = sparse_emb
+        self._max_len = int(max_len)
+        self._beam_size = int(beam_size)
+        self._end_id = int(end_id)
+        self._outputs = None
+
+    def _expand_to_beam(self, x):
+        """(B, ...) → (B*W, ...) row-tiling (shared helper)."""
+        from ...layers.rnn import expand_to_beam
+        return expand_to_beam(x, self._beam_size)
+
+    def decode(self):
+        """Build the standard search loop (ref decode(), :653)."""
+        from ...layers import nn as L
+        from ...layers import tensor as T
+        from ...layers.rnn import beam_search
+        from ...layers.control_flow import StaticRNN
+        import numpy as np
+
+        cell = self.state_cell
+        cell._enter_decoder(self)
+        W = self._beam_size
+
+        # beam-expand the search state in the enclosing block
+        ids0 = self._expand_to_beam(T.cast(self._init_ids, 'int64'))
+        ids0 = L.reshape(ids0, shape=[-1, 1])
+        scores0 = self._expand_to_beam(T.cast(self._init_scores, 'float32'))
+        scores0 = L.reshape(scores0, shape=[-1, 1])
+        # keep only beam 0 live initially so identical beams don't flood
+        # the top-k (the reference gets this from the init LoD structure)
+        beam_penalty = T.fill_constant_array(
+            np.where(np.tile(np.arange(W), ids0.shape[0] // W) > 0,
+                     -1e9, 0.0).reshape(-1, 1).astype('float32'))
+        scores0 = L.elementwise_add(scores0, beam_penalty)
+
+        state_inits = {}
+        for name in cell._state_names:
+            init = cell._cur_states[name]
+            state_inits[name] = self._expand_to_beam(init.value)
+        static_feeds = {k: self._expand_to_beam(v)
+                        for k, v in self._input_var_dict.items()}
+
+        times = T.fill_constant_array(
+            np.arange(self._max_len, dtype=np.int64))
+        srnn = StaticRNN()
+        self._srnn = srnn
+        with srnn.step():
+            _ = srnn.step_input(times)
+            pre_ids = srnn.memory(init=ids0)
+            pre_scores = srnn.memory(init=scores0)
+            self._pre = {}
+            for name in cell._state_names:
+                pre = srnn.memory(init=state_inits[name])
+                self._pre[name] = pre
+                cell.set_state(name, pre)
+
+            flat_ids = L.reshape(pre_ids, shape=[-1])
+            emb = L.embedding(flat_ids,
+                              size=[self._target_dict_dim, self._word_dim],
+                              is_sparse=self._sparse_emb)
+            feed_dict = dict(static_feeds)
+            for input_name in cell._inputs:
+                if input_name not in feed_dict:
+                    feed_dict[input_name] = emb
+            cell.compute_state(inputs=feed_dict)
+            current_state = cell.out_state()
+            scores = L.fc(current_state, size=self._target_dict_dim,
+                          act='softmax')
+            topk_scores, topk_indices = L.topk(scores, k=self._topk_size)
+            accu_scores = L.elementwise_add(
+                L.log(L.scale(topk_scores, scale=1.0, bias=1e-20)),
+                pre_scores, axis=0)
+            sel_ids, sel_scores, parent = beam_search(
+                pre_ids, pre_scores, topk_indices, accu_scores, W,
+                end_id=self._end_id, return_parent_idx=True)
+            # static shapes for the scan-stacked outputs (B known from
+            # init_ids; shape inference is lazy elsewhere)
+            BW = int(self._init_ids.shape[0]) * W
+            sel_ids.shape = (BW, 1)
+            sel_scores.shape = (BW, 1)
+            parent.shape = (BW,)
+            srnn.update_memory(pre_ids, sel_ids)
+            srnn.update_memory(pre_scores, sel_scores)
+            for name, pre in self._pre.items():
+                new = cell._cur_states[name]
+                reordered = L.gather(new, parent)
+                srnn.update_memory(pre, reordered)
+            srnn.step_output(sel_ids)
+            srnn.step_output(parent)
+            srnn.step_output(sel_scores)
+        cell._leave_decoder(self)
+        self._outputs = srnn()
+
+    def _commit_states(self, state_cell):
+        # states are committed (with parent reordering) inside decode()
+        pass
+
+    def early_stop(self):
+        """The fixed-trip-count scan already masks finished beams inside
+        beam_search (finished rows only extend with end_id), which is the
+        TPU replacement for dynamically stopping the While loop."""
+
+    def __call__(self):
+        """(translation_ids (B, W, max_len), translation_scores (B, W))."""
+        from ...layers import nn as L
+        from ...layers.rnn import gather_tree
+        if self._outputs is None:
+            raise ValueError('call decode() before reading the results')
+        from ...layers import tensor as T
+        step_ids, step_parents, step_scores = self._outputs
+        T_, BW = step_ids.shape[0], step_ids.shape[1]
+        B = BW // self._beam_size
+        ids_tbw = L.reshape(step_ids, shape=[T_, B, self._beam_size])
+        par_tbw = L.reshape(step_parents, shape=[T_, B, self._beam_size])
+        # parent indices are flat (B*W); make them beam-local for the tree
+        par_local = L.elementwise_mod(
+            par_tbw, T.fill_constant([1], 'int64', self._beam_size))
+        full = gather_tree(ids_tbw, par_local)       # (T, B, W)
+        trans_ids = L.transpose(full, perm=[1, 2, 0])  # (B, W, T)
+        last = L.slice(step_scores, axes=[0], starts=[T_ - 1], ends=[T_])
+        last_scores = L.reshape(last, shape=[B, self._beam_size])
+        return trans_ids, last_scores

@@ -1,0 +1,5 @@
+"""contrib.extend_optimizer (ref: python/paddle/fluid/contrib/
+extend_optimizer/) — decoupled weight decay lives in contrib.extra."""
+from ..extra import extend_with_decoupled_weight_decay
+
+__all__ = ['extend_with_decoupled_weight_decay']

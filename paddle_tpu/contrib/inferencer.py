@@ -1,0 +1,41 @@
+"""Legacy high-level Inferencer (ref: python/paddle/fluid/contrib/
+inferencer.py) — infer_func rebuilds the inference graph; params load
+from param_path; infer() runs the jitted program."""
+from .. import io as fluid_io
+from ..core.scope import Scope, scope_guard
+from ..executor import Executor
+from ..framework import Program, program_guard
+
+__all__ = ['Inferencer']
+
+
+class Inferencer:
+    """ref inferencer.py:Inferencer(infer_func, param_path, place)."""
+
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        self.param_path = param_path
+        self.scope = Scope()
+        self.parallel = parallel
+        self.place = place
+        self.exe = Executor(place)
+        self.inference_program = Program()
+        startup = Program()
+        with program_guard(self.inference_program, startup):
+            out = infer_func()
+            self.predict_var = out[0] if isinstance(out, (list, tuple)) \
+                else out
+        self.inference_program = self.inference_program.clone(for_test=True)
+        with scope_guard(self.scope):
+            self.exe.run(startup)
+            fluid_io.load_persistables(self.exe, param_path,
+                                       self.inference_program)
+
+    def infer(self, inputs, return_numpy=True):
+        """ref inferencer.py:infer — inputs: {var_name: ndarray}."""
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                'inputs should be a map of {"input_name": input_var}')
+        with scope_guard(self.scope):
+            return self.exe.run(self.inference_program, feed=inputs,
+                                fetch_list=[self.predict_var],
+                                return_numpy=return_numpy)

@@ -1,0 +1,16 @@
+"""contrib.layers (ref: python/paddle/fluid/contrib/layers/): nn extras,
+basic RNN impls (contrib.extra), ctr metric bundle."""
+from .nn import (fused_elemwise_activation, sequence_topk_avg_pooling,
+                 var_conv_2d, match_matrix_tensor, tree_conv,
+                 fused_embedding_seq_pool, multiclass_nms2,
+                 search_pyramid_hash, shuffle_batch, partial_concat,
+                 partial_sum)
+from .rnn_impl import BasicGRUUnit, basic_gru, BasicLSTMUnit, basic_lstm
+from .metric_op import ctr_metric_bundle
+
+__all__ = ['fused_elemwise_activation', 'sequence_topk_avg_pooling',
+           'var_conv_2d', 'match_matrix_tensor', 'tree_conv',
+           'fused_embedding_seq_pool', 'multiclass_nms2',
+           'search_pyramid_hash', 'shuffle_batch', 'partial_concat',
+           'partial_sum', 'BasicGRUUnit', 'basic_gru', 'BasicLSTMUnit',
+           'basic_lstm', 'ctr_metric_bundle']

@@ -1,0 +1,50 @@
+"""contrib.layers.metric_op (ref: python/paddle/fluid/contrib/layers/
+metric_op.py:ctr_metric_bundle)."""
+from ...core import unique_name
+from ...layer_helper import LayerHelper
+from ...layers.tensor import create_global_var
+from ...layers.common import apply_op_layer
+
+__all__ = ['ctr_metric_bundle']
+
+
+def ctr_metric_bundle(input, label):
+    """ref metric_op.py:30 — streaming CTR metrics.
+
+    Accumulates into four persistable counters every executor run (the
+    accumulate ops fuse into the jitted step): local_sqrerr, local_abserr,
+    local_prob (sum of predicted ctr), local_q (sum of label*prob).
+    Finalize as the reference documents: MAE = abserr/N,
+    RMSE = sqrt(sqrerr/N), ctr = prob/N, q = q/N (allreduce first when
+    distributed)."""
+    helper = LayerHelper('ctr_metric_bundle')
+
+    def acc(name):
+        return create_global_var(
+            [1], 0.0, 'float32', persistable=True,
+            name=unique_name.generate(f'ctr_{name}'))
+
+    local_sqrerr = acc('sqrerr')
+    local_abserr = acc('abserr')
+    local_prob = acc('prob')
+    local_q = acc('q')
+
+    from ...layers import nn as L
+    from ...layers import tensor as T
+    fl = T.cast(label, 'float32')
+    err = apply_op_layer('elementwise_sub', {'x': input, 'y': fl}, {})
+    batch_sqr = L.reduce_sum(apply_op_layer('square', {'x': err}, {}))
+    batch_abs = L.reduce_sum(apply_op_layer('abs', {'x': err}, {}))
+    batch_prob = L.reduce_sum(input)
+    batch_q = L.reduce_sum(apply_op_layer(
+        'elementwise_mul', {'x': input, 'y': fl}, {}))
+
+    block = helper.main_program.current_block()
+    for acc_var, batch in ((local_sqrerr, batch_sqr),
+                           (local_abserr, batch_abs),
+                           (local_prob, batch_prob),
+                           (local_q, batch_q)):
+        block.append_op(type='elementwise_add',
+                        inputs={'x': acc_var.name, 'y': batch.name},
+                        outputs={'Out': acc_var.name}, attrs={})
+    return local_sqrerr, local_abserr, local_prob, local_q

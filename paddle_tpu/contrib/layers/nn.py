@@ -1,0 +1,117 @@
+"""contrib.layers.nn (ref: python/paddle/fluid/contrib/layers/nn.py).
+
+The text-matching family (match_matrix_tensor, var_conv_2d,
+sequence_topk_avg_pooling, search_pyramid_hash, fused_embedding_seq_pool)
+takes the reference's LoD arguments as (B,) length Variables (or None for
+dense batches) over padded tensors — see ops/contrib_ops.py for the masked
+TPU formulations. The remaining names re-export contrib.extra.
+"""
+from ...layer_helper import LayerHelper
+from ...initializer import XavierInitializer, NormalInitializer
+from ...layers.common import apply_op_layer
+from ...layers.sequence_lod import _seq_len
+from ..extra import (fused_elemwise_activation, tree_conv, multiclass_nms2,
+                     shuffle_batch, partial_concat, partial_sum)
+
+__all__ = ['fused_elemwise_activation', 'sequence_topk_avg_pooling',
+           'var_conv_2d', 'match_matrix_tensor', 'tree_conv',
+           'fused_embedding_seq_pool', 'multiclass_nms2',
+           'search_pyramid_hash', 'shuffle_batch', 'partial_concat',
+           'partial_sum']
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype='float32', name=None,
+                        x_len=None, y_len=None):
+    """ref contrib/layers/nn.py:219 — learned bilinear matching matrices.
+    x: (B, Lx, D1), y: (B, Ly, D2) padded (lengths threaded implicitly for
+    LoDTensor feeds, or passed as x_len/y_len). Returns (out, tmp) like
+    the reference: out (B, channel_num, Lx, Ly), tmp the x·W
+    intermediate."""
+    helper = LayerHelper('match_matrix_tensor', param_attr=param_attr,
+                         act=act, name=name)
+    d1, d2 = x.shape[-1], y.shape[-1]
+    w = helper.create_parameter(helper.param_attr, [d1, channel_num, d2],
+                                dtype,
+                                default_initializer=XavierInitializer())
+    out, tmp = apply_op_layer(
+        'match_matrix_tensor',
+        {'x': x, 'y': y, 'w': w, 'x_len': _seq_len(x, x_len),
+         'y_len': _seq_len(y, y_len)},
+        {'channel_num': channel_num}, n_outputs=2)
+    if act:
+        out = helper.append_activation(out)
+    return out, tmp
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
+                stride=1, param_attr=None, act=None, dtype='float32',
+                name=None):
+    """ref contrib/layers/nn.py:103 — conv over per-sample-sized images.
+    input: (B, input_channel, H, W) padded; row/col: (B,) valid
+    height/width Variables (the reference's LoD carriers)."""
+    helper = LayerHelper('var_conv_2d', param_attr=param_attr, act=act,
+                         name=name)
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = helper.create_parameter(
+        helper.param_attr, [output_channel, input_channel, k[0], k[1]],
+        dtype, default_initializer=NormalInitializer(scale=0.1))
+    out = apply_op_layer('var_conv_2d',
+                         {'x': input, 'w': w, 'row': row, 'col': col},
+                         {'stride': stride})
+    if act:
+        out = helper.append_activation(out)
+    return out
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    """ref contrib/layers/nn.py:302 — top-k column averages per row and
+    channel. input: (B, channel_num, R, C) padded (e.g. the
+    match_matrix_tensor output); row/col: (B,) valid sizes."""
+    return apply_op_layer('sequence_topk_avg_pooling',
+                          {'x': input, 'row': row, 'col': col},
+                          {'topks': list(topks),
+                           'channel_num': channel_num})
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
+                             combiner='sum', param_attr=None,
+                             dtype='float32', sequence_length=None):
+    """ref contrib/layers/nn.py:435 — one fused lookup+pool op (XLA fuses
+    the gather and the masked reduction). input: (B, T) ids."""
+    helper = LayerHelper('fused_embedding_seq_pool', param_attr=param_attr)
+    w = helper.create_parameter(helper.param_attr, list(size), dtype,
+                                default_initializer=XavierInitializer())
+    pad = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    return apply_op_layer(
+        'fused_embedding_seq_pool',
+        {'ids': input, 'w': w, 'length': _seq_len(input, sequence_length)},
+        {'combiner': combiner, 'padding_idx': pad})
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,
+                        drop_out_percent, is_training, use_filter,
+                        white_list_len, black_list_len, seed,
+                        lr=1.0, param_attr=None, param_attr_wl=None,
+                        param_attr_bl=None, name=None,
+                        distribute_update_vars=None, dtype='float32',
+                        sequence_length=None):
+    """ref contrib/layers/nn.py:631 — pyramid n-gram hash embedding.
+    input: (B, T) ids. The white/black-list filtering args are accepted
+    (the hash space is dense here, so filtering is a no-op) and
+    rand_len folds into the table width."""
+    helper = LayerHelper('search_pyramid_hash', param_attr=param_attr,
+                         name=name)
+    w = helper.create_parameter(
+        helper.param_attr, [space_len, num_emb], dtype,
+        default_initializer=NormalInitializer(scale=1.0 / num_emb))
+    return apply_op_layer(
+        'search_pyramid_hash',
+        {'ids': input, 'w': w,
+         'length': _seq_len(input, sequence_length)},
+        {'num_emb': num_emb, 'space_len': space_len,
+         'pyramid_layer': pyramid_layer, 'rand_len': rand_len,
+         'drop_out_percent': drop_out_percent, 'is_training': is_training,
+         'seed': seed})

@@ -1,0 +1,5 @@
+"""contrib.layers.rnn_impl (ref: python/paddle/fluid/contrib/layers/
+rnn_impl.py) — implementations live in contrib.extra."""
+from ..extra import BasicGRUUnit, basic_gru, BasicLSTMUnit, basic_lstm
+
+__all__ = ['BasicGRUUnit', 'basic_gru', 'BasicLSTMUnit', 'basic_lstm']

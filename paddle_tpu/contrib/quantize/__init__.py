@@ -1,0 +1,4 @@
+"""contrib.quantize (ref: python/paddle/fluid/contrib/quantize/)."""
+from .quantize_transpiler import QuantizeTranspiler
+
+__all__ = ['QuantizeTranspiler']

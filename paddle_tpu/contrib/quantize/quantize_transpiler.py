@@ -1,0 +1,144 @@
+"""Static-graph quantization transpiler (ref: python/paddle/fluid/contrib/
+quantize/quantize_transpiler.py:80).
+
+The reference rewrites the Program: fake-quant/dequant op pairs around
+every quantizable op's inputs for QAT, then freezes scales for int8
+deploy. Here the rewrite inserts the registered STE fake-quant ops
+(ops/quant_ops.py) in front of quantizable compute ops, which XLA then
+fuses into the step — training proceeds with quantization noise exactly
+like the reference's QAT. Freezing (inference int8) is served by
+inference.Config.enable_int8 / slim's PTQ path.
+"""
+import numpy as np
+
+from ...framework import Operator
+
+__all__ = ['QuantizeTranspiler']
+
+_QUANTIZABLE_OP_TYPES = ('conv2d', 'depthwise_conv2d', 'mul', 'matmul')
+# input slots holding (activation, weight) per quantizable type
+_SLOTS = {'conv2d': ('x', 'w'), 'depthwise_conv2d': ('x', 'w'),
+          'mul': ('x', 'y'), 'matmul': ('x', 'y')}
+
+
+def _quantized_var_name(var_name):
+    return f'{var_name}.quantized'
+
+
+def _dequantized_var_name(var_name):
+    return f'{var_name}.dequantized'
+
+
+class QuantizeTranspiler:
+    """ref quantize_transpiler.py:80."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type='abs_max',
+                 weight_quantize_type='abs_max', window_size=10000,
+                 moving_rate=0.9):
+        quant_types = ('abs_max', 'range_abs_max',
+                       'moving_average_abs_max')
+        if activation_quantize_type not in quant_types:
+            raise ValueError(
+                f'Unknown activation_quantize_type: '
+                f'{activation_quantize_type}')
+        if weight_quantize_type != 'abs_max':
+            raise ValueError(
+                f'Unknown weight_quantize_type: {weight_quantize_type}')
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.window_size = window_size
+        self.moving_rate = moving_rate
+
+    def _insert_fake_quant(self, block, idx, var_name, bits):
+        """Insert fake_quantize_dequantize before op idx; returns the
+        dequantized var name."""
+        src = block.var(var_name)
+        out_name = _dequantized_var_name(var_name)
+        if not block.has_var(out_name):
+            block.create_var(name=out_name, shape=src.shape,
+                             dtype=src.dtype)
+            block.create_var(name=out_name + '@SCALE', shape=[1],
+                             dtype='float32')
+        op = Operator(block, 'fake_quantize_dequantize_abs_max',
+                      {'x': var_name},
+                      {'Out': out_name, 'OutScale': out_name + '@SCALE'},
+                      {'bit_length': bits})
+        block.ops.insert(idx, op)
+        return out_name
+
+    def training_transpile(self, program=None, startup_program=None):
+        """ref quantize_transpiler.py:training_transpile — rewrite the
+        program in place for quantization-aware training."""
+        from ...framework import default_main_program
+        program = program or default_main_program()
+        n_rewritten = 0
+        for block in program.blocks:
+            i = 0
+            while i < len(block.ops):
+                op = block.ops[i]
+                already = any(
+                    n.endswith('.dequantized')
+                    for ns in op.inputs.values() for n in ns)
+                if op.type in _QUANTIZABLE_OP_TYPES and not already:
+                    act_slot, w_slot = _SLOTS[op.type]
+                    inserted = 0
+                    for slot, bits in ((act_slot, self.activation_bits),
+                                       (w_slot, self.weight_bits)):
+                        names = op.inputs.get(slot)
+                        if not names:
+                            continue
+                        deq = self._insert_fake_quant(
+                            block, i, names[0], bits)
+                        op.inputs[slot] = [deq]
+                        inserted += 1
+                    n_rewritten += 1
+                    i += inserted
+                i += 1
+        program._bump_version()
+        return n_rewritten
+
+    def freeze_program(self, program, place=None, fuse_bn=False):
+        """ref quantize_transpiler.py:freeze_program — for inference the
+        fake-quant pairs stay in-graph (XLA folds them); scale freezing
+        for true int8 weights is the slim PTQ / inference int8 path."""
+        return program
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        """ref quantize_transpiler.py:convert_to_int8 — quantize every
+        Parameter feeding a quantizable op to int8.
+
+        The int8 tensor + scale land in the scope as `<name>@INT8` /
+        `<name>@SCALE` (the deploy artifacts the int8 Predictor consumes),
+        and the dense fp32 parameter is REPLACED by its int8→fp32
+        reconstruction so the program's numerics genuinely reflect int8
+        weights from this point on."""
+        from ...core.scope import global_scope
+        from ...framework import Parameter
+        scope = scope or global_scope()
+        n = 0
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type not in _QUANTIZABLE_OP_TYPES:
+                    continue
+                _, w_slot = _SLOTS[op.type]
+                for name in op.inputs.get(w_slot, []):
+                    base = name.split('.dequantized')[0]
+                    v = block.vars.get(base)
+                    if not isinstance(v, Parameter):
+                        continue
+                    w = scope.find(base)
+                    if w is None:
+                        continue
+                    w = np.asarray(w)
+                    scale = np.abs(w).max() or 1.0
+                    q = np.clip(np.round(w / scale * 127), -127,
+                                127).astype(np.int8)
+                    scope.set(base + '@INT8', q)
+                    scope.set(base + '@SCALE', np.float32(scale))
+                    scope.set(base, (q.astype(np.float32) * scale
+                                     / 127.0).astype(w.dtype))
+                    n += 1
+        return n

@@ -1,0 +1,4 @@
+"""contrib.reader (ref: python/paddle/fluid/contrib/reader/)."""
+from .distributed_reader import distributed_batch_reader
+
+__all__ = ['distributed_batch_reader']

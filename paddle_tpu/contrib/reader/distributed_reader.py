@@ -1,0 +1,22 @@
+"""Distributed batch reader (ref: python/paddle/fluid/contrib/reader/
+distributed_reader.py:21) — each trainer keeps every
+trainer_id-th batch, driven by the PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM env set by distributed.launch."""
+import os
+
+__all__ = ['distributed_batch_reader']
+
+
+def distributed_batch_reader(batch_reader):
+    """Wrap a batch reader so each worker consumes its 1/N batch shard."""
+    trainer_id = int(os.environ.get('PADDLE_TRAINER_ID', '0'))
+    trainer_num = int(os.environ.get('PADDLE_TRAINERS_NUM', '1'))
+    if trainer_id >= trainer_num:
+        raise ValueError(
+            'trainer_id must be less than the number of trainers')
+
+    def decorated():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainer_num == trainer_id:
+                yield batch
+    return decorated

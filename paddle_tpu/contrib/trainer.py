@@ -1,0 +1,186 @@
+"""Legacy high-level Trainer API (ref: python/paddle/fluid/contrib/
+trainer.py) — train_func returns (loss, ...) built in a fresh program;
+Trainer owns programs/executor, runs epochs from a reader, fires events,
+and checkpoints via CheckpointConfig."""
+import os
+
+from .. import io as fluid_io
+from ..core.scope import Scope, scope_guard
+from ..data_feeder import DataFeeder
+from ..executor import Executor
+from ..framework import Program, program_guard
+
+__all__ = ['BeginEpochEvent', 'EndEpochEvent', 'BeginStepEvent',
+           'EndStepEvent', 'CheckpointConfig', 'Trainer']
+
+
+class BeginEpochEvent:
+    """ref trainer.py:BeginEpochEvent."""
+
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    """ref trainer.py:EndEpochEvent."""
+
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    """ref trainer.py:BeginStepEvent."""
+
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    """ref trainer.py:EndStepEvent."""
+
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """ref trainer.py:CheckpointConfig."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or os.getcwd()
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(1, int(epoch_interval))
+        self.step_interval = max(1, int(step_interval))
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial = None
+        self.pserver_id = None
+        self.lookup_table_name = None
+
+
+class Trainer:
+    """ref trainer.py:Trainer(train_func, optimizer_func, place, ...).
+
+    `train_func` builds the model and returns the loss Variable (or a
+    [loss, metric...] list); `optimizer_func` returns the optimizer to
+    minimize it. Everything lowers to ONE jitted step via the Executor.
+    """
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        self.parallel = parallel
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+        self.checkpoint_cfg = checkpoint_config
+        if self.checkpoint_cfg is not None and \
+                not isinstance(self.checkpoint_cfg, CheckpointConfig):
+            raise TypeError(
+                'checkpoint_config must be a CheckpointConfig instance')
+
+        with program_guard(self.train_program, self.startup_program):
+            out = train_func()
+            if isinstance(out, (list, tuple)):
+                self.train_func_outputs = list(out)
+            else:
+                self.train_func_outputs = [out]
+            loss = self.train_func_outputs[0]
+            optimizer = optimizer_func()
+            optimizer.minimize(loss)
+        self.loss = loss
+
+        self.place = place
+        self.exe = Executor(place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            if param_path is not None:
+                fluid_io.load_persistables(self.exe, param_path,
+                                           self.train_program)
+
+    def stop(self):
+        """ref trainer.py:stop."""
+        self.__stopped = True
+
+    def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+        """ref trainer.py:train — epoch/step loop with events."""
+        self.__stopped = False
+        feeder = DataFeeder(feed_list=feed_order,
+                            program=self.train_program) \
+            if feed_order else None
+        with scope_guard(self.scope):
+            for epoch_id in range(num_epochs):
+                if self.__stopped:
+                    break
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self.__stopped:
+                        break
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    feed = feeder.feed(data) if feeder else data
+                    fetch = self.train_func_outputs \
+                        if begin.fetch_metrics else []
+                    metrics = self.exe.run(self.train_program, feed=feed,
+                                           fetch_list=fetch)
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                    cfg = self.checkpoint_cfg
+                    if cfg and (step_id + 1) % cfg.step_interval == 0:
+                        self._save_checkpoint(epoch_id, step_id)
+                cfg = self.checkpoint_cfg
+                if cfg and (epoch_id + 1) % cfg.epoch_interval == 0:
+                    self._save_checkpoint(epoch_id, 'end')
+                event_handler(EndEpochEvent(epoch_id))
+
+    def test(self, reader, feed_order):
+        """ref trainer.py:test — average the train_func metrics over a
+        reader on the test-mode program."""
+        import numpy as np
+        test_program = self.train_program.clone(for_test=True)
+        feeder = DataFeeder(feed_list=feed_order, program=test_program)
+        totals, count = None, 0
+        with scope_guard(self.scope):
+            for data in reader():
+                vals = self.exe.run(test_program, feed=feeder.feed(data),
+                                    fetch_list=self.train_func_outputs)
+                vals = [np.mean(v) for v in vals]
+                totals = vals if totals is None else \
+                    [a + b for a, b in zip(totals, vals)]
+                count += 1
+        if count == 0:
+            return []
+        return [t / count for t in totals]
+
+    def save_params(self, param_path):
+        """ref trainer.py:save_params."""
+        with scope_guard(self.scope):
+            fluid_io.save_persistables(self.exe, param_path,
+                                       self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        """ref trainer.py:save_inference_model."""
+        with scope_guard(self.scope):
+            fluid_io.save_inference_model(
+                param_path, feeded_var_names,
+                [self.train_func_outputs[i] for i in target_var_indexes],
+                self.exe, self.train_program)
+
+    def _save_checkpoint(self, epoch_id, step_id):
+        cfg = self.checkpoint_cfg
+        d = os.path.join(cfg.checkpoint_dir, f'checkpoint_{epoch_id}_{step_id}')
+        fluid_io.save_persistables(self.exe, d, self.train_program)
+        # GC old checkpoints beyond max_num_checkpoints
+        kept = sorted(
+            (p for p in os.listdir(cfg.checkpoint_dir)
+             if p.startswith('checkpoint_')),
+            key=lambda p: os.path.getmtime(os.path.join(cfg.checkpoint_dir,
+                                                        p)))
+        while len(kept) > cfg.max_num_checkpoints:
+            victim = kept.pop(0)
+            import shutil
+            shutil.rmtree(os.path.join(cfg.checkpoint_dir, victim),
+                          ignore_errors=True)

@@ -1,0 +1,10 @@
+"""contrib.utils (ref: python/paddle/fluid/contrib/utils/)."""
+from .lookup_table_utils import (convert_dist_to_sparse_program,
+                                 load_persistables_for_increment,
+                                 load_persistables_for_inference)
+from .hdfs_utils import HDFSClient, multi_download, multi_upload
+
+__all__ = ['convert_dist_to_sparse_program',
+           'load_persistables_for_increment',
+           'load_persistables_for_inference',
+           'HDFSClient', 'multi_download', 'multi_upload']

@@ -1,0 +1,172 @@
+"""HDFS helpers (ref: python/paddle/fluid/contrib/utils/hdfs_utils.py).
+
+The reference shells out to `hadoop fs`. TPU pods read from mounted / GCS
+paths instead, so this client maps HDFS-style calls onto the local
+filesystem rooted at the configured fs path (hdfs://host/p → <root>/p)
+— scripts doing ls/upload/download/mkdirs keep working against a staged
+directory. When a real `hadoop` binary is on PATH it is used directly.
+"""
+import os
+import shutil
+import subprocess
+
+__all__ = ['HDFSClient', 'multi_download', 'multi_upload']
+
+
+def _have_hadoop(hadoop_home):
+    return hadoop_home and os.path.exists(
+        os.path.join(hadoop_home, 'bin', 'hadoop'))
+
+
+class HDFSClient:
+    """ref hdfs_utils.py:HDFSClient(hadoop_home, configs)."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        self.hadoop_home = hadoop_home
+        self.configs = dict(configs or {})
+        name = self.configs.get('fs.default.name', 'hdfs://localhost')
+        self.local_root = os.environ.get(
+            'PADDLE_TPU_HDFS_ROOT',
+            os.path.join(os.path.expanduser('~/.cache/paddle_tpu/hdfs'),
+                         name.replace('://', '_').replace('/', '_')))
+
+    @staticmethod
+    def _strip_scheme(hdfs_path):
+        """hdfs://host/p → /p (local paths pass through)."""
+        if '://' in hdfs_path:
+            rest = hdfs_path.split('://', 1)[1]
+            return '/' + rest.split('/', 1)[1] if '/' in rest else '/'
+        return hdfs_path
+
+    def _local(self, hdfs_path):
+        return os.path.join(self.local_root,
+                            self._strip_scheme(hdfs_path).lstrip('/'))
+
+    def _run_hadoop(self, *args):
+        cmd = [os.path.join(self.hadoop_home, 'bin', 'hadoop'), 'fs']
+        for k, v in self.configs.items():
+            cmd += ['-D', f'{k}={v}']
+        cmd += list(args)
+        return subprocess.run(cmd, capture_output=True).returncode == 0
+
+    def is_exist(self, hdfs_path):
+        """ref :is_exist."""
+        if _have_hadoop(self.hadoop_home):
+            return self._run_hadoop('-test', '-e', hdfs_path)
+        return os.path.exists(self._local(hdfs_path))
+
+    def is_dir(self, hdfs_path):
+        """ref :is_dir."""
+        if _have_hadoop(self.hadoop_home):
+            return self._run_hadoop('-test', '-d', hdfs_path)
+        return os.path.isdir(self._local(hdfs_path))
+
+    def delete(self, hdfs_path):
+        """ref :delete."""
+        if _have_hadoop(self.hadoop_home):
+            return self._run_hadoop('-rm', '-r', hdfs_path)
+        p = self._local(hdfs_path)
+        if os.path.isdir(p):
+            shutil.rmtree(p)
+        elif os.path.exists(p):
+            os.remove(p)
+        return True
+
+    def rename(self, hdfs_src_path, hdfs_dst_path, overwrite=False):
+        """ref :rename."""
+        if _have_hadoop(self.hadoop_home):
+            return self._run_hadoop('-mv', hdfs_src_path, hdfs_dst_path)
+        src, dst = self._local(hdfs_src_path), self._local(hdfs_dst_path)
+        if os.path.exists(dst):
+            if not overwrite:
+                return False
+            self.delete(hdfs_dst_path)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.rename(src, dst)
+        return True
+
+    def makedirs(self, hdfs_path):
+        """ref :makedirs."""
+        if _have_hadoop(self.hadoop_home):
+            return self._run_hadoop('-mkdir', '-p', hdfs_path)
+        os.makedirs(self._local(hdfs_path), exist_ok=True)
+        return True
+
+    def ls(self, hdfs_path):
+        """ref :ls — list of file paths under hdfs_path."""
+        if _have_hadoop(self.hadoop_home):
+            raise NotImplementedError(
+                'parse `hadoop fs -ls` output via upload/download flows')
+        p = self._local(hdfs_path)
+        if not os.path.isdir(p):
+            return []
+        return sorted(os.path.join(hdfs_path, f) for f in os.listdir(p))
+
+    def lsr(self, hdfs_path, excludes=None):
+        """ref :lsr — recursive ls."""
+        excludes = set(excludes or ())
+        out = []
+        root = self._local(hdfs_path)
+        for dirpath, _, files in os.walk(root):
+            for f in files:
+                full = os.path.join(dirpath, f)
+                rel = os.path.relpath(full, self.local_root)
+                posix = '/' + rel.replace(os.sep, '/')
+                if posix not in excludes:
+                    out.append(posix)
+        return sorted(out)
+
+    def upload(self, hdfs_path, local_path, overwrite=False, retry_times=5):
+        """ref :upload — local → hdfs."""
+        if _have_hadoop(self.hadoop_home):
+            return self._run_hadoop('-put', '-f', local_path, hdfs_path)
+        dst = self._local(hdfs_path)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if os.path.isdir(local_path):
+            if os.path.exists(dst) and overwrite:
+                shutil.rmtree(dst)
+            shutil.copytree(local_path, dst, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local_path, dst)
+        return True
+
+    def download(self, hdfs_path, local_path, overwrite=False,
+                 unzip=False):
+        """ref :download — hdfs → local."""
+        if _have_hadoop(self.hadoop_home):
+            return self._run_hadoop('-get', hdfs_path, local_path)
+        src = self._local(hdfs_path)
+        os.makedirs(os.path.dirname(local_path) or '.', exist_ok=True)
+        if os.path.isdir(src):
+            shutil.copytree(src, local_path, dirs_exist_ok=True)
+        else:
+            shutil.copy2(src, local_path)
+        return True
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
+                   multi_processes=5):
+    """ref hdfs_utils.py:multi_download — download this trainer's shard of
+    the files under hdfs_path."""
+    root = client._strip_scheme(hdfs_path)
+    files = client.lsr(hdfs_path)
+    my_files = files[trainer_id::trainers]
+    out = []
+    for f in my_files:
+        rel = os.path.relpath(f, root)
+        dst = os.path.join(local_path, rel)
+        client.download(f, dst)
+        out.append(dst)
+    return out
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False, sync=True):
+    """ref hdfs_utils.py:multi_upload."""
+    for dirpath, _, files in os.walk(local_path):
+        for f in files:
+            full = os.path.join(dirpath, f)
+            rel = os.path.relpath(full, local_path)
+            client.upload(os.path.join(hdfs_path, rel), full,
+                          overwrite=overwrite)
+    return True

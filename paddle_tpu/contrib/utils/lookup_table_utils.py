@@ -1,0 +1,72 @@
+"""Distributed lookup-table loading helpers (ref: python/paddle/fluid/
+contrib/utils/lookup_table_utils.py). The reference rewrites PS-era
+programs whose embedding table was sharded across pservers; here the
+table is one dense persistable, so conversion = clearing the
+`is_distributed` mark, and the loaders restore the non-table persistables
+and the table separately."""
+import os
+
+import numpy as np
+
+from ...distribute_lookup_table import LOOKUP_TABLE_TYPE
+from ...core.scope import global_scope
+
+__all__ = ['convert_dist_to_sparse_program',
+           'load_persistables_for_increment',
+           'load_persistables_for_inference']
+
+
+def convert_dist_to_sparse_program(program):
+    """ref lookup_table_utils.py:convert_dist_to_sparse_program — clone the
+    program with distributed lookup_tables downgraded to local sparse
+    ones."""
+    out = program.clone()
+    for block in out.blocks:
+        for op in block.ops:
+            if op.type == LOOKUP_TABLE_TYPE and \
+                    op.attrs.get('is_distributed'):
+                op.attrs['is_distributed'] = False
+                op.attrs['is_sparse'] = True
+    return out
+
+
+def _load_table(lookup_table_var_name, path):
+    scope = global_scope()
+    if os.path.isdir(path):
+        # pserver shard layout: one file per shard, rows concatenated
+        shards = []
+        for f in sorted(os.listdir(path)):
+            shards.append(np.load(os.path.join(path, f),
+                                  allow_pickle=False))
+        table = np.concatenate(shards, axis=0)
+    else:
+        with np.load(path) as data:
+            table = data[lookup_table_var_name] \
+                if lookup_table_var_name in data.files else data[data.files[0]]
+    scope.set(lookup_table_var_name, table)
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var, lookup_table_var_path):
+    """ref lookup_table_utils.py:load_persistables_for_increment — restore
+    all persistables except the big table from `dirname`, then the table
+    itself from its own path."""
+    from ... import io as fluid_io
+    name = getattr(lookup_table_var, 'name', lookup_table_var)
+    fluid_io.load_vars(
+        executor, dirname, program,
+        predicate=lambda v: fluid_io.is_persistable(v) and v.name != name)
+    _load_table(name, lookup_table_var_path)
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name):
+    """ref lookup_table_utils.py:load_persistables_for_inference."""
+    from ... import io as fluid_io
+    fluid_io.load_vars(
+        executor, dirname, program,
+        predicate=lambda v: fluid_io.is_persistable(v)
+        and v.name != lookup_table_var_name)
+    table_path = os.path.join(dirname, lookup_table_var_name)
+    if os.path.exists(table_path) or os.path.isdir(table_path):
+        _load_table(lookup_table_var_name, table_path)

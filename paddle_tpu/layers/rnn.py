@@ -407,6 +407,15 @@ def gather_tree(ids, parents):
     return apply_op_layer('gather_tree', {'ids': ids, 'parents': parents}, {})
 
 
+def expand_to_beam(x, beam_size):
+    """(B, ...) → (B*W, ...) by tiling each row W times (shared by the
+    layers and contrib beam-search decoders)."""
+    ex = nn_layers.unsqueeze(x, axes=[1])
+    ex = nn_layers.expand(
+        ex, expand_times=[1, beam_size] + [1] * (len(x.shape) - 1))
+    return nn_layers.reshape(ex, shape=[-1] + list(x.shape[1:]))
+
+
 class BeamSearchDecoder:
     """ref: layers/rnn.py:758 BeamSearchDecoder. Dense (batch, beam) layout;
     all shapes static; finished beams extend only with end_token."""
@@ -431,11 +440,7 @@ class BeamSearchDecoder:
             x, shape=[B, self.beam_size] + list(x.shape[1:]))
 
     def _expand_to_beam(self, x):
-        """(B, ...) → (B*W, ...) by tiling each row W times."""
-        ex = nn_layers.unsqueeze(x, axes=[1])
-        ex = nn_layers.expand(
-            ex, expand_times=[1, self.beam_size] + [1] * (len(x.shape) - 1))
-        return nn_layers.reshape(ex, shape=[-1] + list(x.shape[1:]))
+        return expand_to_beam(x, self.beam_size)
 
     def initialize(self, initial_cell_states):
         flat = _flatten(initial_cell_states)

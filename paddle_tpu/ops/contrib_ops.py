@@ -1,0 +1,168 @@
+"""contrib layer ops (ref: python/paddle/fluid/contrib/layers/nn.py).
+
+Text-matching / CTR ops reformulated for TPU: the reference's LoD inputs
+(per-sample matrix sizes, ragged sequences) become padded dense tensors
+plus (B,) length vectors (None → full size), masked so results match the
+ragged semantics. Everything is fixed-shape and fuses under XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _len_mask(lengths, size, dtype=jnp.bool_):
+    """(B,) lengths → (B, size) validity mask (all-valid when None)."""
+    if lengths is None:
+        return None
+    return (jnp.arange(size)[None, :]
+            < jnp.asarray(lengths)[:, None]).astype(dtype)
+
+
+@register_op('match_matrix_tensor', outputs=['Out', 'Tmp'])
+def match_matrix_tensor(x, y, w, x_len=None, y_len=None, *, channel_num=1):
+    """ref contrib/layers/nn.py:219 — out[b,c,i,j] = x[b,i]ᵀ W_c y[b,j].
+
+    x: (B, Lx, D1), y: (B, Ly, D2), w: (D1, C, D2) →
+    Out (B, C, Lx, Ly), Tmp (B, Lx, C, D2) (the x·W intermediate the
+    reference also returns)."""
+    tmp = jnp.einsum('bxd,dce->bxce', x, w)
+    out = jnp.einsum('bxce,bye->bcxy', tmp, y)
+    mx = _len_mask(x_len, x.shape[1], out.dtype)
+    my = _len_mask(y_len, y.shape[1], out.dtype)
+    if mx is not None:
+        out = out * mx[:, None, :, None]
+    if my is not None:
+        out = out * my[:, None, None, :]
+    return out, tmp
+
+
+@register_op('var_conv_2d')
+def var_conv_2d(x, w, row=None, col=None, *, stride=1):
+    """ref contrib/layers/nn.py:103 — per-sample-sized conv2d.
+
+    x: (B, Cin, H, W) padded; row/col: (B,) per-sample valid height/width.
+    SAME-padded conv at `stride`, with out-of-extent positions (of both
+    input and output) masked to zero — matching the reference's
+    LoD-derived per-sample image sizes."""
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    mr = _len_mask(row, x.shape[2], x.dtype)
+    mc = _len_mask(col, x.shape[3], x.dtype)
+    if mr is not None:
+        x = x * mr[:, None, :, None]
+    if mc is not None:
+        x = x * mc[:, None, None, :]
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding='SAME',
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    if row is not None:
+        out_rows = (jnp.asarray(row) + stride[0] - 1) // stride[0]
+        out = out * _len_mask(out_rows, out.shape[2],
+                              out.dtype)[:, None, :, None]
+    if col is not None:
+        out_cols = (jnp.asarray(col) + stride[1] - 1) // stride[1]
+        out = out * _len_mask(out_cols, out.shape[3],
+                              out.dtype)[:, None, None, :]
+    return out
+
+
+@register_op('sequence_topk_avg_pooling')
+def sequence_topk_avg_pooling(x, row=None, col=None, *, topks,
+                              channel_num=1):
+    """ref contrib/layers/nn.py:302 — per-row top-k column averages.
+
+    x: (B, C, R, Cc); for each (b, c, r): sort the valid columns
+    descending and emit mean of the top k for each k in `topks` (fewer
+    than k valid values → zero-padded, i.e. sum(valid top)/k, the
+    reference's behavior). Out: (B, R, C * len(topks))."""
+    B, C, R, Cc = x.shape
+    neg = jnp.finfo(x.dtype).min
+    mc = _len_mask(col, Cc)
+    if mc is not None:
+        x = jnp.where(mc[:, None, None, :], x, neg)
+    sorted_desc = -jnp.sort(-x, axis=-1)            # (B, C, R, Cc)
+    if mc is not None:
+        # invalid slots were -inf; zero them so cumsum = sum of valid
+        valid_n = jnp.asarray(col)[:, None, None, None]
+        pos = jnp.arange(Cc)[None, None, None, :]
+        sorted_desc = jnp.where(pos < valid_n, sorted_desc, 0.0)
+    csum = jnp.cumsum(sorted_desc, axis=-1)          # (B, C, R, Cc)
+    outs = []
+    for k in topks:
+        idx = min(k, Cc) - 1
+        outs.append(csum[..., idx] / float(k))       # (B, C, R)
+    out = jnp.stack(outs, axis=-1)                   # (B, C, R, K)
+    out = jnp.transpose(out, (0, 2, 1, 3)).reshape(B, R, C * len(topks))
+    mr = _len_mask(row, R, out.dtype)
+    if mr is not None:
+        out = out * mr[:, :, None]
+    return out
+
+
+@register_op('fused_embedding_seq_pool')
+def fused_embedding_seq_pool(ids, w, length=None, *, combiner='sum',
+                             padding_idx=-1):
+    """ref contrib/layers/nn.py:435 — embedding lookup + sequence pool in
+    one fused op. ids: (B, T) int; w: (V, D) → (B, D)."""
+    ids = jnp.asarray(ids)
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    emb = jnp.take(w, jnp.clip(ids, 0, w.shape[0] - 1), axis=0)  # (B,T,D)
+    valid = jnp.ones(ids.shape, emb.dtype)
+    if padding_idx is not None and padding_idx >= 0:
+        valid = valid * (ids != padding_idx).astype(emb.dtype)
+    m = _len_mask(length, ids.shape[1], emb.dtype)
+    if m is not None:
+        valid = valid * m
+    emb = emb * valid[..., None]
+    s = jnp.sum(emb, axis=1)
+    if combiner == 'sum':
+        return s
+    if combiner == 'mean':
+        n = jnp.maximum(jnp.sum(valid, axis=1, keepdims=True), 1.0)
+        return s / n
+    raise ValueError(f'unknown combiner {combiner!r}')
+
+
+@register_op('search_pyramid_hash', needs_rng=True)
+def search_pyramid_hash(ids, w, length=None, *, num_emb, space_len,
+                        pyramid_layer=2, rand_len=16,
+                        drop_out_percent=0.0, is_training=True,
+                        seed=0, key=None):
+    """ref contrib/layers/nn.py:631 — pyramid n-gram hash embedding.
+
+    For each n-gram length 2..pyramid_layer, token windows hash (FNV-style
+    modular mix, deterministic in `seed`) into a table of shape
+    (space_len, num_emb); position t accumulates the embeddings of every
+    n-gram starting at t. ids: (B, T) int → (B, T, num_emb), masked by
+    `length`; training applies dropout at drop_out_percent."""
+    ids = jnp.asarray(ids)
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    B, T = ids.shape
+    out = jnp.zeros((B, T, num_emb), w.dtype)
+    m = _len_mask(length, T, jnp.int32)
+    valid = m if m is not None else jnp.ones((B, T), jnp.int32)
+    for n in range(2, pyramid_layer + 1):
+        if n > T:
+            break
+        h = jnp.zeros((B, T - n + 1), jnp.uint32) + jnp.uint32(
+            2166136261 ^ (seed & 0x7fffffff))
+        ok = jnp.ones((B, T - n + 1), jnp.int32)
+        for i in range(n):
+            tok = jax.lax.dynamic_slice_in_dim(ids, i, T - n + 1, axis=1)
+            h = (h * jnp.uint32(16777619)) ^ tok.astype(jnp.uint32)
+            ok = ok * jax.lax.dynamic_slice_in_dim(valid, i, T - n + 1,
+                                                   axis=1)
+        idx = (h % jnp.uint32(space_len)).astype(jnp.int32)
+        emb = jnp.take(w, idx, axis=0) * ok[..., None].astype(w.dtype)
+        out = out.at[:, :T - n + 1, :].add(emb)
+    if is_training and drop_out_percent > 0 and key is not None:
+        keep = 1.0 - drop_out_percent
+        mask = jax.random.bernoulli(key, keep, out.shape)
+        out = jnp.where(mask, out / keep, 0.0)
+    if m is not None:
+        out = out * m[..., None].astype(out.dtype)
+    return out

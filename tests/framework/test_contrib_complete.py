@@ -1,0 +1,371 @@
+"""contrib completion: decoder (StateCell/TrainingDecoder/
+BeamSearchDecoder), text-matching layer ops, QuantizeTranspiler,
+reader/utils/model_stat/op_frequence, Trainer/Inferencer."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import contrib
+
+
+# ------------------------------------------------------------ decoder ----
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_training_decoder_matches_manual_gru():
+    B, T, D, H = 2, 4, 3, 5
+    rng = np.random.RandomState(0)
+    emb = rng.rand(B, T, D).astype('float32')
+    boot = rng.rand(B, H).astype('float32')
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data('td_x', [B, T, D], 'float32')
+        h0 = fluid.data('td_h0', [B, H], 'float32')
+        state = contrib.InitState(init=h0)
+        cell = contrib.StateCell(inputs={'w': None}, states={'h': state},
+                                 out_state='h')
+
+        @cell.state_updater
+        def updater(c):
+            w = c.get_input('w')
+            h = c.get_state('h')
+            new_h = fluid.layers.fc(
+                fluid.layers.concat([w, h], axis=1), H, act='tanh',
+                param_attr=fluid.ParamAttr(
+                    name='td_w',
+                    initializer=fluid.initializer.ConstantInitializer(0.1)),
+                bias_attr=False)
+            c.set_state('h', new_h)
+
+        decoder = contrib.TrainingDecoder(cell)
+        with decoder.block():
+            w = decoder.step_input(x)
+            cell.compute_state(inputs={'w': w})
+            cell.update_states()
+            decoder.output(cell.get_state('h'))
+        out = decoder()
+    res, = _run(main, startup, {'td_x': emb, 'td_h0': boot}, [out])
+    assert res.shape == (B, T, H)
+    # manual reference
+    W = np.full((D + H, H), 0.1, 'float32')
+    h = boot
+    for t in range(T):
+        h = np.tanh(np.concatenate([emb[:, t], h], axis=1) @ W)
+        np.testing.assert_allclose(res[:, t], h, rtol=2e-5, atol=2e-5)
+
+
+def test_beam_search_decoder_decodes():
+    B, W, H, V, D = 2, 3, 6, 11, 4
+    max_len = 5
+    rng = np.random.RandomState(1)
+    boot = rng.rand(B, H).astype('float32')
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        h0 = fluid.data('bsd_h0', [B, H], 'float32')
+        init_ids = fluid.data('bsd_ids', [B, 1], 'int64')
+        init_scores = fluid.data('bsd_scores', [B, 1], 'float32')
+        state = contrib.InitState(init=h0)
+        cell = contrib.StateCell(inputs={'w': None}, states={'h': state},
+                                 out_state='h')
+
+        @cell.state_updater
+        def updater(c):
+            w = c.get_input('w')
+            h = c.get_state('h')
+            new_h = fluid.layers.fc(fluid.layers.concat([w, h], axis=1), H,
+                                    act='tanh', bias_attr=False)
+            c.set_state('h', new_h)
+
+        decoder = contrib.BeamSearchDecoder(
+            cell, init_ids, init_scores, target_dict_dim=V, word_dim=D,
+            topk_size=V, max_len=max_len, beam_size=W, end_id=1)
+        decoder.decode()
+        ids, scores = decoder()
+    r_ids, r_scores = _run(
+        main, startup,
+        {'bsd_h0': boot, 'bsd_ids': np.zeros((B, 1), 'int64'),
+         'bsd_scores': np.zeros((B, 1), 'float32')},
+        [ids, scores])
+    assert r_ids.shape == (B, W, max_len)
+    assert r_scores.shape == (B, W)
+    assert r_ids.min() >= 0 and r_ids.max() < V
+    # beams are sorted best-first by construction of top-k
+    assert np.all(np.diff(r_scores, axis=1) <= 1e-5)
+
+
+# --------------------------------------------------- layer ops (masked) ----
+
+def test_match_matrix_tensor():
+    B, Lx, Ly, D, C = 2, 3, 4, 5, 2
+    rng = np.random.RandomState(2)
+    xv = rng.rand(B, Lx, D).astype('float32')
+    yv = rng.rand(B, Ly, D).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data('mm_x', [B, Lx, D], 'float32')
+        y = fluid.data('mm_y', [B, Ly, D], 'float32')
+        xl = fluid.data('mm_xl', [B], 'int32')
+        out, tmp = contrib.layers.match_matrix_tensor(
+            x, y, channel_num=C, x_len=xl)
+    r, = _run(main, startup,
+              {'mm_x': xv, 'mm_y': yv,
+               'mm_xl': np.array([2, 3], 'int32')}, [out])[:1]
+    assert r.shape == (B, C, Lx, Ly)
+    # masked rows are zero
+    assert np.allclose(r[0, :, 2:, :], 0)
+    assert np.allclose(r[1, :, 3:, :], 0)
+    assert not np.allclose(r[0, :, :2, :], 0)
+
+
+def test_var_conv_2d_masks_extent():
+    B, C, Hh, Ww = 2, 1, 6, 6
+    rng = np.random.RandomState(3)
+    xv = rng.rand(B, C, Hh, Ww).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data('vc_x', [B, C, Hh, Ww], 'float32')
+        row = fluid.data('vc_r', [B], 'int32')
+        col = fluid.data('vc_c', [B], 'int32')
+        out = contrib.layers.var_conv_2d(x, row, col, input_channel=C,
+                                         output_channel=3, filter_size=3)
+    r, = _run(main, startup,
+              {'vc_x': xv, 'vc_r': np.array([4, 6], 'int32'),
+               'vc_c': np.array([3, 6], 'int32')}, [out])
+    assert r.shape == (B, 3, Hh, Ww)
+    assert np.allclose(r[0, :, 4:, :], 0) and np.allclose(r[0, :, :, 3:], 0)
+    assert not np.allclose(r[1], 0)
+
+
+def test_sequence_topk_avg_pooling():
+    B, C, R, Cc = 1, 1, 2, 5
+    x = np.array([[[[5, 1, 3, 9, 7],
+                    [2, 8, 4, 6, 0]]]], 'float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.data('tk_x', [B, C, R, Cc], 'float32')
+        row = fluid.data('tk_r', [B], 'int32')
+        col = fluid.data('tk_c', [B], 'int32')
+        out = contrib.layers.sequence_topk_avg_pooling(
+            xv, row, col, topks=[1, 3], channel_num=C)
+    r, = _run(main, startup,
+              {'tk_x': x, 'tk_r': np.array([2], 'int32'),
+               'tk_c': np.array([4], 'int32')}, [out])
+    assert r.shape == (B, R, C * 2)
+    # valid cols of row0: [5,1,3,9] → top1=9, top3 avg=(9+5+3)/3
+    np.testing.assert_allclose(r[0, 0], [9.0, 17 / 3], rtol=1e-6)
+    # row1: [2,8,4,6] → top1=8, top3=(8+6+4)/3=6
+    np.testing.assert_allclose(r[0, 1], [8.0, 6.0], rtol=1e-6)
+
+
+def test_fused_embedding_seq_pool():
+    B, T, V, D = 2, 3, 7, 4
+    ids = np.array([[1, 2, 0], [3, 0, 0]], 'int64')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        iv = fluid.data('fe_ids', [B, T], 'int64')
+        ln = fluid.data('fe_len', [B], 'int32')
+        out = contrib.layers.fused_embedding_seq_pool(
+            iv, size=[V, D], combiner='sum', sequence_length=ln)
+    exe = fluid.Executor()
+    exe.run(startup)
+    w = np.asarray(fluid.global_scope().find(
+        fluid.io.get_program_parameter(main)[0].name))
+    r, = exe.run(main, feed={'fe_ids': ids,
+                             'fe_len': np.array([2, 1], 'int32')},
+                 fetch_list=[out])
+    np.testing.assert_allclose(r[0], w[1] + w[2], rtol=1e-5)
+    np.testing.assert_allclose(r[1], w[3], rtol=1e-5)
+
+
+def test_search_pyramid_hash_shapes_and_mask():
+    B, T = 2, 5
+    ids = np.array([[3, 4, 5, 6, 7], [8, 9, 1, 1, 1]], 'int64')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        iv = fluid.data('ph_ids', [B, T], 'int64')
+        ln = fluid.data('ph_len', [B], 'int32')
+        out = contrib.layers.search_pyramid_hash(
+            iv, num_emb=8, space_len=64, pyramid_layer=3, rand_len=8,
+            drop_out_percent=0.0, is_training=False, use_filter=False,
+            white_list_len=0, black_list_len=0, seed=7,
+            sequence_length=ln)
+    r, = _run(main, startup,
+              {'ph_ids': ids, 'ph_len': np.array([5, 2], 'int32')}, [out])
+    assert r.shape == (B, T, 8)
+    assert np.allclose(r[1, 2:], 0)       # masked tail
+    assert not np.allclose(r[0], 0)
+
+
+def test_ctr_metric_bundle_accumulates():
+    B = 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = fluid.data('ctr_p', [B, 1], 'float32')
+        lab = fluid.data('ctr_l', [B, 1], 'float32')
+        sqr, abse, prob, q = contrib.layers.ctr_metric_bundle(p, lab)
+    exe = fluid.Executor()
+    exe.run(startup)
+    pv = np.array([[0.2], [0.8], [0.5], [0.9]], 'float32')
+    lv = np.array([[0.0], [1.0], [0.0], [1.0]], 'float32')
+    for _ in range(2):
+        r = exe.run(main, feed={'ctr_p': pv, 'ctr_l': lv},
+                    fetch_list=[sqr, abse, prob, q])
+    err = pv - lv
+    np.testing.assert_allclose(r[0], 2 * np.sum(err ** 2), rtol=1e-5)
+    np.testing.assert_allclose(r[1], 2 * np.sum(np.abs(err)), rtol=1e-5)
+    np.testing.assert_allclose(r[2], 2 * np.sum(pv), rtol=1e-5)
+    np.testing.assert_allclose(r[3], 2 * np.sum(pv * lv), rtol=1e-5)
+
+
+# --------------------------------------------------- QuantizeTranspiler ----
+
+def test_quantize_transpiler_training_and_int8():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data('qt_x', [4, 8], 'float32')
+        y = fluid.layers.fc(x, 4)
+        loss = fluid.layers.reduce_mean(y)
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    t = contrib.QuantizeTranspiler()
+    n = t.training_transpile(main)
+    assert n >= 1
+    types = [op.type for op in main.global_block().ops]
+    assert 'fake_quantize_dequantize_abs_max' in types
+    # re-transpile is a no-op
+    assert t.training_transpile(main) == 0
+    exe = fluid.Executor()
+    exe.run(startup)
+    r1, = exe.run(main, feed={'qt_x': np.random.rand(4, 8).astype(
+        'float32')}, fetch_list=[loss])
+    assert np.isfinite(r1).all()
+    w_name = fluid.io.get_program_parameter(main)[0].name
+    w_before = np.asarray(fluid.global_scope().find(w_name)).copy()
+    assert t.convert_to_int8(main) >= 1
+    q = np.asarray(fluid.global_scope().find(w_name + '@INT8'))
+    scale = np.asarray(fluid.global_scope().find(w_name + '@SCALE'))
+    assert q.dtype == np.int8
+    w_after = np.asarray(fluid.global_scope().find(w_name))
+    np.testing.assert_allclose(w_after, q.astype('float32') * scale / 127.0,
+                               rtol=1e-6)
+    # reconstruction is close to, but genuinely different from, fp32
+    assert np.abs(w_after - w_before).max() < scale / 64.0
+
+
+# --------------------------------------------- misc contrib utilities ----
+
+def test_distributed_batch_reader(monkeypatch):
+    monkeypatch.setenv('PADDLE_TRAINER_ID', '1')
+    monkeypatch.setenv('PADDLE_TRAINERS_NUM', '2')
+
+    def batches():
+        yield from range(10)
+    r = contrib.distributed_batch_reader(batches)
+    assert list(r()) == [1, 3, 5, 7, 9]
+
+
+def test_hdfs_client_local_mapping(tmp_path, monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_HDFS_ROOT', str(tmp_path))
+    c = contrib.HDFSClient(None, {'fs.default.name': 'hdfs://x'})
+    local = tmp_path / 'src.txt'
+    local.write_text('hello')
+    assert c.upload('/data/a.txt', str(local))
+    assert c.is_exist('/data/a.txt')
+    assert c.ls('/data') == ['/data/a.txt']
+    got = tmp_path / 'out.txt'
+    assert c.download('/data/a.txt', str(got))
+    assert got.read_text() == 'hello'
+    files = contrib.multi_download(c, '/data', str(tmp_path / 'dl'), 0, 1)
+    assert files
+    c.delete('/data/a.txt')
+    assert not c.is_exist('/data/a.txt')
+
+
+def test_model_stat_and_op_frequence(capsys):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data('ms_x', [2, 3, 8, 8], 'float32')
+        y = fluid.layers.conv2d(x, 4, 3)
+        y = fluid.layers.relu(y)
+        y = fluid.layers.pool2d(y, 2)
+    rows, params, flops = contrib.summary(main)
+    out = capsys.readouterr().out
+    assert 'Total PARAMs' in out and params > 0 and flops > 0
+    uni, adj = contrib.op_freq_statistic(main)
+    assert uni['conv2d'] == 1 and sum(uni.values()) >= 3
+    with pytest.raises(ValueError):
+        contrib.op_freq_statistic('not a program')
+
+
+def test_lookup_table_utils():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data('lt_ids', [4], 'int64')
+        emb = fluid.layers.embedding(ids, size=[20, 4],
+                                     is_distributed=True)
+    sparse = contrib.convert_dist_to_sparse_program(main)
+    for op in sparse.global_block().ops:
+        if op.type == 'lookup_table':
+            assert not op.attrs.get('is_distributed')
+            assert op.attrs.get('is_sparse')
+    # original untouched
+    assert any(op.attrs.get('is_distributed')
+               for op in main.global_block().ops
+               if op.type == 'lookup_table')
+
+
+# ------------------------------------------------- Trainer / Inferencer ----
+
+def test_trainer_and_inferencer_roundtrip(tmp_path):
+    rng = np.random.RandomState(5)
+    X = rng.rand(64, 3).astype('float32')
+    Wt = np.array([[1.0], [-2.0], [3.0]], 'float32')
+    Y = X @ Wt
+
+    def train_func():
+        x = fluid.data('tr_x', [-1, 3], 'float32')
+        y = fluid.data('tr_y', [-1, 1], 'float32')
+        pred = fluid.layers.fc(
+            x, 1, bias_attr=False,
+            param_attr=fluid.ParamAttr(name='tr_fc_w'))
+        return fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+
+    def optimizer_func():
+        return fluid.optimizer.Adam(0.1)
+
+    def reader():
+        for i in range(0, 64, 16):
+            yield [(X[j], Y[j]) for j in range(i, i + 16)]
+
+    losses = []
+
+    def handler(event):
+        if isinstance(event, contrib.EndStepEvent):
+            losses.append(float(np.asarray(event.metrics[0])))
+
+    trainer = contrib.Trainer(train_func, optimizer_func)
+    trainer.train(num_epochs=25, event_handler=handler, reader=reader,
+                  feed_order=['tr_x', 'tr_y'])
+    assert losses[-1] < losses[0] * 0.05
+    test_loss = trainer.test(reader, feed_order=['tr_x', 'tr_y'])
+    assert test_loss[0] < losses[0]
+    params_dir = str(tmp_path / 'params')
+    trainer.save_params(params_dir)
+
+    def infer_func():
+        x = fluid.data('tr_x', [-1, 3], 'float32')
+        return fluid.layers.fc(
+            x, 1, bias_attr=False,
+            param_attr=fluid.ParamAttr(name='tr_fc_w'))
+
+    inf = contrib.Inferencer(infer_func, params_dir)
+    pred, = inf.infer({'tr_x': X[:8]})
+    np.testing.assert_allclose(pred, Y[:8], atol=0.5)

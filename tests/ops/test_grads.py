@@ -50,6 +50,31 @@ def _std(shape):
 # differentiable ops: name → spec(args builder, diff arg indices, attrs)
 # ---------------------------------------------------------------------------
 GRAD_SPECS = {
+    # --- contrib text-matching ops ---
+    'match_matrix_tensor': S(
+        lambda r: [f32(r.standard_normal((2, 3, 4))),
+                   f32(r.standard_normal((2, 5, 4))),
+                   f32(r.standard_normal((4, 2, 4)))],
+        diff=(0, 1, 2), attrs={'channel_num': 2}),
+    'var_conv_2d': S(
+        lambda r: [f32(r.standard_normal((2, 2, 5, 5))),
+                   f32(r.standard_normal((3, 2, 3, 3)))],
+        diff=(0, 1), attrs={'stride': 1}),
+    'sequence_topk_avg_pooling': S(
+        lambda r: [f32(0.1 * np.arange(48).reshape(2, 2, 3, 4) +
+                       r.uniform(0, 0.03, (2, 2, 3, 4)))],
+        attrs={'topks': [1, 2], 'channel_num': 2}),
+    'fused_embedding_seq_pool': S(
+        lambda r: [np.array([[1, 2, 0], [3, 4, 5]], np.int64),
+                   f32(r.standard_normal((7, 4)))],
+        diff=(1,), attrs={'combiner': 'mean'}),
+    'search_pyramid_hash': S(
+        lambda r: [np.array([[3, 4, 5, 6], [8, 9, 1, 2]], np.int64),
+                   f32(r.standard_normal((64, 8)))],
+        diff=(1,),
+        attrs={'num_emb': 8, 'space_len': 64, 'pyramid_layer': 3,
+               'rand_len': 8, 'drop_out_percent': 0.0,
+               'is_training': False, 'seed': 1}),
     # --- unary elementwise ---
     'abs': S(lambda r: [away(r, (3, 4))]),
     'acos': S(lambda r: [f32(r.uniform(-0.8, 0.8, (3, 4)))]),
