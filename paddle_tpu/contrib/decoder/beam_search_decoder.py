@@ -307,6 +307,12 @@ class BeamSearchDecoder:
         cell = self.state_cell
         cell._enter_decoder(self)
         W = self._beam_size
+        b0 = (self._init_ids.shape or [-1])[0]
+        if b0 is None or int(b0) <= 0:
+            raise ValueError(
+                'BeamSearchDecoder needs a static batch size: declare '
+                'init_ids with a concrete leading dim (got '
+                f'{self._init_ids.shape})')
 
         # beam-expand the search state in the enclosing block
         ids0 = self._expand_to_beam(T.cast(self._init_ids, 'int64'))

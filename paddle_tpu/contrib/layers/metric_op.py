@@ -11,12 +11,13 @@ __all__ = ['ctr_metric_bundle']
 def ctr_metric_bundle(input, label):
     """ref metric_op.py:30 — streaming CTR metrics.
 
-    Accumulates into four persistable counters every executor run (the
+    Accumulates into six persistable counters every executor run (the
     accumulate ops fuse into the jitted step): local_sqrerr, local_abserr,
-    local_prob (sum of predicted ctr), local_q (sum of label*prob).
-    Finalize as the reference documents: MAE = abserr/N,
-    RMSE = sqrt(sqrerr/N), ctr = prob/N, q = q/N (allreduce first when
-    distributed)."""
+    local_prob (sum of predicted ctr), local_q (sum of label*prob),
+    local_pos_num (sum of positive labels), local_ins_num (instances
+    seen). Finalize as the reference documents: MAE = abserr/ins_num,
+    RMSE = sqrt(sqrerr/ins_num), ctr = prob/ins_num, q = q/ins_num
+    (allreduce the counters first when distributed)."""
     helper = LayerHelper('ctr_metric_bundle')
 
     def acc(name):
@@ -28,6 +29,8 @@ def ctr_metric_bundle(input, label):
     local_abserr = acc('abserr')
     local_prob = acc('prob')
     local_q = acc('q')
+    local_pos_num = acc('pos_num')
+    local_ins_num = acc('ins_num')
 
     from ...layers import nn as L
     from ...layers import tensor as T
@@ -38,13 +41,18 @@ def ctr_metric_bundle(input, label):
     batch_prob = L.reduce_sum(input)
     batch_q = L.reduce_sum(apply_op_layer(
         'elementwise_mul', {'x': input, 'y': fl}, {}))
+    batch_pos = L.reduce_sum(fl)
+    batch_ins = L.reduce_sum(T.ones_like(fl))
 
     block = helper.main_program.current_block()
     for acc_var, batch in ((local_sqrerr, batch_sqr),
                            (local_abserr, batch_abs),
                            (local_prob, batch_prob),
-                           (local_q, batch_q)):
+                           (local_q, batch_q),
+                           (local_pos_num, batch_pos),
+                           (local_ins_num, batch_ins)):
         block.append_op(type='elementwise_add',
                         inputs={'x': acc_var.name, 'y': batch.name},
                         outputs={'Out': acc_var.name}, attrs={})
-    return local_sqrerr, local_abserr, local_prob, local_q
+    return (local_sqrerr, local_abserr, local_prob, local_q,
+            local_pos_num, local_ins_num)

@@ -16,8 +16,9 @@ from ...framework import Operator
 __all__ = ['QuantizeTranspiler']
 
 _QUANTIZABLE_OP_TYPES = ('conv2d', 'depthwise_conv2d', 'mul', 'matmul')
-# input slots holding (activation, weight) per quantizable type
-_SLOTS = {'conv2d': ('x', 'w'), 'depthwise_conv2d': ('x', 'w'),
+# input slots holding (activation, weight) per quantizable type — conv ops
+# name the weight slot 'weight', matmul-family ops 'y'
+_SLOTS = {'conv2d': ('x', 'weight'), 'depthwise_conv2d': ('x', 'weight'),
           'mul': ('x', 'y'), 'matmul': ('x', 'y')}
 
 

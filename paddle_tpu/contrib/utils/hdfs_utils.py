@@ -121,9 +121,11 @@ class HDFSClient:
         if _have_hadoop(self.hadoop_home):
             return self._run_hadoop('-put', '-f', local_path, hdfs_path)
         dst = self._local(hdfs_path)
+        if os.path.exists(dst) and not overwrite:
+            return False
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         if os.path.isdir(local_path):
-            if os.path.exists(dst) and overwrite:
+            if os.path.exists(dst):
                 shutil.rmtree(dst)
             shutil.copytree(local_path, dst, dirs_exist_ok=True)
         else:
@@ -136,6 +138,8 @@ class HDFSClient:
         if _have_hadoop(self.hadoop_home):
             return self._run_hadoop('-get', hdfs_path, local_path)
         src = self._local(hdfs_path)
+        if not os.path.exists(src):
+            return False
         os.makedirs(os.path.dirname(local_path) or '.', exist_ok=True)
         if os.path.isdir(src):
             shutil.copytree(src, local_path, dirs_exist_ok=True)

@@ -61,12 +61,24 @@ def load_persistables_for_increment(dirname, executor, program,
 
 def load_persistables_for_inference(dirname, executor, program,
                                     lookup_table_var_name):
-    """ref lookup_table_utils.py:load_persistables_for_inference."""
+    """ref lookup_table_utils.py:load_persistables_for_inference — the
+    table loads from its own shard file/dir when present (PS layout),
+    otherwise from the bundled persistables archive this repo's
+    save_persistables writes."""
     from ... import io as fluid_io
-    fluid_io.load_vars(
-        executor, dirname, program,
-        predicate=lambda v: fluid_io.is_persistable(v)
-        and v.name != lookup_table_var_name)
     table_path = os.path.join(dirname, lookup_table_var_name)
-    if os.path.exists(table_path) or os.path.isdir(table_path):
+    if os.path.exists(table_path):
+        fluid_io.load_vars(
+            executor, dirname, program,
+            predicate=lambda v: fluid_io.is_persistable(v)
+            and v.name != lookup_table_var_name)
         _load_table(lookup_table_var_name, table_path)
+    else:
+        # bundled layout: the table is a normal persistable in params.npz
+        fluid_io.load_vars(executor, dirname, program,
+                           predicate=fluid_io.is_persistable)
+        if global_scope().find(lookup_table_var_name) is None:
+            raise IOError(
+                f'lookup table {lookup_table_var_name!r} found neither as '
+                f'a shard file under {dirname} nor in the bundled '
+                f'persistables')

@@ -506,7 +506,7 @@ for _op in ['sigmoid', 'logsigmoid', 'exp', 'tanh', 'atan', 'tanh_shrink',
             'uniform_random_batch_size_like', 'gaussian_random_batch_size_like',
             'sampling_id', 'random_crop',
             'logical_and', 'logical_or', 'logical_xor', 'logical_not',
-            'has_inf', 'has_nan', 'isfinite', 'mean_iou']:
+            'has_inf', 'has_nan', 'isfinite', 'mean_iou', 'cumsum']:
     _gen(_op)
 
 _gen('slice', fname='slice')

@@ -83,6 +83,24 @@ def mul(x, y, *, x_num_col_dims=1, y_num_col_dims=1):
     return out.reshape(out_shape)
 
 
+@register_op('cumsum')
+def cumsum(x, *, axis=None, exclusive=False, reverse=False, flatten=False):
+    """Cumulative sum (ref: paddle/fluid/operators/cum_op.cc). axis=None
+    follows the reference: flatten and cumsum over all elements."""
+    x = jnp.asarray(x)
+    if axis is None or flatten:
+        x = x.reshape(-1)
+        axis = 0
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
 @register_op('sum', variadic=['xs'])
 def sum_op(xs):
     """Add N tensors (ref: paddle/fluid/operators/sum_op.cc)."""

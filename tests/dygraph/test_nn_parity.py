@@ -1,0 +1,145 @@
+"""Dygraph nn layers: value parity vs numpy / the static-graph layer fns
+(ref test model: unittests/test_imperative_* family)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph import to_variable
+
+RNG = np.random.RandomState(3)
+
+
+def const_attr(v):
+    return fluid.ParamAttr(
+        initializer=fluid.initializer.ConstantInitializer(v))
+
+
+def test_linear_value():
+    with dygraph.guard():
+        lin = dygraph.Linear(4, 2, param_attr=const_attr(0.5),
+                             bias_attr=const_attr(1.0))
+        x = RNG.rand(3, 4).astype('float32')
+        out = lin(to_variable(x))
+        np.testing.assert_allclose(out.numpy(),
+                                   x @ np.full((4, 2), 0.5) + 1.0,
+                                   rtol=1e-5)
+
+
+def test_conv2d_value():
+    with dygraph.guard():
+        conv = dygraph.Conv2D(1, 1, 3, param_attr=const_attr(1.0),
+                              bias_attr=False)
+        x = np.ones((1, 1, 4, 4), 'float32')
+        out = conv(to_variable(x))
+        # valid center taps of an all-ones 3x3 conv over ones = 9
+        np.testing.assert_allclose(out.numpy()[0, 0], 9.0, rtol=1e-5)
+
+
+def test_conv2d_transpose_shape_and_grad():
+    with dygraph.guard():
+        deconv = dygraph.Conv2DTranspose(2, 3, 4, stride=2, padding=1)
+        x = to_variable(RNG.rand(2, 2, 5, 5).astype('float32'))
+        out = deconv(x)
+        assert out.shape == (2, 3, 10, 10)
+        loss = fluid.layers.reduce_mean(out)
+        loss.backward()
+        assert deconv.weight.gradient() is not None
+
+
+def test_pool2d_and_batchnorm_stats():
+    with dygraph.guard():
+        pool = dygraph.Pool2D(pool_size=2, pool_type='avg', pool_stride=2)
+        x = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+        np.testing.assert_allclose(
+            pool(to_variable(x)).numpy()[0, 0],
+            [[2.5, 4.5], [10.5, 12.5]])
+        bn = dygraph.BatchNorm(3)
+        bn.train()
+        xb = RNG.rand(8, 3, 2, 2).astype('float32')
+        out = bn(to_variable(xb)).numpy()
+        np.testing.assert_allclose(out.mean((0, 2, 3)), 0.0, atol=1e-4)
+        np.testing.assert_allclose(out.std((0, 2, 3)), 1.0, atol=1e-2)
+
+
+def test_embedding_and_layernorm():
+    with dygraph.guard():
+        emb = dygraph.Embedding([5, 4], param_attr=const_attr(2.0))
+        ids = np.array([0, 3], 'int64')
+        np.testing.assert_allclose(emb(to_variable(ids)).numpy(), 2.0)
+        ln = dygraph.LayerNorm([6])
+        x = RNG.rand(2, 6).astype('float32')
+        out = ln(to_variable(x)).numpy()
+        np.testing.assert_allclose(out.mean(1), 0.0, atol=1e-5)
+
+
+def test_prelu_nce_bilinear_groupnorm_spectral():
+    with dygraph.guard():
+        x = RNG.rand(2, 4).astype('float32') - 0.5
+        pr = dygraph.PRelu('all', param_attr=const_attr(0.25))
+        got = pr(to_variable(x.astype('float32'))).numpy()
+        np.testing.assert_allclose(
+            got, np.where(x > 0, x, 0.25 * x), rtol=1e-5)
+
+        gn = dygraph.GroupNorm(channels=4, groups=2)
+        xg = RNG.rand(2, 4, 3, 3).astype('float32')
+        og = gn(to_variable(xg)).numpy()
+        grp = og.reshape(2, 2, 2 * 9)
+        np.testing.assert_allclose(grp.mean(-1), 0.0, atol=1e-4)
+
+        bt = dygraph.BilinearTensorProduct(3, 3, 2)
+        o = bt(to_variable(RNG.rand(2, 3).astype('float32')),
+               to_variable(RNG.rand(2, 3).astype('float32')))
+        assert o.shape == (2, 2)
+
+
+def test_sequential_and_parameterlist_training():
+    """A Sequential MLP trains end-to-end in dygraph."""
+    with dygraph.guard():
+        model = dygraph.Sequential(
+            dygraph.Linear(3, 8, act='relu'),
+            dygraph.Linear(8, 1))
+        opt = fluid.optimizer.Adam(0.05,
+                                   parameter_list=model.parameters())
+        X = RNG.rand(32, 3).astype('float32')
+        W = np.array([[1.], [2.], [-1.]], 'float32')
+        Y = X @ W
+        losses = []
+        for _ in range(60):
+            pred = model(to_variable(X))
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, to_variable(Y)))
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.05
+
+
+def test_dygraph_static_parity_mlp():
+    """Same weights → same outputs in dygraph and static modes."""
+    x = RNG.rand(4, 5).astype('float32')
+    with dygraph.guard():
+        lin = dygraph.Linear(5, 3, param_attr=const_attr(0.3),
+                             bias_attr=const_attr(0.1))
+        dy_out = lin(to_variable(x)).numpy()
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.data('dp_x', [4, 5], 'float32')
+        out = fluid.layers.fc(xv, 3, param_attr=const_attr(0.3),
+                              bias_attr=const_attr(0.1))
+    exe = fluid.Executor()
+    exe.run(startup)
+    st_out, = exe.run(main, feed={'dp_x': x}, fetch_list=[out])
+    np.testing.assert_allclose(dy_out, st_out, rtol=1e-5)
+
+
+def test_state_dict_roundtrip_changes_output():
+    with dygraph.guard():
+        m1 = dygraph.Linear(3, 2)
+        m2 = dygraph.Linear(3, 2)
+        x = to_variable(RNG.rand(2, 3).astype('float32'))
+        assert not np.allclose(m1(x).numpy(), m2(x).numpy())
+        m2.set_dict(m1.state_dict())
+        np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)

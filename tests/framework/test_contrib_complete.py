@@ -210,19 +210,22 @@ def test_ctr_metric_bundle_accumulates():
     with fluid.program_guard(main, startup):
         p = fluid.data('ctr_p', [B, 1], 'float32')
         lab = fluid.data('ctr_l', [B, 1], 'float32')
-        sqr, abse, prob, q = contrib.layers.ctr_metric_bundle(p, lab)
+        sqr, abse, prob, q, pos, ins = contrib.layers.ctr_metric_bundle(
+            p, lab)
     exe = fluid.Executor()
     exe.run(startup)
     pv = np.array([[0.2], [0.8], [0.5], [0.9]], 'float32')
     lv = np.array([[0.0], [1.0], [0.0], [1.0]], 'float32')
     for _ in range(2):
         r = exe.run(main, feed={'ctr_p': pv, 'ctr_l': lv},
-                    fetch_list=[sqr, abse, prob, q])
+                    fetch_list=[sqr, abse, prob, q, pos, ins])
     err = pv - lv
     np.testing.assert_allclose(r[0], 2 * np.sum(err ** 2), rtol=1e-5)
     np.testing.assert_allclose(r[1], 2 * np.sum(np.abs(err)), rtol=1e-5)
     np.testing.assert_allclose(r[2], 2 * np.sum(pv), rtol=1e-5)
     np.testing.assert_allclose(r[3], 2 * np.sum(pv * lv), rtol=1e-5)
+    np.testing.assert_allclose(r[4], 2 * np.sum(lv), rtol=1e-5)
+    np.testing.assert_allclose(r[5], 2 * B, rtol=1e-5)
 
 
 # --------------------------------------------------- QuantizeTranspiler ----
@@ -257,6 +260,25 @@ def test_quantize_transpiler_training_and_int8():
                                rtol=1e-6)
     # reconstruction is close to, but genuinely different from, fp32
     assert np.abs(w_after - w_before).max() < scale / 64.0
+
+
+def test_quantize_transpiler_covers_conv_weights():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data('qc_x', [1, 2, 6, 6], 'float32')
+        y = fluid.layers.conv2d(x, 3, 3)
+        loss = fluid.layers.reduce_mean(y)
+    t = contrib.QuantizeTranspiler()
+    t.training_transpile(main)
+    conv = [op for op in main.global_block().ops
+            if op.type == 'conv2d'][0]
+    assert conv.inputs['x'][0].endswith('.dequantized')
+    assert conv.inputs['weight'][0].endswith('.dequantized')
+    exe = fluid.Executor()
+    exe.run(startup)
+    assert t.convert_to_int8(main) >= 1
+    w_name = fluid.io.get_program_parameter(main)[0].name
+    assert fluid.global_scope().find(w_name + '@INT8') is not None
 
 
 # --------------------------------------------- misc contrib utilities ----

@@ -1,0 +1,166 @@
+"""Executor semantics depth (ref test model: unittests/test_executor_*):
+scope isolation, compile-cache behavior across shapes/program edits,
+multi-program interleaving, fetch forms, feed dtype coercion."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _linear_prog(name):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(f'{name}_x', [-1, 3], 'float32')
+        out = fluid.layers.fc(x, 2, param_attr=fluid.ParamAttr(
+            name=f'{name}_w',
+            initializer=fluid.initializer.ConstantInitializer(1.0)),
+            bias_attr=False)
+    return main, startup, out
+
+
+def test_scope_isolation():
+    main, startup, out = _linear_prog('si')
+    exe = fluid.Executor()
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    x = np.ones((2, 3), 'float32')
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        r1 = exe.run(main, feed={'si_x': x}, fetch_list=[out])[0]
+        fluid.global_scope().set('si_w', np.zeros((3, 2), 'float32'))
+        r1z = exe.run(main, feed={'si_x': x}, fetch_list=[out])[0]
+    with fluid.scope_guard(s2):
+        exe.run(startup)
+        r2 = exe.run(main, feed={'si_x': x}, fetch_list=[out])[0]
+    np.testing.assert_allclose(r1, 3.0)
+    np.testing.assert_allclose(r1z, 0.0)     # s1 was mutated
+    np.testing.assert_allclose(r2, 3.0)      # s2 unaffected
+
+
+def test_variable_feed_shapes_recompile():
+    """Different batch sizes must each produce correct results (shape-keyed
+    compile cache)."""
+    main, startup, out = _linear_prog('vs')
+    exe = fluid.Executor()
+    exe.run(startup)
+    for b in (1, 4, 7, 4):
+        r = exe.run(main, feed={'vs_x': np.ones((b, 3), 'float32')},
+                    fetch_list=[out])[0]
+        assert r.shape == (b, 2)
+        np.testing.assert_allclose(r, 3.0)
+
+
+def test_program_edit_invalidates_cache():
+    main, startup, out = _linear_prog('pe')
+    exe = fluid.Executor()
+    exe.run(startup)
+    x = np.ones((2, 3), 'float32')
+    r1 = exe.run(main, feed={'pe_x': x}, fetch_list=[out])[0]
+    with fluid.program_guard(main, startup):
+        out2 = fluid.layers.scale(out, scale=10.0)
+    r2 = exe.run(main, feed={'pe_x': x}, fetch_list=[out2])[0]
+    np.testing.assert_allclose(r1, 3.0)
+    np.testing.assert_allclose(r2, 30.0)
+
+
+def test_two_programs_interleaved_shared_scope():
+    m1, s1, o1 = _linear_prog('tp1')
+    m2, s2, o2 = _linear_prog('tp2')
+    exe = fluid.Executor()
+    exe.run(s1)
+    exe.run(s2)
+    x = np.ones((2, 3), 'float32')
+    for _ in range(2):
+        r1 = exe.run(m1, feed={'tp1_x': x}, fetch_list=[o1])[0]
+        r2 = exe.run(m2, feed={'tp2_x': 2 * x}, fetch_list=[o2])[0]
+    np.testing.assert_allclose(r1, 3.0)
+    np.testing.assert_allclose(r2, 6.0)
+
+
+def test_fetch_by_name_and_by_var_and_empty():
+    main, startup, out = _linear_prog('fn')
+    exe = fluid.Executor()
+    exe.run(startup)
+    x = np.ones((2, 3), 'float32')
+    by_var = exe.run(main, feed={'fn_x': x}, fetch_list=[out])[0]
+    by_name = exe.run(main, feed={'fn_x': x}, fetch_list=[out.name])[0]
+    np.testing.assert_allclose(by_var, by_name)
+    assert exe.run(main, feed={'fn_x': x}) == []
+
+
+def test_feed_dtype_coercion():
+    """float64/int feeds coerce to the declared var dtype."""
+    main, startup, out = _linear_prog('dc')
+    exe = fluid.Executor()
+    exe.run(startup)
+    r = exe.run(main, feed={'dc_x': np.ones((2, 3), 'float64')},
+                fetch_list=[out])[0]
+    assert r.dtype == np.float32
+    np.testing.assert_allclose(r, 3.0)
+
+
+def test_uninitialized_persistable_raises():
+    main, startup, out = _linear_prog('up')
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(RuntimeError, match='uninitialized'):
+            exe.run(main, feed={'up_x': np.ones((2, 3), 'float32')},
+                    fetch_list=[out])
+
+
+def test_return_numpy_false_returns_device_arrays():
+    main, startup, out = _linear_prog('rn')
+    exe = fluid.Executor()
+    exe.run(startup)
+    r = exe.run(main, feed={'rn_x': np.ones((2, 3), 'float32')},
+                fetch_list=[out], return_numpy=False)[0]
+    import jax
+    assert isinstance(r, jax.Array)
+    np.testing.assert_allclose(np.asarray(r), 3.0)
+
+
+def test_prune_keeps_only_needed_ops():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data('pr_x', [2, 3], 'float32')
+        a = fluid.layers.scale(x, scale=2.0)
+        b = fluid.layers.scale(x, scale=3.0)     # dead for fetch=a
+    pruned = main._prune([a])
+    types = [op.type for op in pruned.global_block().ops]
+    assert len(types) < len(main.global_block().ops)
+    exe = fluid.Executor()
+    exe.run(startup)
+    r = exe.run(pruned, feed={'pr_x': np.ones((2, 3), 'float32')},
+                fetch_list=[a])[0]
+    np.testing.assert_allclose(r, 2.0)
+
+
+def test_startup_runs_idempotent():
+    main, startup, out = _linear_prog('ip')
+    exe = fluid.Executor()
+    exe.run(startup)
+    w1 = np.asarray(fluid.global_scope().find('ip_w')).copy()
+    exe.run(startup)      # re-init: constant init → same values
+    w2 = np.asarray(fluid.global_scope().find('ip_w'))
+    np.testing.assert_allclose(w1, w2)
+
+
+def test_clone_for_test_shares_params():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data('cl_x', [4, 3], 'float32')
+        h = fluid.layers.fc(x, 4, name='cl_fc')
+        h = fluid.layers.dropout(h, 0.5)
+        loss = fluid.layers.reduce_mean(h)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor()
+    exe.run(startup)
+    x = np.ones((4, 3), 'float32')
+    # deterministic in test mode: two runs agree
+    r1 = exe.run(test_prog, feed={'cl_x': x}, fetch_list=[loss])[0]
+    r2 = exe.run(test_prog, feed={'cl_x': x}, fetch_list=[loss])[0]
+    np.testing.assert_allclose(r1, r2)
+    # training updates the shared parameter; test program sees the change
+    exe.run(main, feed={'cl_x': x}, fetch_list=[loss])
+    r3 = exe.run(test_prog, feed={'cl_x': x}, fetch_list=[loss])[0]
+    assert not np.allclose(r1, r3)

@@ -1,0 +1,608 @@
+"""Numeric value checks for the fluid.layers API surface against numpy
+references (ref test model: python/paddle/fluid/tests/unittests/
+test_layers.py + per-op OpTests). Each case builds a tiny static program,
+runs it, and asserts VALUES (not just shapes)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+L = fluid.layers
+T = fluid.layers  # tensor fns re-exported at layers level
+
+RNG = np.random.RandomState(7)
+
+
+def run_prog(build, feeds):
+    """build(vars...) inside a fresh program; returns fetched numpy."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor()
+    exe.run(startup)
+    fetch = fetch if isinstance(fetch, (list, tuple)) else [fetch]
+    return exe.run(main, feed=feeds, fetch_list=list(fetch))
+
+
+def feed_var(name, arr, lod_level=0):
+    return fluid.data(name, list(arr.shape), str(arr.dtype),
+                      lod_level=lod_level)
+
+
+# ---------------------------------------------------------------- math ----
+
+def test_elementwise_family_values():
+    a = RNG.rand(3, 4).astype('float32') + 0.5
+    b = RNG.rand(3, 4).astype('float32') + 0.5
+
+    def build():
+        x, y = feed_var('ew_a', a), feed_var('ew_b', b)
+        return [L.elementwise_add(x, y), L.elementwise_sub(x, y),
+                L.elementwise_mul(x, y), L.elementwise_div(x, y),
+                L.elementwise_max(x, y), L.elementwise_min(x, y),
+                L.elementwise_pow(x, y)]
+    r = run_prog(build, {'ew_a': a, 'ew_b': b})
+    for got, want in zip(r, [a + b, a - b, a * b, a / b, np.maximum(a, b),
+                             np.minimum(a, b), a ** b]):
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_elementwise_broadcast_axis():
+    a = RNG.rand(2, 3, 4).astype('float32')
+    b = RNG.rand(3).astype('float32')
+
+    def build():
+        x, y = feed_var('eb_a', a), feed_var('eb_b', b)
+        return L.elementwise_add(x, y, axis=1)
+    r, = run_prog(build, {'eb_a': a, 'eb_b': b})
+    np.testing.assert_allclose(r, a + b[None, :, None], rtol=1e-6)
+
+
+def test_matmul_and_mul():
+    a = RNG.rand(3, 4).astype('float32')
+    b = RNG.rand(4, 5).astype('float32')
+
+    def build():
+        x, y = feed_var('mm_a', a), feed_var('mm_b', b)
+        return [L.matmul(x, y), L.mul(x, y)]
+    r = run_prog(build, {'mm_a': a, 'mm_b': b})
+    np.testing.assert_allclose(r[0], a @ b, rtol=1e-5)
+    np.testing.assert_allclose(r[1], a @ b, rtol=1e-5)
+
+
+def test_matmul_transpose_flags():
+    a = RNG.rand(4, 3).astype('float32')
+    b = RNG.rand(5, 4).astype('float32')
+
+    def build():
+        x, y = feed_var('mt_a', a), feed_var('mt_b', b)
+        return L.matmul(x, y, transpose_x=True, transpose_y=True)
+    r, = run_prog(build, {'mt_a': a, 'mt_b': b})
+    np.testing.assert_allclose(r, a.T @ b.T, rtol=1e-5)
+
+
+def test_scale_clip_sign_abs():
+    a = (RNG.rand(3, 4).astype('float32') - 0.5) * 4
+
+    def build():
+        x = feed_var('sc_a', a)
+        return [L.scale(x, scale=2.5, bias=1.0), L.clip(x, min=-1.0, max=1.0),
+                L.sign(x), L.abs(x)]
+    r = run_prog(build, {'sc_a': a})
+    np.testing.assert_allclose(r[0], a * 2.5 + 1.0, rtol=1e-5)
+    np.testing.assert_allclose(r[1], np.clip(a, -1, 1), rtol=1e-6)
+    np.testing.assert_allclose(r[2], np.sign(a))
+    np.testing.assert_allclose(r[3], np.abs(a))
+
+
+def test_reductions_with_axis_and_keepdim():
+    a = RNG.rand(2, 3, 4).astype('float32')
+
+    def build():
+        x = feed_var('rd_a', a)
+        return [L.reduce_sum(x, dim=[1]), L.reduce_mean(x, dim=[0, 2]),
+                L.reduce_max(x, dim=[2], keep_dim=True),
+                L.reduce_min(x), L.reduce_prod(x, dim=[1])]
+    r = run_prog(build, {'rd_a': a})
+    np.testing.assert_allclose(r[0], a.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(r[1], a.mean((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(r[2], a.max(2, keepdims=True), rtol=1e-6)
+    np.testing.assert_allclose(r[3], a.min(), rtol=1e-6)
+    np.testing.assert_allclose(r[4], a.prod(1), rtol=1e-5)
+
+
+def test_cumsum_and_logsumexp():
+    a = RNG.rand(3, 4).astype('float32')
+
+    def build():
+        x = feed_var('cs_a', a)
+        return [L.cumsum(x, axis=1), L.logsumexp(x)]
+    r = run_prog(build, {'cs_a': a})
+    np.testing.assert_allclose(r[0], np.cumsum(a, 1), rtol=1e-5)
+    np.testing.assert_allclose(
+        r[1], np.log(np.sum(np.exp(a))), rtol=1e-5)
+
+
+# -------------------------------------------------------------- tensor ----
+
+def test_concat_split_stack_unstack():
+    a = RNG.rand(2, 3).astype('float32')
+    b = RNG.rand(2, 3).astype('float32')
+
+    def build():
+        x, y = feed_var('ct_a', a), feed_var('ct_b', b)
+        cat = L.concat([x, y], axis=0)
+        s1, s2 = L.split(cat, 2, dim=0)
+        st = L.stack([x, y], axis=0)
+        return [cat, s1, s2, st]
+    r = run_prog(build, {'ct_a': a, 'ct_b': b})
+    np.testing.assert_allclose(r[0], np.concatenate([a, b], 0))
+    np.testing.assert_allclose(r[1], a)
+    np.testing.assert_allclose(r[2], b)
+    np.testing.assert_allclose(r[3], np.stack([a, b], 0))
+
+
+def test_reshape_transpose_squeeze_expand_tile():
+    a = RNG.rand(2, 1, 6).astype('float32')
+
+    def build():
+        x = feed_var('rs_a', a)
+        return [L.reshape(x, shape=[2, 6]), L.transpose(x, perm=[2, 0, 1]),
+                L.squeeze(x, axes=[1]), L.unsqueeze(x, axes=[0]),
+                L.expand(x, expand_times=[1, 3, 1])]
+    r = run_prog(build, {'rs_a': a})
+    np.testing.assert_allclose(r[0], a.reshape(2, 6))
+    np.testing.assert_allclose(r[1], a.transpose(2, 0, 1))
+    np.testing.assert_allclose(r[2], a[:, 0, :])
+    np.testing.assert_allclose(r[3], a[None])
+    np.testing.assert_allclose(r[4], np.tile(a, (1, 3, 1)))
+
+
+def test_slice_strided_slice_reverse():
+    a = np.arange(24, dtype='float32').reshape(4, 6)
+
+    def build():
+        x = feed_var('sl_a', a)
+        return [L.slice(x, axes=[0, 1], starts=[1, 2], ends=[3, 5]),
+                L.strided_slice(x, axes=[1], starts=[0], ends=[6],
+                                strides=[2]),
+                T.reverse(x, axis=[0])]
+    r = run_prog(build, {'sl_a': a})
+    np.testing.assert_allclose(r[0], a[1:3, 2:5])
+    np.testing.assert_allclose(r[1], a[:, ::2])
+    np.testing.assert_allclose(r[2], a[::-1])
+
+
+def test_gather_scatter_family():
+    a = np.arange(20, dtype='float32').reshape(5, 4)
+    idx = np.array([3, 1], 'int64')
+
+    def build():
+        x = feed_var('gs_a', a)
+        i = feed_var('gs_i', idx)
+        upd = L.fill_constant([2, 4], 'float32', 100.0)
+        return [L.gather(x, i), L.scatter(x, i, upd),
+                L.gather_nd(x, L.reshape(i, shape=[2, 1]))]
+    r = run_prog(build, {'gs_a': a, 'gs_i': idx})
+    np.testing.assert_allclose(r[0], a[idx])
+    want = a.copy(); want[idx] = 100.0
+    np.testing.assert_allclose(r[1], want)
+    np.testing.assert_allclose(r[2], a[idx])
+
+
+def test_fill_arange_linspace_eye_diag():
+    def build():
+        return [T.fill_constant([2, 3], 'float32', 2.5),
+                T.range(0, 10, 2, 'int64'),
+                T.linspace(0.0, 1.0, 5, 'float32'),
+                T.eye(3, 4),
+                T.diag(T.fill_constant([3], 'float32', 7.0)),
+                T.ones([2, 2], 'float32'), T.zeros([2], 'int64')]
+    r = run_prog(build, {})
+    np.testing.assert_allclose(r[0], np.full((2, 3), 2.5, 'float32'))
+    np.testing.assert_allclose(r[1], np.arange(0, 10, 2))
+    np.testing.assert_allclose(r[2], np.linspace(0, 1, 5), rtol=1e-6)
+    np.testing.assert_allclose(r[3], np.eye(3, 4))
+    np.testing.assert_allclose(r[4], np.diag([7.0] * 3))
+    np.testing.assert_allclose(r[5], np.ones((2, 2)))
+    np.testing.assert_allclose(r[6], np.zeros(2))
+
+
+def test_argminmax_topk_argsort_unique():
+    a = np.array([[3., 1., 2.], [0., 5., 4.]], 'float32')
+
+    def build():
+        x = feed_var('am_a', a)
+        tv, ti = L.topk(x, k=2)
+        return [L.argmax(x, axis=1), L.argmin(x, axis=0), tv, ti,
+                L.argsort(x, axis=1)[0]
+                if isinstance(L.argsort(x, axis=1), tuple) else
+                T.argsort(x, axis=1)]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = feed_var('am_a', a)
+        am = L.argmax(x, axis=1)
+        an = L.argmin(x, axis=0)
+        tv, ti = L.topk(x, k=2)
+    exe = fluid.Executor()
+    exe.run(startup)
+    r = exe.run(main, feed={'am_a': a}, fetch_list=[am, an, tv, ti])
+    np.testing.assert_allclose(r[0], [0, 1])
+    np.testing.assert_allclose(r[1], [1, 0, 0])
+    np.testing.assert_allclose(r[2], [[3., 2.], [5., 4.]])
+    np.testing.assert_allclose(r[3], [[0, 2], [1, 2]])
+
+
+def test_where_cond_and_masking():
+    c = np.array([[True, False], [False, True]])
+    a = np.ones((2, 2), 'float32')
+    b = np.zeros((2, 2), 'float32')
+
+    def build():
+        cv = feed_var('wh_c', c)
+        x, y = feed_var('wh_a', a), feed_var('wh_b', b)
+        return L.where(cv, x, y)
+    r, = run_prog(build, {'wh_c': c, 'wh_a': a, 'wh_b': b})
+    np.testing.assert_allclose(r, np.where(c, a, b))
+
+
+def test_cast_one_hot_label_smooth():
+    ids = np.array([0, 2, 1], 'int64')
+
+    def build():
+        i = feed_var('oh_i', ids)
+        oh = L.one_hot(i, 4)
+        return [oh, T.cast(i, 'float32'),
+                L.label_smooth(oh, epsilon=0.1)]
+    r = run_prog(build, {'oh_i': ids})
+    want = np.eye(4)[ids]
+    np.testing.assert_allclose(r[0], want)
+    np.testing.assert_allclose(r[1], ids.astype('float32'))
+    np.testing.assert_allclose(r[2], want * 0.9 + 0.1 / 4, rtol=1e-5)
+
+
+# ------------------------------------------------------------------ nn ----
+
+def test_fc_value():
+    a = RNG.rand(3, 4).astype('float32')
+
+    def build():
+        x = feed_var('fc_a', a)
+        return L.fc(x, 2, param_attr=fluid.ParamAttr(
+            name='fcv_w',
+            initializer=fluid.initializer.ConstantInitializer(0.5)),
+            bias_attr=fluid.ParamAttr(
+                name='fcv_b',
+                initializer=fluid.initializer.ConstantInitializer(1.0)))
+    r, = run_prog(build, {'fc_a': a})
+    np.testing.assert_allclose(r, a @ np.full((4, 2), 0.5) + 1.0, rtol=1e-5)
+
+
+def test_conv2d_value_identity_kernel():
+    a = RNG.rand(1, 1, 4, 4).astype('float32')
+
+    def build():
+        x = feed_var('cv_a', a)
+        return L.conv2d(x, 1, 1, param_attr=fluid.ParamAttr(
+            name='cv_w',
+            initializer=fluid.initializer.ConstantInitializer(1.0)),
+            bias_attr=False)
+    r, = run_prog(build, {'cv_a': a})
+    np.testing.assert_allclose(r, a, rtol=1e-5)
+
+
+def test_pool2d_avg_and_max():
+    a = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+
+    def build():
+        x = feed_var('pl_a', a)
+        return [L.pool2d(x, 2, 'max', pool_stride=2),
+                L.pool2d(x, 2, 'avg', pool_stride=2),
+                L.adaptive_pool2d(x, [1, 1], 'avg')]
+    r = run_prog(build, {'pl_a': a})
+    np.testing.assert_allclose(r[0][0, 0], [[5, 7], [13, 15]])
+    np.testing.assert_allclose(r[1][0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    np.testing.assert_allclose(r[2][0, 0], [[7.5]])
+
+
+def test_norm_layers_values():
+    a = RNG.rand(4, 6).astype('float32')
+
+    def build():
+        x = feed_var('ln_a', a)
+        return [L.layer_norm(x), L.softmax(x), L.l2_normalize(x, axis=1)]
+    r = run_prog(build, {'ln_a': a})
+    mu, var = a.mean(1, keepdims=True), a.var(1, keepdims=True)
+    np.testing.assert_allclose(r[0], (a - mu) / np.sqrt(var + 1e-5),
+                               rtol=1e-4, atol=1e-4)
+    e = np.exp(a - a.max(1, keepdims=True))
+    np.testing.assert_allclose(r[1], e / e.sum(1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(
+        r[2], a / np.sqrt((a * a).sum(1, keepdims=True)), rtol=1e-5)
+
+
+def test_batch_norm_inference_stats():
+    a = RNG.rand(8, 3).astype('float32')
+
+    def build():
+        x = feed_var('bn_a', a)
+        return L.batch_norm(x, is_test=False)
+    r, = run_prog(build, {'bn_a': a})
+    mu, var = a.mean(0), a.var(0)
+    np.testing.assert_allclose(r, (a - mu) / np.sqrt(var + 1e-5),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_dropout_test_mode_and_train_mask():
+    a = np.ones((64, 64), 'float32')
+
+    def build():
+        x = feed_var('dp_a', a)
+        return [L.dropout(x, 0.5, is_test=True),
+                L.dropout(x, 0.5, is_test=True,
+                          dropout_implementation='upscale_in_train'),
+                L.dropout(x, 0.5, is_test=False,
+                          dropout_implementation='upscale_in_train')]
+    r = run_prog(build, {'dp_a': a})
+    # default 'downgrade_in_infer': inference multiplies by (1-p)
+    np.testing.assert_allclose(r[0], a * 0.5)
+    # upscale_in_train: inference is identity
+    np.testing.assert_allclose(r[1], a)
+    kept = np.count_nonzero(r[2]) / r[2].size
+    assert 0.3 < kept < 0.7                    # ~half kept
+    nz = r[2][r[2] != 0]
+    np.testing.assert_allclose(nz, 2.0, rtol=1e-5)   # upscaled
+
+
+def test_embedding_and_padding_idx():
+    ids = np.array([0, 1, 2], 'int64')
+
+    def build():
+        i = feed_var('em_i', ids)
+        return L.embedding(i, size=[4, 3], padding_idx=1,
+                           param_attr=fluid.ParamAttr(
+                               name='em_w',
+                               initializer=fluid.initializer
+                               .ConstantInitializer(2.0)))
+    r, = run_prog(build, {'em_i': ids})
+    np.testing.assert_allclose(r[0], [2, 2, 2])
+    np.testing.assert_allclose(r[1], [0, 0, 0])   # padding_idx row zeroed
+    np.testing.assert_allclose(r[2], [2, 2, 2])
+
+
+def test_interpolate_nearest_and_bilinear():
+    a = np.arange(4, dtype='float32').reshape(1, 1, 2, 2)
+
+    def build():
+        x = feed_var('ip_a', a)
+        return [L.resize_nearest(x, out_shape=[4, 4]),
+                L.resize_bilinear(x, out_shape=[4, 4])]
+    r = run_prog(build, {'ip_a': a})
+    assert r[0].shape == (1, 1, 4, 4) and r[1].shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(r[0][0, 0, 0], [0, 0, 1, 1])
+    assert r[1].min() >= 0 and r[1].max() <= 3
+
+
+def test_pad_and_pad2d():
+    a = np.ones((1, 1, 2, 2), 'float32')
+
+    def build():
+        x = feed_var('pd_a', a)
+        return [L.pad(x, paddings=[0, 0, 0, 0, 1, 1, 1, 1], pad_value=5.0),
+                L.pad2d(x, paddings=[1, 1, 1, 1], mode='constant',
+                        pad_value=5.0)]
+    r = run_prog(build, {'pd_a': a})
+    for got in r:
+        assert got.shape == (1, 1, 4, 4)
+        assert got[0, 0, 0, 0] == 5.0 and got[0, 0, 1, 1] == 1.0
+
+
+def test_pixel_shuffle_and_space_to_depth():
+    a = np.arange(16, dtype='float32').reshape(1, 4, 2, 2)
+
+    def build():
+        x = feed_var('ps_a', a)
+        return L.pixel_shuffle(x, upscale_factor=2)
+    r, = run_prog(build, {'ps_a': a})
+    assert r.shape == (1, 1, 4, 4)
+    assert set(r.ravel()) == set(a.ravel())
+
+
+def test_unfold_im2col():
+    a = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+
+    def build():
+        x = feed_var('uf_a', a)
+        return L.unfold(x, kernel_sizes=[2, 2], strides=2)
+    r, = run_prog(build, {'uf_a': a})
+    assert r.shape == (1, 4, 4)
+    np.testing.assert_allclose(sorted(r.ravel()), sorted(a.ravel()))
+
+
+def test_maxout_and_prelu():
+    a = np.array([[-1., 2., -3., 4.]], 'float32')
+
+    def build():
+        x = feed_var('mo_a', a)
+        return [L.maxout(x, groups=2, axis=1),
+                L.prelu(x, mode='all', param_attr=fluid.ParamAttr(
+                    name='pr_w',
+                    initializer=fluid.initializer
+                    .ConstantInitializer(0.25)))]
+    r = run_prog(build, {'mo_a': a})
+    np.testing.assert_allclose(r[0], [[2., 4.]])
+    np.testing.assert_allclose(r[1], [[-0.25, 2., -0.75, 4.]])
+
+
+def test_activation_values():
+    a = np.array([[-2., -0.5, 0.5, 2.]], 'float32')
+
+    def build():
+        x = feed_var('ac_a', a)
+        return [L.relu(x), L.relu6(x), L.leaky_relu(x, alpha=0.1),
+                L.elu(x), L.softsign(x), L.softplus(x), L.hard_swish(x),
+                L.swish(x), L.tanh(x), L.sigmoid(x)]
+    r = run_prog(build, {'ac_a': a})
+    np.testing.assert_allclose(r[0], np.maximum(a, 0))
+    np.testing.assert_allclose(r[1], np.clip(a, 0, 6))
+    np.testing.assert_allclose(r[2], np.where(a > 0, a, 0.1 * a), rtol=1e-6)
+    np.testing.assert_allclose(r[3], np.where(a > 0, a, np.exp(a) - 1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(r[4], a / (1 + np.abs(a)), rtol=1e-5)
+    np.testing.assert_allclose(r[5], np.log1p(np.exp(a)), rtol=1e-5)
+    np.testing.assert_allclose(
+        r[6], a * np.clip(a + 3, 0, 6) / 6, rtol=1e-5)
+    sig = 1 / (1 + np.exp(-a))
+    np.testing.assert_allclose(r[7], a * sig, rtol=1e-5)
+    np.testing.assert_allclose(r[8], np.tanh(a), rtol=1e-5)
+    np.testing.assert_allclose(r[9], sig, rtol=1e-5)
+
+
+# ---------------------------------------------------------------- loss ----
+
+def test_cross_entropy_and_softmax_ce():
+    logits = RNG.rand(4, 5).astype('float32')
+    labels = np.array([[1], [0], [4], [2]], 'int64')
+
+    def build():
+        x = feed_var('ce_x', logits)
+        y = feed_var('ce_y', labels)
+        sm = L.softmax(x)
+        return [L.cross_entropy(sm, y),
+                L.softmax_with_cross_entropy(x, y)]
+    r = run_prog(build, {'ce_x': logits, 'ce_y': labels})
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    want = -np.log(p[np.arange(4), labels[:, 0]])[:, None]
+    np.testing.assert_allclose(r[0], want, rtol=1e-4)
+    np.testing.assert_allclose(r[1], want, rtol=1e-4)
+
+
+def test_regression_losses():
+    x = RNG.rand(4, 3).astype('float32')
+    y = RNG.rand(4, 3).astype('float32')
+
+    def build():
+        a, b = feed_var('rl_x', x), feed_var('rl_y', y)
+        return [L.square_error_cost(a, b), L.mse_loss(a, b),
+                L.huber_loss(a, b, delta=0.1)]
+    r = run_prog(build, {'rl_x': x, 'rl_y': y})
+    np.testing.assert_allclose(r[0], (x - y) ** 2, rtol=1e-5)
+    np.testing.assert_allclose(r[1], ((x - y) ** 2).mean(), rtol=1e-5)
+    d = np.abs(x - y)
+    want = np.where(d <= 0.1, 0.5 * d * d, 0.1 * d - 0.005)
+    np.testing.assert_allclose(r[2], want, rtol=1e-4, atol=1e-6)
+
+
+def test_rank_and_margin_losses():
+    left = np.array([[0.8], [0.2]], 'float32')
+    right = np.array([[0.3], [0.7]], 'float32')
+    label = np.array([[1.0], [0.0]], 'float32')
+
+    def build():
+        lv = feed_var('rk_l', left)
+        rv = feed_var('rk_r', right)
+        lb = feed_var('rk_y', label)
+        return [L.rank_loss(lb, lv, rv),
+                L.margin_rank_loss(lb, lv, rv, margin=0.1)]
+    r = run_prog(build, {'rk_l': left, 'rk_r': right, 'rk_y': label})
+    assert r[0].shape[0] == 2 and np.isfinite(r[0]).all()
+    assert (r[1] >= 0).all()
+
+
+def test_kldiv_and_log_loss():
+    p = np.array([[0.2, 0.8], [0.6, 0.4]], 'float32')
+    q = np.array([[0.5, 0.5], [0.3, 0.7]], 'float32')
+
+    def build():
+        x = feed_var('kl_x', np.log(p))
+        t = feed_var('kl_t', q)
+        pr = feed_var('ll_p', p[:, :1])
+        lb = feed_var('ll_y', np.array([[1.], [0.]], 'float32'))
+        return [L.kldiv_loss(x, t, reduction='none'),
+                L.log_loss(pr, lb)]
+    r = run_prog(build, {'kl_x': np.log(p), 'kl_t': q,
+                         'll_p': p[:, :1],
+                         'll_y': np.array([[1.], [0.]], 'float32')})
+    np.testing.assert_allclose(r[0], q * (np.log(q) - np.log(p)),
+                               rtol=1e-3, atol=1e-4)
+    lab = np.array([[1.], [0.]])
+    eps = 1e-4   # the reference log_loss epsilon
+    want = -(lab * np.log(p[:, :1] + eps)
+             + (1 - lab) * np.log(1 - p[:, :1] + eps))
+    np.testing.assert_allclose(r[1], want, rtol=1e-5)
+
+
+def test_sigmoid_ce_and_focal_style():
+    x = RNG.randn(3, 4).astype('float32')
+    lab = (RNG.rand(3, 4) > 0.5).astype('float32')
+
+    def build():
+        xv = feed_var('sce_x', x)
+        lv = feed_var('sce_y', lab)
+        return L.sigmoid_cross_entropy_with_logits(xv, lv)
+    r, = run_prog(build, {'sce_x': x, 'sce_y': lab})
+    want = np.maximum(x, 0) - x * lab + np.log1p(np.exp(-np.abs(x)))
+    np.testing.assert_allclose(r, want, rtol=1e-4, atol=1e-5)
+
+
+def test_dice_and_bpr():
+    p = np.array([[0.8, 0.2], [0.3, 0.7]], 'float32')
+    lab = np.array([[0], [1]], 'int64')
+
+    def build():
+        pv = feed_var('dc_p', p)
+        lv = feed_var('dc_y', lab)
+        return [L.dice_loss(pv, lv), L.bpr_loss(pv, lv)]
+    r = run_prog(build, {'dc_p': p, 'dc_y': lab})
+    assert np.isfinite(r[0]).all() and np.isfinite(r[1]).all()
+    assert (r[1] > 0).all()
+
+
+# ------------------------------------------------------------- compare ----
+
+def test_compare_ops_values():
+    a = np.array([1., 2., 3.], 'float32')
+    b = np.array([2., 2., 2.], 'float32')
+
+    def build():
+        x, y = feed_var('cp_a', a), feed_var('cp_b', b)
+        return [L.equal(x, y), L.not_equal(x, y), L.less_than(x, y),
+                L.less_equal(x, y), L.greater_than(x, y),
+                L.greater_equal(x, y)]
+    r = run_prog(build, {'cp_a': a, 'cp_b': b})
+    np.testing.assert_array_equal(r[0], a == b)
+    np.testing.assert_array_equal(r[1], a != b)
+    np.testing.assert_array_equal(r[2], a < b)
+    np.testing.assert_array_equal(r[3], a <= b)
+    np.testing.assert_array_equal(r[4], a > b)
+    np.testing.assert_array_equal(r[5], a >= b)
+
+
+# ------------------------------------------------------ misc nn extras ----
+
+def test_cos_sim_and_bilinear():
+    a = RNG.rand(3, 4).astype('float32')
+    b = RNG.rand(3, 4).astype('float32')
+
+    def build():
+        x, y = feed_var('cs2_a', a), feed_var('cs2_b', b)
+        return L.cos_sim(x, y)
+    r, = run_prog(build, {'cs2_a': a, 'cs2_b': b})
+    want = (a * b).sum(1) / (np.linalg.norm(a, axis=1)
+                             * np.linalg.norm(b, axis=1))
+    np.testing.assert_allclose(r.ravel(), want, rtol=1e-4)
+
+
+def test_multiplex_and_sums():
+    a = np.ones((3, 2), 'float32')
+    b = np.full((3, 2), 2.0, 'float32')
+    idx = np.array([[0], [1], [0]], 'int32')
+
+    def build():
+        x, y = feed_var('mx_a', a), feed_var('mx_b', b)
+        i = feed_var('mx_i', idx)
+        return [L.multiplex([x, y], i), T.sums([x, y])]
+    r = run_prog(build, {'mx_a': a, 'mx_b': b, 'mx_i': idx})
+    np.testing.assert_allclose(r[0], [[1, 1], [2, 2], [1, 1]])
+    np.testing.assert_allclose(r[1], a + b)

@@ -82,6 +82,7 @@ GRAD_SPECS = {
     'atan': S(_std((3, 4))),
     'brelu': S(lambda r: [pos(r, (3, 4), 1.0, 5.0)]),
     'cos': S(_std((3, 4))),
+    'cumsum': S(_std((3, 4)), attrs={'axis': 1}),
     'cosh': S(_std((3, 4))),
     'elu': S(lambda r: [away(r, (3, 4))]),
     'erf': S(_std((3, 4))),
