@@ -1,0 +1,108 @@
+"""Seq2seq with the contrib decoder API: teacher-forced training via
+TrainingDecoder, inference via BeamSearchDecoder — the reference's
+machine-translation recipe (ref: contrib/decoder/beam_search_decoder.py)
+on a toy cyclic language.
+
+Run: python examples/train_seq2seq_decoder.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+import paddle_tpu as fluid                                    # noqa: E402
+from paddle_tpu import contrib                                # noqa: E402
+
+V, D, H, B, T = 8, 6, 16, 16, 5
+W = 2          # beam width
+
+
+def cyclic_batch():
+    """Deterministic language: next token = (tok + 1) % V."""
+    starts = np.full((B,), 2, 'int64')
+    seq = np.stack([(starts + t) % V for t in range(T + 1)], 1)
+    return seq[:, :-1], seq[:, 1:]
+
+
+def gru_ish_updater(c):
+    w = c.get_input('w')
+    h = c.get_state('h')
+    new_h = fluid.layers.fc(
+        fluid.layers.concat([w, h], axis=1), H, act='tanh',
+        param_attr=fluid.ParamAttr(name='dec_w'), bias_attr=False)
+    c.set_state('h', new_h)
+
+
+def main():
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        src = fluid.data('src', [B, T], 'int64')
+        trg = fluid.data('trg', [B, T], 'int64')
+        emb = fluid.layers.embedding(
+            src, size=[V, D], param_attr=fluid.ParamAttr(name='emb_w'))
+        h0 = fluid.layers.fill_constant([B, H], 'float32', 0.0)
+        cell = contrib.StateCell(inputs={'w': None},
+                                 states={'h': contrib.InitState(init=h0)},
+                                 out_state='h')
+        cell.state_updater(gru_ish_updater)
+        decoder = contrib.TrainingDecoder(cell)
+        with decoder.block():
+            w = decoder.step_input(emb)
+            cell.compute_state(inputs={'w': w})
+            cell.update_states()
+            decoder.output(cell.get_state('h'))
+        hidden = decoder()
+        logits = fluid.layers.fc(
+            hidden, V, num_flatten_dims=2,
+            param_attr=fluid.ParamAttr(name='out_w'),
+            bias_attr=fluid.ParamAttr(name='out_b'))
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(
+                logits, fluid.layers.unsqueeze(trg, axes=[2])))
+        fluid.optimizer.Adam(0.02).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    X, Y = cyclic_batch()
+    for step in range(120):
+        val, = exe.run(main_prog, feed={'src': X, 'trg': Y},
+                       fetch_list=[loss])
+        if step % 30 == 0 or step == 119:
+            print(f'step {step:3d}  loss {float(val):.4f}')
+
+    # --- beam-search inference with the same state updater ---
+    infer, infer_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(infer, infer_startup):
+        bh0 = fluid.data('bh0', [2, H], 'float32')
+        init_ids = fluid.data('bids', [2, 1], 'int64')
+        init_scores = fluid.data('bscores', [2, 1], 'float32')
+        c2 = contrib.StateCell(inputs={'w': None},
+                               states={'h': contrib.InitState(init=bh0)},
+                               out_state='h')
+        c2.state_updater(gru_ish_updater)
+        bsd = contrib.BeamSearchDecoder(
+            c2, init_ids, init_scores, target_dict_dim=V, word_dim=D,
+            topk_size=V, max_len=T, beam_size=W, end_id=V + 100)
+        bsd.decode()
+        ids, scores = bsd()
+    # the infer startup would re-init the shared 'dec_w' — snapshot the
+    # trained value and restore it (the load_params idiom, inlined)
+    trained_dec_w = np.asarray(fluid.global_scope().find('dec_w'))
+    exe.run(infer_startup)
+    fluid.global_scope().set('dec_w', trained_dec_w)
+    out_ids, out_scores = exe.run(
+        infer, feed={'bh0': np.zeros((2, H), 'float32'),
+                     'bids': np.full((2, 1), 2, 'int64'),
+                     'bscores': np.zeros((2, 1), 'float32')},
+        fetch_list=[ids, scores])
+    # (the search shares the trained recurrence; its own embedding/output
+    # projection are decode()-built — as in the reference — so this
+    # demonstrates the machinery, not a trained translator)
+    print('beam 0 decode from token 2:', out_ids[0, 0].tolist(),
+          f'(score {float(out_scores[0, 0]):.2f})')
+
+
+if __name__ == '__main__':
+    main()

@@ -74,6 +74,7 @@ from . import transpiler
 from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
                          memory_optimize, release_memory)
 from . import incubate
+from . import utils
 
 # `import paddle_tpu.fluid as fluid` parity: fluid IS this module's namespace.
 import sys as _sys

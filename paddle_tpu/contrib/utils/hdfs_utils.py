@@ -119,7 +119,9 @@ class HDFSClient:
     def upload(self, hdfs_path, local_path, overwrite=False, retry_times=5):
         """ref :upload — local → hdfs."""
         if _have_hadoop(self.hadoop_home):
-            return self._run_hadoop('-put', '-f', local_path, hdfs_path)
+            args = ['-put'] + (['-f'] if overwrite else []) \
+                + [local_path, hdfs_path]
+            return self._run_hadoop(*args)
         dst = self._local(hdfs_path)
         if os.path.exists(dst) and not overwrite:
             return False
@@ -139,6 +141,8 @@ class HDFSClient:
             return self._run_hadoop('-get', hdfs_path, local_path)
         src = self._local(hdfs_path)
         if not os.path.exists(src):
+            return False
+        if os.path.exists(local_path) and not overwrite:
             return False
         os.makedirs(os.path.dirname(local_path) or '.', exist_ok=True)
         if os.path.isdir(src):

@@ -30,6 +30,11 @@ class Initializer:
         raise NotImplementedError
 
     def _key(self, key):
+        # a nonzero per-initializer seed pins the stream (ref semantics:
+        # seed=0 defers to the global random seed)
+        seed = getattr(self, 'seed', 0)
+        if seed:
+            return jax.random.PRNGKey(seed)
         return key if key is not None else default_generator.next_key()
 
 
@@ -44,6 +49,7 @@ class ConstantInitializer(Initializer):
 class UniformInitializer(Initializer):
     def __init__(self, low=-1.0, high=1.0, seed=0):
         self.low, self.high = low, high
+        self.seed = seed
 
     def compute(self, shape, dtype, key=None):
         return jax.random.uniform(self._key(key), tuple(shape),
@@ -53,6 +59,7 @@ class UniformInitializer(Initializer):
 class NormalInitializer(Initializer):
     def __init__(self, loc=0.0, scale=1.0, seed=0):
         self.loc, self.scale = loc, scale
+        self.seed = seed
 
     def compute(self, shape, dtype, key=None):
         return self.loc + self.scale * jax.random.normal(
@@ -62,6 +69,7 @@ class NormalInitializer(Initializer):
 class TruncatedNormalInitializer(Initializer):
     def __init__(self, loc=0.0, scale=1.0, seed=0):
         self.loc, self.scale = loc, scale
+        self.seed = seed
 
     def compute(self, shape, dtype, key=None):
         return self.loc + self.scale * jax.random.truncated_normal(
@@ -86,6 +94,7 @@ class XavierInitializer(Initializer):
 
     def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
         self.uniform, self.fan_in, self.fan_out = uniform, fan_in, fan_out
+        self.seed = seed
 
     def compute(self, shape, dtype, key=None):
         fi, fo = _fans(shape)
@@ -105,6 +114,7 @@ class MSRAInitializer(Initializer):
 
     def __init__(self, uniform=True, fan_in=None, seed=0):
         self.uniform, self.fan_in = uniform, fan_in
+        self.seed = seed
 
     def compute(self, shape, dtype, key=None):
         fi, _ = _fans(shape)

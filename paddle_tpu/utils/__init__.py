@@ -1,0 +1,6 @@
+"""paddle.utils parity (ref: python/paddle/utils/): training-curve Ploter
+and env-config dump."""
+from .plot import Ploter, PlotData
+from .dump_config import dump_config
+
+__all__ = ['Ploter', 'PlotData', 'dump_config']

@@ -1,0 +1,19 @@
+"""Config/env dump (ref: python/paddle/utils/dump_config.py) — prints the
+effective runtime configuration for bug reports."""
+import os
+import sys
+
+__all__ = ['dump_config']
+
+
+def dump_config():
+    """Print python/jax/devices/env configuration."""
+    import jax
+    print('python:', sys.version.split()[0])
+    print('jax:', jax.__version__)
+    print('backend:', jax.default_backend())
+    for d in jax.devices():
+        print('device:', d.id, getattr(d, 'device_kind', ''))
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(('PADDLE_', 'JAX_', 'XLA_', 'TPU_')):
+            print(f'{k}={v}')

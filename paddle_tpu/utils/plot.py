@@ -1,0 +1,79 @@
+"""Training-curve plotting (ref: python/paddle/utils/plot.py).
+
+Headless-safe: with matplotlib available it renders (Agg backend off-tty),
+otherwise it still records data and `plot(path)` writes a CSV next to the
+requested path so curves are never lost."""
+import os
+
+__all__ = ['Ploter', 'PlotData']
+
+
+class PlotData:
+    """ref plot.py:20 — one curve's (step, value) series."""
+
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter:
+    """ref plot.py:33 — multi-curve live plot:
+
+        ploter = Ploter('train cost', 'test cost')
+        ploter.append('train cost', step, loss)
+        ploter.plot('curve.png')
+    """
+
+    def __init__(self, *args):
+        self.__args__ = args
+        self.__plot_data__ = {title: PlotData() for title in args}
+        self.__disable_plot__ = os.environ.get('DISABLE_PLOT', 'False')
+
+    def __plot_is_disabled__(self):
+        return self.__disable_plot__ == 'True'
+
+    def append(self, title, step, value):
+        """ref plot.py:62."""
+        if title not in self.__plot_data__:
+            raise ValueError(f'{title} is not a curve of this Ploter '
+                             f'(curves: {list(self.__plot_data__)})')
+        self.__plot_data__[title].append(step, value)
+
+    def plot(self, path=None):
+        """ref plot.py:82 — render to `path` (or show); falls back to a
+        CSV dump when matplotlib is unavailable."""
+        if self.__plot_is_disabled__():
+            return
+        try:
+            import matplotlib
+            matplotlib.use('Agg')
+            import matplotlib.pyplot as plt
+            titles = []
+            for title in self.__args__:
+                data = self.__plot_data__[title]
+                if len(data.step) > 0:
+                    titles.append(title)
+                    plt.plot(data.step, data.value)
+            plt.legend(titles, loc='upper left')
+            if path is not None:
+                plt.savefig(path)
+            plt.clf()
+        except ImportError:
+            if path is not None:
+                with open(str(path) + '.csv', 'w') as f:
+                    for title in self.__args__:
+                        data = self.__plot_data__[title]
+                        for s, v in zip(data.step, data.value):
+                            f.write(f'{title},{s},{v}\n')
+
+    def reset(self):
+        for data in self.__plot_data__.values():
+            data.reset()

@@ -1,0 +1,108 @@
+"""Collective primitives on the 8-device CPU mesh (SURVEY §2.2 c_* ops):
+numeric parity vs numpy reductions under shard_map."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from paddle_tpu.parallel import collective as C
+
+
+@pytest.fixture(scope='module')
+def mesh8():
+    devs = np.array(jax.devices()[:8])
+    return Mesh(devs, ('dp',))
+
+
+def _smap(mesh, fn, in_spec=P('dp'), out_spec=P('dp')):
+    return shard_map(fn, mesh=mesh, in_specs=(in_spec,),
+                     out_specs=out_spec)
+
+
+def test_allreduce_family(mesh8):
+    x = np.arange(8, dtype='float32') + 1.0        # one scalar per device
+
+    def body(v):
+        v = v.reshape(())
+        return jnp.stack([C.allreduce_sum(v), C.allreduce_mean(v),
+                          C.allreduce_max(v), C.allreduce_min(v)])[None]
+
+    out = _smap(mesh8, body)(x)                     # (8, 4)
+    np.testing.assert_allclose(out[0], [x.sum(), x.mean(), 8.0, 1.0])
+    np.testing.assert_allclose(out, np.tile(out[0], (8, 1)))
+
+
+def test_c_allreduce_prod_and_named_ops(mesh8):
+    x = np.full(8, 2.0, 'float32')
+
+    def body(v):
+        v = v.reshape(())
+        return jnp.stack([
+            C.c_allreduce_sum(v), C.c_allreduce_prod(v),
+            C.c_allreduce_max(v), C.c_allreduce_min(v)])[None]
+    out = _smap(mesh8, body)(x)
+    np.testing.assert_allclose(out[0], [16.0, 256.0, 2.0, 2.0])
+
+
+def test_allgather_and_reduce_scatter(mesh8):
+    x = np.arange(8, dtype='float32')
+
+    def gather_body(v):
+        return C.allgather(v.reshape(()))[None]
+    g = _smap(mesh8, gather_body, out_spec=P('dp', None))(x)
+    np.testing.assert_allclose(np.asarray(g)[0], x)
+
+    xs = np.tile(np.arange(8, dtype='float32'), (8, 1))  # every dev holds 0..7
+
+    def rs_body(v):
+        return C.reduce_scatter(v.reshape(-1))[None]
+    r = _smap(mesh8, rs_body)(xs)
+    # psum_scatter: device i gets sum over devices of shard i = 8 * i
+    np.testing.assert_allclose(np.asarray(r).ravel(),
+                               8.0 * np.arange(8))
+
+
+def test_broadcast_root_value(mesh8):
+    x = np.arange(8, dtype='float32') * 10
+
+    def body(v):
+        return C.broadcast(v.reshape(()), root=3)[None]
+    out = _smap(mesh8, body)(x)
+    np.testing.assert_allclose(out, 30.0)
+
+
+def test_ppermute_ring_shift(mesh8):
+    x = np.arange(8, dtype='float32')
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+
+    def body(v):
+        return C.ppermute(v.reshape(()), perm)[None]
+    out = _smap(mesh8, body)(x)
+    np.testing.assert_allclose(np.asarray(out).ravel(),
+                               np.roll(x, 1))
+
+
+def test_alltoall_transpose(mesh8):
+    # each device holds row i; after all-to-all each device holds column i
+    x = np.arange(64, dtype='float32').reshape(8, 8)
+
+    def body(v):
+        return C.alltoall(v[0])[None]      # (8,) exchange → (8,)
+    out = _smap(mesh8, body, in_spec=P('dp', None),
+                out_spec=P('dp', None))(x)
+    np.testing.assert_allclose(np.asarray(out), x.T)
+
+
+def test_barrier_and_sync_shims(mesh8):
+    x = np.ones(8, 'float32')
+
+    def body(v):
+        C.barrier('dp')
+        v = C.c_sync_calc_stream(v)
+        v = C.c_sync_comm_stream(v)
+        return v
+    out = _smap(mesh8, body)(x)
+    np.testing.assert_allclose(out, x)

@@ -1,0 +1,91 @@
+"""Initializer statistics/values (ref test model: unittests/
+test_initializer.py) — each initializer drives a parameter in a startup
+program; properties checked on the realized array."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import initializer as I
+
+
+def _init_param(init, shape, name):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fluid.layers.create_parameter(
+            shape, 'float32', name=name,
+            attr=fluid.ParamAttr(name=name, initializer=init))
+    exe = fluid.Executor()
+    exe.run(startup)
+    return np.asarray(fluid.global_scope().find(name))
+
+
+def test_constant():
+    w = _init_param(I.ConstantInitializer(3.25), [4, 5], 'ini_const')
+    np.testing.assert_allclose(w, 3.25)
+
+
+def test_uniform_range_and_spread():
+    w = _init_param(I.UniformInitializer(low=-0.3, high=0.7, seed=1),
+                    [200, 50], 'ini_unif')
+    assert w.min() >= -0.3 and w.max() <= 0.7
+    np.testing.assert_allclose(w.mean(), 0.2, atol=0.02)
+
+
+def test_normal_stats():
+    w = _init_param(I.NormalInitializer(loc=1.0, scale=0.5, seed=2),
+                    [300, 40], 'ini_norm')
+    np.testing.assert_allclose(w.mean(), 1.0, atol=0.02)
+    np.testing.assert_allclose(w.std(), 0.5, atol=0.02)
+
+
+def test_truncated_normal_bounds():
+    w = _init_param(I.TruncatedNormalInitializer(loc=0.0, scale=1.0, seed=3),
+                    [200, 50], 'ini_trunc')
+    assert np.abs(w).max() <= 2.0 + 1e-5     # truncated at 2 std
+    np.testing.assert_allclose(w.mean(), 0.0, atol=0.02)
+
+
+def test_xavier_uniform_bound():
+    fan_in, fan_out = 80, 120
+    w = _init_param(I.XavierInitializer(uniform=True, seed=4),
+                    [fan_in, fan_out], 'ini_xav')
+    limit = np.sqrt(6.0 / (fan_in + fan_out))
+    assert np.abs(w).max() <= limit + 1e-6
+    assert w.std() == pytest.approx(limit / np.sqrt(3), rel=0.1)
+
+
+def test_xavier_normal_std():
+    fan_in, fan_out = 100, 100
+    w = _init_param(I.XavierInitializer(uniform=False, seed=5),
+                    [fan_in, fan_out], 'ini_xavn')
+    assert w.std() == pytest.approx(np.sqrt(2.0 / (fan_in + fan_out)),
+                                    rel=0.1)
+
+
+def test_msra_std():
+    fan_in = 90
+    w = _init_param(I.MSRAInitializer(uniform=False, seed=6),
+                    [fan_in, 110], 'ini_msra')
+    assert w.std() == pytest.approx(np.sqrt(2.0 / fan_in), rel=0.1)
+
+
+def test_bilinear_upsampling_kernel():
+    # (C_out, C_in, k, k) deconv kernel: center-peaked, symmetric
+    w = _init_param(I.BilinearInitializer(), [2, 2, 4, 4], 'ini_bil')
+    k = w[0, 0]
+    np.testing.assert_allclose(k, k[::-1, ::-1], rtol=1e-6)   # symmetric
+    assert k.max() == k[1:3, 1:3].max()                       # center peak
+
+
+def test_numpy_array():
+    arr = np.arange(6, dtype='float32').reshape(2, 3)
+    w = _init_param(I.NumpyArrayInitializer(arr), [2, 3], 'ini_np')
+    np.testing.assert_allclose(w, arr)
+
+
+def test_seed_determinism():
+    w1 = _init_param(I.UniformInitializer(seed=42), [10, 10], 'ini_s1')
+    w2 = _init_param(I.UniformInitializer(seed=42), [10, 10], 'ini_s2')
+    w3 = _init_param(I.UniformInitializer(seed=43), [10, 10], 'ini_s3')
+    np.testing.assert_allclose(w1, w2)
+    assert not np.allclose(w1, w3)

@@ -208,29 +208,30 @@ def test_fill_arange_linspace_eye_diag():
     np.testing.assert_allclose(r[6], np.zeros(2))
 
 
-def test_argminmax_topk_argsort_unique():
+def test_argminmax_topk_argsort():
     a = np.array([[3., 1., 2.], [0., 5., 4.]], 'float32')
-
-    def build():
-        x = feed_var('am_a', a)
-        tv, ti = L.topk(x, k=2)
-        return [L.argmax(x, axis=1), L.argmin(x, axis=0), tv, ti,
-                L.argsort(x, axis=1)[0]
-                if isinstance(L.argsort(x, axis=1), tuple) else
-                T.argsort(x, axis=1)]
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = feed_var('am_a', a)
         am = L.argmax(x, axis=1)
         an = L.argmin(x, axis=0)
         tv, ti = L.topk(x, k=2)
+        srt = T.argsort(x, axis=1)
     exe = fluid.Executor()
     exe.run(startup)
-    r = exe.run(main, feed={'am_a': a}, fetch_list=[am, an, tv, ti])
+    srt_fetch = list(srt) if isinstance(srt, (list, tuple)) else [srt]
+    r = exe.run(main, feed={'am_a': a},
+                fetch_list=[am, an, tv, ti] + srt_fetch)
     np.testing.assert_allclose(r[0], [0, 1])
     np.testing.assert_allclose(r[1], [1, 0, 0])
     np.testing.assert_allclose(r[2], [[3., 2.], [5., 4.]])
     np.testing.assert_allclose(r[3], [[0, 2], [1, 2]])
+    # argsort: sorted values first (ref returns (sorted, indices))
+    np.testing.assert_allclose(np.asarray(r[4]),
+                               np.sort(a, axis=1))
+    if len(r) > 5:
+        np.testing.assert_allclose(np.asarray(r[5]),
+                                   np.argsort(a, axis=1))
 
 
 def test_where_cond_and_masking():
