@@ -109,7 +109,9 @@ def bench_bert(on_tpu):
         cfg = BertConfig(attention_probs_dropout_prob=0.0,
                          hidden_dropout_prob=0.0,
                          max_position_embeddings=128)
-        batch, seq, iters = 64, 128, 20
+        # bs sweep on v5e (PERF.md §7): 32/64/128/256 →
+        # 1022/1270/1294/1172 seq/s — 128 is the knee
+        batch, seq, iters = 128, 128, 20
     else:
         cfg = BertConfig.tiny()
         batch, seq, iters = 4, 32, 2

@@ -56,12 +56,11 @@ def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
 
 def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
                        act='sigmoid', pool_type='max', bias_attr=None):
-    from .layers import sequence as seq_layers
-    conv_out = seq_layers.sequence_conv(input, num_filters=num_filters,
-                                        filter_size=filter_size,
-                                        param_attr=param_attr, act=act,
-                                        bias_attr=bias_attr)
-    return seq_layers.sequence_pool(conv_out, pool_type=pool_type)
+    conv_out = layers.sequence_conv(input, num_filters=num_filters,
+                                    filter_size=filter_size,
+                                    param_attr=param_attr, act=act,
+                                    bias_attr=bias_attr)
+    return layers.sequence_pool(conv_out, pool_type=pool_type)
 
 
 def glu(input, dim=-1):

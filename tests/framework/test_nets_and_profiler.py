@@ -1,0 +1,104 @@
+"""nets.py composites + profiler op-timer surface (ref test model:
+unittests/test_nets.py, test_profiler.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import nets, profiler
+
+RNG = np.random.RandomState(11)
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor()
+    exe.run(startup)
+    fetch = fetch if isinstance(fetch, (list, tuple)) else [fetch]
+    return exe.run(main, feed=feeds, fetch_list=list(fetch))
+
+
+def test_simple_img_conv_pool():
+    x = RNG.rand(2, 1, 8, 8).astype('float32')
+
+    def build():
+        xv = fluid.data('ni_x', [2, 1, 8, 8], 'float32')
+        return nets.simple_img_conv_pool(xv, num_filters=4, filter_size=3,
+                                         pool_size=2, pool_stride=2,
+                                         act='relu')
+    r, = _run(build, {'ni_x': x})
+    # conv pad 0: 8→6; pool 2/2: 6→3
+    assert r.shape == (2, 4, 3, 3)
+    assert (r >= 0).all()
+
+
+def test_img_conv_group():
+    x = RNG.rand(2, 3, 8, 8).astype('float32')
+
+    def build():
+        xv = fluid.data('ig_x', [2, 3, 8, 8], 'float32')
+        return nets.img_conv_group(xv, conv_num_filter=[4, 4], pool_size=2,
+                                   pool_stride=2, conv_with_batchnorm=True)
+    r, = _run(build, {'ig_x': x})
+    # conv pad 1 keeps 8; pool 2/2: 8→4
+    assert r.shape == (2, 4, 4, 4)
+
+
+def test_sequence_conv_pool():
+    x = RNG.rand(3, 6, 8).astype('float32')
+
+    def build():
+        xv = fluid.data('sc_x', [3, 6, 8], 'float32')
+        return nets.sequence_conv_pool(xv, num_filters=5, filter_size=3)
+    r, = _run(build, {'sc_x': x})
+    assert r.shape == (3, 5)
+
+
+def test_glu_halves_dim():
+    x = RNG.rand(2, 6).astype('float32')
+
+    def build():
+        xv = fluid.data('gl_x', [2, 6], 'float32')
+        return nets.glu(xv, dim=-1)
+    r, = _run(build, {'gl_x': x})
+    a, b = x[:, :3], x[:, 3:]
+    np.testing.assert_allclose(r, a / (1 + np.exp(-b)), rtol=1e-5)
+
+
+def test_scaled_dot_product_attention():
+    q = RNG.rand(2, 4, 8).astype('float32')
+
+    def build():
+        qv = fluid.data('at_q', [2, 4, 8], 'float32')
+        kv = fluid.data('at_k', [2, 4, 8], 'float32')
+        vv = fluid.data('at_v', [2, 4, 8], 'float32')
+        return nets.scaled_dot_product_attention(qv, kv, vv, num_heads=2)
+    r, = _run(build, {'at_q': q, 'at_k': q, 'at_v': q})
+    assert r.shape == (2, 4, 8)
+    # attention over identical k/v rows is a convex combination: bounded
+    assert r.min() >= q.min() - 1e-5 and r.max() <= q.max() + 1e-5
+
+
+def test_profiler_records_and_reports(capsys):
+    profiler.reset_profiler()
+    profiler.start_profiler(state='CPU')
+    with profiler.record_event('my_region'):
+        x = np.zeros(10)
+        for _ in range(3):
+            x = x + 1
+    with profiler.record_event('my_region'):
+        pass
+    times = profiler.get_op_times()
+    assert 'my_region' in times and times['my_region'][0] == 2
+    profiler.stop_profiler(sorted_key='calls')
+    out = capsys.readouterr().out
+    assert 'my_region' in out
+    profiler.reset_profiler()
+    assert profiler.get_op_times() == {}
+
+
+def test_profiler_context_manager():
+    with profiler.profiler(state='CPU', sorted_key='total'):
+        with profiler.record_event('ctx_region'):
+            pass
