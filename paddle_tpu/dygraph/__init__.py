@@ -41,3 +41,26 @@ def start_gperf_profiler():
 def stop_gperf_profiler():
     from ..profiler import stop_profiler
     stop_profiler()
+
+
+from .base import Tracer  # noqa: E402  (the tracer guard() installs)
+
+
+# ref: fluid/dygraph/layer_object_helper.py — parameter-creation helper
+# bound to a Layer; the static LayerHelper serves both modes here.
+from ..layer_helper import LayerHelper as LayerObjectHelper  # noqa: E402
+
+
+def monkey_patch_varbase():
+    """ref: fluid/dygraph/varbase_patch_methods.py — attaches Tensor
+    methods (numpy/backward/gradient/detach). Already installed at import
+    (tape.monkey_patch_tensor); calling again is idempotent."""
+    from .tape import monkey_patch_tensor
+    monkey_patch_tensor()
+
+
+def monkey_patch_math_varbase():
+    """ref: fluid/dygraph/math_op_patch.py — math dunders on Tensor;
+    installed at import time (see monkey_patch_varbase)."""
+    from .tape import monkey_patch_tensor
+    monkey_patch_tensor()

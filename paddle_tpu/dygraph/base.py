@@ -9,10 +9,36 @@ from .. import framework
 from .tape import Tensor, no_grad, no_grad_guard
 
 
-class _Tracer:
-    """Marker object; framework.in_dygraph_mode() keys off its presence
-    (ref: the C++ imperative::Tracer held by framework._dygraph_tracer_)."""
-    pass
+class Tracer:
+    """ref: fluid/dygraph/tracer.py — the imperative op tracer, held by
+    framework._dygraph_tracer_ while dygraph mode is on. Tracing IS the
+    tape here (dygraph/tape.py): every dispatched op eagerly runs its jax
+    functional and records a vjp node; the class carries the reference's
+    train/eval flag and trace_op entry point."""
+
+    def __init__(self, block=None):
+        self._train_mode = True
+
+    def train_mode(self):
+        self._train_mode = True
+
+    def eval_mode(self):
+        self._train_mode = False
+
+    def trace_op(self, type, inputs, outputs=None, attrs=None,
+                 stop_gradient=False):
+        from .tape import dispatch_op
+        if stop_gradient:
+            with no_grad_guard():
+                out = dispatch_op(type, inputs, attrs or {})
+            for t in (out if isinstance(out, (list, tuple)) else [out]):
+                if hasattr(t, 'stop_gradient'):
+                    t.stop_gradient = True
+            return out
+        return dispatch_op(type, inputs, attrs or {})
+
+
+_Tracer = Tracer  # legacy internal alias
 
 
 def enabled():
@@ -20,7 +46,7 @@ def enabled():
 
 
 def enable_dygraph(place=None):
-    framework._dygraph_tracer_ = _Tracer()
+    framework._dygraph_tracer_ = Tracer()
 
 
 def disable_dygraph():

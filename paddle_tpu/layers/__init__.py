@@ -17,6 +17,9 @@ from .distributions import Categorical, MultivariateNormalDiag, Normal, Uniform
 from .detection import *  # noqa
 from . import detection
 from .math_op_patch import monkey_patch_variable
+from . import utils
+from .utils import (convert_to_list, is_sequence, map_structure,
+                    pack_sequence_as, assert_same_structure)
 
 monkey_patch_variable()
 

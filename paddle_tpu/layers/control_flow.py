@@ -97,27 +97,10 @@ def _sub_block(program):
         program._rollback()
 
 
-def _flatten(x):
-    if isinstance(x, (list, tuple)):
-        flat = []
-        for item in x:
-            flat.extend(_flatten(item))
-        return flat
-    return [x]
-
-
-def _pack_like(template, flat):
-    """Rebuild the nested structure of `template` from the flat list."""
-    it = iter(flat)
-
-    def rec(t):
-        if isinstance(t, tuple) and hasattr(t, '_fields'):   # namedtuple
-            return type(t)(*[rec(e) for e in t])
-        if isinstance(t, (list, tuple)):
-            return type(t)(rec(e) for e in t)
-        return next(it)
-
-    return rec(template)
+# one nest semantics repo-wide: layers/utils.py (dicts flatten by sorted
+# key, namedtuples/lists/tuples by position)
+from .utils import flatten as _flatten
+from .utils import pack_sequence_as as _pack_like
 
 
 def _parent_writes(blk):

@@ -32,13 +32,7 @@ __all__ = ['RNNCell', 'GRUCell', 'LSTMCell', 'rnn', 'birnn', 'dynamic_lstm',
 from .control_flow import _flatten, _pack_like as _pack
 
 
-def _map_structure(fn, *trees):
-    t0 = trees[0]
-    if isinstance(t0, tuple) and hasattr(t0, '_fields'):     # namedtuple
-        return type(t0)(*[_map_structure(fn, *elems) for elems in zip(*trees)])
-    if isinstance(t0, (list, tuple)):
-        return type(t0)(_map_structure(fn, *elems) for elems in zip(*trees))
-    return fn(*trees)
+from .utils import map_structure as _map_structure
 
 
 class RNNCell:

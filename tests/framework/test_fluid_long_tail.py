@@ -288,3 +288,36 @@ def test_debugger_reprs_and_nan_inf():
         fluid.debugger.run_fast_nan_inf_debug(
             exe, main, feed={'dx': -np.ones((2, 2), 'float32')},
             fetch_list=[out])
+
+
+# ----------------------------------------------------- layers.utils ----
+
+def test_layers_nest_utils():
+    u = fluid.layers.utils
+    assert u.convert_to_list(3, 2, 'k') == [3, 3]
+    assert u.convert_to_list([1, 2], 2, 'k') == [1, 2]
+    with pytest.raises(ValueError):
+        u.convert_to_list([1], 2, 'k')
+    nest = {'a': [1, (2, 3)], 'b': 4}
+    flat = u.flatten(nest)
+    assert flat == [1, 2, 3, 4]
+    rebuilt = u.pack_sequence_as(nest, [x * 10 for x in flat])
+    assert rebuilt == {'a': [10, (20, 30)], 'b': 40}
+    assert u.map_structure(lambda x: x + 1, nest) == \
+        {'a': [2, (3, 4)], 'b': 5}
+    u.assert_same_structure(nest, rebuilt)
+    with pytest.raises((ValueError, TypeError)):
+        u.assert_same_structure(nest, [1, 2, 3, 4])
+    assert u.is_sequence([1]) and not u.is_sequence('abc')
+
+
+def test_dygraph_tracer_and_patches():
+    from paddle_tpu import dygraph
+    t = dygraph.Tracer()
+    t.eval_mode(); t.train_mode()
+    dygraph.monkey_patch_varbase()
+    dygraph.monkey_patch_math_varbase()
+    with dygraph.guard():
+        out = t.trace_op('scale', {'x': dygraph.to_variable(
+            np.array([2.0], 'float32'))}, {}, {'scale': 3.0})
+        np.testing.assert_allclose(out.numpy(), [6.0])
