@@ -133,3 +133,64 @@ def test_fp16_loss_scaling_matches_unscaled_trajectory():
     base, amp = run(False), run(True)
     assert amp[-1] < amp[0]
     np.testing.assert_allclose(amp, base, rtol=0.1, atol=0.05)
+
+
+def test_dygraph_amp_decorate_trains():
+    """Dygraph decorate(): finite-check + skip/step bookkeeping wraps the
+    inner optimizer (the dygraph path is a fused finiteness gate — the
+    loss itself stays fp32; static mode owns the cast rewrite). Training
+    must proceed normally through the wrapper, and `incr_every_n_steps`
+    consecutive good steps must grow the dynamic scale."""
+    from paddle_tpu import dygraph
+    from paddle_tpu.contrib import mixed_precision as mp
+
+    fluid.manual_seed(7)
+    np.random.seed(0)
+    rng = np.random.RandomState(0)
+    X = rng.rand(32, 4).astype('float32')
+    W = np.array([[1.0], [-2.0], [0.5], [3.0]], 'float32')
+    Y = X @ W
+    with dygraph.guard():
+        model = dygraph.Linear(4, 1)
+        opt = mp.decorate(
+            fluid.optimizer.Adam(0.05,
+                                 parameter_list=model.parameters()),
+            init_loss_scaling=4.0, incr_every_n_steps=10,
+            incr_ratio=2.0, dtype='float16')
+        losses = []
+        for _ in range(40):
+            pred = model(dygraph.to_variable(X))
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(
+                    pred, dygraph.to_variable(Y)))
+            losses.append(float(loss.numpy()))
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+        assert losses[-1] < losses[0] * 0.3
+        # 40 good steps at incr_every=10 → scale doubled 4 times
+        assert opt.get_loss_scaling() == pytest.approx(4.0 * 2 ** 4)
+
+
+def test_dygraph_amp_skips_inf_and_decays_scale():
+    from paddle_tpu import dygraph
+    from paddle_tpu.contrib import mixed_precision as mp
+
+    with dygraph.guard():
+        model = dygraph.Linear(2, 1)
+        opt = mp.decorate(
+            fluid.optimizer.SGD(0.1, parameter_list=model.parameters()),
+            init_loss_scaling=4.0, decr_every_n_nan_or_inf=1,
+            dtype='float16')
+        w0 = np.asarray(model.parameters()[0].numpy()).copy()
+        x = dygraph.to_variable(
+            np.array([[1e30, 1e30]], 'float32'))   # 1e30*1e30 > fp32 max
+        pred = model(x)
+        loss = fluid.layers.reduce_mean(pred) * 1e30
+        s0 = opt.get_loss_scaling()
+        loss.backward()
+        opt.minimize(loss)
+        model.clear_gradients()
+        w1 = np.asarray(model.parameters()[0].numpy())
+        np.testing.assert_allclose(w0, w1)        # inf step skipped
+        assert opt.get_loss_scaling() < s0        # scale decayed

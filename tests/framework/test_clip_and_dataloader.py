@@ -1,0 +1,153 @@
+"""Static gradient clipping numerics + DataLoader iteration paths
+(ref test model: unittests/test_gradient_clip.py, test_dataloader_*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _sgd_step_with_clip(clip, lr=1.0):
+    """One SGD step on w (shape [3]) whose grad is exactly `g`; returns
+    (w_before - w_after) / lr = the applied (clipped) gradient."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data('cl_x', [1, 3], 'float32')
+        w = fluid.layers.create_parameter(
+            [3], 'float32', name='clip_w',
+            attr=fluid.ParamAttr(
+                name='clip_w',
+                initializer=fluid.initializer.ConstantInitializer(0.0)))
+        # loss = sum(x * w) → dL/dw = x
+        loss = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(
+                fluid.layers.reshape(x, shape=[3]), w))
+        if clip is not None:
+            fluid.clip.set_gradient_clip(clip, program=main)
+        fluid.optimizer.SGD(lr).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        g = np.array([[3.0, -4.0, 12.0]], 'float32')
+        w0 = np.asarray(fluid.global_scope().find('clip_w')).copy()
+        exe.run(main, feed={'cl_x': g}, fetch_list=[loss])
+        w1 = np.asarray(fluid.global_scope().find('clip_w'))
+    return (w0 - w1) / lr, g[0]
+
+
+def test_no_clip_baseline():
+    applied, g = _sgd_step_with_clip(None)
+    np.testing.assert_allclose(applied, g, rtol=1e-5)
+
+
+def test_clip_by_value():
+    applied, g = _sgd_step_with_clip(
+        fluid.clip.GradientClipByValue(max=2.0, min=-2.0))
+    np.testing.assert_allclose(applied, np.clip(g, -2, 2), rtol=1e-5)
+
+
+def test_clip_by_norm():
+    applied, g = _sgd_step_with_clip(fluid.clip.GradientClipByNorm(6.5))
+    norm = np.linalg.norm(g)          # 13
+    np.testing.assert_allclose(applied, g * 6.5 / norm, rtol=1e-4)
+    np.testing.assert_allclose(np.linalg.norm(applied), 6.5, rtol=1e-4)
+
+
+def test_clip_by_global_norm():
+    applied, g = _sgd_step_with_clip(
+        fluid.clip.GradientClipByGlobalNorm(1.3))
+    np.testing.assert_allclose(np.linalg.norm(applied), 1.3, rtol=1e-4)
+    # direction preserved
+    np.testing.assert_allclose(applied / np.linalg.norm(applied),
+                               g / np.linalg.norm(g), rtol=1e-4)
+
+
+def test_clip_below_threshold_is_identity():
+    applied, g = _sgd_step_with_clip(
+        fluid.clip.GradientClipByGlobalNorm(1000.0))
+    np.testing.assert_allclose(applied, g, rtol=1e-5)
+
+
+# -------------------------------------------------------- DataLoader ----
+
+def _loader_prog():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data('dl_x', [-1, 3], 'float32')
+        y = fluid.data('dl_y', [-1, 1], 'int64')
+    return main, startup, [x, y]
+
+
+def test_dataloader_sample_generator_batches():
+    main, startup, feeds = _loader_prog()
+
+    def samples():
+        for i in range(10):
+            yield np.full(3, i, 'float32'), np.array([i], 'int64')
+
+    loader = fluid.DataLoader.from_generator(feed_list=feeds, capacity=4)
+    loader.set_sample_generator(samples, batch_size=4, drop_last=True)
+    batches = list(loader())
+    assert len(batches) == 2            # 10 // 4 with drop_last
+    assert batches[0]['dl_x'].shape == (4, 3)
+    np.testing.assert_allclose(np.asarray(batches[1]['dl_x'])[:, 0],
+                               [4, 5, 6, 7])
+
+
+def test_dataloader_batch_generator_and_return_list():
+    main, startup, feeds = _loader_prog()
+
+    def batches():
+        for i in range(3):
+            yield (np.full((2, 3), i, 'float32'),
+                   np.full((2, 1), i, 'int64'))
+
+    loader = fluid.DataLoader.from_generator(feed_list=feeds,
+                                             return_list=True)
+    loader.set_batch_generator(batches)
+    out = list(loader())
+    assert len(out) == 3 and len(out[0]) == 2
+    np.testing.assert_allclose(np.asarray(out[2][0]), 2.0)
+
+
+def test_dataloader_feeds_training_loop():
+    fluid.manual_seed(11)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data('tlx', [-1, 4], 'float32')
+        y = fluid.data('tly', [-1, 1], 'float32')
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 4).astype('float32')
+    W = np.array([[1.0], [2.0], [3.0], [4.0]], 'float32')
+    Y = X @ W
+
+    def sample_list():
+        for i in range(0, 64, 16):
+            yield [(X[j], Y[j]) for j in range(i, i + 16)]
+
+    loader = fluid.DataLoader.from_generator(feed_list=[x, y])
+    loader.set_sample_list_generator(sample_list)
+    exe = fluid.Executor()
+    exe.run(startup)
+    losses = []
+    for epoch in range(25):
+        for feed in loader():
+            losses.append(float(exe.run(main, feed=feed,
+                                        fetch_list=[loss])[0]))
+    assert losses[-1] < losses[0] * 0.05
+
+
+def test_dataloader_producer_errors_surface():
+    main, startup, feeds = _loader_prog()
+
+    def bad():
+        yield np.zeros((2, 3), 'float32'), np.zeros((2, 1), 'int64')
+        raise RuntimeError('boom in reader')
+
+    loader = fluid.DataLoader.from_generator(feed_list=feeds)
+    loader.set_batch_generator(bad)
+    with pytest.raises(RuntimeError, match='boom in reader'):
+        list(loader())
