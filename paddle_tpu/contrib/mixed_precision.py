@@ -27,8 +27,18 @@ class AutoMixedPrecisionLists:
 
 
 class OptimizerWithMixedPrecision:
-    """Wraps an optimizer: scales the loss, unscales grads, skips steps on
-    inf/nan (dynamic loss scaling, ref decorator.py)."""
+    """Wraps an optimizer (ref decorator.py).
+
+    Static mode: the full AMP pipeline — white/black-list cast rewrite at
+    lowering, loss scaling/unscaling and the fused finite-check +
+    update_loss_scaling fused into the jitted step.
+
+    Dygraph mode: forward math stays fp32 on TPU (bf16 via
+    TrainStep(amp_dtype=...) is the production path), so loss
+    scaling would be a no-op numerically; the wrapper contributes the
+    ONE fused all-finite gradient gate (skip step + decay scale on
+    overflow, grow scale after incr_every good steps) so scripts using
+    the fp16 recipe keep their semantics."""
 
     def __init__(self, optimizer, amp_lists=None, init_loss_scaling=2.**15,
                  incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
@@ -141,12 +151,17 @@ class OptimizerWithMixedPrecision:
         grads = [p.grad for p in params if p.grad is not None]
         # ONE fused all-finite reduction + one host sync (not per-param)
         grads_finite = bool(_all_finite(grads)) if grads else True
-        if not grads_finite and self._dynamic:
-            self._bad_steps += 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every:
-                self._loss_scale = max(self._loss_scale * self._decr_ratio, 1.0)
-                self._bad_steps = 0
+        if not grads_finite:
+            # the skip gate is unconditional (matching the static path's
+            # lax.cond guard); dynamic scaling only controls whether the
+            # scale decays on overflow
+            if self._dynamic:
+                self._bad_steps += 1
+                self._good_steps = 0
+                if self._bad_steps >= self._decr_every:
+                    self._loss_scale = max(
+                        self._loss_scale * self._decr_ratio, 1.0)
+                    self._bad_steps = 0
             for p in params:
                 p.clear_gradient()
             return None, []

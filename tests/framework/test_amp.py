@@ -145,7 +145,6 @@ def test_dygraph_amp_decorate_trains():
     from paddle_tpu.contrib import mixed_precision as mp
 
     fluid.manual_seed(7)
-    np.random.seed(0)
     rng = np.random.RandomState(0)
     X = rng.rand(32, 4).astype('float32')
     W = np.array([[1.0], [-2.0], [0.5], [3.0]], 'float32')
@@ -194,3 +193,22 @@ def test_dygraph_amp_skips_inf_and_decays_scale():
         w1 = np.asarray(model.parameters()[0].numpy())
         np.testing.assert_allclose(w0, w1)        # inf step skipped
         assert opt.get_loss_scaling() < s0        # scale decayed
+
+
+def test_dygraph_amp_skips_inf_even_without_dynamic_scaling():
+    from paddle_tpu import dygraph
+    from paddle_tpu.contrib import mixed_precision as mp
+
+    with dygraph.guard():
+        model = dygraph.Linear(2, 1)
+        opt = mp.decorate(
+            fluid.optimizer.SGD(0.1, parameter_list=model.parameters()),
+            use_dynamic_loss_scaling=False, dtype='float16')
+        w0 = np.asarray(model.parameters()[0].numpy()).copy()
+        x = dygraph.to_variable(np.array([[1e30, 1e30]], 'float32'))
+        loss = fluid.layers.reduce_mean(model(x)) * 1e30
+        loss.backward()
+        opt.minimize(loss)
+        model.clear_gradients()
+        np.testing.assert_allclose(
+            np.asarray(model.parameters()[0].numpy()), w0)

@@ -1,0 +1,77 @@
+"""The canonical fluid-book training recipe, end to end: paddle.dataset
+reader → paddle.batch → DataLoader → conv net (nets.simple_img_conv_pool)
+→ LR schedule + gradient clip + momentum → accuracy metric → save
+inference model → Predictor inference. One test = the whole reference
+user journey on TPU lowering."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import nets
+
+
+def test_mnist_recipe_end_to_end(tmp_path):
+    fluid.manual_seed(3)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data('img', [-1, 1, 28, 28], 'float32')
+        label = fluid.data('label', [-1, 1], 'int64')
+        conv = nets.simple_img_conv_pool(img, num_filters=8, filter_size=5,
+                                         pool_size=2, pool_stride=2,
+                                         act='relu')
+        logits = fluid.layers.fc(conv, 10)
+        prob = fluid.layers.softmax(logits)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.cross_entropy(prob, label))
+        acc = fluid.layers.accuracy(prob, label)
+        lr = fluid.layers.exponential_decay(0.05, decay_steps=20,
+                                            decay_rate=0.9)
+        opt = fluid.optimizer.Momentum(
+            lr, momentum=0.9,
+            grad_clip=fluid.clip.GradientClipByGlobalNorm(5.0))
+        opt.minimize(loss)
+    test_prog = main.clone(for_test=True)
+
+    # zoo reader (synthetic fallback off-cache) → batch → DataLoader
+    train_reader = fluid.dataset.mnist.train()
+    batched = fluid.reader.batch(train_reader, batch_size=32,
+                                 drop_last=True)
+
+    def to_feed():
+        for rows in batched():
+            xs = np.stack([r[0].reshape(1, 28, 28) for r in rows])
+            ys = np.array([[r[1]] for r in rows], 'int64')
+            yield xs.astype('float32'), ys
+
+    loader = fluid.DataLoader.from_generator(feed_list=[img, label])
+    loader.set_batch_generator(to_feed)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    losses = []
+    for epoch in range(3):
+        for feed in loader():
+            l, a = exe.run(main, feed=feed, fetch_list=[loss, acc])
+            losses.append(float(l))
+    # synthetic labels are random, but the net must still fit SOMETHING
+    # (training loss decreases) and the whole pipeline must be finite
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])
+
+    # save → Predictor round trip
+    model_dir = str(tmp_path / 'mnist_model')
+    fluid.io.save_inference_model(model_dir, ['img'], [prob], exe,
+                                  main_program=test_prog)
+    from paddle_tpu.inference import Config, create_paddle_predictor
+    pred = create_paddle_predictor(Config(model_dir))
+    x = np.zeros((4, 1, 28, 28), 'float32')
+    out = pred.run({'img': x})[0]
+    assert out.shape == (4, 10)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+
+    # the test program (clone for_test) evaluates without updates
+    # (consume the loader fully — a dropped iterator would strand its
+    # producer thread on the bounded queue)
+    feed0 = list(loader())[0]
+    before = exe.run(test_prog, feed=feed0, fetch_list=[loss])[0]
+    after = exe.run(test_prog, feed=feed0, fetch_list=[loss])[0]
+    np.testing.assert_allclose(before, after)
