@@ -6,6 +6,8 @@ inferred via jax.eval_shape over the op functional, and appends ops.
 """
 from __future__ import annotations
 
+import copy
+
 import jax
 import jax.numpy as jnp
 
@@ -50,6 +52,10 @@ class LayerHelper:
         attr = ParamAttr._to_attr(attr)
         if attr is False:
             return None
+        # copy before naming (ref layer_helper_base.py:296): a ParamAttr
+        # with no explicit name reused across create_parameter calls must
+        # yield DISTINCT parameters, not silently alias the first one
+        attr = copy.copy(attr)
         if attr.name is None:
             attr.name = unique_name.generate('.'.join([self.name, 'w' if not is_bias else 'b']))
         init = attr.initializer or default_initializer or (

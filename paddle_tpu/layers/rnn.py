@@ -359,25 +359,32 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None,
 def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
              activation='tanh', gate_activation='sigmoid',
              origin_mode=False):
-    """Single GRU step (ref: layers/nn.py gru_unit)."""
+    """Single GRU step (ref: layers/nn.py gru_unit): `input` is the
+    (B, 3D) projected input (the fc happens outside, as in the
+    reference), `hidden` (B, D). Creates the (D, 3D) recurrent weight +
+    (3D,) bias; returns (new_hidden, reset_hidden_pre, gate) like the
+    reference. activation/gate_activation accept only the reference
+    defaults (tanh/sigmoid — what the fused op computes)."""
+    if activation != 'tanh' or gate_activation != 'sigmoid':
+        raise ValueError('gru_unit supports the reference defaults '
+                         "activation='tanh', gate_activation='sigmoid'")
     helper = LayerHelper('gru_unit', param_attr=param_attr,
                          bias_attr=bias_attr)
     D = size // 3
-    gate_w = helper.create_parameter(helper.param_attr, [D, 2 * D], 'float32')
-    cand_w = helper.create_parameter(helper.param_attr, [D, D], 'float32')
+    w = helper.create_parameter(helper.param_attr, [D, 3 * D], 'float32')
     bias = helper.create_parameter(helper.bias_attr, [3 * D], 'float32',
                                    is_bias=True)
     return apply_op_layer(
         'gru_unit',
-        {'x': input, 'h_prev': hidden, 'gate_w': gate_w, 'cand_w': cand_w,
-         'bias': bias},
-        {'activation': activation, 'gate_activation': gate_activation,
-         'origin_mode': origin_mode}, n_outputs=None)
+        {'x': input, 'hidden': hidden, 'weight': w, 'bias': bias},
+        {'origin_mode': origin_mode})
 
 
 def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
               param_attr=None, bias_attr=None, name=None):
-    """Single LSTM step (ref: layers/nn.py lstm_unit)."""
+    """Single LSTM step (ref: layers/nn.py lstm_unit): projects
+    [x_t, h_prev] through a created (D_in+D, 4D) weight + bias, then runs
+    the fused lstm_unit gate op. Returns (new_hidden, new_cell)."""
     helper = LayerHelper('lstm_unit', param_attr=param_attr,
                          bias_attr=bias_attr, name=name)
     D = hidden_t_prev.shape[-1]
@@ -386,9 +393,11 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
                                 'float32')
     b = helper.create_parameter(helper.bias_attr, [4 * D], 'float32',
                                 is_bias=True)
+    xh = tensor_layers.concat([x_t, hidden_t_prev], axis=1)
+    gates = apply_op_layer('elementwise_add',
+                           {'x': nn_layers.matmul(xh, w), 'y': b}, {})
     return apply_op_layer(
-        'lstm_unit', {'x': x_t, 'h_prev': hidden_t_prev,
-                      'c_prev': cell_t_prev, 'w': w, 'bias': b},
+        'lstm_unit', {'x': gates, 'cell': cell_t_prev},
         {'forget_bias': float(forget_bias)})
 
 

@@ -25,9 +25,11 @@ def create_tensor(dtype, name=None, persistable=False):
 
 def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
                      default_initializer=None):
+    import copy
     from ..param_attr import ParamAttr
     helper = LayerHelper('create_parameter', name=name)
-    attr = ParamAttr._to_attr(attr)
+    # copy before naming — never mutate a caller-shared ParamAttr
+    attr = copy.copy(ParamAttr._to_attr(attr))
     if name is not None and attr.name is None:
         attr.name = name
     return helper.create_parameter(attr, shape, dtype, is_bias,

@@ -1,0 +1,170 @@
+"""RNN layer numerics beyond shapes: rnn() over cells vs manual
+recurrence, birnn, StaticRNN vs rnn() parity, gru_unit/lstm_unit single
+steps, dynamic_decode greedy path."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+L = fluid.layers
+RNG = np.random.RandomState(5)
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor()
+    exe.run(startup)
+    fetch = fetch if isinstance(fetch, (list, tuple)) else [fetch]
+    return exe.run(main, feed=feeds, fetch_list=list(fetch))
+
+
+def const_attr(v):
+    return fluid.ParamAttr(
+        initializer=fluid.initializer.ConstantInitializer(v))
+
+
+def test_rnn_over_grucell_matches_manual():
+    B, T, D, H = 2, 4, 3, 5
+    x = RNG.rand(B, T, D).astype('float32')
+
+    def build():
+        xv = fluid.data('rg_x', [B, T, D], 'float32')
+        cell = L.GRUCell(H, param_attr=const_attr(0.1),
+                         bias_attr=const_attr(0.0))
+        out, final = L.rnn(cell, xv)
+        return [out, final]
+    out, final = _run(build, {'rg_x': x})
+    assert out.shape == (B, T, H)
+    # manual GRU with the same constant weights (gate order u, r)
+    Wg = np.full((D + H, 2 * H), 0.1, 'float32')
+    Wc = np.full((D + H, H), 0.1, 'float32')
+    h = np.zeros((B, H), 'float32')
+    for t in range(T):
+        xh = np.concatenate([x[:, t], h], 1)
+        g = 1 / (1 + np.exp(-(xh @ Wg)))
+        u, r = g[:, :H], g[:, H:]
+        c = np.tanh(np.concatenate([x[:, t], r * h], 1) @ Wc)
+        h = u * h + (1 - u) * c
+        np.testing.assert_allclose(out[:, t], h, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(final, h, rtol=2e-4, atol=2e-4)
+
+
+def test_rnn_sequence_length_freezes_state():
+    B, T, D, H = 2, 5, 3, 4
+    x = RNG.rand(B, T, D).astype('float32')
+
+    def build():
+        xv = fluid.data('rl_x', [B, T, D], 'float32')
+        ln = fluid.data('rl_len', [B], 'int64')
+        cell = L.LSTMCell(H)
+        out, final = L.rnn(cell, xv, sequence_length=ln)
+        return [out, final[0]]
+    out, final_h = _run(build, {'rl_x': x,
+                                'rl_len': np.array([2, 5], 'int64')})
+    # beyond row 0's length the outputs are zero
+    np.testing.assert_allclose(out[0, 2:], 0.0, atol=1e-6)
+    assert not np.allclose(out[1, 2:], 0.0)
+    # final state for row 0 is the step-2 state: recompute with len 5 and
+    # compare the step-1 output (the last valid one) to final_h
+    np.testing.assert_allclose(final_h[0], out[0, 1], rtol=1e-5)
+
+
+def test_birnn_concats_directions():
+    B, T, D, H = 2, 3, 4, 5
+    x = RNG.rand(B, T, D).astype('float32')
+
+    def build():
+        xv = fluid.data('bi_x', [B, T, D], 'float32')
+        fw = L.GRUCell(H, name='bi_fw')
+        bw = L.GRUCell(H, name='bi_bw')
+        out, states = L.birnn(fw, bw, xv)
+        return out
+    out, = _run(build, {'bi_x': x})
+    assert out.shape == (B, T, 2 * H)
+
+
+def test_static_rnn_matches_rnn_layer():
+    B, T, D, H = 2, 4, 3, 4
+    x = RNG.rand(B, T, D).astype('float32')
+
+    def build():
+        xv = fluid.data('sr_x', [B, T, D], 'float32')
+        # rnn() path
+        cell = L.GRUCell(H, param_attr=const_attr(0.15),
+                         bias_attr=const_attr(0.0), name='sr_cell')
+        out1, _ = L.rnn(cell, xv)
+
+        # StaticRNN path reusing the SAME cell (params shared by name)
+        xt = L.transpose(xv, perm=[1, 0, 2])
+        srnn = L.StaticRNN()
+        with srnn.step():
+            w = srnn.step_input(xt)
+            pre = srnn.memory(batch_ref=xv, shape=[-1, H],
+                              ref_batch_dim_idx=0)
+            _, new = cell.call(w, pre)
+            srnn.update_memory(pre, new)
+            srnn.step_output(new)
+        out2 = L.transpose(srnn(), perm=[1, 0, 2])
+        return [out1, out2]
+    out1, out2 = _run(build, {'sr_x': x})
+    np.testing.assert_allclose(out1, out2, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_unit_and_lstm_unit_single_step():
+    B, D, H = 3, 4, 5
+    x = RNG.rand(B, 3 * H).astype('float32')
+    h = RNG.rand(B, H).astype('float32')
+
+    def build():
+        xv = fluid.data('gu_x', [B, 3 * H], 'float32')
+        hv = fluid.data('gu_h', [B, H], 'float32')
+        out = L.gru_unit(xv, hv, 3 * H)
+        xl = fluid.data('lu_x', [B, D], 'float32')
+        cl = fluid.data('lu_c', [B, H], 'float32')
+        hl = fluid.data('lu_h', [B, H], 'float32')
+        lh, lc = L.lstm_unit(xl, hl, cl)
+        return [out[0], lh, lc]
+    xo = RNG.rand(B, D).astype('float32')
+    c0 = RNG.rand(B, H).astype('float32')
+    r = _run(build, {'gu_x': x, 'gu_h': h, 'lu_x': xo, 'lu_c': c0,
+                     'lu_h': h})
+    assert r[0].shape == (B, H)
+    assert r[1].shape == (B, H) and r[2].shape == (B, H)
+    assert all(np.isfinite(a).all() for a in r)
+
+
+def test_dynamic_decode_greedy_terminates_on_end_token():
+    """GreedyEmbeddingHelper-style decode: with a fixed output layer that
+    always argmaxes to the end token, decoding finishes immediately."""
+    B, H, V = 2, 4, 6
+    end_id = 3
+
+    def build():
+        h0 = fluid.data('dd_h', [B, H], 'float32')
+        cell = L.GRUCell(H)
+        from paddle_tpu.layers.rnn import (BasicDecoder,
+                                           GreedyEmbeddingHelper)
+        emb_w = L.create_parameter([V, H], 'float32', name='dd_emb',
+                                   attr=const_attr(0.05))
+
+        def embedding_fn(ids):
+            return L.gather(emb_w, L.reshape(ids, shape=[-1]))
+
+        # output layer biased so end_id always wins
+        bias = np.zeros(V, 'float32'); bias[end_id] = 100.0
+
+        def output_fn(h):
+            logits = L.fc(h, V, bias_attr=False,
+                          param_attr=const_attr(0.0))
+            return logits + fluid.layers.tensor.fill_constant_array(bias)
+        starts = fluid.layers.tensor.fill_constant([B], 'int64', 0)
+        helper = GreedyEmbeddingHelper(embedding_fn, start_tokens=starts,
+                                       end_token=end_id)
+        decoder = BasicDecoder(cell, helper, output_fn=output_fn)
+        outputs, states = L.dynamic_decode(decoder, inits=h0,
+                                           max_step_num=4)
+        return outputs[1]          # sampled ids
+    ids, = _run(build, {'dd_h': np.zeros((B, H), 'float32')})
+    assert (np.asarray(ids)[:, 0] == 3).all()
