@@ -14,19 +14,33 @@ import jax
 
 
 class KeyGenerator:
+    """LAZY: building the PRNGKey initializes the jax backend, so it must
+    not happen at construction — `import paddle_tpu` has to stay free of
+    backend init (on a dead axon tunnel that first touch hangs forever,
+    and it would land before any watchdog can be set up)."""
+
     def __init__(self, seed: int = 0):
-        self.seed(seed)
+        self._seed = int(seed)
+        self._base = None
+        self._counter = 0
 
     def seed(self, seed: int):
-        self._base = jax.random.PRNGKey(int(seed))
+        self._seed = int(seed)
+        self._base = None
         self._counter = 0
+
+    @property
+    def _key(self):
+        if self._base is None:
+            self._base = jax.random.PRNGKey(self._seed)
+        return self._base
 
     def next_key(self):
         self._counter += 1
-        return jax.random.fold_in(self._base, self._counter)
+        return jax.random.fold_in(self._key, self._counter)
 
     def base_key(self):
-        return self._base
+        return self._key
 
     @contextlib.contextmanager
     def bind_base(self, base_key):

@@ -458,7 +458,7 @@ class TrainStep:
     """
 
     def __init__(self, layer: Layer, loss_fn, optimizer, data_sharding=None,
-                 remat=False, donate=True, amp_dtype=None):
+                 remat=False, donate=True, amp_dtype=None, accum_steps=1):
         self._layer = layer
         self._params = dict(layer.named_parameters())
         self._buffers = dict(layer.named_buffers())
@@ -470,6 +470,12 @@ class TrainStep:
         # the forward sees a low-precision cast, grads/updates are fp32 —
         # param dtypes are stable across steps so the step compiles once.
         self._amp_dtype = amp_dtype
+        # accum_steps > 1: gradient merge (ref GradientMergeOptimizer,
+        # optimizer.py:3870 semantics) — grads accumulate across k calls,
+        # the optimizer applies once on the k-step mean, inside the same
+        # jitted program via lax.cond so the step still compiles once.
+        self._accum_steps = int(accum_steps)
+        self._acc = None
         self._jitted = None
         self._slots = None
         self._step = 0
@@ -512,20 +518,13 @@ class TrainStep:
         if self._remat:
             forward = jax.checkpoint(forward, static_argnums=())
 
-        def step(pvals, bvals, slots, lr, batch):
-            train_p = {n: pvals[n] for n in trainable}
-            frozen_p = {n: v for n, v in pvals.items() if n not in trainable}
-
-            def f(tp):
-                return forward({**frozen_p, **tp}, bvals, batch)
-
-            (loss, new_b), grads = jax.value_and_grad(f, has_aux=True)(train_p)
+        def apply_update(train_p, grads, slots, lr):
             for n in grads:
                 if regs[n] is not None:
                     grads[n] = regs[n].apply(train_p[n], grads[n])
             if clip is not None:
                 grads = clip.apply_tree(grads)
-            new_p = dict(frozen_p)
+            new_tp = {}
             new_slots = {}
             for n in trainable:
                 args = [train_p[n], grads[n]] + \
@@ -534,11 +533,60 @@ class TrainStep:
                     args.append(lr)
                 res = update_fn(*args, **hypers)
                 res = res if isinstance(res, tuple) else (res,)
-                new_p[n] = res[0]
+                new_tp[n] = res[0]
                 new_slots[n] = dict(zip(slot_names, res[1:]))
-            return new_p, new_b, new_slots, loss
+            return new_tp, new_slots
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        accum_steps = self._accum_steps
+        if accum_steps <= 1:
+            def step(pvals, bvals, slots, lr, batch):
+                train_p = {n: pvals[n] for n in trainable}
+                frozen_p = {n: v for n, v in pvals.items()
+                            if n not in trainable}
+
+                def f(tp):
+                    return forward({**frozen_p, **tp}, bvals, batch)
+
+                (loss, new_b), grads = \
+                    jax.value_and_grad(f, has_aux=True)(train_p)
+                new_tp, new_slots = apply_update(train_p, grads, slots, lr)
+                return {**frozen_p, **new_tp}, new_b, new_slots, loss
+
+            return jax.jit(step, donate_argnums=(0, 1, 2))
+
+        def step(pvals, bvals, slots, acc, count, lr, batch):
+            # gradient merge: accumulate, and on every k-th call apply the
+            # optimizer on the k-step MEAN (regularizer/clip act on the
+            # merged grad, matching ref GradientMergeOptimizer which scales
+            # by 1/k before the inner optimizer runs)
+            train_p = {n: pvals[n] for n in trainable}
+            frozen_p = {n: v for n, v in pvals.items() if n not in trainable}
+
+            def f(tp):
+                return forward({**frozen_p, **tp}, bvals, batch)
+
+            (loss, new_b), grads = jax.value_and_grad(f, has_aux=True)(train_p)
+            acc = jax.tree_util.tree_map(lambda a, g: a + g, acc, grads)
+            do_apply = (count + 1) % accum_steps == 0
+
+            def on_apply(operand):
+                acc, slots = operand
+                mean_g = {n: a / accum_steps for n, a in acc.items()}
+                new_tp, new_slots = apply_update(dict(train_p), mean_g,
+                                                 slots, lr)
+                zero = jax.tree_util.tree_map(jnp.zeros_like, acc)
+                return new_tp, new_slots, zero
+
+            def on_skip(operand):
+                acc, slots = operand
+                return dict(train_p), slots, acc
+
+            new_tp, new_slots, new_acc = jax.lax.cond(
+                do_apply, on_apply, on_skip, (acc, slots))
+            return ({**frozen_p, **new_tp}, new_b, new_slots, new_acc,
+                    count + 1, loss)
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     def state(self):
         return ({n: p.value for n, p in self._params.items()},
@@ -559,9 +607,21 @@ class TrainStep:
                 arr = jax.device_put(arr, self._data_sharding)
             batch_vals.append(arr)
         pvals, bvals = self.state()
-        new_p, new_b, self._slots, loss = self._jitted(
-            pvals, bvals, self._slots, jnp.float32(self._opt._current_lr()),
-            tuple(batch_vals))
+        if self._accum_steps > 1:
+            if self._acc is None:
+                self._acc = {n: jnp.zeros(tuple(p.shape), jnp.float32)
+                             for n, p in self._params.items()
+                             if p.trainable}
+                self._count = jnp.int32(0)
+            new_p, new_b, self._slots, self._acc, self._count, loss = \
+                self._jitted(pvals, bvals, self._slots, self._acc,
+                             self._count,
+                             jnp.float32(self._opt._current_lr()),
+                             tuple(batch_vals))
+        else:
+            new_p, new_b, self._slots, loss = self._jitted(
+                pvals, bvals, self._slots,
+                jnp.float32(self._opt._current_lr()), tuple(batch_vals))
         for n, p in self._params.items():
             p.value = new_p[n]
         for n, b in self._buffers.items():

@@ -1,0 +1,56 @@
+"""Bounded jax backend init.
+
+A dead axon tunnel makes the first backend touch (`jax.devices()`) block
+forever inside the remote handshake — the failure mode that turned an infra
+outage into rc=124 with zero output at r4 driver-capture time. `probe_backend`
+touches the backend from a daemon thread under a watchdog so callers get a
+clear, fast error instead of an indefinite hang.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+DEFAULT_TIMEOUT_ENV = 'PADDLE_TPU_BACKEND_TIMEOUT'
+
+
+class BackendInitTimeout(RuntimeError):
+    pass
+
+
+def probe_backend(timeout=None):
+    """Return (devices, backend_name) or raise.
+
+    Raises BackendInitTimeout after `timeout` seconds (default
+    $PADDLE_TPU_BACKEND_TIMEOUT or 120) if backend init hangs, and
+    re-raises any exception the init itself threw. An explicit
+    JAX_PLATFORMS env var beats the axon sitecustomize platform pin.
+    """
+    if timeout is None:
+        timeout = float(os.environ.get(DEFAULT_TIMEOUT_ENV, '120'))
+    probe = {}
+
+    def _touch():
+        try:
+            import jax
+            env = os.environ.get('JAX_PLATFORMS', '')
+            if env and jax.config.jax_platforms != env:
+                jax.config.update('jax_platforms', env)
+            probe['devices'] = jax.devices()
+            probe['backend'] = jax.default_backend()
+        except BaseException as e:  # surfaced to the caller's thread
+            probe['error'] = e
+
+    t = threading.Thread(target=_touch, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise BackendInitTimeout(
+            f"jax backend init did not answer within {timeout:.0f}s "
+            f"(JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '')!r}); "
+            "if this is an axon session the remote TPU tunnel is down — "
+            "re-run when it is back, or set JAX_PLATFORMS=cpu for a "
+            "CPU-shape run.")
+    if 'error' in probe:
+        raise probe['error']
+    return probe['devices'], probe['backend']
