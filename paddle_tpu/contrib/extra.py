@@ -91,8 +91,22 @@ class BasicLSTMUnit(_Layer):
         gates = _dispatch('matmul', {'x': xh, 'y': self.weight}, {})
         gates = _dispatch('elementwise_add', {'x': gates, 'y': self.bias},
                           {'axis': -1})
-        h, c = _dispatch('lstm_unit', {'x': gates, 'cell': pre_cell},
-                         {'forget_bias': self._forget_bias})
+        # NOTE: ref BasicLSTMUnit's gate layout is i, j(candidate), f, o —
+        # different from the lstm_unit OP's i, f, o, g — so the split is
+        # done here, not via the op, to keep exchanged weights compatible
+        # (ref: contrib/layers/rnn_impl.py:816 `i, j, f, o = split(...)`)
+        i, j, f, o = (_dispatch('split', {'x': gates},
+                                {'num_or_sections': 4, 'dim': -1}))
+        sig = lambda t: _dispatch('sigmoid', {'x': t}, {})
+        tanh = lambda t: _dispatch('tanh', {'x': t}, {})
+        fb = sig(_dispatch('scale', {'x': f},
+                           {'bias': self._forget_bias}))
+        c = _dispatch('elementwise_add',
+                      {'x': _dispatch('elementwise_mul',
+                                      {'x': pre_cell, 'y': fb}, {}),
+                       'y': _dispatch('elementwise_mul',
+                                      {'x': sig(i), 'y': tanh(j)}, {})}, {})
+        h = _dispatch('elementwise_mul', {'x': tanh(c), 'y': sig(o)}, {})
         return h, c
 
 

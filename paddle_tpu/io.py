@@ -40,12 +40,13 @@ def is_persistable(var):
 def is_belong_to_optimizer(var):
     """ref io.py:113 — optimizer slot vars (moments, velocities, steps…).
 
-    The reference keys on ``var.desc.need_check_feed`` absence + persistable
-    non-parameters; our slots are persistable non-Parameter vars created by
-    optimizer ops, named ``<param>@<slot>`` or ``@LR_DECAY_COUNTER@`` etc.
+    Keyed on the explicit ``belong_to_optimizer`` tag set at accumulator /
+    lr-var creation (optimizer.py `_make_slot_var`), not on name patterns —
+    a user var whose name happens to contain '@' or start with
+    ``learning_rate`` must not be misclassified.
     """
     return (bool(var.persistable) and not isinstance(var, Parameter)
-            and ('@' in var.name or var.name.startswith('learning_rate')))
+            and bool(getattr(var, 'belong_to_optimizer', False)))
 
 
 def get_program_parameter(program):
@@ -152,7 +153,9 @@ def _program_to_dict(program):
                 'dtype': v.dtype, 'persistable': v.persistable,
                 'is_data': v.is_data, 'stop_gradient': v.stop_gradient,
                 'is_parameter': isinstance(v, Parameter),
-                'trainable': v.trainable, 'lod_level': v.lod_level})
+                'trainable': v.trainable, 'lod_level': v.lod_level,
+                'belong_to_optimizer': bool(
+                    getattr(v, 'belong_to_optimizer', False))})
         ops = []
         for op in b.ops:
             attrs = {}
@@ -181,11 +184,14 @@ def _program_from_dict(d):
     for bd in d['blocks']:
         b = Block(p, bd['idx'], bd['parent_idx'])
         for vd in bd['vars']:
+            belong = vd.pop('belong_to_optimizer', False)
             if vd.pop('is_parameter', False):
                 b.create_parameter(vd['name'], vd['shape'], vd['dtype'],
                                    trainable=vd.get('trainable', True))
             else:
-                b.create_var(**vd)
+                v = b.create_var(**vd)
+                if belong:
+                    v.belong_to_optimizer = True
         for od in bd['ops']:
             attrs = od['attrs']
             if 'constant_value' in od:
@@ -275,8 +281,12 @@ def save(program, model_path):
            for v in program.list_vars()
            if is_persistable(v) and not is_parameter(v)
            and scope.find(v.name) is not None}
-    np.savez(model_path + '.pdparams', **params)
-    np.savez(model_path + '.pdopt', **opt)
+    # open the exact filename: np.savez(str) would append '.npz', breaking
+    # the documented `{path}.pdparams` artifact layout
+    with open(model_path + '.pdparams', 'wb') as f:
+        np.savez(f, **params)
+    with open(model_path + '.pdopt', 'wb') as f:
+        np.savez(f, **opt)
     with open(model_path + '.pdmodel', 'w') as f:
         json.dump(_program_to_dict(program), f)
 
@@ -292,7 +302,10 @@ def load_program_state(model_path, var_list=None):
     state = {}
     for ext in ('.pdparams', '.pdopt'):
         p = model_path + ext
-        if os.path.exists(p + '.npz'):   # np.savez appends .npz
+        # legacy fallback ONLY when the exact-name artifact is absent (old
+        # save() went through np.savez(str) which appended '.npz'); a stale
+        # legacy file must never shadow a fresh exact-name checkpoint
+        if not os.path.exists(p) and os.path.exists(p + '.npz'):
             p = p + '.npz'
         if os.path.exists(p):
             with np.load(p) as data:

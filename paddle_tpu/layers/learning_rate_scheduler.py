@@ -35,6 +35,7 @@ def _decay_step_counter(begin=0):
     counter = create_global_var(
         [1], begin - 1, 'int64', persistable=True,
         name=unique_name.generate('lr_decay_counter'))
+    counter.belong_to_optimizer = True  # io.is_belong_to_optimizer tag
     increment(counter, value=1, in_place=True)
     return cast(counter, 'float32')
 

@@ -372,7 +372,10 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
                          bias_attr=bias_attr)
     D = size // 3
     w = helper.create_parameter(helper.param_attr, [D, 3 * D], 'float32')
-    bias = helper.create_parameter(helper.bias_attr, [3 * D], 'float32',
+    # bias shape [1, 3D] matches the reference layout (rnn.py:2675
+    # bias_size = [1, 3 * size]) so exchanged checkpoints pass
+    # set_program_state's shape check
+    bias = helper.create_parameter(helper.bias_attr, [1, 3 * D], 'float32',
                                    is_bias=True)
     return apply_op_layer(
         'gru_unit',

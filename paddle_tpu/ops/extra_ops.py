@@ -217,9 +217,11 @@ def lstm_unit(x, cell, *, forget_bias=0.0):
     x = jnp.asarray(x)
     c_prev = jnp.asarray(cell)
     d = c_prev.shape[-1]
-    i, f, c_hat, o = jnp.split(x, 4, axis=-1)
+    # gate layout matches the reference kernel: i, f, o at 2D, candidate g
+    # at 3D — weights exchanged with the reference stay bit-compatible
+    i, f, o, g = jnp.split(x, 4, axis=-1)
     new_c = c_prev * jax.nn.sigmoid(f + forget_bias) + \
-        jax.nn.sigmoid(i) * jnp.tanh(c_hat)
+        jax.nn.sigmoid(i) * jnp.tanh(g)
     new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
     return new_h, new_c
 

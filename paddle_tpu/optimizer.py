@@ -60,6 +60,7 @@ class Optimizer:
             self._lr_var = create_global_var(
                 [1], float(self._learning_rate), 'float32', persistable=True,
                 name=unique_name.generate('learning_rate'))
+            self._lr_var.belong_to_optimizer = True
         return self._lr_var
 
     def backward(self, loss, startup_program=None, parameter_list=None,
@@ -107,6 +108,10 @@ class Optimizer:
         sb = helper.startup_program.global_block()
         sv = sb.create_var(name=name, shape=list(shape), dtype='float32',
                            persistable=True, stop_gradient=True)
+        # explicit tag: io.is_belong_to_optimizer keys on this, not on name
+        # patterns (a user var containing '@' must not be misclassified)
+        v.belong_to_optimizer = True
+        sv.belong_to_optimizer = True
         ConstantInitializer(fill)(sv, sb)
         return v
 

@@ -1,0 +1,129 @@
+"""Regression tests for the r4 advisor findings: lstm_unit gate layout,
+save() artifact filenames, gru_unit bias shape, optimizer-var predicate."""
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as L
+from paddle_tpu.ops.registry import get_op
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_lstm_unit_op_matches_reference_gate_order():
+    """ref lstm_unit_op.h: i at 0, f at D, o at 2D, candidate g at 3D."""
+    rng = np.random.RandomState(0)
+    B, D = 3, 5
+    x = rng.randn(B, 4 * D).astype(np.float32)
+    c_prev = rng.randn(B, D).astype(np.float32)
+    h, c = get_op('lstm_unit').fn(x, c_prev, forget_bias=0.5)
+
+    i, f, o, g = x[:, :D], x[:, D:2 * D], x[:, 2 * D:3 * D], x[:, 3 * D:]
+    want_c = c_prev * _sigmoid(f + 0.5) + _sigmoid(i) * np.tanh(g)
+    want_h = np.tanh(want_c) * _sigmoid(o)
+    np.testing.assert_allclose(np.asarray(c), want_c, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h), want_h, rtol=1e-5, atol=1e-6)
+
+
+def test_basic_lstm_unit_matches_reference_ijfo_layout():
+    """ref contrib/layers/rnn_impl.py:816 splits gates as i, j, f, o —
+    a DIFFERENT layout from the lstm_unit op; weights exchanged with the
+    reference BasicLSTMUnit must stay compatible."""
+    from paddle_tpu import dygraph
+    from paddle_tpu.contrib.extra import BasicLSTMUnit
+    rng = np.random.RandomState(1)
+    B, I, D = 2, 3, 4
+    with dygraph.guard():
+        cell = BasicLSTMUnit(hidden_size=D, forget_bias=1.0)
+        x = fluid.dygraph.to_variable(rng.randn(B, I).astype(np.float32))
+        hp = fluid.dygraph.to_variable(rng.randn(B, D).astype(np.float32))
+        cp = fluid.dygraph.to_variable(rng.randn(B, D).astype(np.float32))
+        h, c = cell(x, hp, cp)
+        w = np.asarray(cell.weight.value)
+        b = np.asarray(cell.bias.value)
+        xv, hv, cv = (np.asarray(t.value) for t in (x, hp, cp))
+        got_h, got_c = np.asarray(h.value), np.asarray(c.value)
+
+    gates = np.concatenate([xv, hv], -1) @ w + b
+    i, j, f, o = np.split(gates, 4, axis=-1)
+    want_c = cv * _sigmoid(f + 1.0) + _sigmoid(i) * np.tanh(j)
+    want_h = np.tanh(want_c) * _sigmoid(o)
+    np.testing.assert_allclose(got_c, want_c, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got_h, want_h, rtol=1e-5, atol=1e-6)
+
+
+def test_save_writes_exact_pdparams_filename(tmp_path):
+    """np.savez(str) appends '.npz'; save() must produce the documented
+    {path}.pdparams / {path}.pdopt artifacts byte-for-name."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data('x', [4, 3], 'float32')
+        y = L.fc(x, size=2)
+        loss = L.reduce_mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    path = str(tmp_path / 'ckpt' / 'model')
+    fluid.io.save(prog, path)
+    assert os.path.exists(path + '.pdparams'), os.listdir(tmp_path / 'ckpt')
+    assert os.path.exists(path + '.pdopt')
+    assert not os.path.exists(path + '.pdparams.npz')
+    state = fluid.io.load_program_state(path)
+    assert any(k for k in state)
+
+
+def test_gru_unit_bias_matches_reference_shape():
+    """ref layers/rnn.py:2675: bias_size = [1, 3 * size]."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        D = 4
+        x = fluid.data('x', [2, 3 * D], 'float32')
+        h = fluid.data('h', [2, D], 'float32')
+        L.gru_unit(x, h, 3 * D)
+        biases = [v for v in prog.list_vars()
+                  if 'gru_unit' in v.name and v.shape == (1, 3 * D)]
+        assert biases, [(v.name, v.shape) for v in prog.list_vars()]
+
+
+def test_is_belong_to_optimizer_uses_tag_not_name():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data('x', [4, 3], 'float32')
+        y = L.fc(x, size=2)
+        loss = L.reduce_mean(y)
+        # a USER persistable var whose name contains '@' — must NOT be
+        # classified as optimizer state
+        tricky = L.create_global_var([1], 1.0, 'float32', persistable=True,
+                                     name='user@stat')
+        fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(loss)
+    opt_vars = [v.name for v in prog.list_vars()
+                if fluid.io.is_belong_to_optimizer(v)]
+    assert 'user@stat' not in opt_vars
+    # momentum velocity slots ARE classified
+    assert any('velocity' in n or 'momentum' in n.lower() or '_' in n
+               for n in opt_vars), opt_vars
+    assert opt_vars, "no optimizer vars tagged at all"
+
+
+def test_belong_to_optimizer_tag_survives_program_roundtrip(tmp_path):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data('x', [4, 3], 'float32')
+        loss = L.reduce_mean(L.fc(x, size=2))
+        fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    path = str(tmp_path / 'model')
+    fluid.io.save(prog, path)
+    from paddle_tpu.io import _program_from_dict
+    import json
+    with open(path + '.pdmodel') as f:
+        p2 = _program_from_dict(json.load(f))
+    before = sorted(v.name for v in prog.list_vars()
+                    if fluid.io.is_belong_to_optimizer(v))
+    after = sorted(v.name for v in p2.list_vars()
+                   if fluid.io.is_belong_to_optimizer(v))
+    assert before and before == after
