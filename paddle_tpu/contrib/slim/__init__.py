@@ -1,0 +1,34 @@
+"""Model compression suite (ref: python/paddle/fluid/contrib/slim/):
+quantization (QAT/PTQ), knowledge distillation, filter pruning, light NAS,
+and the config-driven Compressor strategy pipeline that composes them.
+"""
+from .core import Strategy, Context, Compressor, ConfigFactory
+from .graph import GraphWrapper, VarWrapper, OpWrapper, SlimGraphExecutor
+from .quantization import (FakeQuantWrapper, quant_aware, convert,
+                           quant_post, PostTrainingQuantization,
+                           WeightQuantization, QUANTIZABLE)
+from .quant_strategy import QuantizationStrategy
+from .distillation import (FSPDistiller, L2Distiller, SoftLabelDistiller,
+                           DistillationStrategy)
+from .prune import (Pruner, StructurePruner, PruneStrategy,
+                    UniformPruneStrategy, SensitivePruneStrategy)
+from .searcher import EvolutionaryController, SAController
+from .nas import SearchSpace, LightNASStrategy
+from . import core
+from . import graph
+from . import quantization
+from . import distillation
+from . import prune
+from . import nas
+from . import searcher
+
+__all__ = [
+    'Strategy', 'Context', 'Compressor', 'ConfigFactory', 'GraphWrapper',
+    'SlimGraphExecutor', 'FakeQuantWrapper', 'quant_aware', 'convert',
+    'quant_post', 'PostTrainingQuantization', 'WeightQuantization',
+    'QuantizationStrategy', 'FSPDistiller', 'L2Distiller',
+    'SoftLabelDistiller', 'DistillationStrategy', 'Pruner',
+    'StructurePruner', 'PruneStrategy', 'UniformPruneStrategy',
+    'SensitivePruneStrategy', 'EvolutionaryController', 'SAController',
+    'SearchSpace', 'LightNASStrategy',
+]

@@ -1,0 +1,369 @@
+"""slim compression pipeline core: Strategy callbacks, Context, Compressor.
+
+ref: python/paddle/fluid/contrib/slim/core/{strategy.py, compressor.py,
+config.py}. The Compressor drives epoch-based training while strategies
+(quantization / distillation / pruning / NAS) rewrite the train graph at
+their scheduled epochs through the callback protocol. TPU-first notes: the
+rewritten Program is re-lowered to one jitted XLA step on the next run call
+(executor compile cache keys on program version), so a strategy swap costs
+one recompile, not per-batch overhead.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ...framework import Program, program_guard
+from ...executor import Executor
+from .graph import GraphWrapper, SlimGraphExecutor
+
+__all__ = ['Strategy', 'Context', 'Compressor', 'ConfigFactory']
+
+
+class Strategy:
+    """ref slim/core/strategy.py — epoch-scheduled compression callbacks."""
+
+    def __init__(self, start_epoch=0, end_epoch=0):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_batch_begin(self, context):
+        pass
+
+    def on_batch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass
+
+    def restore_from_checkpoint(self, context):
+        pass
+
+
+class Context:
+    """ref slim/core/compressor.py:Context — the mutable compression state
+    the strategies communicate through."""
+
+    def __init__(self, place=None, scope=None, train_graph=None,
+                 train_reader=None, eval_graph=None, eval_reader=None,
+                 teacher_graphs=None, train_optimizer=None,
+                 distiller_optimizer=None, search_space=None):
+        self.epoch = 0
+        self.epoch_id = 0
+        self.batch_id = 0
+        self.k_v = {}
+        self.place = place
+        self.scope = scope
+        self.train_graph = train_graph
+        self.train_reader = train_reader
+        self.eval_graph = eval_graph
+        self.eval_reader = eval_reader
+        self.executor = None
+        self.teacher_graphs = teacher_graphs or []
+        self.train_optimizer = train_optimizer
+        self.distiller_optimizer = distiller_optimizer
+        self.optimize_graph = None
+        self.eval_results = {}
+        self.skip_training = False
+        self.search_space = search_space
+
+    def put(self, key, value):
+        self.k_v[key] = value
+
+    def get(self, key):
+        return self.k_v.get(key)
+
+    def get_executor(self):
+        """One SlimGraphExecutor per context: its Executor caches compiled
+        XLA programs, so reusing it across epochs avoids re-tracing the
+        identical train/eval step every epoch."""
+        if self.executor is None:
+            self.executor = SlimGraphExecutor(self.place)
+        return self.executor
+
+    def to_file(self, file_name):
+        with open(file_name, 'wb') as f:
+            pickle.dump({'epoch_id': self.epoch_id,
+                         'eval_results': self.eval_results}, f)
+
+    def from_file(self, file_name):
+        with open(file_name, 'rb') as f:
+            data = pickle.load(f)
+        self.epoch_id = data['epoch_id']
+        self.eval_results = data['eval_results']
+
+    def eval_converged(self, metric_name, delta=0.001):
+        if metric_name not in self.eval_results or \
+                len(self.eval_results[metric_name]) < 2:
+            return False
+        a, b = self.eval_results[metric_name][-2:]
+        return abs(b - a) / (abs(a) + 1e-12) < delta
+
+    def run_eval_graph(self, sampled_rate=None, cached_id=0):
+        """Evaluate eval_graph over eval_reader; records and returns the
+        mean of each eval out_node."""
+        assert self.eval_graph is not None and self.eval_reader is not None
+        executor = self.get_executor()
+        # cache the for_test clone: cloning per call would defeat the
+        # executor's compile cache (keyed on program identity+version)
+        cached = self.k_v.get('_eval_clone')
+        key = (id(self.eval_graph), self.eval_graph.program.num_ops())
+        if cached is None or cached[0] != key:
+            cached = (key, self.eval_graph.clone(for_test=True))
+            self.k_v['_eval_clone'] = cached
+        eval_graph = cached[1]
+        accum, names, batches = None, None, 0
+        for data in self.eval_reader():
+            feed = data if isinstance(data, dict) else None
+            results, names = executor.run(eval_graph, scope=self.scope,
+                                          data=None if feed else data,
+                                          feed=feed)
+            vals = [float(np.asarray(r).mean()) for r in results]
+            accum = vals if accum is None else \
+                [a + v for a, v in zip(accum, vals)]
+            batches += 1
+        assert batches, "eval_reader yielded no batches"
+        result = {n: a / batches for n, a in zip(names, accum)}
+        for n, v in result.items():
+            self.eval_results.setdefault(n, []).append(v)
+        return result
+
+
+class Compressor:
+    """ref slim/core/compressor.py:Compressor — config-driven strategy
+    pipeline (quantization / distillation / pruning / NAS) around an
+    epoch training loop."""
+
+    def __init__(self, place=None, scope=None, train_program=None,
+                 train_reader=None, train_feed_list=None,
+                 train_fetch_list=None, eval_program=None, eval_reader=None,
+                 eval_feed_list=None, eval_fetch_list=None,
+                 teacher_programs=(), checkpoint_path=None,
+                 train_optimizer=None, distiller_optimizer=None,
+                 search_space=None, epoch=1, log_period=20):
+        def _graph(p, feeds, fetches):
+            if p is None:
+                return None
+            if isinstance(p, GraphWrapper):
+                return p
+            in_nodes = {}
+            for i, f in enumerate(feeds or []):
+                in_nodes[f] = i
+            out_nodes = {}
+            for i, f in enumerate(fetches or []):
+                name = f if isinstance(f, str) else f.name
+                key = 'loss' if i == 0 and fetches is not None and \
+                    p is train_program else name
+                out_nodes[key] = name
+            return GraphWrapper(p, in_nodes, out_nodes)
+
+        self.place = place
+        self.scope = scope
+        self.train_graph = _graph(train_program, train_feed_list,
+                                  train_fetch_list)
+        self.eval_graph = _graph(eval_program, eval_feed_list,
+                                 eval_fetch_list)
+        self.train_reader = train_reader
+        self.eval_reader = eval_reader
+        self.teacher_graphs = [g if isinstance(g, GraphWrapper)
+                               else GraphWrapper(g) for g in teacher_programs]
+        self.checkpoint_path = checkpoint_path
+        self.train_optimizer = train_optimizer
+        self.distiller_optimizer = distiller_optimizer
+        self.search_space = search_space
+        self.epoch = epoch
+        self.log_period = log_period
+        self.strategies = []
+        self.init_model = None
+
+    def add_strategy(self, strategy):
+        self.strategies.append(strategy)
+        self.epoch = max(self.epoch, strategy.end_epoch)
+        return self
+
+    def config(self, config_file):
+        """Load strategies from a slim YAML config (ref slim/core/config.py
+        schema: a `strategies:` list naming registered strategy classes with
+        kwargs, and a `compressor:` section with epoch/checkpoint)."""
+        factory = ConfigFactory(config_file)
+        for s in factory.strategies:
+            self.add_strategy(s)
+        if factory.compressor.get('epoch'):
+            self.epoch = int(factory.compressor['epoch'])
+        if factory.compressor.get('checkpoint_path'):
+            self.checkpoint_path = factory.compressor['checkpoint_path']
+        if factory.compressor.get('init_model'):
+            self.init_model = factory.compressor['init_model']
+        return self
+
+    # ---- checkpoints (ref compressor.py:_load_checkpoint/_save_checkpoint)
+    def _checkpoint_dir(self, epoch_id):
+        return os.path.join(self.checkpoint_path, str(epoch_id))
+
+    def _scope_guard(self, context):
+        """io.save/load_persistables read the GLOBAL scope; training runs in
+        context.scope — guard so checkpoints hit the scope that trained."""
+        import contextlib
+        from ...core.scope import scope_guard
+        return scope_guard(context.scope) if context.scope is not None \
+            else contextlib.nullcontext()
+
+    def _save_checkpoint(self, context):
+        if not self.checkpoint_path:
+            return
+        d = self._checkpoint_dir(context.epoch_id)
+        os.makedirs(d, exist_ok=True)
+        context.to_file(os.path.join(d, 'context'))
+        with open(os.path.join(d, 'strategies'), 'wb') as f:
+            pickle.dump(self.strategies, f)
+        exe = Executor(self.place)
+        from ... import io
+        with self._scope_guard(context):
+            io.save_persistables(exe, d, context.optimize_graph.program
+                                 if context.optimize_graph else
+                                 context.train_graph.program)
+
+    def _load_checkpoint(self, context):
+        if not self.checkpoint_path or not os.path.isdir(
+                self.checkpoint_path):
+            return context
+        epochs = sorted(int(e) for e in os.listdir(self.checkpoint_path)
+                        if e.isdigit())
+        if not epochs:
+            return context
+        d = self._checkpoint_dir(epochs[-1])
+        context.from_file(os.path.join(d, 'context'))
+        context.epoch_id += 1
+        spath = os.path.join(d, 'strategies')
+        if os.path.exists(spath):
+            # strategy STATE (prune masks/ratios, controller state) resumes
+            # with the checkpoint, like the reference's pickled strategies
+            with open(spath, 'rb') as f:
+                self.strategies = pickle.load(f)
+        exe = Executor(self.place)
+        from ... import io
+        with self._scope_guard(context):
+            io.load_persistables(exe, d, context.train_graph.program)
+        for s in self.strategies:
+            s.restore_from_checkpoint(context)
+        return context
+
+    # ---- main loop ----
+    def _train_one_epoch(self, context):
+        if context.skip_training or context.train_reader is None:
+            return
+        graph = context.optimize_graph or context.train_graph
+        executor = context.get_executor()
+        for batch_id, data in enumerate(context.train_reader()):
+            context.batch_id = batch_id
+            for s in self.strategies:
+                s.on_batch_begin(context)
+            feed = data if isinstance(data, dict) else None
+            executor.run(graph, scope=context.scope,
+                         data=None if feed else data, feed=feed)
+            for s in self.strategies:
+                s.on_batch_end(context)
+
+    def run(self):
+        context = Context(
+            place=self.place, scope=self.scope,
+            train_graph=self.train_graph, train_reader=self.train_reader,
+            eval_graph=self.eval_graph, eval_reader=self.eval_reader,
+            teacher_graphs=self.teacher_graphs,
+            train_optimizer=self.train_optimizer,
+            distiller_optimizer=self.distiller_optimizer,
+            search_space=self.search_space)
+        context.epoch = self.epoch
+        self.context = context
+        if context.optimize_graph is None and self.train_optimizer is not None:
+            context.optimize_graph = self.train_graph.get_optimize_graph(
+                self.train_optimizer, self.place, self.scope)
+        context = self._load_checkpoint(context)
+
+        for s in self.strategies:
+            s.on_compression_begin(context)
+        start = context.epoch_id
+        for epoch_id in range(start, self.epoch):
+            context.epoch_id = epoch_id
+            for s in self.strategies:
+                s.on_epoch_begin(context)
+            self._train_one_epoch(context)
+            for s in self.strategies:
+                s.on_epoch_end(context)
+            if context.eval_graph is not None and \
+                    context.eval_reader is not None:
+                context.run_eval_graph()
+            self._save_checkpoint(context)
+        for s in self.strategies:
+            s.on_compression_end(context)
+        return context.eval_graph
+
+
+class ConfigFactory:
+    """ref slim/core/config.py — YAML strategy registry. Schema:
+
+        version: 1.0
+        strategies:
+          quant_strategy:
+            class: QuantizationStrategy
+            start_epoch: 0
+            end_epoch: 2
+            weight_bits: 8
+        compressor:
+          epoch: 2
+          checkpoint_path: ./ckpt
+          strategies: [quant_strategy]
+    """
+
+    def __init__(self, config):
+        import yaml
+        if isinstance(config, str) and os.path.exists(config):
+            with open(config) as f:
+                spec = yaml.safe_load(f)
+        elif isinstance(config, str):
+            spec = yaml.safe_load(config)
+        else:
+            spec = config
+        self.compressor = dict(spec.get('compressor', {}))
+        wanted = self.compressor.get('strategies')
+        self.strategies = []
+        defs = spec.get('strategies', {}) or {}
+        for name, sdef in defs.items():
+            if wanted is not None and name not in wanted:
+                continue
+            sdef = dict(sdef)
+            cls_name = sdef.pop('class')
+            self.strategies.append(_strategy_class(cls_name)(**sdef))
+
+    def instance(self, name):
+        for s in self.strategies:
+            if type(s).__name__ == name:
+                return s
+        return None
+
+
+def _strategy_class(name):
+    from . import distillation, prune, nas, quant_strategy
+    registry = {
+        'QuantizationStrategy': quant_strategy.QuantizationStrategy,
+        'DistillationStrategy': distillation.DistillationStrategy,
+        'UniformPruneStrategy': prune.UniformPruneStrategy,
+        'SensitivePruneStrategy': prune.SensitivePruneStrategy,
+        'PruneStrategy': prune.PruneStrategy,
+        'LightNASStrategy': nas.LightNASStrategy,
+    }
+    if name not in registry:
+        raise ValueError(f"unknown slim strategy class {name!r}; "
+                         f"known: {sorted(registry)}")
+    return registry[name]

@@ -1,0 +1,160 @@
+"""Knowledge distillation (ref: python/paddle/fluid/contrib/slim/
+distillation/{distiller.py, distillation_strategy.py}).
+
+Distillers add a teacher-guidance loss to the merged student+teacher graph:
+- L2Distiller: mean squared error between feature maps,
+- FSPDistiller: L2 between FSP (flow of solution procedure) matrices of
+  layer pairs (the `fsp` op — one einsum on TPU, ops/nn_ops.py:494),
+- SoftLabelDistiller: soft cross-entropy between temperature-scaled logits.
+
+DistillationStrategy merges the teacher program into a clone of the student
+train graph at start_epoch, sums the distill losses onto the student loss,
+appends the distiller optimizer, and swaps the result in as
+context.optimize_graph until end_epoch.
+"""
+from __future__ import annotations
+
+from ... import layers
+from ...framework import Program, Variable, program_guard
+from ...executor import Executor
+from .core import Strategy
+
+__all__ = ['FSPDistiller', 'L2Distiller', 'SoftLabelDistiller',
+           'DistillationStrategy']
+
+
+class L2Distiller:
+    """ref distiller.py:L2Distiller — L2 loss between a student and a
+    teacher feature map (same shape)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 distillation_loss_weight=1):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, graph):
+        with program_guard(graph.program):
+            s = graph.var(self.student_feature_map)._var
+            t = graph.var(self.teacher_feature_map)._var
+            l2 = layers.reduce_mean(layers.square(s - t))
+            dl = l2 * self.distillation_loss_weight
+            loss = dl
+            if 'loss' in graph.out_nodes:
+                loss = dl + graph.var(graph.out_nodes['loss'])._var
+            graph.out_nodes['loss'] = loss.name
+            graph.out_nodes['l2loss_' + self.student_feature_map + '_' +
+                            self.teacher_feature_map] = dl.name
+        return graph
+
+
+class FSPDistiller:
+    """ref distiller.py:FSPDistiller — L2 between FSP matrices of
+    (start, end) feature-map pairs from student and teacher."""
+
+    def __init__(self, student_pairs, teacher_pairs,
+                 distillation_loss_weight=1):
+        self.student_pairs = student_pairs
+        self.teacher_pairs = teacher_pairs
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, graph):
+        with program_guard(graph.program):
+            losses = []
+            for s_pair, t_pair in zip(self.student_pairs,
+                                      self.teacher_pairs):
+                s_fsp = layers.fsp_matrix(graph.var(s_pair[0])._var,
+                                          graph.var(s_pair[1])._var)
+                t_fsp = layers.fsp_matrix(graph.var(t_pair[0])._var,
+                                          graph.var(t_pair[1])._var)
+                losses.append(layers.reduce_mean(
+                    layers.square(s_fsp - t_fsp)))
+            dl = layers.sum(losses) * self.distillation_loss_weight
+            loss = dl
+            if 'loss' in graph.out_nodes:
+                loss = dl + graph.var(graph.out_nodes['loss'])._var
+            graph.out_nodes['loss'] = loss.name
+            graph.out_nodes['fsp_distillation_loss'] = dl.name
+        return graph
+
+
+class SoftLabelDistiller:
+    """ref distiller.py:SoftLabelDistiller — soft cross-entropy between
+    temperature-scaled student logits and teacher soft labels."""
+
+    def __init__(self, student_feature_map=None, teacher_feature_map=None,
+                 student_temperature=1.0, teacher_temperature=1.0,
+                 distillation_loss_weight=1):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.student_temperature = student_temperature
+        self.teacher_temperature = teacher_temperature
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, graph):
+        with program_guard(graph.program):
+            s = graph.var(self.student_feature_map)._var
+            t = graph.var(self.teacher_feature_map)._var
+            s_scaled = s / self.student_temperature
+            t_soft = layers.softmax(t / self.teacher_temperature)
+            t_soft.stop_gradient = True
+            ce = layers.softmax_with_cross_entropy(s_scaled, t_soft,
+                                                   soft_label=True)
+            dl = layers.reduce_mean(ce) * self.distillation_loss_weight
+            loss = dl
+            if 'loss' in graph.out_nodes:
+                loss = dl + graph.var(graph.out_nodes['loss'])._var
+            graph.out_nodes['loss'] = loss.name
+            graph.out_nodes['soft_label_loss_' + self.student_feature_map +
+                            '_' + self.teacher_feature_map] = dl.name
+        return graph
+
+
+class DistillationStrategy(Strategy):
+    """ref distillation_strategy.py — swap in the merged distillation graph
+    between start_epoch and end_epoch."""
+
+    def __init__(self, distillers=None, start_epoch=0, end_epoch=0):
+        super().__init__(start_epoch, end_epoch)
+        self.distillers = distillers or []
+
+    def restore_from_checkpoint(self, context):
+        if self.start_epoch < context.epoch_id < self.end_epoch:
+            self._create_distillation_graph(context)
+
+    def on_epoch_begin(self, context):
+        if self.start_epoch == context.epoch_id:
+            self._create_distillation_graph(context)
+
+    def _create_distillation_graph(self, context):
+        teacher = context.teacher_graphs[0]
+        for var in teacher.program.list_vars():
+            var.stop_gradient = True
+        graph = context.train_graph.clone()
+        graph.merge(teacher)
+        if 'loss' in graph.out_nodes:
+            graph.out_nodes['student_loss'] = graph.out_nodes['loss']
+
+        for distiller in self.distillers:
+            graph = distiller.distiller_loss(graph)
+
+        startup = Program()
+        with program_guard(graph.program, startup):
+            optimizer = context.distiller_optimizer
+            # only student params update: teacher params came in through
+            # merge() and are recorded in teacher_persistables
+            students = [p._var for p in graph.all_parameters()
+                        if p.name not in graph.teacher_persistables]
+            optimizer.minimize(graph.var(graph.out_nodes['loss'])._var,
+                               parameter_list=[p.name for p in students])
+        exe = Executor(context.place)
+        exe.run(startup, scope=context.scope)
+
+        context.put('distillation_backup_optimize_graph',
+                    context.optimize_graph)
+        context.optimize_graph = graph
+
+    def on_epoch_end(self, context):
+        if context.epoch_id == (self.end_epoch - 1):
+            context.optimize_graph = context.get(
+                'distillation_backup_optimize_graph')

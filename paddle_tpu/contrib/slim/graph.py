@@ -1,0 +1,159 @@
+"""Graph abstraction for the slim compression pipeline.
+
+ref: python/paddle/fluid/contrib/slim/graph/graph_wrapper.py — the reference
+wraps an IrGraph; here the Program op-list IR is already the graph, so
+GraphWrapper is a thin shell holding the program plus the in/out node name
+maps the strategies communicate through. SlimGraphExecutor
+(ref: slim/graph/executor.py) delegates to the XLA-lowering Executor.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework import Program, Variable, program_guard
+from ...executor import Executor
+
+
+class VarWrapper:
+    """ref graph_wrapper.VarWrapper — `._var` unwraps to the framework var."""
+
+    def __init__(self, var, graph):
+        self._var = var
+        self._graph = graph
+
+    @property
+    def name(self):
+        return self._var.name
+
+    def shape(self):
+        return list(self._var.shape) if self._var.shape else []
+
+    def set_shape(self, shape):
+        self._var.shape = tuple(int(s) for s in shape)
+
+
+class OpWrapper:
+    def __init__(self, op, graph):
+        self._op = op
+        self._graph = graph
+
+    @property
+    def type(self):
+        return self._op.type
+
+    def attr(self, name):
+        return self._op.attrs.get(name)
+
+
+class GraphWrapper:
+    """Program + the in/out node registry the strategies share.
+
+    ref: slim/graph/graph_wrapper.py:GraphWrapper. `out_nodes['loss']` names
+    the training loss; distillers rebind it to the combined loss.
+    """
+
+    def __init__(self, program=None, in_nodes=None, out_nodes=None):
+        self.program = program if program is not None else Program()
+        self.in_nodes = dict(in_nodes or {})
+        self.out_nodes = dict(out_nodes or {})
+        self.teacher_persistables = {}
+
+    # ---- queries ----
+    def all_parameters(self):
+        return [VarWrapper(p, self) for p in self.program.all_parameters()]
+
+    def is_parameter(self, var):
+        from ...framework import Parameter
+        return isinstance(var._var if isinstance(var, VarWrapper) else var,
+                          Parameter)
+
+    def is_persistable(self, var):
+        v = var._var if isinstance(var, VarWrapper) else var
+        return bool(v.persistable)
+
+    def var(self, name):
+        return VarWrapper(self.program.global_block().var(name), self)
+
+    def vars(self):
+        return [VarWrapper(v, self) for v in self.program.list_vars()]
+
+    def ops(self):
+        return [OpWrapper(op, self)
+                for b in self.program.blocks for op in b.ops]
+
+    def numel_params(self):
+        return sum(int(np.prod(p._var.shape)) for p in self.all_parameters()
+                   if p._var.shape)
+
+    # ---- transforms ----
+    def clone(self, for_test=False):
+        g = GraphWrapper(self.program.clone(for_test),
+                         self.in_nodes, self.out_nodes)
+        g.teacher_persistables = dict(self.teacher_persistables)
+        return g
+
+    def merge(self, other):
+        """Append `other`'s vars + ops into this graph (ref merge semantics:
+        same-named vars are SHARED — that is how teacher ops consume the
+        student's feed vars; build teacher nets with distinct param names)."""
+        from ...framework import Operator
+        blk = self.program.global_block()
+        for var in other.program.list_vars():
+            if var.persistable:
+                self.teacher_persistables[var.name] = var
+            if var.name not in blk.vars:
+                import copy
+                nv = copy.copy(var)
+                nv.block = blk
+                blk.vars[var.name] = nv
+        for b in other.program.blocks:
+            for op in b.ops:
+                blk.ops.append(Operator(
+                    blk, op.type,
+                    {k: list(v) for k, v in op.inputs.items()},
+                    {k: list(v) for k, v in op.outputs.items()},
+                    dict(op.attrs)))
+
+    def program_guard(self, startup=None):
+        return program_guard(self.program, startup)
+
+    def get_optimize_graph(self, optimizer, place=None, scope=None):
+        """Clone + append backward/optimize ops for `out_nodes['loss']` and
+        run the resulting startup (ref graph_wrapper.get_optimize_graph)."""
+        g = self.clone()
+        startup = Program()
+        with program_guard(g.program, startup):
+            optimizer.minimize(g.var(g.out_nodes['loss'])._var)
+        Executor(place).run(startup, scope=scope)
+        return g
+
+    def save_persistables(self, path, exe):
+        from ... import io
+        io.save_persistables(exe.exe if isinstance(exe, SlimGraphExecutor)
+                             else exe, path, self.program)
+
+    def load_persistables(self, path, exe):
+        from ... import io
+        io.load_persistables(exe.exe if isinstance(exe, SlimGraphExecutor)
+                             else exe, path, self.program)
+
+
+class SlimGraphExecutor:
+    """ref: slim/graph/executor.py — runs a GraphWrapper with feeds."""
+
+    def __init__(self, place=None):
+        self.exe = Executor(place)
+        self.place = place
+
+    def run(self, graph, scope=None, data=None, feed=None):
+        results = []
+        fetch_list = [graph.out_nodes[n] for n in sorted(graph.out_nodes)]
+        if data is not None and feed is None:
+            feed = {}
+            for name, idx in graph.in_nodes.items():
+                feed[name] = np.asarray([d[idx] for d in data]) \
+                    if isinstance(data, list) else data[idx]
+        outs = self.exe.run(graph.program, feed=feed,
+                            fetch_list=fetch_list, scope=scope)
+        results.extend(outs)
+        return results, sorted(graph.out_nodes)

@@ -1,0 +1,114 @@
+"""Light NAS (ref: python/paddle/fluid/contrib/slim/nas/{search_space.py,
+light_nas_strategy.py}).
+
+The strategy drives an SAController over a user SearchSpace: each round the
+controller proposes tokens, the space builds train/eval programs for them,
+the candidate trains for `retrain_epoch` passes and is scored on the eval
+metric (optionally latency-constrained); the controller anneals toward the
+best tokens. The reference's socket-based controller server / search agent
+(nas/controller_server.py, distributed search workers) is replaced by the
+in-process loop — multi-host search on TPU parallelizes over pods via the
+fleet launch utilities instead of ad-hoc sockets.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...executor import Executor
+from .core import Strategy
+from .graph import GraphWrapper, SlimGraphExecutor
+from .searcher import SAController
+
+__all__ = ['SearchSpace', 'LightNASStrategy']
+
+
+class SearchSpace:
+    """ref nas/search_space.py — NAS problem definition."""
+
+    def init_tokens(self):
+        raise NotImplementedError('Abstract method.')
+
+    def range_table(self):
+        raise NotImplementedError('Abstract method.')
+
+    def create_net(self, tokens):
+        """tokens → (startup_program, train_program, eval_program,
+        train_metrics(dict name→var-name), eval_metrics)."""
+        raise NotImplementedError('Abstract method.')
+
+    def get_model_latency(self, program):
+        """Optional latency model for constrained search."""
+        raise NotImplementedError('Abstract method.')
+
+
+class LightNASStrategy(Strategy):
+    """ref nas/light_nas_strategy.py — SA search over the space. Runs the
+    whole search in on_compression_begin (search is a pre-training phase);
+    the best tokens/programs are left on the context for the caller."""
+
+    def __init__(self, controller=None, end_epoch=0, target_latency=None,
+                 retrain_epoch=1, metric_name='acc', search_steps=10,
+                 max_train_batches=None, start_epoch=0):
+        super().__init__(start_epoch, max(end_epoch, start_epoch))
+        self.controller = controller or SAController(seed=0)
+        self.target_latency = target_latency
+        self.retrain_epoch = retrain_epoch
+        self.metric_name = metric_name
+        self.search_steps = search_steps
+        self.max_train_batches = max_train_batches
+
+    def _constrain(self, space):
+        if self.target_latency is None:
+            return None
+
+        def ok(tokens):
+            _, train_p, _, _, _ = space.create_net(tokens)
+            return space.get_model_latency(train_p) <= self.target_latency
+        return ok
+
+    def _score(self, space, tokens, context):
+        """Train the candidate briefly and return the eval metric."""
+        startup, train_p, eval_p, train_m, eval_m = space.create_net(tokens)
+        exe = Executor(context.place)
+        exe.run(startup, scope=context.scope)
+        sge = SlimGraphExecutor(context.place)
+        train_g = GraphWrapper(train_p, out_nodes=train_m)
+        for _ in range(self.retrain_epoch):
+            for bi, data in enumerate(context.train_reader()):
+                if self.max_train_batches is not None and \
+                        bi >= self.max_train_batches:
+                    break
+                feed = data if isinstance(data, dict) else None
+                sge.run(train_g, scope=context.scope,
+                        data=None if feed else data, feed=feed)
+        eval_g = GraphWrapper(eval_p, out_nodes=eval_m)
+        vals, names = [], []
+        batches = 0
+        accum = None
+        for data in context.eval_reader():
+            feed = data if isinstance(data, dict) else None
+            res, names = sge.run(eval_g, scope=context.scope,
+                                 data=None if feed else data, feed=feed)
+            vals = [float(np.asarray(r).mean()) for r in res]
+            accum = vals if accum is None else \
+                [a + v for a, v in zip(accum, vals)]
+            batches += 1
+        result = {n: a / batches for n, a in zip(names, accum)}
+        return result[self.metric_name]
+
+    def on_compression_begin(self, context):
+        space = context.search_space
+        assert space is not None, "LightNASStrategy needs a search_space"
+        tokens = list(space.init_tokens())
+        self.controller.reset(space.range_table(), tokens,
+                              self._constrain(space))
+        reward = self._score(space, tokens, context)
+        self.controller.update(tokens, reward)
+        for _ in range(self.search_steps):
+            tokens = self.controller.next_tokens()
+            reward = self._score(space, tokens, context)
+            self.controller.update(tokens, reward)
+        best = self.controller.best_tokens
+        context.put('best_tokens', best)
+        context.put('best_reward', self.controller.max_reward)
+        context.put('best_net', space.create_net(best))

@@ -1,0 +1,215 @@
+"""Filter/structure pruning (ref: python/paddle/fluid/contrib/slim/prune/
+{pruner.py, prune_strategy.py}).
+
+TPU-first formulation: pruning keeps STATIC shapes — pruned filter groups
+are masked to zero and the masks are re-applied after each optimizer step
+(`lazy` semantics of the reference's Pruner.prune_tensor), so the jitted
+XLA step never recompiles and the dense MXU tiling is untouched. The
+reference's shape-shrinking mode exists as `prune_tensor(lazy=False)` for
+parity/export; on TPU the win comes at export (smaller deployed weights),
+not in training, so the strategies train masked.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .core import Strategy
+
+__all__ = ['Pruner', 'StructurePruner', 'PruneStrategy',
+           'UniformPruneStrategy', 'SensitivePruneStrategy']
+
+
+class Pruner:
+    """ref prune/pruner.py:Pruner — base class."""
+
+    def prune(self, param):
+        pass
+
+
+class StructurePruner(Pruner):
+    """ref prune/pruner.py:StructurePruner — group pruning along an axis
+    ranked by a criterion (l1_norm)."""
+
+    def __init__(self, pruning_axis=None, criterions=None):
+        self.pruning_axis = pruning_axis or {'*': 0}
+        self.criterions = criterions or {'*': 'l1_norm'}
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        """Indices of the weakest `ratio` fraction of groups on `axis`."""
+        criterion = self.criterions.get(name, self.criterions.get('*'))
+        if axis is None:
+            axis = self.pruning_axis.get(name, self.pruning_axis.get('*'))
+        prune_num = int(round(param.shape[axis] * ratio))
+        reduce_dims = tuple(i for i in range(param.ndim) if i != axis)
+        if criterion == 'l1_norm':
+            scores = np.sum(np.abs(param), axis=reduce_dims)
+        elif criterion == 'l2_norm':
+            scores = np.sqrt(np.sum(param * param, axis=reduce_dims))
+        else:
+            raise ValueError(f"unsupported criterion {criterion!r}")
+        return scores.argsort()[:prune_num]
+
+    def prune_tensor(self, tensor, pruned_idx, pruned_axis, lazy=False):
+        """lazy=True zeroes the pruned groups (shape-stable — the TPU
+        training mode); lazy=False removes them (export mode)."""
+        mask = np.zeros(tensor.shape[pruned_axis], dtype=bool)
+        mask[np.asarray(pruned_idx, np.int64)] = True
+        if lazy:
+            keep = (~mask).astype(tensor.dtype)
+            shape = [1] * tensor.ndim
+            shape[pruned_axis] = -1
+            return tensor * keep.reshape(shape)
+        return np.take(tensor, np.flatnonzero(~mask), axis=pruned_axis)
+
+
+class PruneStrategy(Strategy):
+    """Base pruning strategy: applies masks to scope params at start_epoch
+    and re-applies them after every batch so pruned groups stay zero through
+    training (ref prune_strategy.py:PruneStrategy, masked formulation)."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 params=None, ratios=None):
+        super().__init__(start_epoch, end_epoch)
+        self.pruner = pruner or StructurePruner()
+        self.params = params or []
+        self.ratios = ratios or []
+        self._masks = {}
+
+    def _scope_get(self, context, name):
+        return np.asarray(context.scope.find(name))
+
+    def _scope_set(self, context, name, value):
+        import jax.numpy as jnp
+        context.scope.set(name, jnp.asarray(value))
+
+    def _build_masks(self, context):
+        self._masks = {}
+        for name, ratio in zip(self.params, self.ratios):
+            w = self._scope_get(context, name)
+            idx = self.pruner.cal_pruned_idx(name, w, ratio)
+            axis = self.pruner.pruning_axis.get(
+                name, self.pruner.pruning_axis.get('*'))
+            mask = np.ones(w.shape[axis], dtype=w.dtype)
+            mask[idx] = 0
+            shape = [1] * w.ndim
+            shape[axis] = -1
+            self._masks[name] = mask.reshape(shape)
+
+    def _apply_masks(self, context):
+        for name, mask in self._masks.items():
+            self._scope_set(context, name,
+                            self._scope_get(context, name) * mask)
+
+    def sparsity(self, context, name):
+        w = self._scope_get(context, name)
+        return float((w == 0).mean())
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch:
+            self._build_masks(context)
+            self._apply_masks(context)
+
+    def on_batch_end(self, context):
+        if self._masks and self.start_epoch <= context.epoch_id:
+            self._apply_masks(context)
+
+    def restore_from_checkpoint(self, context):
+        """Strategy state (params/ratios/masks) rides the Compressor's
+        pickled-strategies checkpoint; re-derive masks from the restored
+        weights and re-apply so pruning survives the resume."""
+        if context.epoch_id > self.start_epoch and self.params:
+            if not self._masks:
+                self._build_masks(context)
+            self._apply_masks(context)
+
+
+class UniformPruneStrategy(PruneStrategy):
+    """ref prune_strategy.py:UniformPruneStrategy — one target ratio applied
+    uniformly to every (or the named) conv filter params."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, params=None, pruning_axis=0,
+                 criterion='l1_norm'):
+        if pruner is None:  # YAML-config path: build from scalar kwargs
+            pruner = StructurePruner({'*': pruning_axis}, {'*': criterion})
+        super().__init__(pruner, start_epoch, end_epoch,
+                         params=params or [], ratios=[])
+        self.target_ratio = target_ratio
+
+    def _ensure_params(self, context):
+        if not self.params:
+            # default: every conv-like (ndim==4) parameter
+            self.params = [
+                p.name for p in context.train_graph.all_parameters()
+                if p._var.shape and len(p._var.shape) == 4]
+        self.ratios = [self.target_ratio] * len(self.params)
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch:
+            self._ensure_params(context)
+            self._build_masks(context)
+            self._apply_masks(context)
+
+    def restore_from_checkpoint(self, context):
+        if context.epoch_id > self.start_epoch:
+            self._ensure_params(context)
+            if not self._masks:
+                self._build_masks(context)
+            self._apply_masks(context)
+
+
+class SensitivePruneStrategy(PruneStrategy):
+    """ref prune_strategy.py:SensitivePruneStrategy — per-param ratios from
+    a sensitivity scan: each param is test-pruned at `delta_rate` steps and
+    the eval-metric drop determines how much it tolerates within
+    `sensitivities_tolerance`."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 delta_rate=0.2, target_ratio=0.5, metric_name=None,
+                 sensitivities_tolerance=0.01, params=None):
+        super().__init__(pruner, start_epoch, end_epoch,
+                         params=params or [], ratios=[])
+        self.delta_rate = delta_rate
+        self.target_ratio = target_ratio
+        self.metric_name = metric_name
+        self.tolerance = sensitivities_tolerance
+
+    def _sensitivity_scan(self, context):
+        """For each param: the largest tested ratio whose eval drop stays
+        within tolerance; baseline from the unpruned eval."""
+        assert context.eval_graph is not None and \
+            context.eval_reader is not None, \
+            "SensitivePruneStrategy needs eval_graph + eval_reader"
+        metric = self.metric_name or sorted(
+            context.eval_graph.out_nodes)[0]
+        base = context.run_eval_graph()[metric]
+        chosen = []
+        for name in self.params:
+            orig = self._scope_get(context, name)
+            best = 0.0
+            ratio = self.delta_rate
+            while ratio < min(1.0, self.target_ratio + 1e-9):
+                idx = self.pruner.cal_pruned_idx(name, orig, ratio)
+                axis = self.pruner.pruning_axis.get(
+                    name, self.pruner.pruning_axis.get('*'))
+                self._scope_set(context, name, self.pruner.prune_tensor(
+                    orig, idx, axis, lazy=True))
+                score = context.run_eval_graph()[metric]
+                if abs(base - score) <= self.tolerance * (abs(base) + 1e-12):
+                    best = ratio
+                else:
+                    break
+                ratio += self.delta_rate
+            self._scope_set(context, name, orig)
+            chosen.append(best)
+        return chosen
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch:
+            if not self.params:
+                self.params = [
+                    p.name for p in context.train_graph.all_parameters()
+                    if p._var.shape and len(p._var.shape) == 4]
+            self.ratios = self._sensitivity_scan(context)
+            self._build_masks(context)
+            self._apply_masks(context)

@@ -1,0 +1,79 @@
+"""QuantizationStrategy for the Compressor pipeline (ref: python/paddle/
+fluid/contrib/slim/quantization/quantization_strategy.py) — rewrites the
+train graph with QAT fake-quant ops at start_epoch and freezes/saves the
+int8 artifacts at end_epoch."""
+from __future__ import annotations
+
+import os
+
+from .core import Strategy
+
+__all__ = ['QuantizationStrategy']
+
+
+class QuantizationStrategy(Strategy):
+    def __init__(self, start_epoch=0, end_epoch=0, float_model_save_path=None,
+                 int8_model_save_path=None, weight_bits=8,
+                 activation_bits=8, activation_quantize_type='abs_max',
+                 weight_quantize_type='abs_max', save_in_nodes=None,
+                 save_out_nodes=None):
+        super().__init__(start_epoch, end_epoch)
+        self.float_model_save_path = float_model_save_path
+        self.int8_model_save_path = int8_model_save_path
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.save_in_nodes = save_in_nodes
+        self.save_out_nodes = save_out_nodes
+
+    def __getstate__(self):
+        # the transpiler holds program references — rebuilt on restore
+        d = dict(self.__dict__)
+        d.pop('_transpiler', None)
+        return d
+
+    def _transpile(self, context):
+        from ..quantize import QuantizeTranspiler
+        t = QuantizeTranspiler(
+            weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits,
+            activation_quantize_type=self.activation_quantize_type,
+            weight_quantize_type=self.weight_quantize_type)
+        graph = context.optimize_graph or context.train_graph
+        t.training_transpile(graph.program)
+        if context.eval_graph is not None:
+            t.training_transpile(context.eval_graph.program)
+        self._transpiler = t
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch:
+            self._transpile(context)
+
+    def restore_from_checkpoint(self, context):
+        # a resume past start_epoch must re-insert the fake-quant ops (the
+        # checkpointed weights are float; the rewrite is not persisted)
+        if context.epoch_id > self.start_epoch:
+            self._transpile(context)
+
+    def on_epoch_end(self, context):
+        if context.epoch_id == self.end_epoch - 1 and \
+                (self.float_model_save_path or self.int8_model_save_path):
+            from ...executor import Executor
+            from ... import io
+            exe = Executor(context.place)
+            graph = context.eval_graph or context.train_graph
+            feeds = self.save_in_nodes or sorted(graph.in_nodes)
+            fetches = self.save_out_nodes or \
+                [graph.out_nodes[k] for k in sorted(graph.out_nodes)]
+            if self.float_model_save_path:
+                os.makedirs(self.float_model_save_path, exist_ok=True)
+                io.save_inference_model(self.float_model_save_path, feeds,
+                                        fetches, exe, graph.program)
+            if self.int8_model_save_path:
+                os.makedirs(self.int8_model_save_path, exist_ok=True)
+                prog = graph.program.clone(for_test=True)
+                self._transpiler.convert_to_int8(prog, context.place,
+                                                 context.scope)
+                io.save_inference_model(self.int8_model_save_path, feeds,
+                                        fetches, exe, prog)

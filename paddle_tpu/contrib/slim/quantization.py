@@ -11,9 +11,9 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..dygraph import Layer
-from ..dygraph.nn import Conv2D, Linear
-from ..dygraph.tape import dispatch_op, Tensor
+from ...dygraph import Layer
+from ...dygraph.nn import Conv2D, Linear
+from ...dygraph.tape import dispatch_op, Tensor
 
 
 class FakeQuantWrapper(Layer):
@@ -191,7 +191,7 @@ class PostTrainingQuantization:
         if self._scales is None:
             self.quantize()
         os.makedirs(save_model_path, exist_ok=True)
-        from ..dygraph.checkpoint import save_dygraph
+        from ...dygraph.checkpoint import save_dygraph
         save_dygraph(self._model.state_dict(),
                      os.path.join(save_model_path, 'model'))
         flat = {}
@@ -234,75 +234,3 @@ class WeightQuantization:
                 axes = tuple(range(1, w.ndim))
                 scales[name] = np.max(np.abs(w), axis=axes)
         return scales
-
-
-class Compressor:
-    """ref: contrib/slim/core/compressor.py — the slim compression
-    pipeline driver (config-file driven prune/quant/distill strategies).
-
-    The TPU build keeps the constructor surface; of the reference's
-    strategies, quantization is implemented (quant_aware/quant_post above
-    lower to STE fake-quant ops that XLA fuses), magnitude pruning adds no
-    TPU win without sparse kernels, and distillation is expressible
-    directly with two models + a KD loss. `run()` executes the
-    epoch/eval loop for the configured quantization strategy.
-    """
-
-    def __init__(self, place=None, scope=None, train_program=None,
-                 train_reader=None, train_feed_list=None,
-                 train_fetch_list=None, eval_program=None, eval_reader=None,
-                 eval_feed_list=None, eval_fetch_list=None,
-                 teacher_programs=(), checkpoint_path=None,
-                 train_optimizer=None, distiller_optimizer=None,
-                 search_space=None):
-        self.place = place
-        self.scope = scope
-        self.train_program = train_program
-        self.train_reader = train_reader
-        self.train_feed_list = train_feed_list
-        self.train_fetch_list = train_fetch_list
-        self.eval_program = eval_program
-        self.eval_reader = eval_reader
-        self.eval_feed_list = eval_feed_list
-        self.eval_fetch_list = eval_fetch_list
-        self.checkpoint_path = checkpoint_path
-        self.epoch = 1
-        self._strategies = []
-
-    def config(self, config_file=None):
-        """Accept a slim YAML config; only the quantization strategy maps
-        to a TPU-meaningful transformation (see class docstring)."""
-        self.config_file = config_file
-
-    def run(self):
-        """Run training with the quantization strategy applied to
-        train_program, returning the final eval fetches. Runs inside the
-        configured scope (global scope when none given)."""
-        import contextlib
-        from ..core.scope import scope_guard
-        from ..executor import Executor
-        from .quantize import QuantizeTranspiler
-        exe = Executor(self.place)
-        guard = scope_guard(self.scope) if self.scope is not None \
-            else contextlib.nullcontext()
-        with guard:
-            return self._run_impl(exe, QuantizeTranspiler)
-
-    def _run_impl(self, exe, QuantizeTranspiler):
-        if self.train_program is not None:
-            QuantizeTranspiler().training_transpile(self.train_program)
-            for _ in range(self.epoch):
-                for data in self.train_reader():
-                    feed = dict(zip(self.train_feed_list or [], data)) \
-                        if self.train_feed_list else data
-                    exe.run(self.train_program, feed=feed,
-                            fetch_list=self.train_fetch_list or [])
-        if self.eval_program is not None and self.eval_reader is not None:
-            outs = []
-            for data in self.eval_reader():
-                feed = dict(zip(self.eval_feed_list or [], data)) \
-                    if self.eval_feed_list else data
-                outs.append(exe.run(self.eval_program, feed=feed,
-                                    fetch_list=self.eval_fetch_list or []))
-            return outs
-        return None

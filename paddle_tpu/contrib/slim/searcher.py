@@ -1,0 +1,89 @@
+"""Evolutionary search controllers (ref: python/paddle/fluid/contrib/slim/
+searcher/controller.py): the simulated-annealing controller light NAS uses.
+Own formulation of the standard SA accept rule — accept a worse solution
+with probability exp(Δreward / T), T decaying geometrically per iteration.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ['EvolutionaryController', 'SAController']
+
+
+class EvolutionaryController:
+    def update(self, tokens, reward):
+        raise NotImplementedError('Abstract method.')
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        raise NotImplementedError('Abstract method.')
+
+    def next_tokens(self):
+        raise NotImplementedError('Abstract method.')
+
+
+class SAController(EvolutionaryController):
+    """Simulated-annealing token search. tokens[i] ∈ [0, range_table[i])."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=None):
+        self._range_table = range_table
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._rng = np.random.RandomState(seed)
+        self._constrain_func = None
+        self._reward = -float('inf')
+        self._tokens = None
+        self._max_reward = -float('inf')
+        self._best_tokens = None
+        self._iter = 0
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens)
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        """SA accept rule: always take improvements; take regressions with
+        probability exp(Δ/T) at the current temperature."""
+        self._iter += 1
+        temperature = self._init_temperature * \
+            self._reduce_rate ** self._iter
+        delta = reward - self._reward
+        if delta > 0 or self._rng.random_sample() <= math.exp(
+                min(0.0, delta) / max(temperature, 1e-12)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self, control_token=None):
+        """Mutate one random position to a different value in its range."""
+        tokens = list(control_token) if control_token else list(self._tokens)
+        new_tokens = list(tokens)
+        index = self._rng.randint(len(self._range_table))
+        span = self._range_table[index]
+        if span > 1:
+            new_tokens[index] = (new_tokens[index] + 1 +
+                                 self._rng.randint(span - 1)) % span
+        if self._constrain_func is None:
+            return new_tokens
+        for _ in range(self._max_iter_number):
+            if self._constrain_func(new_tokens):
+                break
+            index = self._rng.randint(len(self._range_table))
+            new_tokens = list(tokens)
+            new_tokens[index] = self._rng.randint(self._range_table[index])
+        return new_tokens

@@ -1,2 +1,3 @@
 """Incubate namespace (ref: python/paddle/fluid/incubate/__init__.py)."""
 from . import fleet
+from . import data_generator

@@ -1,0 +1,150 @@
+"""User-facing MultiSlot data generators (ref: python/paddle/fluid/incubate/
+data_generator/__init__.py).
+
+A DataGenerator subclass turns raw input lines into the MultiSlot text
+format that `fluid.dataset` (dataset/fluid_dataset.py) consumes:
+`<ids_num> id1 id2 ... <ids_num> ...` per line, one group per slot. The
+reference runs these as subprocesses behind a pipe_command; here
+run_from_stdin/run_from_memory write the same format to stdout (or any
+file object via `write_to_file`) so a generator-produced file round-trips
+through InMemoryDataset → train_from_dataset.
+"""
+from __future__ import annotations
+
+import sys
+
+__all__ = ['DataGenerator', 'MultiSlotDataGenerator',
+           'MultiSlotStringDataGenerator']
+
+
+class DataGenerator:
+    """Base class: override generate_sample (line → [(slot, [feasign…])…])
+    and optionally generate_batch for batch-level preprocessing."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+        self._line_limit = None
+
+    def _set_line_limit(self, line_limit):
+        if not isinstance(line_limit, int):
+            raise ValueError(f"line_limit {type(line_limit)} must be int")
+        if line_limit < 1:
+            raise ValueError("line_limit can not less than 1")
+        self._line_limit = line_limit
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    # ---- drivers ----
+    def _drain(self, lines, out):
+        batch_samples = []
+        for line in lines:
+            for parsed in self.generate_sample(line)():
+                if parsed is None:
+                    continue
+                batch_samples.append(parsed)
+                if len(batch_samples) == self.batch_size_:
+                    for sample in self.generate_batch(batch_samples)():
+                        out.write(self._gen_str(sample))
+                    batch_samples = []
+        if batch_samples:
+            for sample in self.generate_batch(batch_samples)():
+                out.write(self._gen_str(sample))
+
+    def run_from_memory(self, out=None):
+        """Generate from generate_sample(None) — debug/benchmark path."""
+        self._drain([None], out or sys.stdout)
+
+    def run_from_stdin(self, out=None):
+        """stdin lines → MultiSlot lines on stdout (the pipe_command
+        contract of the reference)."""
+        self._drain(sys.stdin, out or sys.stdout)
+
+    def write_to_file(self, lines, path):
+        """Convenience (TPU build): materialize a MultiSlot file for
+        fluid.dataset set_filelist without a shell pipeline."""
+        with open(path, 'w') as f:
+            self._drain(lines, f)
+        return path
+
+    # ---- user hooks ----
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "Please rewrite this function to return a list or tuple: "
+            "[(name, [feasign, ...]), ...] or ((name, [feasign, ...]), ...)")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for sample in samples:
+                yield sample
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "pls use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """[(name, [str, ...]), ...] → `len v1 v2 ...` groups, no type check."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type; "
+                "example: [('words', ['1926', '08', '17']), "
+                "('label', ['1'])]")
+        parts = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """[(name, [int|float, ...]), ...] → MultiSlot line, with slot schema
+    (name, uint64|float) checked consistent across lines."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type; "
+                "example: [('words', [1926, 8, 17]), ('label', [1])]")
+        if self._proto_info is None:
+            self._proto_info = []
+            first = True
+        else:
+            first = False
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    "the complete field set of two given line are "
+                    "inconsistent.")
+        parts = []
+        for index, (name, elements) in enumerate(line):
+            if not isinstance(name, str):
+                raise ValueError(f"name {type(name)} must be in str type")
+            if not isinstance(elements, list):
+                raise ValueError(
+                    f"elements {type(elements)} must be in list type")
+            if not elements:
+                raise ValueError(
+                    "the elements of each field can not be empty, you need "
+                    "padding it in process().")
+            if first:
+                self._proto_info.append((name, "uint64"))
+            else:
+                if name != self._proto_info[index][0]:
+                    raise ValueError(
+                        "the field name of two given line are not match: "
+                        f"require<{self._proto_info[index][0]}>, "
+                        f"get<{name}>.")
+            parts.append(str(len(elements)))
+            for elem in elements:
+                if isinstance(elem, float):
+                    self._proto_info[index] = (name, "float")
+                elif not isinstance(elem, int):
+                    raise ValueError(
+                        f"the type of element {type(elem)} must be in int "
+                        "or float")
+                parts.append(str(elem))
+        return " ".join(parts) + "\n"

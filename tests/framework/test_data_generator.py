@@ -1,0 +1,111 @@
+"""incubate.data_generator round-trip (ref: fluid/incubate/data_generator/
+__init__.py): generator-produced MultiSlot file → InMemoryDataset →
+train_from_dataset (VERDICT r4 item 5)."""
+import io
+
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as L
+from paddle_tpu.incubate.data_generator import (MultiSlotDataGenerator,
+                                                MultiSlotStringDataGenerator)
+
+
+class WordsGen(MultiSlotDataGenerator):
+    def generate_sample(self, line):
+        def local_iter():
+            toks = [int(x) for x in line.split()]
+            yield ("words", toks[:-1]), ("label", [toks[-1]])
+        return local_iter
+
+
+def test_multislot_format():
+    g = WordsGen()
+    out = io.StringIO()
+    g._drain(["10 20 30 1"], out)
+    assert out.getvalue() == "3 10 20 30 1 1\n"
+    assert g._proto_info == [("words", "uint64"), ("label", "uint64")]
+
+
+def test_multislot_float_promotes_schema():
+    class FloatGen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield ("score", [0.5, 1.5]), ("label", [1])
+            return it
+    g = FloatGen()
+    out = io.StringIO()
+    g.run_from_memory(out)
+    assert out.getvalue() == "2 0.5 1.5 1 1\n"
+    assert g._proto_info[0] == ("score", "float")
+
+
+def test_multislot_inconsistent_slots_rejected():
+    class BadGen(MultiSlotDataGenerator):
+        def __init__(self):
+            super().__init__()
+            self.n = 0
+
+        def generate_sample(self, line):
+            def it():
+                self.n += 1
+                if self.n == 1:
+                    yield ("a", [1]), ("b", [2])
+                else:
+                    yield (("a", [1]),)
+            return it
+    g = BadGen()
+    out = io.StringIO()
+    try:
+        g._drain(["x", "y"], out)
+        raise AssertionError("inconsistent slot count not rejected")
+    except ValueError as e:
+        assert "inconsistent" in str(e)
+
+
+def test_string_generator():
+    class SGen(MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield ("words", line.split()), ("label", ["1"])
+            return it
+    g = SGen()
+    out = io.StringIO()
+    g._drain(["a b c"], out)
+    assert out.getvalue() == "3 a b c 1 1\n"
+
+
+def test_generator_file_roundtrip_train_from_dataset(tmp_path):
+    """The full reference recipe: generator writes the MultiSlot file,
+    fluid.dataset parses it, train_from_dataset runs a pass."""
+    rng = np.random.RandomState(0)
+    lines = []
+    for _ in range(32):
+        words = rng.randint(1, 50, 5)
+        label = int(words.sum() % 2)
+        lines.append(" ".join(map(str, words)) + f" {label}")
+    path = str(tmp_path / "part-0.txt")
+    WordsGen().write_to_file(lines, path)
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        words = fluid.data('words', [8, 5], 'int64')
+        label = fluid.data('label', [8, 1], 'int64')
+        emb = L.embedding(words, size=[50, 8])
+        feat = L.reduce_mean(emb, dim=1)
+        logits = L.fc(feat, size=2)
+        loss = L.reduce_mean(L.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    dataset = fluid.DatasetFactory().create_dataset('InMemoryDataset')
+    dataset.set_batch_size(8)
+    dataset.set_use_var([words, label])
+    dataset.set_filelist([path])
+    dataset.load_into_memory()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.train_from_dataset(program=prog, dataset=dataset)
+    w = np.asarray(fluid.global_scope().find(
+        prog.all_parameters()[0].name))
+    assert np.isfinite(w).all()

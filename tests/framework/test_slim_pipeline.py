@@ -1,0 +1,310 @@
+"""slim distillation / pruning / NAS / Compressor pipeline tests
+(ref parity: contrib/slim/{distillation,prune,nas,core} — VERDICT r4 §3)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as L
+from paddle_tpu.contrib import slim
+
+RNG = np.random.RandomState(7)
+B, IN, H, C = 8, 6, 10, 3
+
+
+def _reader(n=4, seed=0):
+    rng = np.random.RandomState(seed)
+
+    def r():
+        for _ in range(n):
+            x = rng.randn(B, IN).astype('float32')
+            y = (np.abs(x[:, :C]).argmax(1)[:, None]).astype('int64')
+            yield {'img': x, 'label': y}
+    return r
+
+
+def _build_student(prefix='s'):
+    """fc→fc classifier; returns (program, startup, feat_name, logit_name,
+    loss_name)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data('img', [B, IN], 'float32')
+        y = fluid.data('label', [B, 1], 'int64')
+        feat = L.fc(x, size=H, act='relu',
+                    param_attr=fluid.ParamAttr(name=prefix + '_w1'))
+        logits = L.fc(feat, size=C,
+                      param_attr=fluid.ParamAttr(name=prefix + '_w2'))
+        loss = L.reduce_mean(
+            L.softmax_with_cross_entropy(logits, y))
+    return prog, startup, feat.name, logits.name, loss.name
+
+
+def _build_teacher():
+    """Wider net with DISTINCT param names (merge shares same-named vars)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data('img', [B, IN], 'float32')
+        feat = L.fc(x, size=H, act='relu',
+                    param_attr=fluid.ParamAttr(name='t_w1'),
+                    name='t_feat')
+        logits = L.fc(feat, size=C,
+                      param_attr=fluid.ParamAttr(name='t_w2'),
+                      name='t_logits')
+    return prog, startup, feat.name, logits.name
+
+
+def test_distillation_strategy_trains_student():
+    s_prog, s_start, s_feat, s_logits, s_loss = _build_student()
+    t_prog, t_start, t_feat, t_logits = _build_teacher()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(s_start)
+    exe.run(t_start)
+
+    train_g = slim.GraphWrapper(s_prog, in_nodes={'img': 0, 'label': 1},
+                                out_nodes={'loss': s_loss})
+    teacher_g = slim.GraphWrapper(t_prog)
+    strategy = slim.DistillationStrategy(
+        distillers=[
+            slim.L2Distiller(s_feat, t_feat, distillation_loss_weight=0.5),
+            slim.SoftLabelDistiller(s_logits, t_logits,
+                                    student_temperature=1.0,
+                                    teacher_temperature=2.0,
+                                    distillation_loss_weight=0.5),
+        ], start_epoch=0, end_epoch=2)
+    comp = slim.Compressor(
+        place=fluid.CPUPlace(), scope=fluid.global_scope(),
+        train_program=train_g, train_reader=_reader(6),
+        teacher_programs=[teacher_g],
+        distiller_optimizer=fluid.optimizer.Adam(5e-3), epoch=2)
+    comp.add_strategy(strategy)
+
+    w_before = np.asarray(fluid.global_scope().find('s_w1')).copy()
+    t_before = np.asarray(fluid.global_scope().find('t_w1')).copy()
+    comp.run()
+    w_after = np.asarray(fluid.global_scope().find('s_w1'))
+    t_after = np.asarray(fluid.global_scope().find('t_w1'))
+    assert not np.allclose(w_before, w_after), "student params did not train"
+    np.testing.assert_array_equal(t_before, t_after)  # teacher frozen
+
+
+def test_fsp_distiller_adds_loss_node():
+    s_prog, s_start, s_feat, s_logits, s_loss = _build_student('sf')
+    t_prog, t_start, t_feat, t_logits = _build_teacher()
+    g = slim.GraphWrapper(s_prog, out_nodes={'loss': s_loss})
+    g.merge(slim.GraphWrapper(t_prog))
+    d = slim.FSPDistiller([(s_feat, s_logits)], [(t_feat, t_logits)])
+    g = d.distiller_loss(g)
+    assert 'fsp_distillation_loss' in g.out_nodes
+    assert g.out_nodes['loss'] != s_loss  # rebound to combined loss
+
+
+def test_structure_pruner_idx_and_tensor():
+    p = slim.StructurePruner({'*': 0}, {'*': 'l1_norm'})
+    w = np.array([[3., 3.], [0.1, 0.1], [2., 2.], [0.2, 0.2]], np.float32)
+    idx = p.cal_pruned_idx('w', w, 0.5)
+    assert sorted(idx.tolist()) == [1, 3]  # two weakest rows
+    lazy = p.prune_tensor(w, idx, 0, lazy=True)
+    assert lazy.shape == w.shape
+    assert np.all(lazy[1] == 0) and np.all(lazy[3] == 0)
+    hard = p.prune_tensor(w, idx, 0, lazy=False)
+    assert hard.shape == (2, 2)
+    np.testing.assert_array_equal(hard, w[[0, 2]])
+
+
+def test_uniform_prune_strategy_keeps_masks_through_training():
+    prog, startup, feat, logits, loss = _build_student('p')
+    with fluid.program_guard(prog):
+        pass
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    train_g = slim.GraphWrapper(prog, out_nodes={'loss': loss})
+    strategy = slim.UniformPruneStrategy(
+        pruner=slim.StructurePruner({'*': 1}, {'*': 'l1_norm'}),
+        start_epoch=0, end_epoch=2, target_ratio=0.5, params=['p_w1'])
+    comp = slim.Compressor(
+        place=fluid.CPUPlace(), scope=fluid.global_scope(),
+        train_program=train_g, train_reader=_reader(5),
+        train_optimizer=fluid.optimizer.SGD(0.05), epoch=2)
+    comp.add_strategy(strategy)
+    comp.run()
+    w = np.asarray(fluid.global_scope().find('p_w1'))
+    col_zero = np.all(w == 0, axis=0)
+    assert col_zero.sum() == H // 2, \
+        f"expected {H // 2} pruned columns, got {col_zero.sum()}"
+    # and training actually happened on the surviving columns
+    assert np.abs(w[:, ~col_zero]).sum() > 0
+
+
+def test_compressor_two_strategy_yaml_config(tmp_path):
+    cfg = """
+version: 1.0
+strategies:
+  quant:
+    class: QuantizationStrategy
+    start_epoch: 0
+    end_epoch: 2
+    weight_bits: 8
+    activation_bits: 8
+  prune:
+    class: UniformPruneStrategy
+    start_epoch: 0
+    end_epoch: 2
+    target_ratio: 0.5
+    pruning_axis: 1
+    params: [c_w1]
+compressor:
+  epoch: 2
+  strategies: [quant, prune]
+"""
+    f = tmp_path / 'compress.yaml'
+    f.write_text(cfg)
+    prog, startup, feat, logits, loss = _build_student('c')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    train_g = slim.GraphWrapper(prog, out_nodes={'loss': loss})
+    comp = slim.Compressor(
+        place=fluid.CPUPlace(), scope=fluid.global_scope(),
+        train_program=train_g, train_reader=_reader(4),
+        train_optimizer=fluid.optimizer.SGD(0.05))
+    comp.config(str(f))
+    assert len(comp.strategies) == 2
+    assert comp.epoch == 2
+    comp.run()
+    # prune strategy held: half the columns of c_w1 are zero
+    w = np.asarray(fluid.global_scope().find('c_w1'))
+    assert np.all(w == 0, axis=0).sum() == H // 2
+    # quant strategy rewrote the train program with fake-quant ops
+    graph = comp.context.optimize_graph or comp.context.train_graph
+    assert any('fake_quant' in op.type for op in graph.ops())
+
+
+def test_compressor_checkpoint_resume_keeps_prune_and_quant(tmp_path):
+    """Kill the run after epoch 0, resume from the checkpoint: prune masks
+    must re-apply and the quant rewrite must be re-inserted (strategy
+    restore_from_checkpoint paths)."""
+    def make(prefix):
+        prog, startup, feat, logits, loss = _build_student(prefix)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        g = slim.GraphWrapper(prog, out_nodes={'loss': loss})
+        comp = slim.Compressor(
+            place=fluid.CPUPlace(), scope=fluid.global_scope(),
+            train_program=g, train_reader=_reader(3),
+            train_optimizer=fluid.optimizer.SGD(0.05),
+            checkpoint_path=str(tmp_path / 'ckpt'), epoch=1)
+        comp.add_strategy(slim.QuantizationStrategy(start_epoch=0,
+                                                    end_epoch=3))
+        comp.add_strategy(slim.UniformPruneStrategy(
+            pruner=slim.StructurePruner({'*': 1}, {'*': 'l1_norm'}),
+            start_epoch=0, end_epoch=3, target_ratio=0.5,
+            params=[prefix + '_w1']))
+        return comp
+
+    comp = make('r')
+    comp.epoch = 1          # first run: one epoch, then "dies"
+    comp.run()
+    # second run resumes from the checkpoint and finishes epochs 1..2
+    comp2 = make('r')
+    comp2.epoch = 3
+    comp2.run()
+    w = np.asarray(fluid.global_scope().find('r_w1'))
+    assert np.all(w == 0, axis=0).sum() == H // 2, \
+        "prune masks lost across checkpoint resume"
+    graph = comp2.context.optimize_graph or comp2.context.train_graph
+    assert any('fake_quant' in op.type for op in graph.ops()), \
+        "quant rewrite lost across checkpoint resume"
+
+
+def test_save_quantized_model(tmp_path):
+    from paddle_tpu import dygraph
+    from paddle_tpu.dygraph.nn import Linear
+    from paddle_tpu.contrib.slim import PostTrainingQuantization
+    rng = np.random.RandomState(0)
+    with dygraph.guard():
+        model = Linear(4, 2)
+
+        def reader():
+            for _ in range(2):
+                yield rng.randn(3, 4).astype('float32')
+        ptq = PostTrainingQuantization(model=model, sample_generator=reader,
+                                       batch_nums=2)
+        out = ptq.save_quantized_model(str(tmp_path / 'q'))
+    import os
+    assert os.path.exists(os.path.join(out, 'quant_scales.npz'))
+
+
+def test_sa_controller_finds_optimum():
+    ctrl = slim.SAController(reduce_rate=0.9, init_temperature=1.0, seed=3)
+    target = [3, 1, 4]
+    ctrl.reset([5, 5, 5], [0, 0, 0])
+
+    def reward(tokens):
+        return -sum(abs(a - b) for a, b in zip(tokens, target))
+
+    tokens = [0, 0, 0]
+    ctrl.update(tokens, reward(tokens))
+    for _ in range(200):
+        tokens = ctrl.next_tokens()
+        ctrl.update(tokens, reward(tokens))
+    assert ctrl.best_tokens == target, \
+        (ctrl.best_tokens, ctrl.max_reward)
+
+
+class _TinySpace(slim.SearchSpace):
+    """Search over fc width exponent; wider → better eval accuracy proxy."""
+
+    def init_tokens(self):
+        return [0]
+
+    def range_table(self):
+        return [3]
+
+    def create_net(self, tokens):
+        width = 4 * (tokens[0] + 1)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.data('img', [B, IN], 'float32')
+            y = fluid.data('label', [B, 1], 'int64')
+            feat = L.fc(x, size=width, act='relu')
+            logits = L.fc(feat, size=C)
+            loss = L.reduce_mean(L.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+        eval_prog = prog.clone(for_test=True)
+        return (startup, prog, eval_prog,
+                {'loss': loss.name}, {'loss': loss.name})
+
+
+def test_light_nas_strategy_searches():
+    strategy = slim.LightNASStrategy(
+        controller=slim.SAController(seed=1), metric_name='loss',
+        search_steps=3, retrain_epoch=1, max_train_batches=2)
+    # reward == metric value; loss is positive so LOWER is worse reward —
+    # invert by searching on negative loss via a wrapper space
+    space = _TinySpace()
+    ctx = slim.Context(place=fluid.CPUPlace(), scope=fluid.global_scope(),
+                       train_reader=_reader(3), eval_reader=_reader(2),
+                       search_space=space)
+    strategy.on_compression_begin(ctx)
+    assert ctx.get('best_tokens') is not None
+    assert ctx.get('best_net') is not None
+
+
+def test_sensitive_prune_strategy_scans():
+    prog, startup, feat, logits, loss = _build_student('sp')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    train_g = slim.GraphWrapper(prog, out_nodes={'loss': loss})
+    eval_g = slim.GraphWrapper(prog.clone(for_test=True),
+                               out_nodes={'loss': loss})
+    strategy = slim.SensitivePruneStrategy(
+        pruner=slim.StructurePruner({'*': 1}, {'*': 'l1_norm'}),
+        start_epoch=0, end_epoch=1, delta_rate=0.3, target_ratio=0.9,
+        metric_name='loss', sensitivities_tolerance=10.0,  # tolerate all
+        params=['sp_w1'])
+    ctx = slim.Context(place=fluid.CPUPlace(), scope=fluid.global_scope(),
+                       train_graph=train_g, train_reader=_reader(2),
+                       eval_graph=eval_g, eval_reader=_reader(2))
+    strategy.on_epoch_begin(ctx)
+    # with huge tolerance every tested ratio passes → ratio 0.9 chosen
+    assert strategy.ratios and strategy.ratios[0] >= 0.89
+    w = np.asarray(fluid.global_scope().find('sp_w1'))
+    assert np.all(w == 0, axis=0).sum() == int(round(H * 0.9))
