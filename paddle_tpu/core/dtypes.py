@@ -57,7 +57,52 @@ def convert_dtype(dtype):
 
 
 def to_jax_dtype(dtype):
-    return _NAME_TO_DTYPE[convert_dtype(dtype)]
+    """Canonical name → the dtype jax will actually use on device.
+
+    int64/uint64 boundary (TPU-first contract): with jax x64 disabled (the
+    default here — TPU integer units and HBM favor 32-bit), `int64`
+    declarations COMPUTE in int32 on device. Mapping int64→int32 up front
+    keeps jax from warning at every asarray; the executor's feed path
+    guards values ≥ 2³¹ with a hard error instead of a silent wrap (see
+    `check_int32_bounds`). Set JAX_ENABLE_X64=1 to opt into true 64-bit
+    (e.g. embedding id spaces ≥ 2³¹) at double the index memory.
+    """
+    from jax import config as _cfg
+    name = convert_dtype(dtype)
+    if name == 'int64' and not _cfg.jax_enable_x64:
+        return jnp.int32
+    return _NAME_TO_DTYPE[name]
+
+
+def runtime_int64():
+    """The device dtype for values declared int64: int32 under the default
+    x64-off config (see to_jax_dtype), real int64 when x64 is enabled.
+    Library code uses this instead of jnp.int64 so jax never emits a
+    truncation warning."""
+    from jax import config as _cfg
+    return jnp.int64 if _cfg.jax_enable_x64 else jnp.int32
+
+
+_INT32_MAX = 2 ** 31 - 1
+_INT32_MIN = -2 ** 31
+
+
+def check_int32_bounds(value, name=''):
+    """Raise on host-side int64 data that will not survive the int64→int32
+    on-device mapping. Called on numpy feeds — never inside a jit."""
+    import numpy as _np
+    from jax import config as _cfg
+    if _cfg.jax_enable_x64:
+        return value
+    a = _np.asarray(value)
+    if a.dtype == _np.int64 and a.size and (
+            a.max(initial=0) > _INT32_MAX or a.min(initial=0) < _INT32_MIN):
+        raise OverflowError(
+            f"int64 feed {name!r} holds values outside int32 range "
+            f"[{_INT32_MIN}, {_INT32_MAX}]; on TPU int64 computes as int32 "
+            "(see core/dtypes.py). Set JAX_ENABLE_X64=1 to enable true "
+            "64-bit integers, or re-index the data below 2^31.")
+    return value
 
 
 def is_float(dtype):

@@ -65,4 +65,10 @@ def guard(place=None):
 def to_variable(value, name=None, zero_copy=None):
     if isinstance(value, Tensor):
         return value
-    return Tensor(np.asarray(value), name=name, stop_gradient=True)
+    value = np.asarray(value)
+    if value.dtype == np.int64:
+        # int64 computes as int32 on device; out-of-range ids must raise,
+        # not wrap (core/dtypes.py int64 boundary contract)
+        from ..core.dtypes import check_int32_bounds
+        check_int32_bounds(value, name or '<to_variable>')
+    return Tensor(value, name=name, stop_gradient=True)

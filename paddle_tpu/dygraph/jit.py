@@ -227,7 +227,11 @@ class StaticFunction:
             if _is_array_like(x):
                 v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
                 if spec_i is not None and spec_i.dtype is not None:
-                    from ..core.dtypes import to_jax_dtype
+                    from ..core.dtypes import (to_jax_dtype,
+                                               check_int32_bounds)
+                    if str(spec_i.dtype) == 'int64' and \
+                            not hasattr(v, 'aval'):
+                        check_int32_bounds(np.asarray(v), 'InputSpec')
                     v = v.astype(to_jax_dtype(spec_i.dtype))
                 arr_vals.append(v)
                 slots.append(None)

@@ -765,9 +765,16 @@ class Executor:
                 # ragged feed: bind the padded data plus the companion
                 # length var that data(lod_level>0) declared
                 if block.has_var(name + '@LEN'):
-                    feed_vals[name + '@LEN'] = jnp.asarray(value.lengths)
+                    from .core.dtypes import check_int32_bounds
+                    feed_vals[name + '@LEN'] = jnp.asarray(
+                        check_int32_bounds(value.lengths, name + '@LEN'))
                 value = value.data
             dtype = block.var(name).dtype if block.has_var(name) else None
+            if dtype == 'int64':
+                # int64 computes as int32 on device (core/dtypes.py); a
+                # feed that would wrap must fail loudly, not silently
+                from .core.dtypes import check_int32_bounds
+                check_int32_bounds(value, name)
             arr = jnp.asarray(value, to_jax_dtype(dtype) if dtype else None)
             if sharding is not None:
                 arr = jax.device_put(arr, sharding)

@@ -75,8 +75,10 @@ class Variable:
         return np.asarray(val)
 
     def set_value(self, value):
-        from .core.dtypes import to_jax_dtype
+        from .core.dtypes import to_jax_dtype, check_int32_bounds
         import jax.numpy as jnp
+        if self.dtype == 'int64':
+            check_int32_bounds(value, self.name)
         global_scope().set(self.name, jnp.asarray(value, to_jax_dtype(self.dtype)))
 
     # math ops are monkey-patched in layers/math_op_patch.py

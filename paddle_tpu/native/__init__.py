@@ -252,7 +252,12 @@ class DataPipeline:
             try:
                 self._lib.ptpu_pipeline_cancel(self._h)
                 t = getattr(self, '_thread', None)
-                if t is not None and t.is_alive():
+                # the GC can run __del__ ON the feed thread (e.g. when the
+                # last consumer reference dies inside it) — joining the
+                # current thread raises
+                import threading
+                if (t is not None and t.is_alive()
+                        and t is not threading.current_thread()):
                     t.join(timeout=5.0)
             finally:
                 self._lib.ptpu_pipeline_destroy(self._h)

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import numpy as np
 import jax
+from ..core.dtypes import runtime_int64 as _i64
 import jax.numpy as jnp
 from jax import lax
 
@@ -117,7 +118,7 @@ def edit_distance(x, label, x_len=None, label_len=None, *, normalized=True):
     d = jax.vmap(per_row)(x, label, xl, ll).astype(jnp.float32)
     if normalized:
         d = d / jnp.maximum(ll.astype(jnp.float32), 1.0)
-    return d[:, None], jnp.asarray([b], jnp.int64)
+    return d[:, None], jnp.asarray([b], _i64())
 
 
 @register_op('warpctc')
@@ -329,7 +330,7 @@ def crf_decoding(emission, transition, length=None):
         path = jnp.concatenate([path_rev[::-1], lastn[None]])
         return path
 
-    return jax.vmap(per_seq)(em, ln).astype(jnp.int64)
+    return jax.vmap(per_seq)(em, ln).astype(_i64())
 
 
 @register_op('chunk_eval', outputs=['Precision', 'Recall', 'F1',
@@ -367,8 +368,8 @@ def chunk_eval(inference, label, length=None, *, num_chunk_types,
     rec = correct / jnp.maximum(num_lab, 1)
     f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-9)
     return (prec.astype(jnp.float32), rec.astype(jnp.float32),
-            f1.astype(jnp.float32), num_inf.astype(jnp.int64),
-            num_lab.astype(jnp.int64), correct.astype(jnp.int64))
+            f1.astype(jnp.float32), num_inf.astype(_i64()),
+            num_lab.astype(_i64()), correct.astype(_i64()))
 
 
 # ---------------------------------------------------------------------------
@@ -398,7 +399,7 @@ def rank_op(x):
 
 @register_op('size')
 def size_op(x):
-    return jnp.asarray(jnp.asarray(x).size, jnp.int64)
+    return jnp.asarray(jnp.asarray(x).size, _i64())
 
 
 @register_op('hash')
@@ -423,7 +424,7 @@ def hash_op(x, *, num_hash=1, mod_by=100000000):
         for c in range(flat.shape[1]):
             acc = mix(acc ^ flat[:, c],
                       (0x9e3779b9 + h * 0x61c88647 + c) & 0xFFFFFFFF)
-        outs.append((acc % jnp.uint32(mod_by)).astype(jnp.int64))
+        outs.append((acc % jnp.uint32(mod_by)).astype(_i64()))
     return jnp.stack(outs, 1)[:, :, None]
 
 
@@ -493,7 +494,7 @@ def filter_by_instag(x, ins_tag, filter_tag, *, is_lod=False,
     w = keep.astype(x.dtype)
     out = jnp.where(keep.reshape((-1,) + (1,) * (x.ndim - 1)), x,
                     jnp.asarray(out_val_if_empty, x.dtype))
-    idx = jnp.arange(x.shape[0], dtype=jnp.int64)
+    idx = jnp.arange(x.shape[0], dtype=_i64())
     return out, w[:, None], jnp.stack([idx, idx], axis=1)
 
 

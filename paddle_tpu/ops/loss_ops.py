@@ -8,6 +8,7 @@ center_loss,accuracy}_op.* — numerically-stable jax formulations.
 from __future__ import annotations
 
 import jax
+from ..core.dtypes import runtime_int64 as _i64
 import jax.numpy as jnp
 from jax import lax
 
@@ -193,8 +194,8 @@ def accuracy(pred, label, *, k=1):
     label = _squeeze_label(label).astype(jnp.int32)
     _, top = lax.top_k(pred, k)
     correct = jnp.any(top == label[:, None], -1)
-    total = jnp.asarray(pred.shape[0], jnp.int64)
-    ncorrect = jnp.sum(correct).astype(jnp.int64)
+    total = jnp.asarray(pred.shape[0], _i64())
+    ncorrect = jnp.sum(correct).astype(_i64())
     return (ncorrect.astype(jnp.float32) / total.astype(jnp.float32),
             ncorrect, total)
 

@@ -16,6 +16,7 @@ checkpoints are not imported from the reference):
 from __future__ import annotations
 
 import jax
+from ..core.dtypes import runtime_int64 as _i64
 import jax.numpy as jnp
 
 from .registry import register_op
@@ -168,9 +169,9 @@ def beam_search_step(pre_ids, pre_scores, ids, scores, *, beam_size, end_id,
     top_scores, top_idx = jax.lax.top_k(flat_scores, W)     # (B, W)
     parent = top_idx // K + (jnp.arange(B) * W)[:, None]    # flat beam index
     sel_ids = ids.reshape(B, W * K)[jnp.arange(B)[:, None], top_idx]
-    return (sel_ids.reshape(BW, 1).astype(jnp.int64),
+    return (sel_ids.reshape(BW, 1).astype(_i64()),
             top_scores.reshape(BW, 1),
-            parent.reshape(BW).astype(jnp.int64))
+            parent.reshape(BW).astype(_i64()))
 
 
 @register_op('gather_tree')

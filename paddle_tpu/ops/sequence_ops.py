@@ -11,6 +11,7 @@ Valid data is always LEFT-PACKED: row b occupies steps [0, length[b]).
 from __future__ import annotations
 
 import jax
+from ..core.dtypes import runtime_int64 as _i64
 import jax.numpy as jnp
 
 from .registry import register_op
@@ -68,21 +69,21 @@ def sequence_pool(x, length=None, *, pool_type='average', pad_value=0.0):
         elif pt == 'sqrt':
             s = s / jnp.sqrt(denom)
         out = s
-        idx = jnp.zeros((x.shape[0], x.shape[2]), jnp.int64)
+        idx = jnp.zeros((x.shape[0], x.shape[2]), _i64())
     elif pt == 'max':
         neg = jnp.where(mask, x, -jnp.inf)
         out = jnp.max(neg, axis=1)
-        idx = jnp.argmax(neg, axis=1).astype(jnp.int64)
+        idx = jnp.argmax(neg, axis=1).astype(_i64())
     elif pt == 'min':
         out = jnp.min(jnp.where(mask, x, jnp.inf), axis=1)
-        idx = jnp.zeros((x.shape[0], x.shape[2]), jnp.int64)
+        idx = jnp.zeros((x.shape[0], x.shape[2]), _i64())
     elif pt in ('first', 'last'):
         t = jnp.zeros_like(lens) if pt == 'first' \
             else jnp.maximum(lens - 1, 0)
         out = jnp.take_along_axis(x, t[:, None, None].astype(jnp.int32),
                                   axis=1)[:, 0]
         idx = jnp.broadcast_to(t[:, None], (x.shape[0], x.shape[2]))
-        idx = idx.astype(jnp.int64)
+        idx = idx.astype(_i64())
     else:
         raise ValueError(f"unknown pool_type {pool_type!r}")
     empty = (lens == 0)[:, None]
@@ -140,7 +141,7 @@ def sequence_pad(x, pad_value, length=None, *, maxlen=-1):
         x = x[:, :maxlen]
     mask = jnp.arange(maxlen)[None, :] < lens[:, None]
     mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
-    return jnp.where(mask, x, pad), lens.astype(jnp.int64)
+    return jnp.where(mask, x, pad), lens.astype(_i64())
 
 
 @register_op('sequence_unpad')
@@ -164,7 +165,7 @@ def sequence_reshape(x, length=None, *, new_dim):
     T_new = T * D // new_dim
     out = x.reshape(B, T_new, new_dim)
     new_lens = (lens * D) // new_dim
-    return out, new_lens.astype(jnp.int64)
+    return out, new_lens.astype(_i64())
 
 
 @register_op('sequence_slice', outputs=('Out', 'OutLen'))
@@ -181,7 +182,7 @@ def sequence_slice(x, offset, slice_length, length=None):
         x, src.reshape((B, T) + (1,) * (x.ndim - 2)), axis=1)
     valid = t_idx < sl[:, None]
     valid = valid.reshape((B, T) + (1,) * (x.ndim - 2))
-    return jnp.where(valid, gathered, 0.0), sl.astype(jnp.int64)
+    return jnp.where(valid, gathered, 0.0), sl.astype(_i64())
 
 
 @register_op('sequence_expand_as')

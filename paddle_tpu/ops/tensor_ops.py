@@ -13,6 +13,7 @@ from __future__ import annotations
 import math
 
 import jax
+from ..core.dtypes import runtime_int64 as _i64
 import jax.numpy as jnp
 from jax import lax
 
@@ -244,14 +245,14 @@ def argsort(x, *, axis=-1, descending=False):
     x = jnp.asarray(x)
     idx = jnp.argsort(-x if descending else x, axis=axis)
     out = jnp.take_along_axis(x, idx, axis=axis)
-    return out, idx.astype(jnp.int64)
+    return out, idx.astype(_i64())
 
 
 @register_op('top_k', outputs=['Out', 'Indices'])
 def top_k(x, *, k):
     x = jnp.asarray(x)
     vals, idx = lax.top_k(x, k)
-    return vals, idx.astype(jnp.int64)
+    return vals, idx.astype(_i64())
 
 
 @register_op('where_index')
@@ -266,7 +267,7 @@ def where_index(cond):
     ranks = jnp.arange(n)
     sel = jnp.where(ranks < count, order[ranks], -1)
     idx = jnp.stack(jnp.unravel_index(jnp.clip(sel, 0, n - 1), cond.shape), -1)
-    return jnp.where(sel[:, None] >= 0, idx, -1).astype(jnp.int64)
+    return jnp.where(sel[:, None] >= 0, idx, -1).astype(_i64())
 
 
 @register_op('where')

@@ -20,8 +20,13 @@ pytestmark = pytest.mark.skipif(
 
 
 def ref_public(path):
+    import warnings
     with open(path) as f:
-        tree = ast.parse(f.read())
+        with warnings.catch_warnings():
+            # the reference sources carry pre-PEP-675 escape sequences;
+            # their SyntaxWarnings are not ours to fix
+            warnings.simplefilter('ignore', SyntaxWarning)
+            tree = ast.parse(f.read())
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
             for t in node.targets:

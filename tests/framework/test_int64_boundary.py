@@ -1,0 +1,66 @@
+"""int64→int32 device-boundary contract (VERDICT r4 item 6): library code
+emits no truncation warnings, and data that would wrap raises instead of
+silently corrupting (core/dtypes.py)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as L
+
+
+def test_int64_feed_no_truncation_warning():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        ids = fluid.data('ids', [4, 3], 'int64')
+        emb = L.embedding(ids, size=[50, 8])
+        out = L.reduce_sum(emb)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with warnings.catch_warnings():
+        warnings.simplefilter('error', UserWarning)  # any truncation → fail
+        r, = exe.run(prog, feed={
+            'ids': np.random.randint(0, 50, (4, 3)).astype(np.int64)},
+            fetch_list=[out])
+    assert np.isfinite(r).all()
+
+
+def test_int64_feed_out_of_range_raises():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        ids = fluid.data('ids', [2, 2], 'int64')
+        out = L.reduce_sum(L.cast(ids, 'float32'))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    bad = np.array([[2 ** 31, 1], [2, 3]], np.int64)
+    with pytest.raises(OverflowError, match='int32 range'):
+        exe.run(prog, feed={'ids': bad}, fetch_list=[out])
+
+
+def test_to_variable_out_of_range_raises():
+    from paddle_tpu import dygraph
+    with dygraph.guard():
+        with pytest.raises(OverflowError, match='int32 range'):
+            fluid.dygraph.to_variable(np.array([2 ** 40], np.int64))
+
+
+def test_set_value_out_of_range_raises():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        v = prog.global_block().create_var(name='ids64', shape=[2],
+                                           dtype='int64', persistable=True)
+    with pytest.raises(OverflowError, match='int32 range'):
+        v.set_value(np.array([2 ** 50, 1], np.int64))
+
+
+def test_in_range_int64_values_preserved():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        ids = fluid.data('ids', [3], 'int64')
+        out = L.scale(ids, scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    vals = np.array([0, 5, 2 ** 31 - 1], np.int64)
+    r, = exe.run(prog, feed={'ids': vals}, fetch_list=[out])
+    np.testing.assert_array_equal(np.asarray(r).astype(np.int64), vals)

@@ -31,7 +31,7 @@ def test_switch_first_true_wins():
                 layers.assign(three, output=out)
     for val, want in [(20.0, 1.0), (7.0, 2.0), (1.0, 3.0)]:
         r, = _run(main, start, {'x': np.array([val], 'float32')}, [out])
-        assert float(r) == want, (val, float(r), want)
+        assert float(np.asarray(r).item()) == want, (val, r, want)
 
 
 def test_ifelse_rowwise_merge():

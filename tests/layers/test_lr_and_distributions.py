@@ -17,7 +17,8 @@ def _run_steps(build_lr, n):
     with fluid.scope_guard(fluid.Scope()):
         exe.run(start)
         for _ in range(n):
-            out.append(float(exe.run(main, fetch_list=[lr])[0]))
+            out.append(float(np.asarray(exe.run(main,
+                                                fetch_list=[lr])[0]).item()))
     return out
 
 
