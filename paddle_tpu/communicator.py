@@ -21,7 +21,10 @@ class Communicator:
 
     def start(self):
         """ref :start — begin async communication (no-op on TPU: XLA
-        collectives run in-step)."""
+        collectives run in-step; a one-time warning makes the semantics
+        change visible to ported async-PS scripts)."""
+        from .transpiler import warn_ps_lowering
+        warn_ps_lowering(self.mode or 'async')
         self._running = True
 
     def stop(self):

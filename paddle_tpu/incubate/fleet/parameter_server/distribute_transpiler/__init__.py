@@ -22,6 +22,13 @@ class TranspilerOptimizer(DistributedOptimizer):
     with default strategy."""
 
     def __init__(self, optimizer, strategy=None):
+        from .....transpiler import warn_ps_lowering
+        mode = 'geo-sgd' if (isinstance(strategy,
+                                        DistributeTranspilerConfig)
+                             and strategy.geo_sgd_mode) else \
+            ('sync' if strategy is None or getattr(strategy, 'sync_mode',
+                                                   True) else 'async')
+        warn_ps_lowering(mode)
         if isinstance(strategy, DistributeTranspilerConfig) or strategy is None:
             ds = DistributedStrategy()
         else:

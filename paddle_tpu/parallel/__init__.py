@@ -15,3 +15,4 @@ from .tensor_parallel import (megatron_param_spec, shard_params,
                               vocab_parallel_embedding)
 from .pipeline import gpipe, stack_stage_params
 from .local_sgd import LocalSGDStep
+from .geo_sgd import GeoSGDStep

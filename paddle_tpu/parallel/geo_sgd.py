@@ -1,0 +1,109 @@
+"""Geo-SGD: delayed delta-sum synchronization (ref: python/paddle/fluid/
+transpiler/geo_sgd_transpiler.py + the geo async-PS runtime).
+
+Reference semantics: each trainer updates a LOCAL copy of the parameters;
+every `need_push_nums` steps it pushes the accumulated DELTA (local - base)
+to the parameter server, which applies the sum of trainer deltas to the
+global base; trainers pull the fresh base and continue. Unlike LocalSGD's
+parameter averaging, geo-SGD SUMS deltas — k local steps on n workers move
+the base by the total of all workers' progress.
+
+TPU-first formulation (same trick as parallel/local_sgd.py): parameters
+carry an explicit leading replica axis sharded over the mesh axis, plus a
+carried `base` copy. Under shard_map each device steps its own replica with
+its own batch shard; every k-th step ONE psum over ICI aggregates the
+deltas, the base advances by their sum, and every replica resets to the new
+base. No per-step collective — the k-step window trades staleness for an
+ICI round, exactly the reference's trade.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class GeoSGDStep:
+    """Jitted geo-SGD training step over `mesh` axis `axis`.
+
+        step = GeoSGDStep(loss_fn, params, mesh, need_push_nums=4, lr=0.1)
+        for batch in data:            # leading dim sharded over `axis`
+            loss = step(batch)
+        final = step.base_params()    # the synchronized base
+    """
+
+    def __init__(self, loss_fn, params, mesh, need_push_nums, lr=0.1,
+                 axis='dp'):
+        self._k = int(need_push_nums)
+        n = self._n = mesh.shape[axis]
+        rep_spec = {name: P(axis, *([None] * jnp.ndim(v)))
+                    for name, v in params.items()}
+        rep_sharding = {name: NamedSharding(mesh, spec)
+                        for name, spec in rep_spec.items()}
+        stacked = {
+            name: jax.device_put(
+                jnp.broadcast_to(jnp.asarray(v), (n,) + jnp.shape(v)),
+                rep_sharding[name])
+            for name, v in params.items()}
+        # local replicas and the base start identical — DISTINCT buffers
+        # (both arguments are donated; aliasing them would donate twice)
+        self._state = (stacked,
+                       jax.tree_util.tree_map(
+                           lambda x: jax.device_put(jnp.array(x), x.sharding),
+                           stacked))
+        self._t = 0
+        k = self._k
+
+        def body(local_stacked, base_stacked, batch, t):
+            local = {m: v[0] for m, v in local_stacked.items()}
+            base = {m: v[0] for m, v in base_stacked.items()}
+            loss, grads = jax.value_and_grad(loss_fn)(local, batch)
+            local = {m: v - lr * grads[m] for m, v in local.items()}
+
+            def push_pull(operand):
+                local, base = operand
+                # sum of per-replica deltas moves the base (geo semantics);
+                # adding the varying `base` keeps the result 'varying', so
+                # both cond branches type-match under shard_map
+                new_base = {
+                    m: base[m] + lax.psum(local[m] - base[m], axis)
+                    for m in base}
+                return new_base, new_base
+
+            def keep(operand):
+                return operand
+
+            local, base = lax.cond((t % k) == (k - 1), push_pull, keep,
+                                   (local, base))
+            return ({m: v[None] for m, v in local.items()},
+                    {m: v[None] for m, v in base.items()},
+                    lax.pmean(loss, axis))
+
+        fn = jax.shard_map(body, mesh=mesh,
+                           in_specs=(rep_spec, rep_spec, P(axis), P()),
+                           out_specs=(rep_spec, rep_spec, P()))
+        self._step = jax.jit(fn, donate_argnums=(0, 1))
+
+    def __call__(self, batch):
+        local, base = self._state
+        local, base, loss = self._step(local, base, jnp.asarray(batch),
+                                       jnp.int32(self._t))
+        self._state = (local, base)
+        self._t += 1
+        return loss
+
+    def replica_params(self):
+        """name → (n_replicas, *shape): the divergent local copies."""
+        return dict(self._state[0])
+
+    def base_params(self):
+        """name → array: the synchronized base (row 0 — identical rows
+        after a push/pull boundary)."""
+        return {m: v[0] for m, v in self._state[1].items()}
+
+    def replicas_in_sync(self, rtol=1e-6):
+        return all(
+            bool(jnp.allclose(v, jnp.broadcast_to(v[:1], v.shape),
+                              rtol=rtol))
+            for v in self._state[0].values())
