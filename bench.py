@@ -86,9 +86,14 @@ def bench_resnet(on_tpu):
     # NHWC on TPU: convs lower without layout transposes — measured ~6%
     # faster end-to-end than NCHW on v5e (PERF.md §2)
     fmt = 'NHWC' if on_tpu else 'NCHW'
+    # opt-in until measured on-chip (tools/bench_fused_conv.py): s2d stem
+    # re-lays the 7×7/s2 stem as 4×4/s1 on the 2×2 space-to-depth grid
+    s2d = on_tpu and os.environ.get('PADDLE_TPU_STEM_S2D', '0') == '1' \
+        and img == 224
 
     with dygraph.guard():
-        model = ResNet50(class_dim=1000, data_format=fmt)
+        model = ResNet50(class_dim=1000, data_format=fmt,
+                         stem_space_to_depth=s2d)
         opt = fluid.optimizer.Momentum(0.1, momentum=0.9,
                                        parameter_list=model.parameters())
 

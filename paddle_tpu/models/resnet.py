@@ -17,7 +17,8 @@ import math
 
 class ConvBNLayer(Layer):
     def __init__(self, num_channels, num_filters, filter_size, stride=1,
-                 groups=1, act=None, data_format='NCHW'):
+                 groups=1, act=None, data_format='NCHW',
+                 space_to_depth=False):
         super().__init__()
         self._conv = Conv2D(num_channels, num_filters, filter_size,
                             stride=stride, padding=(filter_size - 1) // 2,
@@ -25,9 +26,23 @@ class ConvBNLayer(Layer):
                             data_format=data_format)
         self._bn = BatchNorm(num_filters, act=None, data_layout=data_format)
         self._act = act
+        # s2d stem (ops/pallas_conv.py): same 7×7 weight param (checkpoint
+        # compatible), re-laid-out as 4×4/s1 on the 2×2 s2d grid so the MXU
+        # sees 12 input channels instead of 3
+        self._s2d = space_to_depth
+        if space_to_depth and (filter_size != 7 or stride != 2
+                               or data_format != 'NHWC'):
+            raise ValueError('space_to_depth stem requires the 7x7/s2 '
+                             'NHWC stem conv')
 
     def forward(self, x):
-        y = self._bn(self._conv(x))
+        if self._s2d:
+            y = dispatch_op('conv2d_stem_s2d',
+                            {'x': x, 'weight': self._conv.weight},
+                            {'data_format': 'NHWC'})
+        else:
+            y = self._conv(x)
+        y = self._bn(y)
         if self._act:
             y = dispatch_op(self._act, {'x': y}, {})
         return y
@@ -85,13 +100,15 @@ _DEPTH_CFG = {
 
 
 class ResNet(Layer):
-    def __init__(self, layers_depth=50, class_dim=1000, data_format='NCHW'):
+    def __init__(self, layers_depth=50, class_dim=1000, data_format='NCHW',
+                 stem_space_to_depth=False):
         super().__init__()
         depth, block_cls, expansion = _DEPTH_CFG[layers_depth]
         num_filters = [64, 128, 256, 512]
         df = data_format
         self.conv = ConvBNLayer(3, 64, 7, stride=2, act='relu',
-                                data_format=df)
+                                data_format=df,
+                                space_to_depth=stem_space_to_depth)
         self.pool = Pool2D(3, 'max', 2, 1, data_format=df)
         from ..dygraph import LayerList
         self.blocks = LayerList()
@@ -121,8 +138,9 @@ class ResNet(Layer):
         return self.out(y)
 
 
-def ResNet50(class_dim=1000, data_format='NCHW'):
-    return ResNet(50, class_dim, data_format=data_format)
+def ResNet50(class_dim=1000, data_format='NCHW', stem_space_to_depth=False):
+    return ResNet(50, class_dim, data_format=data_format,
+                  stem_space_to_depth=stem_space_to_depth)
 
 
 def ResNet18(class_dim=1000):

@@ -249,6 +249,14 @@ GRAD_SPECS = {
     'conv2d': S(lambda r: [f32(r.standard_normal((1, 2, 5, 5))),
                            f32(r.standard_normal((3, 2, 3, 3)) * 0.3)],
                 diff=(0, 1)),
+    'conv2d_stem_s2d': S(lambda r: [
+        f32(r.standard_normal((1, 15, 15, 3))),
+        f32(r.standard_normal((7, 7, 3, 4)) * 0.2)], diff=(0, 1)),
+    'fused_conv1x1_bn_act': S(lambda r: [
+        f32(r.standard_normal((1, 4, 4, 6))),
+        f32(r.standard_normal((1, 1, 6, 5)) * 0.3),
+        f32(r.random(5) + 0.5), f32(r.standard_normal(5) * 0.1)],
+        diff=(0, 1, 2, 3)),
     'conv2d_transpose': S(lambda r: [f32(r.standard_normal((1, 2, 4, 4))),
                                      f32(r.standard_normal((2, 3, 3, 3))
                                          * 0.3)], diff=(0, 1)),
