@@ -52,6 +52,8 @@ from . import distributed
 from . import contrib
 from . import profiler
 from . import debugger
+from . import log_helper
+from . import annotations
 from . import average
 from . import evaluator
 from . import install_check

@@ -4,3 +4,4 @@ mesh data-parallelism with XLA collectives (SURVEY 2.8)."""
 from . import base
 from . import collective
 from . import parameter_server
+from . import utils

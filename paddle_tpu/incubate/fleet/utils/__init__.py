@@ -1,0 +1,12 @@
+"""Fleet operational utilities (ref: python/paddle/fluid/incubate/fleet/
+utils/)."""
+from . import fleet_util
+from . import fleet_barrier_util
+from . import hdfs
+from . import utils
+from .fleet_util import FleetUtil
+from .fleet_barrier_util import check_all_trainers_ready
+from .hdfs import HDFSClient
+
+__all__ = ['FleetUtil', 'check_all_trainers_ready', 'HDFSClient',
+           'fleet_util', 'fleet_barrier_util', 'hdfs', 'utils']

@@ -1,0 +1,32 @@
+"""Trainer file-barrier (ref: python/paddle/fluid/incubate/fleet/utils/
+fleet_barrier_util.py — HDFS touch-file barrier). Same protocol over the
+shared filesystem: each trainer touches ready/<epoch>.<trainer_id>; the
+barrier completes when all trainer files for the epoch exist."""
+from __future__ import annotations
+
+import os
+import time
+
+__all__ = ['check_all_trainers_ready']
+
+
+def check_all_trainers_ready(ready_path, epoch, timeout=None, poll=0.2):
+    from ....parallel.fleet import fleet
+    trainer_id = fleet.worker_index     # property on the collective fleet
+    trainers = max(fleet.worker_num(), 1)
+    os.makedirs(ready_path, exist_ok=True)
+    mine = os.path.join(ready_path, f'{epoch}.{trainer_id}')
+    with open(mine, 'w') as f:
+        f.write(str(time.time()))
+    deadline = None if timeout is None else time.time() + timeout
+    while True:
+        ready = sum(os.path.exists(os.path.join(ready_path,
+                                                f'{epoch}.{i}'))
+                    for i in range(trainers))
+        if ready >= trainers:
+            return True
+        if deadline is not None and time.time() > deadline:
+            raise TimeoutError(
+                f'barrier {ready_path} epoch {epoch}: {ready}/{trainers} '
+                'trainers ready')
+        time.sleep(poll)

@@ -1,0 +1,413 @@
+"""Fleet operational toolkit (ref: python/paddle/fluid/incubate/fleet/
+utils/fleet_util.py — the pslib online-learning utilities).
+
+TPU lowering notes:
+- rank gating uses the collective fleet's worker_index (one process per
+  host; rank 0 speaks);
+- the reference's mpi all-reduce of AUC stat buckets is an identity here:
+  the jitted step already psums metric stats across the mesh, so the scope
+  holds GLOBAL buckets (ref fleet_util.py:186 reduces per-worker copies);
+- model artifacts follow the same output_path/day/pass directory protocol
+  (donefiles included) over the local/shared filesystem via io.py;
+  pslib embedding-table RPC ops (load_fleet_model_one_table etc.) have no
+  TPU meaning and raise with a pointer to the checkpoint API.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from .... import io as _io
+from ....executor import Executor
+from ....core.scope import global_scope
+
+__all__ = ['FleetUtil']
+
+
+class FleetUtil:
+    """ref fleet_util.py:40 — operational helpers for fleet training."""
+
+    def __init__(self, mode='collective'):
+        self.mode = mode
+
+    # ---- rank-0 logging ----
+    def _rank(self):
+        from ....parallel.fleet import fleet
+        try:
+            return fleet.worker_index   # property on the collective fleet
+        except Exception:
+            return 0
+
+    def rank0_print(self, s):
+        """ref :63 — only worker 0 prints."""
+        if self._rank() == 0:
+            print(s, flush=True)
+
+    def rank0_info(self, s):
+        if self._rank() == 0:
+            import logging
+            logging.getLogger(__name__).info(s)
+
+    def rank0_error(self, s):
+        if self._rank() == 0:
+            import logging
+            logging.getLogger(__name__).error(s)
+
+    # ---- metric helpers ----
+    def set_zero(self, var_name, scope=None, place=None, param_type='int64'):
+        """ref :121 — zero a stat var in the scope."""
+        scope = scope or global_scope()
+        import jax.numpy as jnp
+        from ....core.dtypes import to_jax_dtype
+        cur = scope.find(var_name)
+        if cur is None:
+            raise KeyError(f'{var_name} not in scope')
+        scope.set(var_name, jnp.zeros(jnp.asarray(cur).shape,
+                                      to_jax_dtype(param_type)))
+
+    @staticmethod
+    def _auc_from_buckets(pos, neg):
+        pos = np.asarray(pos, np.float64).reshape(-1)
+        neg = np.asarray(neg, np.float64).reshape(-1)
+        num_bucket = pos.size
+        area = new_pos = new_neg = p = n = 0.0
+        total = 0.0
+        for i in range(num_bucket):
+            idx = num_bucket - 1 - i
+            new_pos = p + pos[idx]
+            new_neg = n + neg[idx]
+            total += pos[idx] + neg[idx]
+            area += (new_neg - n) * (p + new_pos) / 2.0
+            p, n = new_pos, new_neg
+        if p * n == 0 or total == 0:
+            return 0.5, int(total)
+        return float(area / (p * n)), int(total)
+
+    def get_global_auc(self, scope=None, stat_pos='_generated_var_2',
+                       stat_neg='_generated_var_3'):
+        """ref :186 — AUC from the pos/neg stat buckets. The buckets in
+        scope are already global (in-step psum), so no host all-reduce."""
+        scope = scope or global_scope()
+        pos = scope.find(stat_pos)
+        neg = scope.find(stat_neg)
+        if pos is None or neg is None:
+            self.rank0_print('not found auc bucket')
+            return None
+        auc, _ = self._auc_from_buckets(np.asarray(pos), np.asarray(neg))
+        return auc
+
+    def print_global_auc(self, scope=None, stat_pos='_generated_var_2',
+                         stat_neg='_generated_var_3',
+                         print_prefix=''):
+        """ref :147."""
+        auc = self.get_global_auc(scope, stat_pos, stat_neg)
+        self.rank0_print(f'{print_prefix} global auc = {auc}')
+        return auc
+
+    def get_global_metrics(self, scope=None,
+                           stat_pos_name='_generated_var_2',
+                           stat_neg_name='_generated_var_3',
+                           sqrerr_name='sqrerr', abserr_name='abserr',
+                           prob_name='prob', q_name='q',
+                           pos_ins_num_name='pos', total_ins_num_name='total'):
+        """ref :1268 — the 8-metric CTR bundle (auc, bucket_error, mae,
+        rmse, actual_ctr, predicted_ctr, copc, mean_q, ins count)."""
+        scope = scope or global_scope()
+
+        def val(name):
+            v = scope.find(name)
+            return None if v is None else float(np.asarray(v).sum())
+
+        pos_b = scope.find(stat_pos_name)
+        neg_b = scope.find(stat_neg_name)
+        if pos_b is None or neg_b is None:
+            self.rank0_print('not found auc bucket')
+            return None
+        pos_arr = np.asarray(pos_b, np.float64).reshape(-1)
+        neg_arr = np.asarray(neg_b, np.float64).reshape(-1)
+        auc, _ = self._auc_from_buckets(pos_arr, neg_arr)
+        total = val(total_ins_num_name) or 0.0
+        pos = val(pos_ins_num_name) or 0.0
+        sqrerr = val(sqrerr_name) or 0.0
+        abserr = val(abserr_name) or 0.0
+        prob = val(prob_name) or 0.0
+        q = val(q_name) or 0.0
+        keys = ('auc', 'bucket_error', 'mae', 'rmse', 'actual_ctr',
+                'predicted_ctr', 'copc', 'mean_q', 'total_ins_num')
+        if total <= 0:   # empty pass: stable key set, zeroed stats
+            out = dict.fromkeys(keys, 0.0)
+            out.update(auc=auc, total_ins_num=0)
+            return out
+        actual_ctr = pos / total
+        predicted_ctr = prob / total
+        return {
+            'auc': auc,
+            'bucket_error': self._bucket_error(pos_arr, neg_arr),
+            'mae': abserr / total,
+            'rmse': float(np.sqrt(sqrerr / total)),
+            'actual_ctr': actual_ctr,
+            'predicted_ctr': predicted_ctr,
+            'copc': (actual_ctr / predicted_ctr) if predicted_ctr else 0.0,
+            'mean_q': q / total,
+            'total_ins_num': int(total),
+        }
+
+    @staticmethod
+    def _bucket_error(pos, neg, k_max_span=0.01,
+                      k_relative_error_bound=0.05):
+        """ref :1408 — calibration error over merged prediction buckets:
+        buckets merge until the adjusted CTR estimate is statistically
+        tight (relative error < bound), then the |actual/predicted - 1|
+        deviation is impression-weighted."""
+        import math
+        num_bucket = pos.size
+        last_ctr, impression_sum, ctr_sum, click_sum = -1.0, 0.0, 0.0, 0.0
+        error_sum, error_count = 0.0, 0.0
+        for i in range(num_bucket):
+            click = pos[i]
+            show = pos[i] + neg[i]
+            ctr = float(i) / num_bucket
+            if abs(ctr - last_ctr) > k_max_span:
+                last_ctr = ctr
+                impression_sum = ctr_sum = click_sum = 0.0
+            impression_sum += show
+            ctr_sum += ctr * show
+            click_sum += click
+            if impression_sum == 0:
+                continue
+            adjust_ctr = ctr_sum / impression_sum
+            if adjust_ctr == 0:
+                continue
+            relative_error = math.sqrt(
+                (1 - adjust_ctr) / (adjust_ctr * impression_sum))
+            if relative_error < k_relative_error_bound:
+                actual = click_sum / impression_sum
+                error_sum += abs(actual / adjust_ctr - 1) * impression_sum
+                error_count += impression_sum
+                last_ctr = -1
+        return error_sum / error_count if error_count > 0 else 0.0
+
+    def print_global_metrics(self, scope=None, print_prefix='', **kw):
+        """ref :1457."""
+        m = self.get_global_metrics(scope, **kw)
+        self.rank0_print(f'{print_prefix} global metrics: {m}')
+        return m
+
+    # ---- model artifact protocol (output_path/day/pass dirs + donefiles)
+    def _model_dir(self, output_path, day, pass_id=None):
+        d = os.path.join(output_path, str(day))
+        if pass_id is not None:
+            d = os.path.join(d, str(pass_id))
+        return d
+
+    def save_model(self, output_path, day, pass_id, program=None):
+        """ref :670 — persist the (train) program state under
+        output_path/day/pass_id."""
+        d = self._model_dir(output_path, day, pass_id)
+        os.makedirs(d, exist_ok=True)
+        from ....framework import default_main_program
+        _io.save_persistables(Executor(), d,
+                              program or default_main_program())
+        return d
+
+    def load_model(self, output_path, day, pass_id, program=None):
+        """ref :645."""
+        d = self._model_dir(output_path, day, pass_id)
+        from ....framework import default_main_program
+        _io.load_persistables(Executor(), d,
+                              program or default_main_program())
+        return d
+
+    def save_batch_model(self, output_path, day, program=None):
+        """ref :695 — day-level (batch) model dir."""
+        return self.save_model(output_path, day, None, program)
+
+    def save_delta_model(self, output_path, day, pass_id, program=None):
+        """ref :718 — delta dirs share the pass protocol here (dense state
+        has no sparse-delta distinction on TPU)."""
+        return self.save_model(output_path, 'delta-' + str(day), pass_id,
+                               program)
+
+    def save_paddle_inference_model(self, executor, scope, program,
+                                    feeded_vars, target_vars, output_path,
+                                    day, pass_id, hadoop_fs_name=None,
+                                    hadoop_fs_ugi=None, **kw):
+        """ref :876 — inference slice under the day/pass dir."""
+        d = self._model_dir(output_path, day, pass_id)
+        os.makedirs(d, exist_ok=True)
+        feeds = [v if isinstance(v, str) else v.name for v in feeded_vars]
+        _io.save_inference_model(d, feeds, list(target_vars), executor,
+                                 program)
+        return d
+
+    def save_paddle_params(self, executor, scope, program, model_name,
+                           output_path, day, pass_id, **kw):
+        """ref :965."""
+        d = self._model_dir(output_path, day, pass_id)
+        os.makedirs(d, exist_ok=True)
+        _io.save_params(executor, d, program, filename=model_name)
+        return d
+
+    # ---- donefiles ----
+    def write_model_donefile(self, output_path, day, pass_id, xbox_base_key,
+                             donefile_name='donefile.txt', **kw):
+        """ref :362 — append 'day\\tkey\\tpath\\tpass' to the donefile."""
+        path = self._model_dir(output_path, day, pass_id)
+        done = os.path.join(output_path, donefile_name)
+        os.makedirs(output_path, exist_ok=True)
+        with open(done, 'a') as f:
+            f.write(f'{day}\t{xbox_base_key}\t{path}\t{pass_id}\t0\n')
+        return done
+
+    def write_xbox_donefile(self, output_path, day, pass_id, xbox_base_key,
+                            donefile_name=None, **kw):
+        """ref :456 — xbox (online serving) donefile, same local protocol."""
+        name = donefile_name or ('xbox_base_done.txt' if pass_id in (-1, '-1')
+                                 else 'xbox_patch_done.txt')
+        return self.write_model_donefile(output_path, day, pass_id,
+                                         xbox_base_key, name)
+
+    def write_cache_donefile(self, output_path, day, pass_id, key_num,
+                             donefile_name='sparse_cache.meta', **kw):
+        """ref :568."""
+        return self.write_model_donefile(output_path, day, pass_id, key_num,
+                                         donefile_name)
+
+    def _last_done_entry(self, output_path, donefile_name):
+        done = os.path.join(output_path, donefile_name)
+        if not os.path.exists(done):
+            return None
+        lines = [l for l in open(done).read().splitlines() if l.strip()]
+        return lines[-1].split('\t') if lines else None
+
+    def get_last_save_model(self, output_path,
+                            donefile_name='donefile.txt', **kw):
+        """ref :1158 — (day, pass_id, path, xbox_base_key)."""
+        e = self._last_done_entry(output_path, donefile_name)
+        if e is None:
+            return [-1, -1, '', int(time.time())]
+        return [int(e[0]), int(e[3]), e[2], int(e[1])]
+
+    def get_last_save_xbox(self, output_path,
+                           donefile_name='xbox_patch_done.txt', **kw):
+        """ref :1112."""
+        return self.get_last_save_model(output_path, donefile_name)
+
+    def get_last_save_xbox_base(self, output_path,
+                                donefile_name='xbox_base_done.txt', **kw):
+        """ref :1067."""
+        e = self._last_done_entry(output_path, donefile_name)
+        if e is None:
+            return [-1, '', int(time.time())]
+        return [int(e[0]), e[2], int(e[1])]
+
+    # ---- schedule logic ----
+    def get_online_pass_interval(self, days, hours, split_interval,
+                                 split_per_pass, is_data_hourly_placed):
+        """ref :1207 — pure schedule arithmetic (no shell expansion; pass
+        explicit lists or '0..23'-style ranges)."""
+        def expand(spec):
+            if isinstance(spec, (list, tuple)):
+                return [str(s) for s in spec]
+            spec = str(spec).strip('{}')
+            if '..' in spec:
+                a, b = spec.split('..')
+                width = len(a)
+                return [str(i).zfill(width) for i in
+                        range(int(a), int(b) + 1)]
+            return spec.split()
+
+        hours = expand(hours)
+        split_interval = int(split_interval)
+        split_per_pass = int(split_per_pass)
+        splits_per_day = 24 * 60 // split_interval
+        pass_per_day = splits_per_day // split_per_pass
+        left, right = int(hours[0]), int(hours[-1])
+        split_path = []
+        start = 0
+        for _ in range(splits_per_day):
+            h, m = start // 60, start % 60
+            start += split_interval
+            if h < left or h > right:
+                continue
+            split_path.append('%02d' % h if is_data_hourly_placed
+                              else '%02d%02d' % (h, m))
+        online_pass_interval = []
+        start = 0
+        for _ in range(pass_per_day):
+            chunk = split_path[start:start + split_per_pass]
+            if not chunk:
+                break
+            online_pass_interval.append(chunk)
+            start += split_per_pass
+        return online_pass_interval
+
+    # ---- program tooling (delegates) ----
+    def program_type_trans(self, prog_dir, prog_fn, is_text):
+        from .utils import program_type_trans
+        return program_type_trans(prog_dir, prog_fn, is_text)
+
+    def draw_from_program_file(self, model_filename, is_text, output_dir,
+                               output_name):
+        from .utils import load_program
+        return self.draw_from_program(load_program(model_filename, is_text),
+                                      output_dir, output_name)
+
+    def draw_from_program(self, program, output_dir, output_name):
+        from .utils import graphviz
+        return graphviz(program.global_block(), output_dir, output_name)
+
+    def check_two_programs(self, config):
+        from .utils import load_program, check_pruned_program_vars
+        train = load_program(config.train_prog_path,
+                             getattr(config, 'is_text_train_program', False))
+        pruned = load_program(config.pruned_prog_path,
+                              getattr(config, 'is_text_pruned_program',
+                                      False))
+        problems = check_pruned_program_vars(train, pruned)
+        for p in problems:
+            self.rank0_error(p)
+        return not problems
+
+    def check_vars_and_dump(self, config):
+        from .utils import check_not_expected_ops, load_program
+        prog = load_program(config.pruned_prog_path,
+                            getattr(config, 'is_text_pruned_program', False))
+        bad = check_not_expected_ops(prog)
+        for b in bad:
+            self.rank0_error(f'unexpected op in inference program: {b}')
+        return not bad
+
+    # ---- pslib-only RPC surface ----
+    def _no_pslib(self, name):
+        raise RuntimeError(
+            f'{name} drives pslib embedding-table RPC, which has no TPU '
+            'equivalent — dense+sparse state is mesh-sharded and saved via '
+            'save_model/load_model (orbax/io checkpoints).')
+
+    def load_fleet_model_one_table(self, table_id, path):
+        self._no_pslib('load_fleet_model_one_table')
+
+    def load_fleet_model(self, path, mode=0):
+        self._no_pslib('load_fleet_model')
+
+    def save_fleet_model(self, path, mode=0):
+        self._no_pslib('save_fleet_model')
+
+    def pull_all_dense_params(self, scope, program):
+        """ref :833 — on TPU dense params already live in the scope; return
+        their names (the reference returns the pulled var list)."""
+        scope = scope or global_scope()
+        return [v.name for v in program.list_vars()
+                if v.persistable and scope.find(v.name) is not None]
+
+    def save_cache_model(self, output_path, day, pass_id, mode=1, **kw):
+        self._no_pslib('save_cache_model')
+
+    def save_cache_base_model(self, output_path, day, **kw):
+        self._no_pslib('save_cache_base_model')
+
+    def save_xbox_base_model(self, output_path, day, **kw):
+        return self.save_model(output_path, day, -1)

@@ -1,0 +1,7 @@
+"""ref: python/paddle/fluid/incubate/fleet/utils/hdfs.py — the fleet-side
+HDFS client. One implementation lives in contrib/utils/hdfs_utils.py;
+re-exported here so fleet scripts' import path works unchanged."""
+from ....contrib.utils.hdfs_utils import HDFSClient, multi_download, \
+    multi_upload
+
+__all__ = ['HDFSClient', 'multi_download', 'multi_upload']

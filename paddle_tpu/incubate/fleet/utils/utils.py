@@ -1,0 +1,168 @@
+"""Fleet program/var tooling (ref: python/paddle/fluid/incubate/fleet/
+utils/utils.py). Programs serialize through the json IR (io.py) — the
+text/binary distinction of the reference's protobuf path collapses to one
+format, but both spellings load it."""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ....framework import Program
+from .... import io as _io
+
+__all__ = [
+    'load_program', 'load_program_binary', 'load_program_text',
+    'save_program', 'program_type_trans', 'check_pruned_program_vars',
+    'graphviz', 'save_var', 'load_var', 'reader', 'feed_gen',
+    'check_not_expected_ops', 'check_saved_vars_try_dump', 'parse_program',
+]
+
+import logging as _logging
+from ....log_helper import get_logger
+logger = get_logger(__name__, _logging.INFO,
+                    fmt='%(asctime)s-%(levelname)s: %(message)s')
+
+
+def load_program(model_filename, is_text=False):
+    """ref utils.py:51 — load a serialized Program (json IR either way)."""
+    with open(model_filename) as f:
+        return _io._program_from_dict(json.load(f))
+
+
+def load_program_binary(model_filename):
+    return load_program(model_filename, is_text=False)
+
+
+def load_program_text(model_filename):
+    return load_program(model_filename, is_text=True)
+
+
+def save_program(program, model_filename='__model__', is_text=False):
+    """ref utils.py:74."""
+    with open(model_filename, 'w') as f:
+        json.dump(_io._program_to_dict(program), f)
+
+
+def program_type_trans(prog_dir, prog_fn, is_text):
+    """ref utils.py:128 — re-serialize a program 'in the other format'
+    (single json IR here; written alongside with the .bin/.pbtxt-style
+    suffix so downstream path expectations hold)."""
+    prog = load_program(os.path.join(prog_dir, prog_fn), is_text)
+    out = prog_fn + ('.bin' if is_text else '.pbtxt')
+    save_program(prog, os.path.join(prog_dir, out), not is_text)
+    return out
+
+
+def check_pruned_program_vars(train_prog, pruned_prog):
+    """ref utils.py:83 — every var the pruned (inference) program keeps
+    must exist in the train program with identical shape/dtype."""
+    problems = []
+    train_vars = {v.name: v for v in train_prog.list_vars()}
+    for v in pruned_prog.list_vars():
+        if v.is_data:
+            continue
+        tv = train_vars.get(v.name)
+        if tv is None:
+            problems.append(f'{v.name}: missing from train program')
+        elif tuple(tv.shape or ()) != tuple(v.shape or ()) or \
+                tv.dtype != v.dtype:
+            problems.append(
+                f'{v.name}: train {tv.shape}/{tv.dtype} != pruned '
+                f'{v.shape}/{v.dtype}')
+    return problems
+
+
+def graphviz(block, output_dir='', filename='debug'):
+    """ref utils.py:115 — dot render of a block via the debugger."""
+    from ....debugger import draw_block_graphviz
+    path = os.path.join(output_dir, filename + '.dot')
+    draw_block_graphviz(block, path=path)
+    return path
+
+
+def save_var(np_array, var_name, shape_list, dtype, save_path):
+    """ref utils.py:149 — raw little-endian dump of one var."""
+    np.asarray(np_array, dtype).reshape(shape_list).tofile(save_path)
+    return save_path
+
+
+def load_var(var_name, shape_list, dtype, save_path):
+    """ref utils.py:159."""
+    return np.fromfile(save_path, dtype).reshape(shape_list)
+
+
+def reader(batch_size, fn, dim):
+    """ref utils.py:170 — list of (batch_size, *dim) float batches. Each
+    line is consumed batch_size·prod(dim) floats at a time, so one line
+    may yield several batches (the reference's `while len(fields) >= dim`
+    loop); leftover floats shorter than a full batch are dropped, exactly
+    as in the reference."""
+    data = []
+    shape = list(dim) if isinstance(dim, (list, tuple)) else [dim]
+    per_sample = int(np.prod(shape))
+    shape = [batch_size] + shape
+    per_batch = per_sample * batch_size
+    with open(fn) as f:
+        for line in f:
+            fields = [float(d) for d in line.strip().split(' ') if d]
+            while len(fields) >= per_batch:
+                tmp, fields = fields[:per_batch], fields[per_batch:]
+                data.append(np.array(tmp).reshape(shape))
+    return data
+
+
+def feed_gen(batch_size, feeded_vars_dims, feeded_vars_filelist):
+    """ref utils.py:194 — per-var batch lists."""
+    return [reader(batch_size, fn, feeded_vars_dims[i])
+            for i, fn in enumerate(feeded_vars_filelist)]
+
+
+def check_not_expected_ops(prog, not_expected_op_types=('lookup_table',)):
+    """ref utils.py:349 — report ops an inference program should not
+    contain (e.g. distributed lookup tables that need the PS runtime)."""
+    found = sorted({op.type for b in prog.blocks for op in b.ops
+                    if op.type in set(not_expected_op_types)})
+    return found
+
+
+def check_saved_vars_try_dump(dump_dir, dump_prog_fn, is_text_dump_program,
+                              feeded_vars=None, fetch_list=None,
+                              batch_size=1, save_filename=None):
+    """ref utils.py:359 — load the dumped program, verify its persistable
+    vars against the saved state, and return (program, problems)."""
+    prog = load_program(os.path.join(dump_dir, dump_prog_fn),
+                        is_text_dump_program)
+    state_path = os.path.join(dump_dir, save_filename or 'params.npz')
+    if not os.path.exists(state_path):
+        # nothing to verify against must FAIL the check, not pass it
+        return prog, [f'saved state not found at {state_path}']
+    with np.load(state_path) as data:
+        saved = {k: data[k].shape for k in data.files}
+    problems = []
+    for v in prog.list_vars():
+        if not v.persistable or v.is_data:
+            continue
+        if v.name not in saved:
+            problems.append(f'{v.name}: not in saved state')
+        elif v.shape and tuple(saved[v.name]) != tuple(v.shape):
+            problems.append(f'{v.name}: saved {saved[v.name]} != '
+                            f'program {v.shape}')
+    return prog, problems
+
+
+def parse_program(program, output_dir):
+    """ref utils.py:381 — dump a human-readable program report."""
+    os.makedirs(output_dir, exist_ok=True)
+    path = os.path.join(output_dir, 'program.txt')
+    with open(path, 'w') as f:
+        for b in program.blocks:
+            f.write(f'block {b.idx} (parent {b.parent_idx})\n')
+            for v in b.vars.values():
+                f.write(f'  var {v.name} shape={v.shape} '
+                        f'dtype={v.dtype} persistable={v.persistable}\n')
+            for op in b.ops:
+                f.write(f'  op {op.type} inputs={op.inputs} '
+                        f'outputs={op.outputs}\n')
+    return path

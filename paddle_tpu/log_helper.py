@@ -1,0 +1,21 @@
+"""ref: python/paddle/fluid/log_helper.py — per-module logger that does not
+touch logging.basicConfig (so importing the framework never hijacks the
+application's logging setup)."""
+from __future__ import annotations
+
+import logging
+
+__all__ = ['get_logger']
+
+
+def get_logger(name, level, fmt=None):
+    """Logger with its own handler/level, basicConfig untouched."""
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if not logger.handlers:     # idempotent: repeat calls add no handlers
+        handler = logging.StreamHandler()
+        if fmt:
+            handler.setFormatter(logging.Formatter(fmt=fmt))
+        logger.addHandler(handler)
+    logger.propagate = False
+    return logger

@@ -130,6 +130,39 @@ def test_contrib_parity():
         assert not missing, f'{m}: missing {missing}'
 
 
+def test_fleet_utils_parity():
+    from paddle_tpu.incubate.fleet import utils as fu
+    import paddle_tpu.incubate.fleet.utils.utils as fuu
+    import paddle_tpu.incubate.fleet.utils.fleet_util as fut
+    for mod, have in [('fluid.incubate.fleet.utils.fleet_util', dir(fut)),
+                      ('fluid.incubate.fleet.utils.fleet_barrier_util',
+                       dir(fu.fleet_barrier_util)),
+                      ('fluid.incubate.fleet.utils.utils', dir(fuu))]:
+        names = ref_public(ref_path(mod))
+        missing = sorted(n for n in names if n not in set(have))
+        assert not missing, f'{mod}: missing {missing}'
+    # FleetUtil methods themselves
+    ref_methods = {
+        'rank0_print', 'set_zero', 'print_global_auc', 'get_global_auc',
+        'load_fleet_model', 'save_fleet_model', 'write_model_donefile',
+        'write_xbox_donefile', 'get_last_save_model', 'get_last_save_xbox',
+        'get_online_pass_interval', 'get_global_metrics',
+        'print_global_metrics', 'save_paddle_inference_model',
+        'draw_from_program', 'check_two_programs'}
+    from paddle_tpu.incubate.fleet.utils import FleetUtil
+    missing = sorted(m for m in ref_methods if not hasattr(FleetUtil, m))
+    assert not missing, f'FleetUtil missing {missing}'
+
+
+def test_log_helper_and_annotations_parity():
+    import paddle_tpu.log_helper as lh
+    import paddle_tpu.annotations as an
+    assert not {n for n in ref_public(ref_path('fluid.log_helper'))
+                if not hasattr(lh, n)}
+    assert not {n for n in ref_public(ref_path('fluid.annotations'))
+                if not hasattr(an, n)}
+
+
 def test_data_generator_parity():
     from paddle_tpu.incubate import data_generator as dg
     names = ref_public(ref_path('fluid.incubate.data_generator'))

@@ -1,0 +1,174 @@
+"""incubate.fleet.utils toolkit (ref: incubate/fleet/utils/{fleet_util,
+fleet_barrier_util, utils}.py) + log_helper + annotations."""
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as L
+from paddle_tpu.incubate.fleet.utils import (FleetUtil,
+                                             check_all_trainers_ready)
+from paddle_tpu.incubate.fleet.utils import utils as fuu
+
+
+def _toy_program():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data('x', [4, 3], 'float32')
+        loss = L.reduce_mean(L.fc(x, size=2))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return prog, startup, loss
+
+
+def test_fleet_util_auc_from_buckets():
+    u = FleetUtil()
+    # perfect separation: all negatives in bucket 0, positives in last
+    pos = np.zeros(10); pos[-1] = 50
+    neg = np.zeros(10); neg[0] = 50
+    auc, total = u._auc_from_buckets(pos, neg)
+    assert auc == 1.0 and total == 100
+    # random: same bucket → 0.5
+    pos2 = np.zeros(10); pos2[3] = 10
+    neg2 = np.zeros(10); neg2[3] = 10
+    auc2, _ = u._auc_from_buckets(pos2, neg2)
+    assert abs(auc2 - 0.5) < 1e-9
+
+
+def test_fleet_util_get_global_auc_from_scope():
+    import jax.numpy as jnp
+    scope = fluid.global_scope()
+    pos = np.zeros((1, 8)); pos[0, -1] = 30
+    neg = np.zeros((1, 8)); neg[0, 0] = 30
+    scope.set('stat_pos', jnp.asarray(pos))
+    scope.set('stat_neg', jnp.asarray(neg))
+    u = FleetUtil()
+    auc = u.get_global_auc(scope, 'stat_pos', 'stat_neg')
+    assert auc == 1.0
+    u.set_zero('stat_pos', scope)
+    assert float(np.asarray(scope.find('stat_pos')).sum()) == 0.0
+
+
+def test_fleet_util_model_protocol(tmp_path):
+    prog, startup, loss = _toy_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    u = FleetUtil()
+    out = str(tmp_path / 'models')
+    d = u.save_model(out, 20260730, 3, program=prog)
+    assert os.path.isdir(d)
+    done = u.write_model_donefile(out, 20260730, 3, xbox_base_key=12345)
+    day, pass_id, path, key = u.get_last_save_model(out)
+    assert (day, pass_id, key) == (20260730, 3, 12345)
+    assert path == d
+    u.load_model(out, 20260730, 3, program=prog)  # round-trips
+
+
+def test_fleet_util_online_pass_interval():
+    u = FleetUtil()
+    iv = u.get_online_pass_interval('{20190720..20190729}', '{0..23}',
+                                    split_interval=30, split_per_pass=2,
+                                    is_data_hourly_placed=False)
+    assert len(iv) == 24           # 48 half-hour splits / 2 per pass
+    assert iv[0] == ['0000', '0030']
+    assert iv[-1] == ['2300', '2330']
+
+
+def test_fleet_util_global_metrics_bundle():
+    import jax.numpy as jnp
+    scope = fluid.global_scope()
+    pos = np.zeros((1, 100)); pos[0, 80] = 40
+    neg = np.zeros((1, 100)); neg[0, 20] = 60
+    scope.set('sp', jnp.asarray(pos)); scope.set('sn', jnp.asarray(neg))
+    for name, v in [('sq', 5.0), ('ab', 10.0), ('pr', 40.0), ('qq', 30.0),
+                    ('pi', 40.0), ('ti', 100.0)]:
+        scope.set(name, jnp.asarray([v]))
+    u = FleetUtil()
+    m = u.get_global_metrics(scope, 'sp', 'sn', 'sq', 'ab', 'pr', 'qq',
+                             'pi', 'ti')
+    assert set(m) == {'auc', 'bucket_error', 'mae', 'rmse', 'actual_ctr',
+                      'predicted_ctr', 'copc', 'mean_q', 'total_ins_num'}
+    assert m['auc'] == 1.0 and m['actual_ctr'] == 0.4
+    assert m['mae'] == 0.1 and abs(m['rmse'] - np.sqrt(0.05)) < 1e-9
+    assert m['total_ins_num'] == 100
+    # empty pass keeps the key set stable
+    scope.set('ti', jnp.asarray([0.0]))
+    m0 = u.get_global_metrics(scope, 'sp', 'sn', 'sq', 'ab', 'pr', 'qq',
+                              'pi', 'ti')
+    assert set(m0) == set(m) and m0['total_ins_num'] == 0
+
+
+def test_utils_reader_ref_semantics(tmp_path):
+    # one long line = several batches; trailing partial batch dropped
+    p = tmp_path / 'feed.txt'
+    p.write_text(' '.join(str(i) for i in range(14)) + '\n')
+    batches = fuu.reader(batch_size=2, fn=str(p), dim=[3])
+    assert len(batches) == 2                      # 14 // 6
+    assert batches[0].shape == (2, 3)
+    np.testing.assert_array_equal(batches[0],
+                                  np.arange(6, dtype=float).reshape(2, 3))
+    feeds = fuu.feed_gen(2, [[3]], [str(p)])
+    assert len(feeds) == 1 and len(feeds[0]) == 2
+
+
+def test_check_saved_vars_missing_state_fails(tmp_path):
+    prog, startup, loss = _toy_program()
+    fuu.save_program(prog, str(tmp_path / 'prog'))
+    _, problems = fuu.check_saved_vars_try_dump(str(tmp_path), 'prog',
+                                                False)
+    assert problems and 'not found' in problems[0]
+
+
+def test_fleet_util_pslib_ops_raise():
+    u = FleetUtil()
+    with pytest.raises(RuntimeError, match='pslib'):
+        u.load_fleet_model('/tmp/x')
+
+
+def test_barrier_single_trainer(tmp_path):
+    assert check_all_trainers_ready(str(tmp_path / 'ready'), epoch=0,
+                                    timeout=5)
+
+
+def test_utils_program_roundtrip_and_checks(tmp_path):
+    prog, startup, loss = _toy_program()
+    path = str(tmp_path / '__model__')
+    fuu.save_program(prog, path)
+    p2 = fuu.load_program(path)
+    assert p2.num_ops() == prog.num_ops()
+    pruned = prog.clone(for_test=True)
+    assert fuu.check_pruned_program_vars(prog, pruned) == []
+    assert fuu.check_not_expected_ops(prog, ('nonexistent_op',)) == []
+    report = fuu.parse_program(prog, str(tmp_path / 'rep'))
+    assert os.path.exists(report)
+
+
+def test_utils_save_load_var(tmp_path):
+    arr = np.arange(12, dtype=np.float32)
+    p = fuu.save_var(arr, 'v', [3, 4], np.float32,
+                     str(tmp_path / 'v.bin'))
+    back = fuu.load_var('v', [3, 4], np.float32, p)
+    np.testing.assert_array_equal(back, arr.reshape(3, 4))
+
+
+def test_log_helper_no_basicconfig_hijack():
+    from paddle_tpu.log_helper import get_logger
+    lg = get_logger('ptpu_test_logger', logging.INFO, fmt='%(message)s')
+    lg2 = get_logger('ptpu_test_logger', logging.INFO)
+    assert lg is lg2 and len(lg.handlers) == 1   # idempotent
+    assert not lg.propagate
+
+
+def test_annotations_deprecated(capsys):
+    from paddle_tpu.annotations import deprecated
+
+    @deprecated('1.8', 'new_fn')
+    def old_fn(a):
+        """doc."""
+        return a + 1
+
+    assert old_fn(1) == 2
+    err = capsys.readouterr().err
+    assert 'deprecated since 1.8' in err and 'new_fn' in err
+    assert 'deprecated' in old_fn.__doc__
