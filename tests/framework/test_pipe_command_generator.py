@@ -13,7 +13,7 @@ import paddle_tpu.layers as L
 
 
 GEN_SCRIPT = textwrap.dedent("""\
-    import os, sys
+    import sys
     sys.path.insert(0, {repo!r})
     from paddle_tpu.incubate.data_generator import MultiSlotDataGenerator
 
