@@ -44,7 +44,9 @@ def init_backend_or_die():
     burning the driver's whole timeout budget (the r4 failure mode)."""
     from paddle_tpu.utils.backend_probe import probe_backend
     try:
-        devices, backend = probe_backend()
+        # in-process watchdog (single init): bench exits on failure, so
+        # the subprocess isolation buys nothing here
+        devices, backend = probe_backend(isolated=False)
     except BaseException as e:
         emit({"metric": "backend_init",
               "error": f"{type(e).__name__}: {e}"})

@@ -2,32 +2,71 @@
 
 A dead axon tunnel makes the first backend touch (`jax.devices()`) block
 forever inside the remote handshake — the failure mode that turned an infra
-outage into rc=124 with zero output at r4 driver-capture time. `probe_backend`
-touches the backend from a daemon thread under a watchdog so callers get a
-clear, fast error instead of an indefinite hang.
+outage into rc=124 with zero output at r4 driver-capture time.
+
+Two modes:
+
+- `probe_backend(isolated=True)` (default): a SUBPROCESS touches the
+  backend first, under a timeout. If the child hangs or errors, the PARENT
+  has never touched the dead backend, so the caller can still pin the CPU
+  platform and carry on (an in-process watchdog thread cannot offer that —
+  a stuck thread holds jax's backend lock and poisons every later device
+  query in the process). After the child proves the backend answers, the
+  parent initializes in-process under its own watchdog (the tunnel can die
+  in the gap; a fast clear error still beats an infinite hang). Costs one
+  extra interpreter+backend init on success — use it where a fallback
+  matters (driver entry points).
+- `probe_backend(isolated=False)`: the in-process watchdog thread only.
+  Cheaper (single init), but on a hang the process's jax backend state is
+  poisoned — right for callers that exit on failure anyway (bench).
+
+Raises BackendInitTimeout on a hang and BackendInitError on a fast init
+failure; both mean "infra, not code".
 """
 from __future__ import annotations
 
 import os
+import subprocess
+import sys
 import threading
 
 DEFAULT_TIMEOUT_ENV = 'PADDLE_TPU_BACKEND_TIMEOUT'
+# isolated mode spends part of its budget on interpreter startup + the jax
+# import in the fresh child; grant that separately so a tuned-low timeout
+# keeps meaning "time for the BACKEND to answer"
+_CHILD_STARTUP_GRACE_S = 30.0
+
+_CHILD = """
+import os, sys
+import jax
+env = os.environ.get('JAX_PLATFORMS', '')
+if env and jax.config.jax_platforms != env:
+    jax.config.update('jax_platforms', env)
+print('PROBE_OK', jax.default_backend(), len(jax.devices()), flush=True)
+"""
 
 
 class BackendInitTimeout(RuntimeError):
-    pass
+    """Backend init did not answer within the budget (likely dead tunnel)."""
 
 
-def probe_backend(timeout=None):
-    """Return (devices, backend_name) or raise.
+class BackendInitError(RuntimeError):
+    """Backend init failed fast (refused connection, bad platform, ...)."""
 
-    Raises BackendInitTimeout after `timeout` seconds (default
-    $PADDLE_TPU_BACKEND_TIMEOUT or 120) if backend init hangs, and
-    re-raises any exception the init itself threw. An explicit
-    JAX_PLATFORMS env var beats the axon sitecustomize platform pin.
-    """
-    if timeout is None:
-        timeout = float(os.environ.get(DEFAULT_TIMEOUT_ENV, '120'))
+
+def _timeout_msg(timeout):
+    return (
+        f"jax backend init did not answer within {timeout:.0f}s "
+        f"(JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '')!r}); "
+        "if this is an axon session the remote TPU tunnel is down — "
+        "re-run when it is back, or set JAX_PLATFORMS=cpu for a "
+        "CPU-shape run.")
+
+
+def _init_in_process(timeout):
+    """Touch the backend under a daemon-thread watchdog. On timeout the
+    stuck thread keeps jax's backend lock — callers must not retry in this
+    process — but the caller gets a clear, fast error."""
     probe = {}
 
     def _touch():
@@ -45,12 +84,38 @@ def probe_backend(timeout=None):
     t.start()
     t.join(timeout)
     if t.is_alive():
-        raise BackendInitTimeout(
-            f"jax backend init did not answer within {timeout:.0f}s "
-            f"(JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '')!r}); "
-            "if this is an axon session the remote TPU tunnel is down — "
-            "re-run when it is back, or set JAX_PLATFORMS=cpu for a "
-            "CPU-shape run.")
+        raise BackendInitTimeout(_timeout_msg(timeout))
     if 'error' in probe:
         raise probe['error']
     return probe['devices'], probe['backend']
+
+
+def probe_backend(timeout=None, isolated=True):
+    """Return (devices, backend_name) with the backend initialized
+    in-process, or raise BackendInitTimeout / BackendInitError (see module
+    docstring for the isolated-vs-in-process trade).
+
+    `timeout` defaults to $PADDLE_TPU_BACKEND_TIMEOUT or 120 (seconds the
+    backend gets to answer; isolated mode adds a fixed startup grace for
+    the child interpreter on top). An explicit JAX_PLATFORMS env var beats
+    the axon sitecustomize platform pin in either mode.
+    """
+    if timeout is None:
+        timeout = float(os.environ.get(DEFAULT_TIMEOUT_ENV, '120'))
+    if not isolated:
+        return _init_in_process(timeout)
+    try:
+        out = subprocess.run([sys.executable, '-c', _CHILD],
+                             capture_output=True, text=True,
+                             timeout=timeout + _CHILD_STARTUP_GRACE_S)
+    except subprocess.TimeoutExpired:
+        raise BackendInitTimeout(_timeout_msg(timeout))
+    if out.returncode != 0 or 'PROBE_OK' not in out.stdout:
+        detail = (out.stderr or out.stdout).strip()
+        raise BackendInitError(
+            "jax backend init failed in the probe subprocess "
+            f"(rc={out.returncode}); child output tail:\n{detail[-2000:]}")
+    # the backend answers — initialize in-process, still bounded (the
+    # tunnel can die in the gap; no fallback is possible past this point,
+    # but a fast error beats an indefinite hang)
+    return _init_in_process(timeout)

@@ -41,7 +41,7 @@ def main():
     import jax
     import jax.numpy as jnp
     from paddle_tpu.utils.backend_probe import probe_backend
-    devices, backend = probe_backend()
+    devices, backend = probe_backend(isolated=False)  # exits on failure
     on_tpu = backend == 'tpu'
     print(json.dumps({"bench": "backend", "backend": backend}), flush=True)
     rng = np.random.RandomState(0)
