@@ -47,11 +47,28 @@ print('PROBE_OK', jax.default_backend(), len(jax.devices()), flush=True)
 
 
 class BackendInitTimeout(RuntimeError):
-    """Backend init did not answer within the budget (likely dead tunnel)."""
+    """Backend init did not answer within the budget (likely dead tunnel).
+
+    `parent_clean` is True when THIS process has not touched the backend
+    (child-probe phase) — a CPU fallback is possible; False when the
+    in-process init hung (a stuck thread holds jax's backend lock — the
+    process cannot fall back, only exit with this clear error)."""
+
+    def __init__(self, msg, parent_clean=False):
+        super().__init__(msg)
+        self.parent_clean = parent_clean
 
 
 class BackendInitError(RuntimeError):
-    """Backend init failed fast (refused connection, bad platform, ...)."""
+    """Backend init failed fast (refused connection, bad platform, ...).
+
+    `parent_clean` as on BackendInitTimeout (fast failures leave the
+    process backend-free in both phases, so it is True unless the raw
+    in-process error proved otherwise)."""
+
+    def __init__(self, msg, parent_clean=True):
+        super().__init__(msg)
+        self.parent_clean = parent_clean
 
 
 def _timeout_msg(timeout):
@@ -66,7 +83,9 @@ def _timeout_msg(timeout):
 def _init_in_process(timeout):
     """Touch the backend under a daemon-thread watchdog. On timeout the
     stuck thread keeps jax's backend lock — callers must not retry in this
-    process — but the caller gets a clear, fast error."""
+    process (`parent_clean=False`) — but the caller gets a clear, fast
+    error. Fast failures are wrapped in BackendInitError so the
+    documented contract (only the two BackendInit* types) holds."""
     probe = {}
 
     def _touch():
@@ -84,9 +103,12 @@ def _init_in_process(timeout):
     t.start()
     t.join(timeout)
     if t.is_alive():
-        raise BackendInitTimeout(_timeout_msg(timeout))
+        raise BackendInitTimeout(_timeout_msg(timeout), parent_clean=False)
     if 'error' in probe:
-        raise probe['error']
+        e = probe['error']
+        raise BackendInitError(
+            f"jax backend init failed: {type(e).__name__}: {e}",
+            parent_clean=False) from e
     return probe['devices'], probe['backend']
 
 
@@ -109,12 +131,13 @@ def probe_backend(timeout=None, isolated=True):
                              capture_output=True, text=True,
                              timeout=timeout + _CHILD_STARTUP_GRACE_S)
     except subprocess.TimeoutExpired:
-        raise BackendInitTimeout(_timeout_msg(timeout))
+        raise BackendInitTimeout(_timeout_msg(timeout), parent_clean=True)
     if out.returncode != 0 or 'PROBE_OK' not in out.stdout:
         detail = (out.stderr or out.stdout).strip()
         raise BackendInitError(
             "jax backend init failed in the probe subprocess "
-            f"(rc={out.returncode}); child output tail:\n{detail[-2000:]}")
+            f"(rc={out.returncode}); child output tail:\n{detail[-2000:]}",
+            parent_clean=True)
     # the backend answers — initialize in-process, still bounded (the
     # tunnel can die in the gap; no fallback is possible past this point,
     # but a fast error beats an indefinite hang)
