@@ -11,7 +11,11 @@ Output protocol (hardened after the r4 tunnel outage lost all evidence):
   hangs it forever), a diagnostic JSON line is printed and we exit 3 fast
   instead of burning the driver's whole timeout budget;
 - a failing bench section prints its own error line and the run exits
-  nonzero only AFTER printing whatever was measured.
+  nonzero only AFTER printing whatever was measured;
+- a `dygraph_eager_overhead` line (valid on CPU too) carries the dispatch
+  microbench from tools/bench_dispatch.py: eager tape step with the per-op
+  kernel cache off/on vs the fused TrainStep, slope-method ms/step for a
+  ResNet bottleneck block and a BERT layer (PERF.md §9).
 
 Baseline (BASELINE.json north star): CUDA V100 ResNet-50 ≈ 383 img/s fp32
 (PaddlePaddle's published reference-class number for the 1.x benchmark suite).
@@ -327,6 +331,17 @@ def bench_ernie(on_tpu):
     return seq_per_sec, flops_per_seq
 
 
+def bench_dispatch_overhead(on_tpu):
+    """Eager-tape step vs fused TrainStep on a ResNet bottleneck block and a
+    BERT layer, with the per-op kernel cache off/on (slope-method timing —
+    PERF.md §9). Measurable on CPU: the quantity under test is host-side
+    dispatch, not FLOPs."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools'))
+    from bench_dispatch import measure_all
+    return measure_all(iters=8 if on_tpu else 4)
+
+
 def main():
     jax, devices, backend = init_backend_or_die()
     on_tpu = backend != 'cpu'
@@ -396,6 +411,15 @@ def main():
         emit({"metric": "ernie_finetune_seq_per_sec",
               "value": summary["ernie_finetune_seq_per_sec"],
               "unit": "seq/sec", "mfu": summary.get("ernie_mfu")})
+
+    d = run("dygraph_eager_overhead", lambda: bench_dispatch_overhead(on_tpu))
+    if d is not None:
+        rb, bl = d['resnet_block'], d['bert_layer']
+        emit({"metric": "dygraph_eager_overhead",
+              "resnet_block": rb, "bert_layer": bl})
+        summary.update(
+            eager_cache_speedup_resnet_block=rb["cache_speedup"],
+            eager_vs_fused_resnet_block=rb["eager_cached_vs_fused"])
 
     emit(summary)  # last line: the original ONE-JSON-line driver contract
     if failures:

@@ -15,7 +15,16 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 class BuildStrategy:
     """ref: framework/details/build_strategy.h knobs — accepted for compat.
-    fuse_all_reduce_ops / fuse_elewise_add_act_ops etc. are XLA's job now."""
+    fuse_all_reduce_ops / fuse_elewise_add_act_ops etc. are XLA's job now.
+
+    Two knobs ARE live on TPU: `enable_inplace` and `memory_optimize` map
+    onto XLA buffer donation of the training state. The default (None) lets
+    the Executor donate parameter/optimizer-state buffers into the jitted
+    step (in-place HBM update, no transient 2× parameter footprint);
+    setting either to False runs the step copy-in/copy-out — pre-step
+    buffers stay valid, at the cost of peak memory. Fetch-aliased
+    persistables are always excluded from donation regardless of the knob
+    (the Executor guards them; see executor.py)."""
 
     class ReduceStrategy:
         AllReduce = 0

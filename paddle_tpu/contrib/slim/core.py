@@ -151,7 +151,8 @@ class Compressor:
                  eval_feed_list=None, eval_fetch_list=None,
                  teacher_programs=(), checkpoint_path=None,
                  train_optimizer=None, distiller_optimizer=None,
-                 search_space=None, epoch=1, log_period=20):
+                 search_space=None, epoch=1, log_period=20,
+                 init_model=None):
         def _graph(p, feeds, fetches):
             if p is None:
                 return None
@@ -185,7 +186,7 @@ class Compressor:
         self.epoch = epoch
         self.log_period = log_period
         self.strategies = []
-        self.init_model = None
+        self.init_model = init_model
 
     def add_strategy(self, strategy):
         self.strategies.append(strategy)
@@ -206,6 +207,24 @@ class Compressor:
         if factory.compressor.get('init_model'):
             self.init_model = factory.compressor['init_model']
         return self
+
+    def _load_init_model(self, context):
+        """ref compressor.py:_load_model — a configured `init_model` seeds
+        the pretrained weights BEFORE checkpoint resume (a later checkpoint
+        overrides it). Without this the pipeline silently compressed a
+        randomly-initialized network (ADVICE r5)."""
+        if not self.init_model:
+            return
+        if not os.path.isdir(self.init_model):
+            raise ValueError(
+                f"Compressor init_model directory {self.init_model!r} does "
+                f"not exist")
+        exe = Executor(self.place)
+        from ... import io
+        with self._scope_guard(context):
+            io.load_persistables(exe, self.init_model,
+                                 context.train_graph.program)
+        print(f"[slim] loaded init model from {self.init_model}")
 
     # ---- checkpoints (ref compressor.py:_load_checkpoint/_save_checkpoint)
     def _checkpoint_dir(self, epoch_id):
@@ -289,6 +308,7 @@ class Compressor:
         if context.optimize_graph is None and self.train_optimizer is not None:
             context.optimize_graph = self.train_graph.get_optimize_graph(
                 self.train_optimizer, self.place, self.scope)
+        self._load_init_model(context)
         context = self._load_checkpoint(context)
 
         for s in self.strategies:

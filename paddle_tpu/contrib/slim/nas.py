@@ -66,6 +66,16 @@ class LightNASStrategy(Strategy):
             return space.get_model_latency(train_p) <= self.target_latency
         return ok
 
+    def restore_from_checkpoint(self, context):
+        """SAController.__getstate__ drops the latency-constraint closure
+        (it captures the SearchSpace and cannot pickle); rebuild it from
+        the live context so a resumed search keeps honoring
+        target_latency."""
+        if self.target_latency is not None and \
+                context.search_space is not None:
+            self.controller._constrain_func = \
+                self._constrain(context.search_space)
+
     def _score(self, space, tokens, context):
         """Train the candidate briefly and return the eval metric."""
         startup, train_p, eval_p, train_m, eval_m = space.create_net(tokens)

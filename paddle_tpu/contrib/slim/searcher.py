@@ -40,6 +40,19 @@ class SAController(EvolutionaryController):
         self._best_tokens = None
         self._iter = 0
 
+    def __getstate__(self):
+        """Checkpointable state: `_constrain_func` is a closure over the
+        SearchSpace (unpicklable), so the epoch-end strategy pickle would
+        abort a latency-constrained LightNAS run (ADVICE r5). Drop it here;
+        LightNASStrategy.restore_from_checkpoint rebuilds it from the
+        context's search space."""
+        state = dict(self.__dict__)
+        state['_constrain_func'] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
     @property
     def best_tokens(self):
         return self._best_tokens

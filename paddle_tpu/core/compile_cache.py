@@ -1,0 +1,65 @@
+"""Persistent cross-process XLA compilation cache.
+
+The Executor/TrainStep in-process jit caches stop re-tracing within one
+process, but every new process (a bench re-run after a tunnel drop, a second
+fleet worker on the same host) still recompiled every program from scratch.
+This module wires jax's persistent compilation cache underneath those jit
+caches: compiled executables are serialized to a shared on-disk directory
+keyed by (HLO, compile options, jax/XLA version), so a second cold process
+deserializes instead of recompiling.
+
+Environment knobs (documented in README):
+- PADDLE_TPU_COMPILE_CACHE=0          disable entirely
+- PADDLE_TPU_COMPILE_CACHE_DIR=<dir>  cache location
+                                      (default ~/.cache/paddle_tpu/xla_cache)
+- PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_SECS=<f>
+                                      only persist compiles slower than this
+                                      (default: jax's own 1.0s floor; set 0
+                                      to persist everything, e.g. in tests)
+"""
+from __future__ import annotations
+
+import os
+
+_configured = None   # None = not attempted; False = disabled; str = cache dir
+
+
+def setup_persistent_cache():
+    """Idempotently point jax at the on-disk compilation cache. Returns the
+    cache dir, or None when disabled. Safe to call from every Executor /
+    TrainStep constructor — only the first call does work."""
+    global _configured
+    if _configured is not None:
+        return _configured or None
+    if os.environ.get('PADDLE_TPU_COMPILE_CACHE', '1') == '0':
+        _configured = False
+        return None
+    import jax
+    cache_dir = os.environ.get(
+        'PADDLE_TPU_COMPILE_CACHE_DIR',
+        os.path.join(os.path.expanduser('~'), '.cache', 'paddle_tpu',
+                     'xla_cache'))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update('jax_compilation_cache_dir', cache_dir)
+        min_secs = os.environ.get('PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_SECS')
+        if min_secs is not None:
+            jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                              float(min_secs))
+            jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)
+    except Exception:
+        _configured = False
+        return None
+    # jax latches cache eligibility on the FIRST compile of the process; if
+    # anything compiled before we configured the dir (eager ops during
+    # import, scope init), un-latch so our programs still reach the disk
+    # cache. Best-effort: on jax versions without reset_cache, skip.
+    try:
+        from jax._src import compilation_cache as _cc
+        if getattr(_cc, '_cache_checked', False) and \
+                not getattr(_cc, '_cache_used', False):
+            _cc.reset_cache()
+    except Exception:
+        pass
+    _configured = cache_dir
+    return cache_dir

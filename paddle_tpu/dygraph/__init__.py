@@ -1,5 +1,7 @@
 """Imperative (dygraph) mode — ref: python/paddle/fluid/dygraph/."""
-from .base import guard, enable_dygraph, disable_dygraph, enabled, to_variable
+from .base import (guard, enable_dygraph, disable_dygraph, enabled,
+                   to_variable, set_eager_kernel_cache,
+                   eager_kernel_cache_guard)
 from .tape import (Tensor, Parameter, no_grad, no_grad_guard, dispatch_op,
                    grad)
 from .layers import Layer

@@ -62,6 +62,31 @@ def guard(place=None):
         disable_dygraph()
 
 
+def set_eager_kernel_cache(enabled, maxsize=None):
+    """Toggle the eager per-op jitted-kernel cache (tape.kernel_cache) at
+    runtime — the programmatic form of the PADDLE_TPU_EAGER_CACHE env hatch.
+    `maxsize` rebounds the LRU (PADDLE_TPU_EAGER_CACHE_SIZE at import)."""
+    from .tape import kernel_cache
+    kernel_cache.enabled = bool(enabled)
+    if maxsize is not None:
+        kernel_cache.maxsize = max(int(maxsize), 1)
+        while len(kernel_cache._entries) > kernel_cache.maxsize:
+            kernel_cache._entries.popitem(last=False)
+            kernel_cache.evictions += 1
+
+
+@contextlib.contextmanager
+def eager_kernel_cache_guard(enabled):
+    """Scope the eager kernel cache on/off (e.g. A/B numerics checks)."""
+    from .tape import kernel_cache
+    old = kernel_cache.enabled
+    kernel_cache.enabled = bool(enabled)
+    try:
+        yield
+    finally:
+        kernel_cache.enabled = old
+
+
 def to_variable(value, name=None, zero_copy=None):
     if isinstance(value, Tensor):
         return value

@@ -463,6 +463,8 @@ class TrainStep:
 
     def __init__(self, layer: Layer, loss_fn, optimizer, data_sharding=None,
                  remat=False, donate=True, amp_dtype=None, accum_steps=1):
+        from ..core.compile_cache import setup_persistent_cache
+        setup_persistent_cache()   # second process reuses the compiled step
         self._layer = layer
         self._params = dict(layer.named_parameters())
         self._buffers = dict(layer.named_buffers())
@@ -470,6 +472,12 @@ class TrainStep:
         self._loss_fn = loss_fn
         self._remat = remat
         self._data_sharding = data_sharding
+        # donate=True (default): params/buffers/optimizer slots are donated
+        # into the jitted step (jax donate_argnums) so XLA writes the update
+        # in place — live HBM stays 1× params instead of 2×. The pre-step
+        # buffers are invalidated (deleted-buffer semantics, asserted by the
+        # donation-safety tests); donate=False keeps them valid.
+        self._donate = bool(donate)
         # amp_dtype (e.g. jnp.bfloat16): params stay fp32 master weights;
         # the forward sees a low-precision cast, grads/updates are fp32 —
         # param dtypes are stable across steps so the step compiles once.
@@ -537,8 +545,15 @@ class TrainStep:
                     args.append(lr)
                 res = update_fn(*args, **hypers)
                 res = res if isinstance(res, tuple) else (res,)
-                new_tp[n] = res[0]
-                new_slots[n] = dict(zip(slot_names, res[1:]))
+                # pin param/slot dtypes across steps: bf16 params meeting
+                # fp32 hypers/slots would otherwise promote the update to
+                # fp32, which breaks donated-buffer reuse (shape/dtype must
+                # match the donated input) and, under accum_steps>1, the
+                # lax.cond branch signatures
+                new_tp[n] = res[0].astype(train_p[n].dtype)
+                new_slots[n] = {
+                    s: r.astype(slots[n][s].dtype)
+                    for s, r in zip(slot_names, res[1:])}
             return new_tp, new_slots
 
         accum_steps = self._accum_steps
@@ -556,7 +571,8 @@ class TrainStep:
                 new_tp, new_slots = apply_update(train_p, grads, slots, lr)
                 return {**frozen_p, **new_tp}, new_b, new_slots, loss
 
-            return jax.jit(step, donate_argnums=(0, 1, 2))
+            return jax.jit(step, donate_argnums=(0, 1, 2)
+                           if self._donate else ())
 
         def step(pvals, bvals, slots, acc, count, lr, batch):
             # gradient merge: accumulate, and on every k-th call apply the
@@ -590,7 +606,8 @@ class TrainStep:
             return ({**frozen_p, **new_tp}, new_b, new_slots, new_acc,
                     count + 1, loss)
 
-        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3)
+                       if self._donate else ())
 
     def state(self):
         return ({n: p.value for n, p in self._params.items()},
@@ -613,7 +630,11 @@ class TrainStep:
         pvals, bvals = self.state()
         if self._accum_steps > 1:
             if self._acc is None:
-                self._acc = {n: jnp.zeros(tuple(p.shape), jnp.float32)
+                # accumulators carry the GRADIENT dtype (== param dtype;
+                # fp32 masters under amp): a hardcoded fp32 accumulator
+                # would promote `acc + grad` for bf16 params and the two
+                # lax.cond branches would disagree on dtypes (ADVICE r5)
+                self._acc = {n: jnp.zeros_like(p.value)
                              for n, p in self._params.items()
                              if p.trainable}
                 self._count = jnp.int32(0)

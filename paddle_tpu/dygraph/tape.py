@@ -6,10 +6,15 @@ imperative/layer.cc), redesigned for XLA: every eager op call runs the SAME
 registered jax functional the static graph uses, capturing its vjp; backward()
 walks the tape in reverse topological order. Under `jit.to_static` the tape
 records through tracers, so the whole step can still fuse into one XLA program.
+
+Hot path: repeated eager dispatches reuse jitted kernels from an LRU cache
+(see _EagerKernelCache below; PERF.md §9) instead of re-tracing per call.
 """
 from __future__ import annotations
 
 import contextlib
+import os
+from collections import OrderedDict
 from typing import Optional
 
 import numpy as np
@@ -23,6 +28,97 @@ from ..ops.registry import get_op
 
 _grad_enabled = True
 _tensor_watchers = []
+
+
+# ---------------------------------------------------------------------------
+# Eager per-op jitted-kernel cache.
+#
+# The reference avoids Python dispatch overhead with ~1,500 LoC of C++ Tracer
+# (imperative/tracer.cc); the TPU analogue is to make the SECOND eager call of
+# an op signature free: each dispatch is keyed by (op_type, input avals, arg
+# structure, attrs) and reuses a jitted kernel — one XLA executable for the
+# forward (returning the vjp residuals as a Partial pytree) plus one for the
+# backward — instead of re-tracing jax.vjp through the functional every call.
+# LRU-bounded; PADDLE_TPU_EAGER_CACHE=0 is the escape hatch; statistics are
+# exposed through profiler.eager_kernel_cache_stats().
+# ---------------------------------------------------------------------------
+
+class _Unhashable(Exception):
+    pass
+
+
+def _attr_sig(v):
+    """Canonical hashable form of an op attr value, or raise _Unhashable
+    (arrays, closures, initializer objects → bypass the cache). Scalars are
+    tagged with their type: True and 1 hash equal in Python but may mean
+    different things to an op body."""
+    if isinstance(v, (str, bytes, int, float, bool, type(None))):
+        return (type(v).__name__, v)
+    if isinstance(v, (np.bool_, np.integer)):
+        return ('int', int(v))
+    if isinstance(v, np.floating):
+        return ('float', float(v))
+    if isinstance(v, (list, tuple)):
+        return tuple(_attr_sig(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _attr_sig(x)) for k, x in v.items()))
+    raise _Unhashable
+
+
+_BLOCKED = object()   # negative-cache sentinel: this key cannot be jitted
+
+
+class _EagerKernelCache:
+    """LRU of per-op-signature jitted kernels for the dygraph hot path."""
+
+    def __init__(self, maxsize=None):
+        if maxsize is None:
+            maxsize = int(os.environ.get('PADDLE_TPU_EAGER_CACHE_SIZE',
+                                         '1024'))
+        self.maxsize = max(int(maxsize), 1)
+        self.enabled = os.environ.get('PADDLE_TPU_EAGER_CACHE', '1') != '0'
+        self._entries = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.bypasses = 0     # unhashable attrs or untraceable op bodies
+
+    def stats(self):
+        return {'enabled': self.enabled, 'size': len(self._entries),
+                'maxsize': self.maxsize, 'hits': self.hits,
+                'misses': self.misses, 'evictions': self.evictions,
+                'bypasses': self.bypasses}
+
+    def clear(self):
+        self._entries.clear()
+        self.hits = self.misses = self.evictions = self.bypasses = 0
+
+    def get(self, key):
+        e = self._entries.get(key)
+        if e is not None and e is not _BLOCKED:
+            self._entries.move_to_end(key)
+            self.hits += 1
+        return e
+
+    def put(self, key, entry):
+        self.misses += 1
+        self._entries[key] = entry
+        while len(self._entries) > self.maxsize:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+
+    def block(self, key):
+        """This signature failed to trace under jit (e.g. value-dependent
+        Python control flow in the op body) — never try again."""
+        self._entries[key] = _BLOCKED
+        self.bypasses += 1
+
+
+kernel_cache = _EagerKernelCache()
+
+
+def kernel_cache_stats():
+    return kernel_cache.stats()
 
 
 @contextlib.contextmanager
@@ -200,10 +296,14 @@ def dispatch_op(op_type, inputs, attrs):
             w.extend(flat_tensors)
 
     attrs = dict(attrs)
-    if opdef.needs_rng and 'key' not in attrs:
-        attrs['key'] = default_generator.next_key()
+    rng = None
+    if opdef.needs_rng:
+        rng = attrs.pop('key', None)
+        if rng is None:
+            rng = default_generator.next_key()
 
-    def call(*vals):
+    def call_with(vals, key):
+        kw = attrs if key is None else dict(attrs, key=key)
         args = []
         for kind, ref in arg_spec:
             if kind == 'const':
@@ -212,12 +312,21 @@ def dispatch_op(op_type, inputs, attrs):
                 args.append(vals[ref])
             else:
                 args.append([vals[i] for i in ref])
-        return opdef.fn(*args, **attrs)
+        return opdef.fn(*args, **kw)
+
+    def call(*vals):
+        return call_with(vals, rng)
 
     vals = [t.value for t in flat_tensors]
     needs_grad = _grad_enabled and any(
         not t.stop_gradient and jnp.issubdtype(t.value.dtype, jnp.inexact)
         for t in flat_tensors)
+
+    if kernel_cache.enabled:
+        out = _cached_dispatch(op_type, opdef, arg_spec, attrs, call_with,
+                               call, vals, rng, needs_grad, flat_tensors)
+        if out is not _BLOCKED:
+            return out
 
     if not needs_grad:
         result = call(*vals)
@@ -226,6 +335,64 @@ def dispatch_op(op_type, inputs, attrs):
     result, vjp_fn = jax.vjp(call, *vals)
     flat_res = _flatten_result(opdef, result)
     node = Node(vjp_fn, flat_tensors, len(flat_res),
+                [(r.shape, r.dtype) for r in flat_res], op_type,
+                call_fn=call)
+    return _wrap_outputs(opdef, result, node)
+
+
+def _cached_dispatch(op_type, opdef, arg_spec, attrs, call_with, call, vals,
+                     rng, needs_grad, flat_tensors):
+    """Dispatch through the per-op jitted-kernel cache. Returns the wrapped
+    outputs, or the _BLOCKED sentinel when this op must take the plain
+    (re-traced) path: unhashable attrs, or a body jit cannot stage out."""
+    try:
+        spec_sig = tuple((kind, len(ref)) if kind == 'list' else (kind,)
+                         for kind, ref in arg_spec)
+        aval_sig = tuple(
+            (v.shape, str(v.dtype), bool(getattr(v, 'weak_type', False)))
+            for v in vals)
+        key = (op_type, needs_grad, spec_sig, aval_sig, _attr_sig(attrs))
+    except _Unhashable:
+        kernel_cache.bypasses += 1
+        return _BLOCKED
+
+    entry = kernel_cache.get(key)
+    if entry is _BLOCKED:
+        return _BLOCKED
+    if entry is None:
+        if needs_grad:
+            # fwd returns (primal outs, vjp residuals as a Partial pytree);
+            # bwd re-applies that Partial under jit, so a repeated backward
+            # through the same op signature is also a cache hit
+            fwd = jax.jit(lambda vs, k: jax.vjp(
+                lambda *v: call_with(v, k), *vs))
+            bwd = jax.jit(lambda vf, ct: vf(ct))
+        else:
+            fwd = jax.jit(call_with)
+            bwd = None
+        entry = (fwd, bwd)
+
+    try:
+        if needs_grad:
+            result, vjp_partial = entry[0](tuple(vals), rng)
+        else:
+            result = entry[0](tuple(vals), rng)
+    except Exception:
+        # e.g. value-dependent Python branching in the op body: fall back to
+        # the eager path (a genuine user error re-raises there with an
+        # untraced stack) and never retry this signature
+        kernel_cache.block(key)
+        return _BLOCKED
+
+    if key not in kernel_cache._entries:
+        kernel_cache.put(key, entry)
+
+    if not needs_grad:
+        return _wrap_outputs(opdef, result, node=None)
+
+    bwd = entry[1]
+    flat_res = _flatten_result(opdef, result)
+    node = Node(lambda ct: bwd(vjp_partial, ct), flat_tensors, len(flat_res),
                 [(r.shape, r.dtype) for r in flat_res], op_type,
                 call_fn=call)
     return _wrap_outputs(opdef, result, node)

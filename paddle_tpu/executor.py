@@ -3,13 +3,19 @@
 Parity with reference python/paddle/fluid/executor.py + the C++ executor
 (/root/reference/paddle/fluid/framework/executor.cc). The TPU redesign (see
 BASELINE.json north star): instead of per-op kernel dispatch, the whole
-Program becomes `step(state, feeds, key) -> (new_state, fetches)`, compiled
-through an XLA compile cache keyed by (program version, feed shapes). Backward
+Program becomes `step(donated_state, kept_state, feeds, key) ->
+(new_state, fetches)`, compiled through an XLA compile cache keyed by
+(program version, feed shapes) and backed by the persistent cross-process
+compilation cache (core/compile_cache.py). Parameter/optimizer-state buffers
+are DONATED into the step (XLA updates them in place — no transient 2×
+parameter HBM) unless fetch-aliased, buffer-shared, or opted out
+(PADDLE_TPU_DONATE=0 / BuildStrategy.enable_inplace=False). Backward
 markers lower to jax.value_and_grad; optimizer ops run inside the same fused
 step; persistable writes return functionally and are stored back to the Scope.
 """
 from __future__ import annotations
 
+import os
 from typing import Dict, List
 
 import numpy as np
@@ -451,7 +457,15 @@ def _remat_segments(fwd_ops, checkpoints):
 
 
 def _lower(program: Program, feed_names, fetch_names, state_names):
-    """Build the pure step function for `program`."""
+    """Build the pure step function for `program`.
+
+    The step takes the training state SPLIT in two dicts so the caller can
+    donate the hot one: `step(dstate, kstate, feeds, key)`. `dstate` holds
+    parameters/optimizer slots whose HBM XLA may reuse in place
+    (jit donate_argnums=(0,)); `kstate` holds state that must survive the
+    call — fetch-aliased persistables and anything sharing a buffer with
+    another argument. The split is the caller's choice; the lowering only
+    sees the union."""
     ops = list(program.global_block().ops)
     bwd_idx = next((i for i, op in enumerate(ops)
                     if op.type == BACKWARD_OP_TYPE), None)
@@ -495,7 +509,8 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
         written_state = [n for n in state_names
                         if any(n in o.output_names() for o in fwd_ops)]
 
-    def step(state, feeds, base_key):
+    def step(dstate, kstate, feeds, base_key):
+        state = {**dstate, **kstate}
         env: Dict[str, object] = dict(feeds)
 
         def make_read(*stores):
@@ -708,6 +723,10 @@ class Executor:
         self._cache = {}
         self._step_counter = 0
         self._fsdp_placed = set()
+        # persistent cross-process XLA compile cache underneath the
+        # in-process program+shape jit cache (core/compile_cache.py)
+        from .core.compile_cache import setup_persistent_cache
+        setup_persistent_cache()
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -715,8 +734,15 @@ class Executor:
             fetch_var_name='fetch'):
         from .compiler import CompiledProgram
         sharding = None
+        donate = os.environ.get('PADDLE_TPU_DONATE', '1') != '0'
         if isinstance(program, CompiledProgram):
             sharding = program._data_sharding
+            bs = program._build_strategy
+            # fluid memory knobs map onto donation: enable_inplace=False or
+            # memory_optimize=False opts the whole program out of buffer reuse
+            if bs is not None and (bs.enable_inplace is False
+                                   or bs.memory_optimize is False):
+                donate = False
             program = program._program
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
@@ -784,17 +810,33 @@ class Executor:
         feed_sig = tuple(sorted((n, v.shape, str(v.dtype))
                                 for n, v in feed_vals.items()))
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               tuple(state_names))
+               tuple(state_names), donate)
         fn = self._cache.get(key)
         if fn is None:
             step = _lower(program, list(feed_vals), fetch_names, state_names)
             fn = jax.jit(step, donate_argnums=(0,))
             self._cache[key] = fn
 
+        # Donation guards: a fetch-aliased persistable must survive the call
+        # (the caller observes its pre-step buffer), and a buffer shared
+        # between two state names — or with a feed — may be donated at most
+        # once. Everything else (params, optimizer slots, BN stats) is
+        # donated so XLA updates it in place instead of doubling live HBM.
+        fetch_set = frozenset(fetch_names)
+        seen_ids = {id(v) for v in feed_vals.values()}
+        dstate, kstate = {}, {}
+        for n in state_names:
+            v = state[n]
+            if donate and n not in fetch_set and id(v) not in seen_ids:
+                dstate[n] = v
+                seen_ids.add(id(v))
+            else:
+                kstate[n] = v
+
         self._step_counter += 1
         base_key = jax.random.fold_in(default_generator.base_key(),
                                       self._step_counter)
-        new_state, fetches = fn(state, feed_vals, base_key)
+        new_state, fetches = fn(dstate, kstate, feed_vals, base_key)
         for n, v in new_state.items():
             scope.set(n, v)
         if return_numpy:
@@ -889,7 +931,9 @@ class Executor:
 
         def fn(*feed_arrays):
             feed_vals = dict(zip(feed_names, feed_arrays))
-            _, fetches = step(dict(state), feed_vals, base_key)
+            # export path: nothing is donated (state is closed over as
+            # constants and must stay readable across calls)
+            _, fetches = step({}, dict(state), feed_vals, base_key)
             return fetches
 
         block = program.global_block()

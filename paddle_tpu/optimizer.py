@@ -191,8 +191,12 @@ class Optimizer:
                         args.append(lr)
                     res = fn(*args, **hypers)
                     res = res if isinstance(res, tuple) else (res,)
-                    new_p[n] = res[0]
-                    new_s[n] = dict(zip(self._slot_names, res[1:]))
+                    # pin param/slot dtypes: fp32 hypers meeting bf16 params
+                    # would promote the update, and a donated step whose
+                    # outputs change dtype recompiles every call
+                    new_p[n] = res[0].astype(p.dtype)
+                    new_s[n] = {s: r.astype(slots[s].dtype)
+                                for s, r in zip(self._slot_names, res[1:])}
                 return new_p, new_s
 
             self._dy_step_fn = jax.jit(step, donate_argnums=(0, 2))

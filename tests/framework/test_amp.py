@@ -42,7 +42,8 @@ def test_bf16_amp_casts_visible_in_hlo():
     feeds = {'x': jnp.zeros((4, 8), jnp.float32),
              'y': jnp.zeros((4, 1), jnp.float32)}
     step = _lower(main, list(feeds), [loss.name], state_names)
-    hlo = jax.jit(step).lower(state, feeds, jax.random.PRNGKey(0)).as_text()
+    hlo = jax.jit(step).lower(state, {}, feeds,
+                              jax.random.PRNGKey(0)).as_text()
     assert 'bf16' in hlo, "no bf16 in lowered HLO — AMP casts not applied"
 
 

@@ -1,0 +1,33 @@
+"""tier-1 guard for the dispatch microbench harness: tools/bench_dispatch.py
+must run end-to-end under JAX_PLATFORMS=cpu (2 slope iterations, smoke
+shapes) and emit well-formed JSON lines with the PERF.md §9 fields."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+REQUIRED = {'eager_uncached_ms', 'eager_cached_ms', 'train_step_ms',
+            'cache_speedup', 'eager_cached_vs_fused', 'cache_hits',
+            'cache_misses'}
+
+
+def test_bench_dispatch_smoke_runs_on_cpu():
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    r = subprocess.run(
+        [sys.executable, os.path.join('tools', 'bench_dispatch.py'),
+         '--smoke', '--iters', '2'],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
+    benches = {d['bench']: d for d in lines if 'bench' in d}
+    assert {'dispatch_resnet_block', 'dispatch_bert_layer'} <= set(benches)
+    for d in benches.values():
+        assert REQUIRED <= set(d), d
+        assert d['eager_uncached_ms'] > 0 and d['eager_cached_ms'] > 0
+        assert d['cache_hits'] > 0, \
+            "a repeated eager step must hit the kernel cache"
+        # directionality only (smoke timing is noisy; PERF.md §9 records the
+        # real margin — >= 2x on the ResNet block at measurement sizes)
+        assert d['cache_speedup'] > 1.0, d

@@ -1,0 +1,79 @@
+"""Persistent cross-process XLA compilation cache (core/compile_cache.py):
+a second COLD process running the same program must deserialize the compiled
+executable from disk (jax cache-hit event) instead of recompiling."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+_CHILD = r"""
+import json, os
+import numpy as np
+import jax
+from jax._src import monitoring
+events = []
+monitoring.register_event_listener(lambda name, **kw: events.append(name))
+import paddle_tpu as fluid
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = fluid.data(name='x', shape=[2, 3], dtype='float32')
+    y = fluid.layers.fc(input=x, size=2)
+exe = fluid.Executor()   # configures the persistent cache
+exe.run(startup)
+out = exe.run(main, feed={'x': np.ones((2, 3), np.float32)},
+              fetch_list=[y.name])
+assert np.isfinite(out[0]).all()
+print('CACHE_EVENTS ' + json.dumps({
+    'hits': sum(e == '/jax/compilation_cache/cache_hits' for e in events),
+    'misses': sum(e == '/jax/compilation_cache/cache_misses' for e in events),
+}))
+"""
+
+
+def _run_child(cache_dir):
+    env = dict(os.environ,
+               JAX_PLATFORMS='cpu',
+               PADDLE_TPU_COMPILE_CACHE='1',
+               PADDLE_TPU_COMPILE_CACHE_DIR=str(cache_dir),
+               PADDLE_TPU_COMPILE_CACHE_MIN_COMPILE_SECS='0')
+    r = subprocess.run([sys.executable, '-c', _CHILD], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    line = next(ln for ln in r.stdout.splitlines()
+                if ln.startswith('CACHE_EVENTS '))
+    return json.loads(line.split(' ', 1)[1])
+
+
+def test_second_cold_process_hits_disk_cache(tmp_path):
+    cache_dir = tmp_path / 'xla_cache'
+    first = _run_child(cache_dir)
+    assert first['misses'] > 0 and first['hits'] == 0, first
+    files = os.listdir(cache_dir)
+    assert files, "first process must persist compiled executables"
+    second = _run_child(cache_dir)
+    assert second['hits'] > 0, second
+    assert second['misses'] == 0, \
+        f"second cold process recompiled despite the disk cache: {second}"
+
+
+def test_env_hatch_disables_cache(tmp_path):
+    cache_dir = tmp_path / 'xla_cache_off'
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               PADDLE_TPU_COMPILE_CACHE='0',
+               PADDLE_TPU_COMPILE_CACHE_DIR=str(cache_dir))
+    r = subprocess.run(
+        [sys.executable, '-c',
+         "import paddle_tpu as fluid\n"
+         "from paddle_tpu.core.compile_cache import setup_persistent_cache\n"
+         "assert setup_persistent_cache() is None\n"
+         "fluid.Executor()\n"
+         "print('CACHE_OFF_OK')\n"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert 'CACHE_OFF_OK' in r.stdout
+    assert not cache_dir.exists()

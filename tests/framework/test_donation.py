@@ -1,0 +1,211 @@
+"""Donation-safety suite (hot-path memory overhaul): donated train-step
+buffers are invalidated (deleted-buffer semantics), fetch-aliased variables
+are provably excluded, BuildStrategy/env opt-outs work, and the bf16
+gradient-merge accumulators keep the lax.cond branches dtype-consistent."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph.jit import TrainStep
+from paddle_tpu.dygraph.nn import Linear
+from paddle_tpu.dygraph.tape import dispatch_op
+
+
+def _mse(m, x, y):
+    d = dispatch_op('elementwise_sub', {'x': m(x), 'y': y}, {})
+    sq = dispatch_op('elementwise_mul', {'x': d, 'y': d}, {})
+    return dispatch_op('reduce_mean', {'x': sq}, {})
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(8, 4).astype(np.float32),
+            rng.randn(8, 1).astype(np.float32))
+
+
+@pytest.fixture(autouse=True)
+def _quiet_cpu_donation_warning():
+    # CPU XLA cannot alias donated buffers and warns; jax still invalidates
+    # the donated arrays, which is exactly the semantics under test
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        yield
+
+
+# ---------------------------------------------------------------------------
+# TrainStep (dygraph fused path)
+# ---------------------------------------------------------------------------
+
+def test_train_step_donates_params_and_slots():
+    x, y = _batch()
+    with dygraph.guard():
+        m = Linear(4, 1)
+        opt = fluid.optimizer.Momentum(
+            0.1, momentum=0.9, parameter_list=m.parameters())
+        step = TrainStep(m, _mse, opt)
+        old_w = m.weight.value
+        step(x, y)
+        assert old_w.is_deleted(), \
+            "param buffer must be donated into the fused step"
+        old_slot = step._slots['weight']['velocity']
+        step(x, y)
+        assert old_slot.is_deleted(), \
+            "optimizer-state buffer must be donated into the fused step"
+        # the live handles were rebound to the step outputs and still work
+        assert np.isfinite(np.asarray(m.weight.value)).all()
+
+
+def test_train_step_donate_false_keeps_buffers():
+    x, y = _batch()
+    with dygraph.guard():
+        m = Linear(4, 1)
+        opt = fluid.optimizer.SGD(0.1, parameter_list=m.parameters())
+        step = TrainStep(m, _mse, opt, donate=False)
+        old_w = m.weight.value
+        step(x, y)
+        assert not old_w.is_deleted()
+        np.testing.assert_allclose(np.asarray(old_w), np.asarray(old_w))
+
+
+def test_train_step_donation_numerics_unchanged():
+    x, y = _batch()
+    got = {}
+    for donate in (True, False):
+        with dygraph.guard():
+            from paddle_tpu.core.random import seed as set_seed
+            set_seed(3)
+            m = Linear(4, 1)
+            opt = fluid.optimizer.SGD(0.1, parameter_list=m.parameters())
+            step = TrainStep(m, _mse, opt, donate=donate)
+            for _ in range(3):
+                loss = step(x, y)
+            got[donate] = (float(loss),
+                           {n: np.asarray(p.value)
+                            for n, p in m.named_parameters()})
+    assert got[True][0] == pytest.approx(got[False][0], rel=1e-6)
+    for n in got[True][1]:
+        np.testing.assert_allclose(got[True][1][n], got[False][1][n],
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_gradient_merge_bf16_accumulators():
+    """ADVICE r5: bf16 params + accum_steps>1 must compile (accumulators in
+    the gradient dtype; both lax.cond branches agree) and keep bf16 params."""
+    import jax.numpy as jnp
+    x, y = _batch()
+    with dygraph.guard():
+        m = Linear(4, 1)
+        for p in m.parameters():
+            p.value = p.value.astype(jnp.bfloat16)
+        opt = fluid.optimizer.Momentum(
+            0.05, momentum=0.9, parameter_list=m.parameters())
+        step = TrainStep(m, _mse, opt, accum_steps=2)
+        w0 = np.asarray(m.weight.value, np.float32).copy()
+        losses = [float(step(x, y)) for _ in range(4)]
+        assert m.weight.value.dtype == jnp.bfloat16
+        assert step._acc['weight'].dtype == jnp.bfloat16
+        assert all(np.isfinite(losses))
+        assert not np.allclose(np.asarray(m.weight.value, np.float32), w0), \
+            "two merged applications must have moved the params"
+
+
+# ---------------------------------------------------------------------------
+# Executor (static path)
+# ---------------------------------------------------------------------------
+
+def _build_sgd_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name='x', shape=[4, 3], dtype='float32')
+        y = fluid.data(name='y', shape=[4, 1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(pred - y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(seed=0):
+    rng = np.random.RandomState(seed)
+    return {'x': rng.randn(4, 3).astype(np.float32),
+            'y': rng.randn(4, 1).astype(np.float32)}
+
+
+def test_executor_donates_nonfetched_state():
+    main, startup, loss = _build_sgd_program()
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    pname = next(n for n in (v.name for v in main.list_vars()
+                             if v.persistable) if '.w_' in n)
+    old = scope.find(pname)
+    exe.run(main, feed=_feed(), fetch_list=[loss.name])
+    assert old.is_deleted(), \
+        "non-fetched persistable state must be donated into the step"
+    # the scope now holds the step's output buffer — further runs work
+    out = exe.run(main, feed=_feed(), fetch_list=[loss.name])
+    assert np.isfinite(out[0]).all()
+
+
+def test_executor_fetch_aliased_var_never_donated():
+    main, startup, loss = _build_sgd_program()
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    pname = next(n for n in (v.name for v in main.list_vars()
+                             if v.persistable) if '.w_' in n)
+    old = scope.find(pname)
+    before = np.asarray(old).copy()
+    outs = exe.run(main, feed=_feed(), fetch_list=[loss.name, pname])
+    assert not old.is_deleted(), \
+        "a fetch-aliased persistable must be excluded from donation"
+    np.testing.assert_allclose(np.asarray(old), before)   # still readable
+    assert np.isfinite(outs[1]).all()
+
+
+def test_executor_build_strategy_inplace_off_disables_donation():
+    main, startup, loss = _build_sgd_program()
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    pname = next(n for n in (v.name for v in main.list_vars()
+                             if v.persistable) if '.w_' in n)
+    bs = fluid.compiler.BuildStrategy()
+    bs.enable_inplace = False
+    cp = fluid.compiler.CompiledProgram(main, build_strategy=bs)
+    old = scope.find(pname)
+    exe.run(cp, feed=_feed(), fetch_list=[loss.name])
+    assert not old.is_deleted()
+
+
+def test_executor_env_hatch_disables_donation(monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_DONATE', '0')
+    main, startup, loss = _build_sgd_program()
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    pname = next(n for n in (v.name for v in main.list_vars()
+                             if v.persistable) if '.w_' in n)
+    old = scope.find(pname)
+    exe.run(main, feed=_feed(), fetch_list=[loss.name])
+    assert not old.is_deleted()
+
+
+def test_executor_donation_numerics_unchanged(monkeypatch):
+    results = {}
+    for donate in ('1', '0'):
+        monkeypatch.setenv('PADDLE_TPU_DONATE', donate)
+        main, startup, loss = _build_sgd_program()
+        exe = fluid.Executor()
+        exe.run(startup)
+        pname = next(n for n in (v.name for v in main.list_vars()
+                                 if v.persistable) if '.w_' in n)
+        fluid.global_scope().set(
+            pname, np.full_like(
+                np.asarray(fluid.global_scope().find(pname)), 0.25))
+        vals = [exe.run(main, feed=_feed(i), fetch_list=[loss.name])[0]
+                for i in range(3)]
+        results[donate] = np.concatenate([np.ravel(v) for v in vals])
+    np.testing.assert_allclose(results['1'], results['0'], rtol=1e-6)

@@ -1,0 +1,118 @@
+"""ADVICE r5 slim regressions: Compressor must seed weights from
+`init_model` (not silently train from random init), and SAController with a
+latency constraint must survive the epoch-end checkpoint pickle."""
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.slim.core import Compressor
+from paddle_tpu.contrib.slim.nas import LightNASStrategy, SearchSpace
+from paddle_tpu.contrib.slim.searcher import SAController
+
+
+def _classifier_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name='x', shape=[4, 3], dtype='float32')
+        y = fluid.layers.fc(input=x, size=2, name='clf')
+        loss = fluid.layers.reduce_mean(fluid.layers.square(y))
+    return main, startup, loss
+
+
+def test_compressor_loads_init_model(tmp_path):
+    # 1) pretrain: init, overwrite the weight with a sentinel, save
+    main, startup, loss = _classifier_program()
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    wname = next(v.name for v in main.list_vars()
+                 if v.persistable and '.w_' in v.name)
+    sentinel = np.full_like(np.asarray(scope.find(wname)), 0.625)
+    scope.set(wname, sentinel)
+    fluid.io.save_persistables(exe, str(tmp_path / 'init'), main)
+
+    # 2) fresh scope + re-initialized program (name generator reset: a new
+    #    process rebuilds the net with identical var names): random weights
+    import paddle_tpu.core.scope as scope_mod
+    from paddle_tpu.core import unique_name
+    scope_mod._global_scope = scope_mod.Scope()
+    unique_name.generator = unique_name.UniqueNameGenerator()
+    main2, startup2, loss2 = _classifier_program()
+    exe2 = fluid.Executor()
+    exe2.run(startup2)
+    wname2 = next(v.name for v in main2.list_vars()
+                  if v.persistable and '.w_' in v.name)
+    assert not np.allclose(
+        np.asarray(fluid.global_scope().find(wname2)), sentinel)
+
+    # 3) Compressor.run() with init_model must load the pretrained weights
+    #    before the (absent) checkpoint resume — no training (no reader)
+    comp = Compressor(train_program=main2, train_reader=None,
+                      train_feed_list=['x'], train_fetch_list=[loss2],
+                      epoch=1, init_model=str(tmp_path / 'init'))
+    comp.run()
+    np.testing.assert_allclose(
+        np.asarray(fluid.global_scope().find(wname2)), sentinel)
+
+
+def test_compressor_missing_init_model_raises(tmp_path):
+    main, startup, loss = _classifier_program()
+    fluid.Executor().run(startup)
+    comp = Compressor(train_program=main, train_reader=None,
+                      train_feed_list=['x'], train_fetch_list=[loss],
+                      epoch=1, init_model=str(tmp_path / 'nope'))
+    with pytest.raises(ValueError, match='init_model'):
+        comp.run()
+
+
+class _Space(SearchSpace):
+    def init_tokens(self):
+        return [0, 0]
+
+    def range_table(self):
+        return [3, 3]
+
+    def create_net(self, tokens):
+        return None, ('prog', tuple(tokens)), None, None, None
+
+    def get_model_latency(self, program):
+        return float(sum(program[1]))
+
+
+def test_sacontroller_with_constraint_pickles():
+    strat = LightNASStrategy(target_latency=2.0, search_steps=1)
+    space = _Space()
+    strat.controller.reset(space.range_table(), space.init_tokens(),
+                           strat._constrain(space))
+    assert strat.controller._constrain_func is not None
+    blob = pickle.dumps([strat])            # the epoch-end checkpoint path
+    (restored,) = pickle.loads(blob)
+    assert restored.controller._constrain_func is None
+    # controller still searches without the constraint...
+    toks = restored.controller.next_tokens()
+    assert len(toks) == 2
+
+    # ...and restore_from_checkpoint rebuilds it from the live context
+    class _Ctx:
+        search_space = space
+    restored.restore_from_checkpoint(_Ctx())
+    fn = restored.controller._constrain_func
+    assert fn is not None
+    assert fn([1, 1]) and not fn([2, 2])    # latency 2.0 <= vs 4.0 >
+
+    # constrained next_tokens only proposes feasible candidates again
+    restored.controller.reset(space.range_table(), [0, 0], fn)
+    for _ in range(5):
+        assert fn(restored.controller.next_tokens())
+
+
+def test_sacontroller_state_roundtrip_preserves_search_state():
+    c = SAController(seed=0)
+    c.reset([4, 4], [1, 2], constrain_func=lambda t: True)
+    c.update([1, 2], reward=0.5)
+    c2 = pickle.loads(pickle.dumps(c))
+    assert c2.best_tokens == [1, 2]
+    assert c2.max_reward == 0.5
+    assert c2._iter == c._iter

@@ -106,7 +106,7 @@ def test_recompute_jaxpr_contains_remat():
     feeds = {'x': jnp.zeros((4, 8), jnp.float32),
              'y': jnp.zeros((4, 1), jnp.float32)}
     step = _lower(main, list(feeds), [loss.name], state_names)
-    jaxpr = jax.make_jaxpr(step)(state, feeds, jax.random.PRNGKey(0))
+    jaxpr = jax.make_jaxpr(step)(state, {}, feeds, jax.random.PRNGKey(0))
     assert 'remat' in str(jaxpr), "no remat segments in lowered step"
 
 

@@ -1,0 +1,176 @@
+"""Dygraph dispatch-overhead microbench (PERF.md §9).
+
+Times one training step of a ResNet bottleneck block and a BERT transformer
+layer three ways:
+
+1. **eager, kernel cache off** — the pre-overhaul tape: every op call
+   re-traces jax.vjp through its functional (the Python-dispatch slow path
+   the reference pays 1,500+ LoC of C++ Tracer to avoid);
+2. **eager, kernel cache on** — the per-op jitted-kernel cache
+   (dygraph/tape.py): op dispatch is an LRU hit onto a compiled kernel;
+3. **fused TrainStep** — forward+vjp+update as ONE donated XLA program
+   (the production path; the remaining eager/fused gap is the cost of
+   op-granular dispatch itself).
+
+Slope-method timing per PERF.md §3 (marginal time between an N-iter and a
+3N-iter run cancels fixed costs). One JSON line per measurement. Runs on any
+backend; sized for CPU by default:
+
+  JAX_PLATFORMS=cpu python tools/bench_dispatch.py [--iters 5] [--smoke]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable as `python tools/bench_dispatch.py` from the repo root
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _slope(fn, iters):
+    """Marginal seconds/step between iters and 3*iters chained runs."""
+    import jax
+
+    def run(n):
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(n):
+            r = fn()
+        jax.block_until_ready(r.value if hasattr(r, 'value') else r)
+        return time.perf_counter() - t0
+
+    run(1)   # warmup: compiles + populates kernel/step caches
+    t1, t3 = run(iters), run(3 * iters)
+    return max((t3 - t1) / (2 * iters), 1e-9)
+
+
+def _mean_sq(out):
+    from paddle_tpu.dygraph.tape import dispatch_op
+    return dispatch_op('reduce_mean', {'x': out * out}, {})
+
+
+def _eager_step_fn(make_model, make_inputs):
+    """Eager tape training step: forward, backward() tape walk, fused
+    optimizer update — op-granular dispatch throughout."""
+    import paddle_tpu as fluid
+    model = make_model()
+    opt = fluid.optimizer.SGD(0.01, parameter_list=model.parameters())
+    inputs = make_inputs()
+
+    def step():
+        loss = _mean_sq(model(*inputs))
+        loss.backward()
+        opt.minimize(loss)
+        opt.clear_gradients()
+        return loss
+
+    return step
+
+
+def _fused_step_fn(make_model, make_inputs):
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.dygraph.jit import TrainStep
+    model = make_model()
+    opt = fluid.optimizer.SGD(0.01, parameter_list=model.parameters())
+
+    def loss_fn(m, *batch):
+        return _mean_sq(m(*batch))
+
+    step = TrainStep(model, loss_fn, opt)
+    batch = [np.asarray(t.value) for t in make_inputs()]
+    return lambda: step(*batch)
+
+
+def bench_block(name, make_model, make_inputs, iters):
+    """→ dict with the three slope timings (ms) + derived ratios."""
+    from paddle_tpu import dygraph
+    from paddle_tpu.dygraph.tape import kernel_cache
+
+    with dygraph.guard():
+        with dygraph.eager_kernel_cache_guard(False):
+            t_uncached = _slope(_eager_step_fn(make_model, make_inputs),
+                                iters)
+        with dygraph.eager_kernel_cache_guard(True):
+            kernel_cache.clear()
+            t_cached = _slope(_eager_step_fn(make_model, make_inputs), iters)
+            stats = kernel_cache.stats()
+        t_fused = _slope(_fused_step_fn(make_model, make_inputs), iters)
+
+    return {
+        'bench': f'dispatch_{name}',
+        'eager_uncached_ms': round(t_uncached * 1e3, 3),
+        'eager_cached_ms': round(t_cached * 1e3, 3),
+        'train_step_ms': round(t_fused * 1e3, 3),
+        # ≥ 2x on the ResNet block is the overhaul's acceptance bar
+        'cache_speedup': round(t_uncached / t_cached, 2),
+        'eager_cached_vs_fused': round(t_cached / t_fused, 2),
+        'cache_hits': stats['hits'], 'cache_misses': stats['misses'],
+    }
+
+
+def _resnet_block(smoke):
+    import numpy as np
+    from paddle_tpu.models.resnet import BottleneckBlock
+    from paddle_tpu import dygraph
+    bs, hw = (2, 8) if smoke else (4, 16)
+    rng = np.random.RandomState(0)
+
+    def make_model():
+        return BottleneckBlock(64, 16, stride=1, shortcut=True)
+
+    def make_inputs():
+        return [dygraph.to_variable(
+            rng.randn(bs, 64, hw, hw).astype(np.float32))]
+
+    return make_model, make_inputs
+
+
+def _bert_layer(smoke):
+    import numpy as np
+    from paddle_tpu.models.bert import BertConfig, TransformerLayer
+    from paddle_tpu import dygraph
+    bs, seq = (1, 8) if smoke else (2, 16)
+    cfg = BertConfig(hidden_size=64, num_attention_heads=2,
+                     intermediate_size=128, hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    rng = np.random.RandomState(0)
+
+    def make_model():
+        return TransformerLayer(cfg)
+
+    def make_inputs():
+        return [dygraph.to_variable(
+            rng.randn(bs, seq, 64).astype(np.float32))]
+
+    return make_model, make_inputs
+
+
+def measure_all(iters=5, smoke=False):
+    """Both blocks; returns {'resnet_block': {...}, 'bert_layer': {...}}."""
+    out = {}
+    for name, builder in [('resnet_block', _resnet_block),
+                          ('bert_layer', _bert_layer)]:
+        make_model, make_inputs = builder(smoke)
+        out[name] = bench_block(name, make_model, make_inputs, iters)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--iters', type=int, default=5,
+                    help='slope base iteration count (runs N then 3N)')
+    ap.add_argument('--smoke', action='store_true',
+                    help='tiny shapes / CI smoke sizes')
+    args = ap.parse_args()
+    for res in measure_all(iters=args.iters, smoke=args.smoke).values():
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == '__main__':
+    main()
