@@ -342,6 +342,27 @@ def bench_dispatch_overhead(on_tpu):
     return measure_all(iters=8 if on_tpu else 4)
 
 
+def bench_telemetry_sidecar(on_tpu):
+    """Telemetry sidecar for the bench run: the headline benches above run
+    with telemetry off (their numbers stay comparable across PRs), then the
+    on-vs-off eager A/B from bench_dispatch runs here — its enabled half
+    populates the metrics registry — and the registry dict export is written
+    next to the BENCH_*.json evidence."""
+    from bench_dispatch import measure_telemetry_overhead
+    from paddle_tpu import observability as obs
+    ab = measure_telemetry_overhead(iters=4 if on_tpu else 2, smoke=True)
+    sidecar = {
+        'telemetry_overhead': ab,
+        'metrics': obs.registry.to_dict(),
+    }
+    out_dir = os.environ.get('PADDLE_TPU_METRICS_DIR') or os.getcwd()
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, 'BENCH_telemetry.json')
+    with open(path, 'w') as f:
+        json.dump(sidecar, f, indent=1)
+    return {'path': path, 'on_over_off': ab['on_over_off']}
+
+
 def main():
     jax, devices, backend = init_backend_or_die()
     on_tpu = backend != 'cpu'
@@ -420,6 +441,11 @@ def main():
         summary.update(
             eager_cache_speedup_resnet_block=rb["cache_speedup"],
             eager_vs_fused_resnet_block=rb["eager_cached_vs_fused"])
+
+    s = run("telemetry_sidecar", lambda: bench_telemetry_sidecar(on_tpu))
+    if s is not None:
+        emit({"metric": "telemetry_sidecar", "path": s["path"],
+              "telemetry_on_over_off": s["on_over_off"]})
 
     emit(summary)  # last line: the original ONE-JSON-line driver contract
     if failures:

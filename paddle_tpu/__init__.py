@@ -50,6 +50,7 @@ from .data_feeder import DataFeeder
 from . import parallel
 from . import distributed
 from . import contrib
+from . import observability
 from . import profiler
 from . import debugger
 from . import log_helper

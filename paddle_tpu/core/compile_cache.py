@@ -16,12 +16,71 @@ Environment knobs (documented in README):
                                       only persist compiles slower than this
                                       (default: jax's own 1.0s floor; set 0
                                       to persist everything, e.g. in tests)
+
+Telemetry (PADDLE_TPU_TELEMETRY=1, docs/OBSERVABILITY.md): the Executor
+reports its in-process program-cache lookups through record_program_cache
+(compile_cache_hits / compile_cache_misses — a miss is a lower+compile), and
+a best-effort jax monitoring listener maps the persistent layer's own events
+onto persistent_cache_{hits,misses} plus a compile_cache_deserialize_seconds
+histogram.
 """
 from __future__ import annotations
 
 import os
 
+from .. import observability as _obs
+
 _configured = None   # None = not attempted; False = disabled; str = cache dir
+_listeners_installed = False
+
+
+def record_program_cache(hit):
+    """Executor program+shape jit-cache lookup result (a miss means the
+    program gets lowered and XLA-compiled on its first execution)."""
+    if _obs._ENABLED:
+        if hit:
+            _obs.inc('compile_cache_hits',
+                     help='in-process program+shape step-cache hits')
+        else:
+            _obs.inc('compile_cache_misses',
+                     help='in-process step-cache misses (lower + compile)')
+
+
+def _install_jax_cache_listeners():
+    """Best-effort: mirror jax's persistent-compilation-cache monitoring
+    events into the metrics registry. jax internals — any failure is
+    silently skipped (the in-process counters above still populate)."""
+    global _listeners_installed
+    if _listeners_installed:
+        return
+    _listeners_installed = True
+    try:
+        from jax._src import monitoring
+
+        def on_event(event, **kw):
+            if not _obs._ENABLED:
+                return
+            if event == '/jax/compilation_cache/cache_hits':
+                _obs.inc('persistent_cache_hits',
+                         help='persistent XLA cache deserializations')
+            elif event == '/jax/compilation_cache/cache_misses':
+                _obs.inc('persistent_cache_misses',
+                         help='persistent XLA cache misses (full compile)')
+
+        def on_duration(event, duration, **kw):
+            if not _obs._ENABLED:
+                return
+            if event == '/jax/compilation_cache/cache_retrieval_time_sec':
+                _obs.observe('compile_cache_deserialize_seconds', duration,
+                             help='time deserializing a persisted executable')
+            elif event == '/jax/compilation_cache/compile_time_saved_sec':
+                _obs.observe('compile_cache_time_saved_seconds', duration,
+                             help='compile seconds avoided by a cache hit')
+
+        monitoring.register_event_listener(on_event)
+        monitoring.register_event_duration_secs_listener(on_duration)
+    except Exception:
+        pass
 
 
 def setup_persistent_cache():
@@ -29,6 +88,7 @@ def setup_persistent_cache():
     cache dir, or None when disabled. Safe to call from every Executor /
     TrainStep constructor — only the first call does work."""
     global _configured
+    _install_jax_cache_listeners()
     if _configured is not None:
         return _configured or None
     if os.environ.get('PADDLE_TPU_COMPILE_CACHE', '1') == '0':

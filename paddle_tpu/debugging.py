@@ -10,18 +10,27 @@ wired through for eager paths.
 """
 from __future__ import annotations
 
+import logging
 import os
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .log_helper import get_logger
+
+_logger = get_logger(__name__, logging.INFO,
+                     fmt='%(asctime)s-%(levelname)s: %(message)s')
+
 _check_enabled = os.environ.get('FLAGS_check_nan_inf', '0') not in ('0', '')
 
 
 def enable_check_nan_inf(enable=True):
     """Also enables jax_debug_nans so eager/dygraph ops raise at the
-    producing op, like the reference's per-op scan."""
+    producing op, like the reference's per-op scan. The instrumented
+    Executor additionally scans fetched values each step and reports
+    detections as the `nonfinite_detections` telemetry counter plus an
+    `executor/check_nan_inf` trace span (docs/OBSERVABILITY.md)."""
     global _check_enabled
     _check_enabled = enable
     jax.config.update('jax_debug_nans', bool(enable))
@@ -91,5 +100,6 @@ def install_check():
                                   'y': np.zeros((8, 1), 'float32')},
                       fetch_list=[loss])
         check_numerics(l0, 'install_check loss')
-    print('paddle_tpu install check passed —', device_report().split('\n')[0])
+    _logger.info('paddle_tpu install check passed — %s',
+                 device_report().split('\n')[0])
     return True

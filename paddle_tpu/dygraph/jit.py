@@ -17,6 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
 from .tape import Tensor, Parameter, no_grad_guard
 from .layers import Layer
 
@@ -614,8 +615,22 @@ class TrainStep:
                 {n: b.value for n, b in self._buffers.items()})
 
     def __call__(self, *batch):
+        if not _obs._ENABLED:
+            return self._call_impl(batch)
+        # span tree per fused step: build (first call only) + execute nest
+        # under train_step/call; one steps.jsonl record per call
+        with _obs.span('train_step/call', step=self._step + 1):
+            loss = self._call_impl(batch)
+        _obs.inc('train_step_calls', help='fused TrainStep invocations')
+        _obs.log_step(kind='train_step', step=self._step,
+                      accum_steps=self._accum_steps,
+                      donate=self._donate)
+        return loss
+
+    def _call_impl(self, batch):
         if self._jitted is None:
-            self._jitted = self._build()
+            with _obs.span('train_step/build'):
+                self._jitted = self._build()
             self._slots = {
                 n: {s: jnp.full(shp, fill, jnp.float32)
                     for s, (shp, fill) in
@@ -628,25 +643,27 @@ class TrainStep:
                 arr = jax.device_put(arr, self._data_sharding)
             batch_vals.append(arr)
         pvals, bvals = self.state()
-        if self._accum_steps > 1:
-            if self._acc is None:
-                # accumulators carry the GRADIENT dtype (== param dtype;
-                # fp32 masters under amp): a hardcoded fp32 accumulator
-                # would promote `acc + grad` for bf16 params and the two
-                # lax.cond branches would disagree on dtypes (ADVICE r5)
-                self._acc = {n: jnp.zeros_like(p.value)
-                             for n, p in self._params.items()
-                             if p.trainable}
-                self._count = jnp.int32(0)
-            new_p, new_b, self._slots, self._acc, self._count, loss = \
-                self._jitted(pvals, bvals, self._slots, self._acc,
-                             self._count,
-                             jnp.float32(self._opt._current_lr()),
-                             tuple(batch_vals))
-        else:
-            new_p, new_b, self._slots, loss = self._jitted(
-                pvals, bvals, self._slots,
-                jnp.float32(self._opt._current_lr()), tuple(batch_vals))
+        with _obs.span('train_step/execute'):
+            if self._accum_steps > 1:
+                if self._acc is None:
+                    # accumulators carry the GRADIENT dtype (== param dtype;
+                    # fp32 masters under amp): a hardcoded fp32 accumulator
+                    # would promote `acc + grad` for bf16 params and the two
+                    # lax.cond branches would disagree on dtypes (ADVICE r5)
+                    self._acc = {n: jnp.zeros_like(p.value)
+                                 for n, p in self._params.items()
+                                 if p.trainable}
+                    self._count = jnp.int32(0)
+                new_p, new_b, self._slots, self._acc, self._count, loss = \
+                    self._jitted(pvals, bvals, self._slots, self._acc,
+                                 self._count,
+                                 jnp.float32(self._opt._current_lr()),
+                                 tuple(batch_vals))
+            else:
+                new_p, new_b, self._slots, loss = self._jitted(
+                    pvals, bvals, self._slots,
+                    jnp.float32(self._opt._current_lr()),
+                    tuple(batch_vals))
         for n, p in self._params.items():
             p.value = new_p[n]
         for n, b in self._buffers.items():

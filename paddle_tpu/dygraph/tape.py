@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import time
 from collections import OrderedDict
 from typing import Optional
 
@@ -21,6 +22,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
 from ..core import unique_name
 from ..core.dtypes import convert_dtype, to_jax_dtype
 from ..core.random import default_generator
@@ -91,6 +93,12 @@ class _EagerKernelCache:
 
     def clear(self):
         self._entries.clear()
+        self.reset_stats()
+
+    def reset_stats(self):
+        """Zero the counters but KEEP the compiled kernels — a profiled
+        re-run over a warm cache must report fresh hit/miss numbers without
+        paying the recompiles that clear() would force."""
         self.hits = self.misses = self.evictions = self.bypasses = 0
 
     def get(self, key):
@@ -119,6 +127,22 @@ kernel_cache = _EagerKernelCache()
 
 def kernel_cache_stats():
     return kernel_cache.stats()
+
+
+def _collect_kernel_cache_gauges():
+    """At-export snapshot of the kernel-cache counters into the telemetry
+    registry — the cache's own hot path stays untouched."""
+    s = kernel_cache.stats()
+    g = _obs.registry.gauge(
+        'eager_kernel_cache',
+        'dygraph per-op jitted-kernel cache state (stat label selects '
+        'hits/misses/evictions/bypasses/size/maxsize/enabled)')
+    for k in ('size', 'maxsize', 'hits', 'misses', 'evictions', 'bypasses'):
+        g.labels(stat=k).set(s[k])
+    g.labels(stat='enabled').set(1.0 if s['enabled'] else 0.0)
+
+
+_obs.registry.register_collector(_collect_kernel_cache_gauges)
 
 
 @contextlib.contextmanager
@@ -271,7 +295,25 @@ def to_tensor_value(x):
 
 def dispatch_op(op_type, inputs, attrs):
     """Run a registered op eagerly, recording the tape. `inputs` is
-    slot → Tensor | [Tensor] | None, matching the op's positional slots."""
+    slot → Tensor | [Tensor] | None, matching the op's positional slots.
+
+    Telemetry shim: with PADDLE_TPU_TELEMETRY off this is one bool check +
+    one extra call frame on top of the real dispatch (_dispatch_op_impl);
+    with it on, each dispatch lands one sample in the per-op latency
+    histogram, labeled by whether the kernel cache served it."""
+    if not _obs._ENABLED:
+        return _dispatch_op_impl(op_type, inputs, attrs)
+    hits0 = kernel_cache.hits
+    t0 = time.perf_counter()
+    try:
+        with _obs.tracer.span('tape/' + op_type):
+            return _dispatch_op_impl(op_type, inputs, attrs)
+    finally:
+        _obs.record_op_dispatch(op_type, time.perf_counter() - t0,
+                                cached=kernel_cache.hits > hits0)
+
+
+def _dispatch_op_impl(op_type, inputs, attrs):
     opdef = get_op(op_type)
     flat_tensors = []   # tensors participating in vjp
     arg_spec = []       # per-slot: ('single', idx) | ('list', [idx]) | ('const', v)

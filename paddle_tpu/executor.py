@@ -22,6 +22,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import observability as _obs
+from .core.compile_cache import record_program_cache
 from .core.dtypes import to_jax_dtype
 from .core.places import _get_paddle_place
 from .core.scope import global_scope
@@ -732,6 +734,18 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True, feed_var_name='feed',
             fetch_var_name='fetch'):
+        if not _obs._ENABLED:
+            return self._run_impl(program, feed, fetch_list, scope,
+                                  return_numpy)
+        # telemetry on: every run is one span tree — prepare / lower /
+        # execute / fetch phases nest under executor/run (trace.json), the
+        # phase durations + donation/byte counts land in the metrics
+        # registry and one steps.jsonl record (docs/OBSERVABILITY.md)
+        with _obs.span('executor/run', step=self._step_counter + 1):
+            return self._run_impl(program, feed, fetch_list, scope,
+                                  return_numpy)
+
+    def _run_impl(self, program, feed, fetch_list, scope, return_numpy):
         from .compiler import CompiledProgram
         sharding = None
         donate = os.environ.get('PADDLE_TPU_DONATE', '1') != '0'
@@ -753,9 +767,12 @@ class Executor:
 
         block = program.global_block()
         if any(op.type == '__init__' for op in block.ops):
-            self._run_startup(program, scope)
+            with _obs.span('executor/startup'):
+                self._run_startup(program, scope)
             return []
 
+        prep_span = _obs.span('executor/prepare')
+        prep_span.__enter__()
         # persistable vars = training state
         state_names = sorted(v.name for v in program.list_vars()
                              if v.persistable)
@@ -806,15 +823,21 @@ class Executor:
                 arr = jax.device_put(arr, sharding)
             feed_vals[name] = arr
         _default_len_feeds(block, feed_vals)
+        prep_span.__exit__(None, None, None)
 
         feed_sig = tuple(sorted((n, v.shape, str(v.dtype))
                                 for n, v in feed_vals.items()))
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
                tuple(state_names), donate)
         fn = self._cache.get(key)
+        compiled_now = fn is None
+        record_program_cache(hit=not compiled_now)
+        lower_span = _obs.span('executor/lower', program=program._id)
         if fn is None:
-            step = _lower(program, list(feed_vals), fetch_names, state_names)
-            fn = jax.jit(step, donate_argnums=(0,))
+            with lower_span:
+                step = _lower(program, list(feed_vals), fetch_names,
+                              state_names)
+                fn = jax.jit(step, donate_argnums=(0,))
             self._cache[key] = fn
 
         # Donation guards: a fetch-aliased persistable must survive the call
@@ -836,12 +859,89 @@ class Executor:
         self._step_counter += 1
         base_key = jax.random.fold_in(default_generator.base_key(),
                                       self._step_counter)
-        new_state, fetches = fn(dstate, kstate, feed_vals, base_key)
-        for n, v in new_state.items():
-            scope.set(n, v)
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return fetches
+        # execute = host-side dispatch of the jitted step (on a cache miss
+        # this includes trace + XLA compile); fetch = scope write-back plus
+        # the device→host transfer that synchronizes with the computation
+        exec_span = _obs.span('executor/execute', compile=compiled_now)
+        try:
+            with exec_span:
+                new_state, fetches = fn(dstate, kstate, feed_vals, base_key)
+        except FloatingPointError:
+            # jax_debug_nans (enable_check_nan_inf) raised inside the step:
+            # record the detection so a NaN storm is a telemetry series,
+            # not only the first traceback
+            _obs.inc('nonfinite_detections', 1,
+                     help='fetched variables containing NaN/Inf '
+                          '(FLAGS_check_nan_inf)')
+            _obs.instant('nonfinite_detected', source='jax_debug_nans')
+            raise
+        fetch_span = _obs.span('executor/fetch')
+        with fetch_span:
+            for n, v in new_state.items():
+                scope.set(n, v)
+            result = [np.asarray(f) for f in fetches] if return_numpy \
+                else fetches
+
+        from .debugging import check_nan_inf_enabled
+        if check_nan_inf_enabled() and fetch_names:
+            # FLAGS_check_nan_inf parity on the fused step: scan the fetched
+            # host values; detections land in telemetry (counter + instant
+            # trace marker) BEFORE the raise so a NaN storm is visible in
+            # the artifacts, not only in the first traceback
+            with _obs.span('executor/check_nan_inf'):
+                self._check_fetches_finite(fetch_names, fetches)
+
+        if _obs._ENABLED:
+            _obs.inc('executor_steps',
+                     help='completed Executor.run training/eval steps')
+            _obs.inc('executor_donated_buffers', len(dstate),
+                     help='state buffers donated into the step (in-place '
+                          'XLA update)')
+            _obs.inc('executor_kept_buffers', len(kstate),
+                     help='state buffers excluded from donation '
+                          '(fetch-aliased or buffer-shared)')
+            feed_bytes = sum(getattr(v, 'nbytes', 0)
+                             for v in feed_vals.values())
+            fetch_bytes = sum(getattr(f, 'nbytes', 0) for f in result)
+            _obs.inc('executor_feed_bytes', feed_bytes,
+                     help='bytes fed into Executor.run')
+            _obs.inc('executor_fetch_bytes', fetch_bytes,
+                     help='bytes fetched out of Executor.run')
+            if compiled_now:
+                _obs.observe(
+                    'executor_compile_seconds',
+                    lower_span.duration + exec_span.duration,
+                    help='lower + first-execution (trace/XLA-compile) time '
+                         'per program+shape cache miss')
+            _obs.log_step(
+                kind='executor', step=self._step_counter,
+                compiled=compiled_now, donated=len(dstate),
+                kept=len(kstate), feed_bytes=feed_bytes,
+                fetch_bytes=fetch_bytes,
+                prepare_s=round(prep_span.duration, 6),
+                lower_s=round(lower_span.duration, 6),
+                execute_s=round(exec_span.duration, 6),
+                fetch_s=round(fetch_span.duration, 6))
+        return result
+
+    @staticmethod
+    def _check_fetches_finite(fetch_names, fetches):
+        """Count + raise on non-finite fetched values (FLAGS_check_nan_inf).
+        The counter increments even when telemetry is disabled-at-env — it
+        is a no-op then — so enabling both shows NaN storms as a
+        `nonfinite_detections` series instead of a lone traceback."""
+        from .debugging import check_numerics
+        bad = {}
+        for n, f in zip(fetch_names, fetches):
+            arr = np.asarray(f)
+            if arr.dtype.kind == 'f' and not np.isfinite(arr).all():
+                bad[n] = arr
+        if bad:
+            _obs.inc('nonfinite_detections', len(bad),
+                     help='fetched variables containing NaN/Inf '
+                          '(FLAGS_check_nan_inf)')
+            _obs.instant('nonfinite_detected', variables=','.join(bad))
+            check_numerics(bad, 'fetches')
 
     # ------------------------------------------------------------------
     def _run_from_dataset(self, program, dataset, scope, debug, fetch_list,

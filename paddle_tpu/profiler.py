@@ -5,10 +5,17 @@ Perfetto instead of the reference's chrome-tracing timeline.
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
 import time
 
 import jax
+
+from . import observability as _obs
+from .log_helper import get_logger
+
+_logger = get_logger(__name__, logging.INFO,
+                     fmt='%(asctime)s-%(levelname)s: %(message)s')
 
 _trace_dir = None
 _op_times = {}
@@ -23,15 +30,17 @@ def start_profiler(state='All', tracer_option='Default',
 
 
 def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    # logger, not print(): headless/captured runs keep the profiler output
+    # (log_helper handler, application logging config untouched)
     jax.profiler.stop_trace()
-    print(f"[paddle_tpu.profiler] trace written to {_trace_dir} "
-          f"(open with TensorBoard or ui.perfetto.dev)")
+    _logger.info("trace written to %s (open with TensorBoard or "
+                 "ui.perfetto.dev)", _trace_dir)
     if _op_times:
-        print(summary_table(sorted_key))
+        _logger.info("\n%s", summary_table(sorted_key))
         _op_times.clear()     # per-session table, like the reference
     stats = eager_kernel_cache_stats()
     if stats['hits'] or stats['misses'] or stats['bypasses']:
-        print(f"[paddle_tpu.profiler] eager kernel cache: {stats}")
+        _logger.info("eager kernel cache: %s", stats)
 
 
 def summary_table(sorted_key=None):
@@ -68,14 +77,19 @@ def profiler(state='All', sorted_key=None, profile_path='/tmp/profile',
 
 @contextlib.contextmanager
 def record_event(name):
-    """Host-side named span; device-side annotation via TraceAnnotation."""
-    with jax.profiler.TraceAnnotation(name):
+    """Host-side named span; device-side annotation via TraceAnnotation.
+    With PADDLE_TPU_TELEMETRY on the region also lands in the telemetry
+    trace/metrics (span `user/<name>`, histogram user_event_seconds)."""
+    with jax.profiler.TraceAnnotation(name), _obs.span(f'user/{name}'):
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
             _op_times.setdefault(name, []).append(dt)
+            _obs.observe('user_event_seconds', dt,
+                         help='profiler.record_event region durations',
+                         event=name)
 
 
 def eager_kernel_cache_stats():
@@ -88,9 +102,12 @@ def eager_kernel_cache_stats():
 
 
 def reset_eager_kernel_cache_stats():
-    """Zero the eager kernel-cache counters (and drop its entries)."""
+    """Zero the hits/misses/evictions/bypasses counters WITHOUT dropping the
+    compiled kernels: two back-to-back profiled runs each report their own
+    hit rate, and the second run stays warm (clear() would force every
+    signature to recompile and read as a miss storm)."""
     from .dygraph.tape import kernel_cache
-    kernel_cache.clear()
+    kernel_cache.reset_stats()
 
 
 def reset_profiler():

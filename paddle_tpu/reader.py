@@ -12,9 +12,12 @@ import itertools
 import queue
 import random as pyrandom
 import threading
+import time
 
 import numpy as np
 import jax
+
+from . import observability as _obs
 
 __all__ = ['DataLoader', 'batch', 'shuffle', 'buffered', 'map_readers',
            'xmap_readers', 'chain', 'compose', 'firstn', 'cache',
@@ -236,6 +239,12 @@ class _GeneratorLoader:
                     staged = {k: (v if isinstance(v, LoDTensor) else
                                   jax.device_put(np.ascontiguousarray(v)))
                               for k, v in feed.items()}
+                    if _obs._ENABLED:
+                        _obs.inc('dataloader_staged_bytes',
+                                 sum(getattr(v, 'nbytes', 0)
+                                     for v in staged.values()),
+                                 help='bytes staged host→device by the '
+                                      'DataLoader producer thread')
                     q.put(staged)
             except BaseException as e:   # surface in the consumer, not stderr
                 err_box.append(e)
@@ -245,7 +254,27 @@ class _GeneratorLoader:
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         while True:
-            item = q.get()
+            if _obs._ENABLED:
+                # consumer-side input starvation: time blocked on the ring.
+                # A well-fed loop keeps this near zero; a starved one makes
+                # the device wait on the host (arXiv:1909.09756's per-step
+                # input-wait signal). wait_seconds_total / wall time is the
+                # starvation fraction telemetry_report.py prints.
+                t0 = time.perf_counter()
+                item = q.get()
+                wait = time.perf_counter() - t0
+                _obs.observe('dataloader_wait_seconds', wait,
+                             help='consumer wait per batch on the prefetch '
+                                  'ring (input starvation)')
+                _obs.inc('dataloader_wait_seconds_total', wait,
+                         help='cumulative consumer input-starvation wait')
+                _obs.set_gauge('dataloader_last_wait_seconds', wait,
+                               help='most recent per-batch input wait')
+                if item is not end:
+                    _obs.inc('dataloader_batches',
+                             help='batches yielded by DataLoader')
+            else:
+                item = q.get()
             if item is end:
                 if err_box:
                     raise err_box[0]

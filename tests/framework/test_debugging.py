@@ -43,5 +43,16 @@ def test_device_report_contents():
 
 
 def test_install_check_end_to_end(capsys):
-    assert debugging.install_check() is True
-    assert 'install check passed' in capsys.readouterr().out
+    # routed through log_helper instead of print(): capture via the logger
+    import io
+    import logging
+    stream = io.StringIO()
+    handler = logging.StreamHandler(stream)
+    log = logging.getLogger('paddle_tpu.debugging')
+    log.addHandler(handler)
+    try:
+        assert debugging.install_check() is True
+    finally:
+        log.removeHandler(handler)
+    assert 'install check passed' not in capsys.readouterr().out
+    assert 'install check passed' in stream.getvalue()

@@ -80,20 +80,30 @@ def test_scaled_dot_product_attention():
     assert r.min() >= q.min() - 1e-5 and r.max() <= q.max() + 1e-5
 
 
-def test_profiler_records_and_reports(capsys):
-    profiler.reset_profiler()
-    profiler.start_profiler(state='CPU')
-    with profiler.record_event('my_region'):
-        x = np.zeros(10)
-        for _ in range(3):
-            x = x + 1
-    with profiler.record_event('my_region'):
-        pass
-    times = profiler.get_op_times()
-    assert 'my_region' in times and times['my_region'][0] == 2
-    profiler.stop_profiler(sorted_key='calls')
-    out = capsys.readouterr().out
-    assert 'my_region' in out
+def test_profiler_records_and_reports():
+    # the summary now goes through log_helper, not print(): capture by
+    # attaching a handler to the module logger
+    import io
+    import logging
+    stream = io.StringIO()
+    handler = logging.StreamHandler(stream)
+    log = logging.getLogger('paddle_tpu.profiler')
+    log.addHandler(handler)
+    try:
+        profiler.reset_profiler()
+        profiler.start_profiler(state='CPU')
+        with profiler.record_event('my_region'):
+            x = np.zeros(10)
+            for _ in range(3):
+                x = x + 1
+        with profiler.record_event('my_region'):
+            pass
+        times = profiler.get_op_times()
+        assert 'my_region' in times and times['my_region'][0] == 2
+        profiler.stop_profiler(sorted_key='calls')
+    finally:
+        log.removeHandler(handler)
+    assert 'my_region' in stream.getvalue()
     profiler.reset_profiler()
     assert profiler.get_op_times() == {}
 

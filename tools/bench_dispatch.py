@@ -161,15 +161,43 @@ def measure_all(iters=5, smoke=False):
     return out
 
 
+def measure_telemetry_overhead(iters=4, smoke=True):
+    """Eager cached-step slope time with telemetry off vs ON (the resnet
+    bottleneck block). The ≤3% telemetry-DISABLED budget is enforced
+    structurally (the disabled dispatch path does no telemetry work — see
+    tests/framework/test_observability.py); this records the measured cost
+    of actually enabling it, for the bench sidecar."""
+    from paddle_tpu import dygraph, observability as obs
+    from paddle_tpu.dygraph.tape import kernel_cache
+    make_model, make_inputs = _resnet_block(smoke)
+    with dygraph.guard():
+        with obs.telemetry_guard(False):
+            kernel_cache.clear()
+            t_off = _slope(_eager_step_fn(make_model, make_inputs), iters)
+        with obs.telemetry_guard(True):
+            kernel_cache.clear()
+            t_on = _slope(_eager_step_fn(make_model, make_inputs), iters)
+    return {'bench': 'telemetry_overhead',
+            'eager_cached_ms_telemetry_off': round(t_off * 1e3, 3),
+            'eager_cached_ms_telemetry_on': round(t_on * 1e3, 3),
+            'on_over_off': round(t_on / t_off, 3)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--iters', type=int, default=5,
                     help='slope base iteration count (runs N then 3N)')
     ap.add_argument('--smoke', action='store_true',
                     help='tiny shapes / CI smoke sizes')
+    ap.add_argument('--telemetry-ab', action='store_true',
+                    help='also measure the eager step with telemetry '
+                         'enabled vs disabled')
     args = ap.parse_args()
     for res in measure_all(iters=args.iters, smoke=args.smoke).values():
         print(json.dumps(res), flush=True)
+    if args.telemetry_ab:
+        print(json.dumps(measure_telemetry_overhead(
+            iters=args.iters, smoke=args.smoke)), flush=True)
 
 
 if __name__ == '__main__':

@@ -1,0 +1,197 @@
+"""Summarize a paddle_tpu telemetry run directory.
+
+Reads the artifacts dumped by paddle_tpu.observability (metrics.json,
+trace.json, steps.jsonl — see docs/OBSERVABILITY.md) and prints a run
+summary: step counts, slowest eager ops, cache hit rates, input-starvation
+fraction, and the compile-time breakdown.
+
+  PADDLE_TPU_TELEMETRY=1 PADDLE_TPU_METRICS_DIR=/tmp/run python train.py
+  python tools/telemetry_report.py /tmp/run [--top 10]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load(path):
+    if path and os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return None
+
+
+def _load_jsonl(path):
+    rows = []
+    if path and os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+    return rows
+
+
+def _counter(metrics, name, default=0.0):
+    m = metrics.get(name)
+    if not m or not m.get('samples'):
+        return default
+    return sum(s['value'] for s in m['samples'])
+
+
+def _gauge_by_label(metrics, name, label):
+    out = {}
+    m = metrics.get(name)
+    for s in (m or {}).get('samples', []):
+        out[s['labels'].get(label)] = s['value']
+    return out
+
+
+def _ms(seconds):
+    return f"{seconds * 1e3:.3f}ms"
+
+
+def _rate(hits, misses):
+    total = hits + misses
+    return f"{hits / total:.1%} ({int(hits)}/{int(total)})" if total \
+        else "n/a (no lookups)"
+
+
+def summarize(metrics, trace, steps, top=10):
+    """→ list of report lines (pure; the CLI prints them)."""
+    lines = ['# paddle_tpu telemetry report', '']
+
+    # ---- run summary ----
+    events = (trace or {}).get('traceEvents', [])
+    wall = 0.0
+    if events:
+        t0 = min(e['ts'] for e in events)
+        t1 = max(e['ts'] + e.get('dur', 0.0) for e in events)
+        wall = (t1 - t0) / 1e6
+    exec_steps = _counter(metrics, 'executor_steps')
+    ts_calls = _counter(metrics, 'train_step_calls')
+    lines += ['## Run summary',
+              f"executor steps:        {int(exec_steps)}",
+              f"fused TrainStep calls: {int(ts_calls)}",
+              f"traced wall time:      {wall:.3f}s "
+              f"({len(events)} trace events, "
+              f"{(trace or {}).get('otherData', {}).get('dropped_events', 0)}"
+              f" dropped)",
+              f"step records:          {len(steps)}",
+              '']
+
+    # ---- slowest ops (eager dispatch histograms) ----
+    lines.append(f'## Slowest eager ops (top {top} by total dispatch time)')
+    rows = []
+    for s in (metrics.get('tape_dispatch_seconds') or {}).get('samples', []):
+        if s['count']:
+            rows.append((s['sum'], s))
+    if rows:
+        rows.sort(key=lambda r: -r[0])
+        lines.append(f"{'op':<28}{'cached':>8}{'calls':>8}{'total':>12}"
+                     f"{'mean':>12}{'max':>12}")
+        for total, s in rows[:top]:
+            lab = s['labels']
+            lines.append(
+                f"{lab.get('op', '?')[:28]:<28}{lab.get('cached', '?'):>8}"
+                f"{s['count']:>8}{_ms(total):>12}"
+                f"{_ms(total / s['count']):>12}{_ms(s['max'] or 0):>12}")
+    else:
+        lines.append('(no eager dispatches recorded)')
+    lines.append('')
+
+    # ---- cache hit rates ----
+    ek = _gauge_by_label(metrics, 'eager_kernel_cache', 'stat')
+    lines += ['## Cache hit rates',
+              f"eager kernel cache:    "
+              f"{_rate(ek.get('hits', 0), ek.get('misses', 0))}"
+              + (f"  [size {int(ek.get('size', 0))}/"
+                 f"{int(ek.get('maxsize', 0))}, "
+                 f"evictions {int(ek.get('evictions', 0))}, "
+                 f"bypasses {int(ek.get('bypasses', 0))}]" if ek else ''),
+              f"executor step cache:   "
+              f"{_rate(_counter(metrics, 'compile_cache_hits'), _counter(metrics, 'compile_cache_misses'))}",
+              f"persistent XLA cache:  "
+              f"{_rate(_counter(metrics, 'persistent_cache_hits'), _counter(metrics, 'persistent_cache_misses'))}",
+              '']
+
+    # ---- input starvation ----
+    wait_total = _counter(metrics, 'dataloader_wait_seconds_total')
+    batches = _counter(metrics, 'dataloader_batches')
+    lines.append('## Input pipeline')
+    if batches:
+        frac = wait_total / wall if wall > 0 else float('nan')
+        lines += [f"batches:               {int(batches)}",
+                  f"total input wait:      {wait_total:.4f}s",
+                  f"mean wait / batch:     {_ms(wait_total / batches)}",
+                  f"starvation fraction:   {frac:.1%} of traced wall time"]
+    else:
+        lines.append('(no DataLoader batches recorded)')
+    lines.append('')
+
+    # ---- compile-time breakdown ----
+    lines.append('## Compile-time breakdown')
+    any_compile = False
+    for name, label in [
+            ('executor_compile_seconds', 'executor lower+compile'),
+            ('compile_cache_deserialize_seconds', 'persistent deserialize'),
+            ('compile_cache_time_saved_seconds', 'compile time saved')]:
+        for s in (metrics.get(name) or {}).get('samples', []):
+            if s['count']:
+                any_compile = True
+                lines.append(f"{label + ':':<23}{s['count']} event(s), "
+                             f"total {s['sum']:.3f}s, "
+                             f"max {s['max'] or 0:.3f}s")
+    build_durs = [e['dur'] / 1e6 for e in events
+                  if e['name'] == 'train_step/build']
+    if build_durs:
+        any_compile = True
+        lines.append(f"{'TrainStep build:':<23}{len(build_durs)} event(s), "
+                     f"total {sum(build_durs):.3f}s")
+    if not any_compile:
+        lines.append('(no compiles recorded — fully warm run)')
+    lines.append('')
+
+    # ---- anomalies ----
+    nonfinite = _counter(metrics, 'nonfinite_detections')
+    if nonfinite:
+        lines += ['## Anomalies',
+                  f"NON-FINITE DETECTIONS: {int(nonfinite)} fetched "
+                  f"variable(s) contained NaN/Inf (FLAGS_check_nan_inf)", '']
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('directory', nargs='?',
+                    default=os.environ.get('PADDLE_TPU_METRICS_DIR'),
+                    help='telemetry artifact dir '
+                         '(default: $PADDLE_TPU_METRICS_DIR)')
+    ap.add_argument('--metrics', help='explicit metrics.json path')
+    ap.add_argument('--trace', help='explicit trace.json path')
+    ap.add_argument('--steps', help='explicit steps.jsonl path')
+    ap.add_argument('--top', type=int, default=10,
+                    help='rows in the slowest-ops table')
+    args = ap.parse_args(argv)
+
+    d = args.directory
+    mpath = args.metrics or (d and os.path.join(d, 'metrics.json'))
+    tpath = args.trace or (d and os.path.join(d, 'trace.json'))
+    spath = args.steps or (d and os.path.join(d, 'steps.jsonl'))
+    mdoc = _load(mpath)
+    if mdoc is None:
+        print(f"telemetry_report: no metrics.json found "
+              f"(looked at {mpath!r}); run with PADDLE_TPU_TELEMETRY=1 and "
+              f"PADDLE_TPU_METRICS_DIR set", file=sys.stderr)
+        return 2
+    metrics = mdoc.get('metrics', mdoc)
+    trace = _load(tpath)
+    steps = _load_jsonl(spath)
+    print('\n'.join(summarize(metrics, trace, steps, top=args.top)))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
