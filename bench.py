@@ -342,6 +342,18 @@ def bench_dispatch_overhead(on_tpu):
     return measure_all(iters=8 if on_tpu else 4)
 
 
+def bench_ir_passes(on_tpu):
+    """Pass-pipeline front-end bench (PERF.md §10): jaxpr eqn count and
+    trace+lower seconds pass-off vs pass-on (fuse knobs live) for the
+    multi-param Adam MLP / ResNet block / BERT layer, plus the
+    executor_compile_seconds cold/warm A/B. Valid on CPU: the quantity
+    under test is host-side trace+lower, not FLOPs."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools'))
+    from bench_passes import measure_all
+    return measure_all(iters=3 if on_tpu else 2, smoke=not on_tpu)
+
+
 def bench_telemetry_sidecar(on_tpu):
     """Telemetry sidecar for the bench run: the headline benches above run
     with telemetry off (their numbers stay comparable across PRs), then the
@@ -441,6 +453,17 @@ def main():
         summary.update(
             eager_cache_speedup_resnet_block=rb["cache_speedup"],
             eager_vs_fused_resnet_block=rb["eager_cached_vs_fused"])
+
+    p = run("ir_pass_pipeline", lambda: bench_ir_passes(on_tpu))
+    if p is not None:
+        emit({"metric": "ir_pass_pipeline",
+              "mlp_adam": p['mlp_adam'], "resnet_block": p['resnet_block'],
+              "bert_layer": p['bert_layer'],
+              "executor_compile": p['executor_compile']})
+        summary.update(
+            ir_pass_eqn_reduction_mlp_adam=p['mlp_adam']['eqn_reduction'],
+            ir_pass_trace_lower_speedup_mlp_adam=(
+                p['mlp_adam']['trace_lower_speedup']))
 
     s = run("telemetry_sidecar", lambda: bench_telemetry_sidecar(on_tpu))
     if s is not None:

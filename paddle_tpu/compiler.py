@@ -3,9 +3,18 @@
 The reference's with_data_parallel clones the graph per GPU and inserts NCCL
 allreduce. TPU redesign: the program is unchanged; data parallelism = shard
 the feed batch over the mesh 'dp' axis, replicate params, and let XLA insert
-AllReduce over ICI inside the already-jitted step. build_strategy /
-exec_strategy knobs that XLA subsumes (op fusion, memory optimize) are
-accepted and ignored — that's the point of the redesign.
+AllReduce over ICI inside the already-jitted step.
+
+BuildStrategy knobs fall in three groups on TPU:
+- `fuse_elewise_add_act_ops` / `fuse_all_optimizer_ops` drive the
+  program-level IR pass pipeline (paddle_tpu/ir/): the Program's op list
+  is rewritten BEFORE the Executor traces it, cutting trace/lower time
+  and jaxpr size (XLA would fuse the kernels anyway; the pass removes the
+  front-end cost of op-granular tracing);
+- `enable_inplace` / `memory_optimize` map onto XLA buffer donation of
+  the training state (executor.py);
+- the rest (reduce_strategy, fuse_all_reduce_ops, …) are subsumed by
+  XLA/GSPMD and accepted for API compat only.
 """
 from __future__ import annotations
 
@@ -14,10 +23,22 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 
 class BuildStrategy:
-    """ref: framework/details/build_strategy.h knobs — accepted for compat.
-    fuse_all_reduce_ops / fuse_elewise_add_act_ops etc. are XLA's job now.
+    """ref: framework/details/build_strategy.h knobs.
 
-    Two knobs ARE live on TPU: `enable_inplace` and `memory_optimize` map
+    Live on TPU:
+    - `fuse_elewise_add_act_ops`: IR pass collapsing elementwise_add +
+      relu/sigmoid/tanh pairs into one fused op before tracing
+      (ir/fuse_act.py);
+    - `fuse_all_optimizer_ops`: IR pass coalescing the per-param
+      sgd/momentum/adam update ops into one multi-tensor op over a
+      flattened param bundle (ir/fuse_optimizer.py) — traced op count and
+      jaxpr size drop by O(#params);
+    - `enable_inplace` / `memory_optimize`, which map onto XLA buffer
+      donation as described below.
+    `fuse_all_reduce_ops` / reduce_strategy etc. are XLA's job and remain
+    accepted-for-compat no-ops.
+
+    `enable_inplace` and `memory_optimize` map
     onto XLA buffer donation of the training state. The default (None) lets
     the Executor donate parameter/optimizer-state buffers into the jitted
     step (in-place HBM update, no transient 2× parameter footprint);

@@ -110,9 +110,33 @@ class Context:
         a, b = self.eval_results[metric_name][-2:]
         return abs(b - a) / (abs(a) + 1e-12) < delta
 
+    def _sampled_batches(self, sampled_rate, cached_id):
+        """Reader subsampling for run_eval_graph (ref compressor.py
+        _eval_graph → cached_reader): keep each batch with probability
+        `sampled_rate`, deterministic per `cached_id` — repeated scans with
+        the same id (SensitivePruneStrategy's per-ratio sweeps) evaluate
+        the SAME subset, so sensitivity deltas compare like for like."""
+        if not (0.0 < sampled_rate <= 1.0):
+            raise ValueError(
+                f"sampled_rate must be in (0, 1], got {sampled_rate}")
+        rng = np.random.RandomState(int(cached_id))
+        kept_any = False
+        first = None
+        have_first = False
+        for data in self.eval_reader():
+            if not have_first:
+                first, have_first = data, True
+            if rng.random_sample() < sampled_rate:
+                kept_any = True
+                yield data
+        if not kept_any and have_first:
+            yield first          # never evaluate on 0 batches
+
     def run_eval_graph(self, sampled_rate=None, cached_id=0):
         """Evaluate eval_graph over eval_reader; records and returns the
-        mean of each eval out_node."""
+        mean of each eval out_node. `sampled_rate` evaluates a
+        deterministic (per `cached_id`) subsample of the reader instead of
+        the full dataset."""
         assert self.eval_graph is not None and self.eval_reader is not None
         executor = self.get_executor()
         # cache the for_test clone: cloning per call would defeat the
@@ -123,8 +147,10 @@ class Context:
             cached = (key, self.eval_graph.clone(for_test=True))
             self.k_v['_eval_clone'] = cached
         eval_graph = cached[1]
+        batches_iter = (self.eval_reader() if sampled_rate is None
+                        else self._sampled_batches(sampled_rate, cached_id))
         accum, names, batches = None, None, 0
-        for data in self.eval_reader():
+        for data in batches_iter:
             feed = data if isinstance(data, dict) else None
             results, names = executor.run(eval_graph, scope=self.scope,
                                           data=None if feed else data,
@@ -359,10 +385,20 @@ class ConfigFactory:
         wanted = self.compressor.get('strategies')
         self.strategies = []
         defs = spec.get('strategies', {}) or {}
-        for name, sdef in defs.items():
-            if wanted is not None and name not in wanted:
-                continue
-            sdef = dict(sdef)
+        if wanted is None:
+            ordered = list(defs)
+        else:
+            # callbacks fire in the compressor's LISTED order, not the
+            # YAML-definition order (reference config.py resolves the
+            # compressor's strategy list by name, preserving it)
+            unknown = [n for n in wanted if n not in defs]
+            if unknown:
+                raise ValueError(
+                    f"compressor.strategies names undefined strategies "
+                    f"{unknown}; defined: {sorted(defs)}")
+            ordered = list(wanted)
+        for name in ordered:
+            sdef = dict(defs[name])
             cls_name = sdef.pop('class')
             self.strategies.append(_strategy_class(cls_name)(**sdef))
 

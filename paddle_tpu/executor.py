@@ -123,7 +123,9 @@ def _amp_cast_args(op_type, args, amp):
 def _run_block(block, read, write, key):
     """Run a sub-Block's ops over a local env chained onto the outer `read`."""
     for i, op in enumerate(block.ops):
-        _OpRunner.run(op, read, write, jax.random.fold_in(key, i))
+        _OpRunner.run(op, read, write,
+                      jax.random.fold_in(key, i) if _op_needs_key(op)
+                      else None)
 
 
 def _chained_env(overrides, outer_read):
@@ -311,6 +313,21 @@ _CONTROL_FLOW_OPS = {
     '__while_legacy__': _run_while_legacy,
     '__scan__': _run_scan,
 }
+
+
+def _op_needs_key(op):
+    """Whether tracing this op must fold a PRNG key. Eagerly folding for
+    EVERY op left 3 dead equations (random_wrap/fold_in/unwrap) per non-RNG
+    op in the jaxpr — pure trace+compile bloat. Skipping the fold cannot
+    change numerics: fold_in(k, salt) depends only on (k, salt), never on
+    which other ops folded."""
+    t = op.type
+    if t in ('__constant__', '__create_array__'):
+        return False
+    if t in _CONTROL_FLOW_OPS or t == '__init__':
+        return True          # sub-blocks may contain RNG consumers
+    from .ops.registry import has_op
+    return has_op(t) and get_op(t).needs_rng
 
 
 def _op_read_names(op):
@@ -528,8 +545,16 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
         def run_seq(op_list, offset, read, write, key=None):
             k = base_key if key is None else key
             for i, op in enumerate(op_list):
-                _OpRunner.run(op, read, write,
-                              jax.random.fold_in(k, offset + i))
+                # pass-pipeline-stamped ops carry their pre-rewrite position
+                # (ir/pass_base.py): the RNG stream is position-independent,
+                # so pass-on and pass-off programs stay bit-identical
+                if _op_needs_key(op):
+                    salt = op.attrs.get('_rng_salt')
+                    kk = jax.random.fold_in(
+                        k, offset + i if salt is None else salt)
+                else:
+                    kk = None
+                _OpRunner.run(op, read, write, kk)
 
         if bwd_idx is None:
             run_seq(ops, 0, make_read(env, state), env.__setitem__)
@@ -597,8 +622,13 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                     ks = jax.random.fold_in(
                         base_key, jax.lax.axis_index('pp') + 1)
                     for i, op in enumerate(fwd_ops[lo0:hi0]):
-                        _OpRunner.run(op, read2, e2.__setitem__,
-                                      jax.random.fold_in(ks, lo0 + i))
+                        if _op_needs_key(op):
+                            salt = op.attrs.get('_rng_salt')
+                            kk = jax.random.fold_in(
+                                ks, lo0 + i if salt is None else salt)
+                        else:
+                            kk = None
+                        _OpRunner.run(op, read2, e2.__setitem__, kk)
                     return e2[pplan['out_name']]
 
                 ym = gpipe(stage_fn, stacked, xm, mesh=pplan['mesh'])
@@ -748,10 +778,11 @@ class Executor:
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy):
         from .compiler import CompiledProgram
         sharding = None
+        build_strategy = None
         donate = os.environ.get('PADDLE_TPU_DONATE', '1') != '0'
         if isinstance(program, CompiledProgram):
             sharding = program._data_sharding
-            bs = program._build_strategy
+            bs = build_strategy = program._build_strategy
             # fluid memory knobs map onto donation: enable_inplace=False or
             # memory_optimize=False opts the whole program out of buffer reuse
             if bs is not None and (bs.enable_inplace is False
@@ -825,17 +856,27 @@ class Executor:
         _default_len_feeds(block, feed_vals)
         prep_span.__exit__(None, None, None)
 
+        from . import ir
         feed_sig = tuple(sorted((n, v.shape, str(v.dtype))
                                 for n, v in feed_vals.items()))
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               tuple(state_names), donate)
+               tuple(state_names), donate,
+               ir.pipeline_signature(build_strategy))
         fn = self._cache.get(key)
         compiled_now = fn is None
         record_program_cache(hit=not compiled_now)
         lower_span = _obs.span('executor/lower', program=program._id)
         if fn is None:
             with lower_span:
-                step = _lower(program, list(feed_vals), fetch_names,
+                # program-level IR passes rewrite a CLONE before the trace
+                # (op fusion / DCE / constant folding — paddle_tpu/ir/);
+                # their runtime lands inside executor/lower and therefore in
+                # executor_compile_seconds, same as the trace they shrink
+                opt_program, _ = ir.apply_pipeline(
+                    program, fetch_names=fetch_names,
+                    feed_names=list(feed_vals),
+                    build_strategy=build_strategy)
+                step = _lower(opt_program, list(feed_vals), fetch_names,
                               state_names)
                 fn = jax.jit(step, donate_argnums=(0,))
             self._cache[key] = fn
@@ -1063,7 +1104,8 @@ class Executor:
 
         for i, op in enumerate(program.global_block().ops):
             _OpRunner.run(op, read, env.__setitem__,
-                          jax.random.fold_in(base_key, i))
+                          jax.random.fold_in(base_key, i)
+                          if _op_needs_key(op) else None)
         for v in program.list_vars():
             if v.persistable and v.name in env:
                 scope.set(v.name, env[v.name])

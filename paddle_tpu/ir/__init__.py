@@ -1,0 +1,83 @@
+"""Program-level IR pass pipeline (see pass_base.py for the design notes).
+
+Entry points:
+
+- :func:`apply_pipeline` — what ``Executor._run_impl`` calls on a
+  program+shape compile-cache miss: builds the pipeline for the run's
+  BuildStrategy, applies it to a clone, returns the optimized Program.
+- :func:`pipeline_signature` — hashable description of which passes WOULD
+  run; part of the executor's compile-cache key so flipping a fuse knob
+  or ``PADDLE_TPU_PASSES`` re-lowers instead of reusing a stale step.
+
+Environment: ``PADDLE_TPU_PASSES`` — unset/``1`` = default pipeline
+(constant_fold + dce always; fuse passes per BuildStrategy flags),
+``0``/empty = pipeline off entirely, or a comma-separated pass list
+(e.g. ``dce,fuse_all_optimizer_ops``) = exactly those passes, flags
+ignored.
+"""
+from __future__ import annotations
+
+import os
+
+from .pass_base import (Pass, PassContext, PassManager, all_passes,  # noqa: F401
+                        get_pass, register_pass, stamp_rng_salts)
+from . import constant_fold, dce, fuse_act, fuse_optimizer  # noqa: F401  (registration)
+
+__all__ = ['Pass', 'PassContext', 'PassManager', 'register_pass',
+           'get_pass', 'all_passes', 'apply_pipeline', 'build_pipeline',
+           'pipeline_signature', 'passes_env']
+
+# always-safe passes, on by default; the fuse passes additionally gate on
+# their BuildStrategy flag inside apply_impl
+_DEFAULT_PASSES = ('constant_fold', 'fuse_elewise_add_act',
+                   'fuse_all_optimizer_ops', 'dce')
+
+
+def passes_env():
+    return os.environ.get('PADDLE_TPU_PASSES', '1')
+
+
+def _selected_names():
+    env = passes_env().strip()
+    if env in ('0', ''):
+        return ()
+    if env == '1':
+        return _DEFAULT_PASSES
+    return tuple(n.strip() for n in env.split(',') if n.strip())
+
+
+def build_pipeline():
+    """PassManager for the current environment selection (may be empty)."""
+    return PassManager([get_pass(n) for n in _selected_names()])
+
+
+def pipeline_signature(build_strategy=None):
+    """Hashable 'which rewrites apply' tuple for the compile-cache key."""
+    names = _selected_names()
+    if not names:
+        return ()
+    env = passes_env().strip()
+    if env == '1':
+        # flag-gated passes only count when their flag is live
+        bs = build_strategy
+        names = tuple(
+            n for n in names
+            if n not in ('fuse_elewise_add_act', 'fuse_all_optimizer_ops')
+            or (bs is not None and getattr(
+                bs, 'fuse_elewise_add_act_ops'
+                if n == 'fuse_elewise_add_act'
+                else 'fuse_all_optimizer_ops', False)))
+    return names
+
+
+def apply_pipeline(program, fetch_names=(), feed_names=(),
+                   build_strategy=None):
+    """Optimized CLONE of `program` (or `program` itself when the pipeline
+    is disabled), plus the PassContext carrying per-pass stats."""
+    mgr = build_pipeline()
+    ctx = PassContext(fetch_names=fetch_names, feed_names=feed_names,
+                      build_strategy=build_strategy)
+    if not mgr.passes:
+        return program, ctx
+    opt, ctx = mgr.apply(program, ctx)
+    return opt, ctx

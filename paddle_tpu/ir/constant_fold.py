@@ -1,0 +1,85 @@
+"""Constant folding of ``fill_constant`` → ``scale`` / ``cast`` chains.
+
+``fill_constant`` already materializes a trace-time numpy constant (see
+ops/tensor_ops.py — concreteness is load-bearing for TensorArray indices
+and loop counters). A ``scale`` or ``cast`` of a uniform constant is
+itself a uniform constant, so the consumer is rewritten INTO an equivalent
+``fill_constant`` — same op type, same concreteness guarantee, no new
+runtime representation — and the original producer is left for DCE to
+sweep once its last reader is folded away.
+
+Folding uses forward current-value dataflow over the straight-line global
+block: a later non-constant write to the same name invalidates the known
+constant, so multi-writer vars (grad-merge accumulators being zeroed,
+reassigned counters) fold only where the constant value is actually the
+live one. The arithmetic runs in numpy at the var's own dtype — exactly
+what the scale/cast kernels would have computed elementwise — so folded
+and unfolded programs are bit-identical.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Operator
+from .pass_base import RNG_SALT_ATTR, Pass, register_pass
+
+
+def _np_dtype(dtype_str):
+    if dtype_str in ('bfloat16',):
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(dtype_str)
+
+
+@register_pass
+class ConstantFoldingPass(Pass):
+    name = 'constant_fold'
+    order = 100          # first: fusion passes then see folded constants
+
+    def apply_impl(self, program, ctx):
+        blk = program.global_block()
+        consts = {}      # var name → (value_scalar, dtype_str, shape) LIVE now
+        folded = 0
+        for i, op in enumerate(blk.ops):
+            new = self._fold_op(op, consts)
+            if new is not None:
+                blk.ops[i] = new
+                op = new
+                folded += 1
+            if op.type == 'fill_constant':
+                a = op.attrs
+                consts[op.outputs['Out'][0]] = (
+                    a['value'], a.get('dtype', 'float32'), tuple(a['shape']))
+            else:
+                for out in op.output_names():
+                    consts.pop(out, None)
+        ctx.record(self.name, folded_ops=folded)
+        return bool(folded)
+
+    @staticmethod
+    def _fold_op(op, consts):
+        """scale/cast over a live constant → equivalent fill_constant op."""
+        if op.type not in ('scale', 'cast'):
+            return None
+        src = op.inputs.get('x', [None])[0]
+        if src not in consts:
+            return None
+        value, dtype_str, shape = consts[src]
+        dt = _np_dtype(dtype_str)
+        if op.type == 'scale':
+            # mirror the kernel bit-for-bit: s/b cast to x.dtype first
+            x = np.asarray(value, dt)
+            s = np.asarray(op.attrs.get('scale', 1.0), dt)
+            b = np.asarray(op.attrs.get('bias', 0.0), dt)
+            out_val = (x * s + b if op.attrs.get('bias_after_scale', True)
+                       else (x + b) * s)
+            out_dtype = dtype_str
+        else:                          # cast
+            out_dtype = op.attrs['dtype']
+            out_val = np.asarray(value, dt).astype(_np_dtype(out_dtype))
+        attrs = {'shape': list(shape), 'value': out_val[()],
+                 'dtype': out_dtype}
+        if RNG_SALT_ATTR in op.attrs:
+            attrs[RNG_SALT_ATTR] = op.attrs[RNG_SALT_ATTR]
+        return Operator(op.block, 'fill_constant', inputs={},
+                        outputs={'Out': list(op.outputs['Out'])}, attrs=attrs)

@@ -1,0 +1,78 @@
+"""Dead-op / dead-var elimination.
+
+The op-list analogue of the reference's graph-level dependency pruning
+(``Program._prune`` covers the save-inference path; this pass covers every
+execution): an op whose outputs are never read by a later op, never
+fetched, and never persisted contributes nothing to the step function —
+but the Python tracer still walks it and jax still carries its equations
+until XLA's own DCE. Dropping it here removes the cost at every layer.
+
+Liveness roots:
+- fetch_names (the caller observes them),
+- persistable vars (training state is written back to the Scope),
+- the ``__backward__`` marker (it defines the autodiff split; its Loss
+  input keeps the forward alive).
+
+A standard reverse walk: keep an op iff any output is live, then mark its
+reads — including sub-block reads via ``executor._op_read_names``, so
+control-flow branches chained onto the outer env are honored — as live.
+Later writers of a var whose value is only read earlier are correctly
+dropped (liveness is checked at the op's own position).
+"""
+from __future__ import annotations
+
+from ..framework import BACKWARD_OP_TYPE
+from .pass_base import Pass, register_pass
+
+
+def _op_read_names(op):
+    from ..executor import _op_read_names as impl
+    return impl(op)
+
+
+@register_pass
+class DeadCodeEliminationPass(Pass):
+    name = 'dce'
+    order = 900          # last: sweeps debris the other passes orphaned
+
+    def apply_impl(self, program, ctx):
+        blk = program.global_block()
+        persist = {v.name for v in program.list_vars() if v.persistable}
+        live = set(ctx.fetch_names)
+        kept_rev = []
+        removed = 0
+        for op in reversed(blk.ops):
+            outs = op.output_names()
+            if (op.type == BACKWARD_OP_TYPE
+                    or any(o in live or o in persist for o in outs)):
+                kept_rev.append(op)
+                live |= _op_read_names(op)
+            else:
+                removed += 1
+        if removed:
+            blk.ops = kept_rev[::-1]
+        dropped_vars = self._drop_dead_vars(blk, persist, ctx)
+        ctx.record(self.name, removed_ops=removed, removed_vars=dropped_vars)
+        return bool(removed or dropped_vars)
+
+    @staticmethod
+    def _drop_dead_vars(blk, persist, ctx):
+        """Remove global-block vars nothing references. Persistables (scope
+        state), data vars (feed declarations, incl. '@LEN' companions), and
+        fetch targets always stay."""
+        used = set(ctx.fetch_names)
+        for op in blk.ops:
+            used |= _op_read_names(op)
+            used |= set(op.output_names())
+            # marker attrs name vars the lowering looks up by name
+            for attr in ('loss', 'params', 'checkpoints'):
+                v = op.attrs.get(attr)
+                if isinstance(v, str):
+                    used.add(v)
+                elif isinstance(v, (list, tuple)):
+                    used.update(x for x in v if isinstance(x, str))
+        dead = [n for n, v in blk.vars.items()
+                if n not in used and n not in persist and not v.is_data]
+        for n in dead:
+            del blk.vars[n]
+        return len(dead)

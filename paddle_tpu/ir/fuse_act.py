@@ -1,0 +1,100 @@
+"""``fuse_elewise_add_act``: elementwise_add + activation → one fused op.
+
+Parity target: the reference's fuse_elewise_add_act_pass.cc, gated by the
+same ``BuildStrategy.fuse_elewise_add_act_ops`` knob. The win on TPU is
+front-end, not kernel: XLA fuses add+act on its own, but the Python
+tracer pays two ``_OpRunner`` dispatches, two env writes, and two jaxpr
+bookkeeping rounds per pair — in an fc/conv-heavy program the (bias-add,
+act) pair is ~2 of every 5 forward ops.
+
+Safety conditions for a pair (add at i, act at j > i):
+- the intermediate is consumed ONLY by the act op (sub-block reads
+  counted), is not fetched, not persistable, and has no other writer;
+- nothing between i and j rewrites the add's inputs (the fused op reads
+  them at position j).
+
+Skipped entirely under AMP: the rewrite would change which ops the
+white/black dtype lists match (``executor._amp_cast_args`` keys on
+``op.type``).
+"""
+from __future__ import annotations
+
+from .pass_base import Pass, register_pass
+from .dce import _op_read_names
+
+# activation op types the fused kernel implements (ops/fused_ops.py)
+FUSABLE_ACTS = ('relu', 'sigmoid', 'tanh')
+
+
+@register_pass
+class FuseElewiseAddActPass(Pass):
+    name = 'fuse_elewise_add_act'
+    order = 200
+
+    def enabled(self, ctx):
+        bs = ctx.build_strategy
+        return bs is not None and getattr(bs, 'fuse_elewise_add_act_ops',
+                                          False)
+
+    def apply_impl(self, program, ctx):
+        if not self.enabled(ctx) or getattr(program, '_amp_config', None):
+            return False
+        blk = program.global_block()
+        ops = blk.ops
+        fetch = set(ctx.fetch_names)
+        persist = {v.name for v in program.list_vars() if v.persistable}
+        # names the lowering resolves through marker ATTRS (not op inputs):
+        # remat checkpoints and pipeline cut vars must keep their producers
+        protected = set()
+        for op in ops:
+            protected.update(op.attrs.get('checkpoints') or [])
+            pipe = op.attrs.get('pipeline')
+            if isinstance(pipe, dict):
+                protected.update(pipe.get('cut_vars') or [])
+
+        readers = {}                     # var → [op index]
+        writers = {}
+        for idx, op in enumerate(ops):
+            for n in _op_read_names(op):
+                readers.setdefault(n, []).append(idx)
+            for n in op.output_names():
+                writers.setdefault(n, []).append(idx)
+
+        from ..framework import Operator
+        from .pass_base import RNG_SALT_ATTR
+        replaced = {}                    # act index → fused Operator
+        dead = set()                     # add indices to drop
+        for i, add in enumerate(ops):
+            if add.type != 'elementwise_add' or i in dead:
+                continue
+            mid = add.outputs['Out'][0]
+            if (mid in fetch or mid in persist or mid in protected
+                    or writers.get(mid, []) != [i]):
+                continue
+            cons = readers.get(mid, [])
+            if len(cons) != 1:
+                continue
+            j = cons[0]
+            act = ops[j]
+            if (j <= i or j in replaced or act.type not in FUSABLE_ACTS
+                    or act.inputs.get('x', [None])[0] != mid):
+                continue
+            x, y = add.inputs['x'][0], add.inputs['y'][0]
+            if any(k for n in (x, y) for k in writers.get(n, [])
+                   if i < k < j):
+                continue
+            attrs = {'functor': act.type,
+                     'axis': add.attrs.get('axis', -1)}
+            if RNG_SALT_ATTR in act.attrs:
+                attrs[RNG_SALT_ATTR] = act.attrs[RNG_SALT_ATTR]
+            replaced[j] = Operator(
+                blk, 'fused_elemwise_add_activation',
+                inputs={'x': x, 'y': y},
+                outputs={'Out': list(act.outputs['Out'])}, attrs=attrs)
+            dead.add(i)
+        if not replaced:
+            return False
+        blk.ops = [replaced.get(idx, op) for idx, op in enumerate(ops)
+                   if idx not in dead]
+        ctx.record(self.name, fused_pairs=len(replaced))
+        return True

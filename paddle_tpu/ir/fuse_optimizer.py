@@ -1,0 +1,130 @@
+"""``fuse_all_optimizer_ops``: N per-param update ops → one multi-tensor op.
+
+Parity target: the reference's fuse_optimizer_ops_pass (fuse_sgd_op_pass /
+fuse_momentum_op_pass / fuse_adam_op_pass), gated by the same
+``BuildStrategy.fuse_all_optimizer_ops`` knob. After ``minimize``, the
+global block tails off with one ``sgd``/``momentum``/``adam`` op per
+parameter; tracing them costs O(#params) Python dispatches and the jaxpr
+carries the full per-param scalar chains (Adam's bias-correction alone is
+~8 equations per parameter). The fused kernels (ops/fused_ops.py) compute
+the update once over a flattened bundle — eqn count drops from
+O(#params · per-op-eqns) to O(#params · split-cost) with a much smaller
+constant, and the tracer dispatches once.
+
+Ops fuse into one group iff they agree on (op type, hyperparameter attrs,
+lr input, param dtype) — all float32 only, so the fused bundle math
+promotes exactly like the per-param ops and numerics stay bit-identical —
+AND the group is independent of everything interleaved between its first
+and last member (no read/write overlap either way). Grad-merge programs
+keep their updates inside a cond sub-block, which this pass never touches.
+"""
+from __future__ import annotations
+
+from ..framework import Operator
+from .pass_base import Pass, register_pass
+from .dce import _op_read_names
+
+# per-param op → (fused op type, input slot → fused variadic slot,
+#                 output slot list shared by both)
+FUSE_SPECS = {
+    'sgd': ('fused_sgd',
+            (('param', 'params'), ('grad', 'grads')),
+            ('ParamOut',)),
+    'momentum': ('fused_momentum',
+                 (('param', 'params'), ('grad', 'grads'),
+                  ('velocity', 'velocities')),
+                 ('ParamOut', 'VelocityOut')),
+    'adam': ('fused_adam',
+             (('param', 'params'), ('grad', 'grads'),
+              ('moment1', 'moment1s'), ('moment2', 'moment2s'),
+              ('beta1_pow', 'beta1_pows'), ('beta2_pow', 'beta2_pows')),
+             ('ParamOut', 'Moment1Out', 'Moment2Out', 'Beta1PowOut',
+              'Beta2PowOut')),
+}
+
+
+def _attr_sig(op):
+    from ..ops.registry import NON_KERNEL_ATTRS
+    return tuple(sorted((k, repr(v)) for k, v in op.attrs.items()
+                        if k not in NON_KERNEL_ATTRS))
+
+
+def _is_f32(blk, name):
+    return (not blk.has_var(name)) or blk.var(name).dtype == 'float32'
+
+
+@register_pass
+class FuseAllOptimizerOpsPass(Pass):
+    name = 'fuse_all_optimizer_ops'
+    order = 300
+
+    def enabled(self, ctx):
+        bs = ctx.build_strategy
+        return bs is not None and getattr(bs, 'fuse_all_optimizer_ops',
+                                          False)
+
+    def apply_impl(self, program, ctx):
+        if not self.enabled(ctx):
+            return False
+        blk = program.global_block()
+        ops = blk.ops
+        groups = {}          # (type, attr sig, lr name) → [op index]
+        for i, op in enumerate(ops):
+            if op.type not in FUSE_SPECS:
+                continue
+            if not all(_is_f32(blk, n) for n in op.input_names()):
+                continue
+            lr = op.inputs.get('lr', [None])[0]
+            groups.setdefault((op.type, _attr_sig(op), lr), []).append(i)
+
+        fused_groups = 0
+        fused_ops = 0
+        dead = set()
+        replaced = {}
+        for (op_type, _, lr), idxs in sorted(groups.items(),
+                                             key=lambda kv: kv[1][0]):
+            if len(idxs) < 2 or not self._independent(ops, idxs, lr):
+                continue
+            fused_type, slot_map, out_slots = FUSE_SPECS[op_type]
+            members = [ops[i] for i in idxs]
+            inputs = {fused: [m.inputs[per][0] for m in members]
+                      for per, fused in slot_map}
+            if lr is not None:
+                inputs['lr'] = lr
+            outputs = {s: [m.outputs[s][0] for m in members]
+                       for s in out_slots}
+            attrs = {k: v for k, v in members[0].attrs.items()}
+            replaced[idxs[0]] = Operator(blk, fused_type, inputs=inputs,
+                                         outputs=outputs, attrs=attrs)
+            dead.update(idxs[1:])
+            fused_groups += 1
+            fused_ops += len(idxs)
+        if not fused_groups:
+            return False
+        blk.ops = [replaced.get(i, op) for i, op in enumerate(ops)
+                   if i not in dead]
+        ctx.record(self.name, fused_groups=fused_groups,
+                   fused_update_ops=fused_ops)
+        return True
+
+    @staticmethod
+    def _independent(ops, idxs, lr):
+        """Group members must be pairwise disjoint (each updates only its
+        own param/slots) and nothing interleaved may touch the group's
+        vars (the fused op runs at the first member's position)."""
+        member = set(idxs)
+        reads, writes = set(), set()
+        for i in idxs:
+            ins, outs = set(ops[i].input_names()), set(ops[i].output_names())
+            if (ins - {lr}) & reads or outs & writes:
+                return False
+            reads |= ins          # lr stays: an interleaved lr write would
+            writes |= outs        # give members position-dependent values
+        for k in range(idxs[0], idxs[-1] + 1):
+            if k in member:
+                continue
+            o_reads, o_writes = _op_read_names(ops[k]), set(
+                ops[k].output_names())
+            if (o_reads & writes) or (o_writes & (reads | writes)):
+                return False
+        return True

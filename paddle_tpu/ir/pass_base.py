@@ -1,0 +1,148 @@
+"""Program-level IR pass infrastructure: Pass base class, registry, manager.
+
+The TPU-native analogue of the reference's ``framework/ir`` graph passes
+(fuse_elewise_add_act_pass.cc, fuse_optimizer_ops_pass/*, …): instead of
+rewriting an SSA graph of OpDesc nodes, a Pass rewrites a ``Program``'s
+op list BEFORE ``executor._lower`` traces it into one jax function. Every
+Python-level op the passes remove is one less ``_OpRunner`` dispatch per
+trace and a handful fewer jaxpr equations per compile — trace+lower time
+(and the compile-cache key cost) scale with raw op count, so this is a
+pure front-end win; XLA sees a smaller program to fuse, never a different
+one numerically.
+
+Determinism contract:
+
+- passes run in ascending ``order`` (ties broken by name), so a pipeline
+  built from the same flags always rewrites identically;
+- passes NEVER mutate the caller's Program — :meth:`PassManager.apply`
+  clones first and rewrites the clone;
+- before any rewrite, every global-block op is stamped with a
+  ``_rng_salt`` bookkeeping attr carrying its original position, which the
+  executor's lowering uses for ``jax.random.fold_in`` — removing or fusing
+  ops therefore cannot shift another op's RNG stream, keeping pass-on /
+  pass-off numerics bit-identical even through dropout.
+
+Per-pass applied/elapsed counters export through the PR 2 metrics registry
+(``ir_pass_applied_total`` / ``ir_pass_seconds`` / ``ir_pass_ops_removed_
+total``, labeled by pass) whenever telemetry is enabled.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from .. import observability as _obs
+from ..framework import BACKWARD_OP_TYPE, Program
+
+RNG_SALT_ATTR = '_rng_salt'
+
+_PASS_REGISTRY: Dict[str, 'Pass'] = {}
+
+
+class PassContext:
+    """Immutable-ish facts a pass may consult, plus the stats it fills in."""
+
+    def __init__(self, fetch_names=(), feed_names=(), build_strategy=None):
+        self.fetch_names = tuple(fetch_names)
+        self.feed_names = tuple(feed_names)
+        self.build_strategy = build_strategy
+        # pass name → {'removed': n, 'fused': n, 'folded': n, ...}
+        self.stats: Dict[str, Dict[str, int]] = {}
+
+    def record(self, pass_name, **counts):
+        d = self.stats.setdefault(pass_name, {})
+        for k, v in counts.items():
+            d[k] = d.get(k, 0) + int(v)
+
+
+class Pass:
+    """One deterministic Program rewrite. Subclasses set ``name`` and
+    ``order`` and implement :meth:`apply_impl` returning True iff the
+    program changed."""
+
+    name: str = None
+    # ascending execution order; folding runs before fusion so fused
+    # patterns see folded constants, DCE runs last to sweep the debris
+    order: int = 100
+
+    def apply(self, program: Program, ctx: PassContext) -> bool:
+        t0 = time.perf_counter()
+        changed = self.apply_impl(program, ctx)
+        if _obs._ENABLED:
+            _obs.inc('ir_pass_applied_total', 1,
+                     help='IR pass executions by pass name',
+                     **{'pass': self.name})
+            _obs.observe('ir_pass_seconds', time.perf_counter() - t0,
+                         help='wall time per IR pass application',
+                         **{'pass': self.name})
+        return changed
+
+    def apply_impl(self, program: Program, ctx: PassContext) -> bool:
+        raise NotImplementedError
+
+
+def register_pass(cls):
+    """Class decorator: add a Pass subclass to the registry (unique name)."""
+    if not cls.name:
+        raise ValueError(f'{cls.__name__} has no pass name')
+    if cls.name in _PASS_REGISTRY:
+        raise ValueError(f'IR pass {cls.name!r} registered twice')
+    _PASS_REGISTRY[cls.name] = cls()
+    return cls
+
+
+def get_pass(name: str) -> Pass:
+    if name not in _PASS_REGISTRY:
+        raise KeyError(f'unknown IR pass {name!r}; registered: '
+                       f'{sorted(_PASS_REGISTRY)}')
+    return _PASS_REGISTRY[name]
+
+
+def all_passes():
+    return dict(_PASS_REGISTRY)
+
+
+def stamp_rng_salts(program: Program):
+    """Record each global-block op's original position as its RNG salt.
+
+    ``_lower`` folds the step key with this salt (falling back to the live
+    op index for unstamped programs), so pass rewrites preserve every
+    surviving op's random stream exactly. Idempotent: already-stamped ops
+    keep their first salt, which is what makes re-running the pipeline a
+    fixpoint."""
+    for i, op in enumerate(program.global_block().ops):
+        if RNG_SALT_ATTR not in op.attrs:
+            op.attrs[RNG_SALT_ATTR] = i
+
+
+class PassManager:
+    """Applies a deterministic sequence of passes to a CLONE of a Program."""
+
+    def __init__(self, passes: List[Pass]):
+        self.passes = sorted(passes, key=lambda p: (p.order, p.name))
+
+    def apply(self, program: Program, ctx: Optional[PassContext] = None):
+        """Returns (optimized_program, ctx). The input Program is untouched;
+        when no pass changes anything the clone is still returned (callers
+        treat the result as theirs to lower)."""
+        ctx = ctx or PassContext()
+        opt = program.clone()
+        # clone() drops non-IR carry attrs the lowering reads
+        for attr in ('_fsdp_axis',):
+            if hasattr(program, attr):
+                setattr(opt, attr, getattr(program, attr))
+        stamp_rng_salts(opt)
+        ops_before = len(opt.global_block().ops)
+        for p in self.passes:
+            p.apply(opt, ctx)
+        if _obs._ENABLED:
+            _obs.inc('ir_pass_pipeline_runs', 1,
+                     help='pass-pipeline applications (one per program+shape '
+                          'compile-cache miss)')
+            _obs.inc('ir_pass_ops_removed_total',
+                     ops_before - len(opt.global_block().ops),
+                     help='net global-block ops removed by the pass pipeline')
+        return opt, ctx
+
+    def names(self):
+        return tuple(p.name for p in self.passes)

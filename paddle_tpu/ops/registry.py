@@ -22,8 +22,11 @@ _REGISTRY: Dict[str, 'OpDef'] = {}
 
 # program-level bookkeeping attrs that must NEVER reach an op kernel's
 # kwargs (filtered by the executor run path, shape inference, the pipeline
-# isomorphism signature, and the debugger printer alike)
-NON_KERNEL_ATTRS = frozenset({'initializer', 'op_device'})
+# isomorphism signature, and the debugger printer alike). '_rng_salt' is
+# the IR pass pipeline's stamp of an op's pre-rewrite position — the
+# lowering folds the step key with it so removing/fusing ops never shifts
+# a surviving op's random stream (ir/pass_base.py).
+NON_KERNEL_ATTRS = frozenset({'initializer', 'op_device', '_rng_salt'})
 
 
 class OpDef:

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core import compat
+
 
 class GeoSGDStep:
     """Jitted geo-SGD training step over `mesh` axis `axis`.
@@ -80,7 +82,7 @@ class GeoSGDStep:
                     {m: v[None] for m, v in base.items()},
                     lax.pmean(loss, axis))
 
-        fn = jax.shard_map(body, mesh=mesh,
+        fn = compat.shard_map(body, mesh=mesh,
                            in_specs=(rep_spec, rep_spec, P(axis), P()),
                            out_specs=(rep_spec, rep_spec, P()))
         self._step = jax.jit(fn, donate_argnums=(0, 1))

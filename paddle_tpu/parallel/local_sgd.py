@@ -19,6 +19,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core import compat
+
 
 class LocalSGDStep:
     """Builds a jitted LocalSGD training step over `mesh` axis `axis`.
@@ -57,7 +59,7 @@ class LocalSGDStep:
             def sync(p):
                 # pmean output is replication-invariant; pcast back to
                 # varying so both cond branches type-match under shard_map
-                return {m: lax.pcast(lax.pmean(v, axis), axis, to='varying')
+                return {m: compat.pcast(lax.pmean(v, axis), axis, to='varying')
                         for m, v in p.items()}
 
             new = lax.cond((t % k) == (k - 1), sync, lambda p: p, new)
@@ -66,7 +68,7 @@ class LocalSGDStep:
 
         pspec = {name: P(axis, *([None] * jnp.ndim(v)))
                  for name, v in params.items()}
-        fn = jax.shard_map(body, mesh=mesh,
+        fn = compat.shard_map(body, mesh=mesh,
                            in_specs=(pspec, P(axis), P()),
                            out_specs=(pspec, P()))
         self._step = jax.jit(fn, donate_argnums=(0,))

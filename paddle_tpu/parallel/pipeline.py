@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..core import compat
 from .mesh import get_default_mesh
 
 __all__ = ['gpipe', 'stack_stage_params']
@@ -53,7 +54,7 @@ def gpipe(stage_fn, stacked_params, x_micro, mesh=None, axis='pp'):
         fwd_perm = [(i, i + 1) for i in range(p - 1)]
         # activations are device-varying (each stage computes differently):
         # mark the zero init for shard_map's vma typing
-        zero = lax.pcast(jnp.zeros_like(xm[0]), axis, to='varying')
+        zero = compat.pcast(jnp.zeros_like(xm[0]), axis, to='varying')
 
         def step(carry, t):
             prev_y = carry
@@ -74,6 +75,6 @@ def gpipe(stage_fn, stacked_params, x_micro, mesh=None, axis='pp'):
 
     param_specs = jax.tree_util.tree_map(
         lambda _: P(axis), stacked_params)
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = compat.shard_map(body, mesh=mesh,
                        in_specs=(param_specs, P()), out_specs=P())
     return fn(stacked_params, x_micro)

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..core import compat
 from .mesh import get_default_mesh
 
 _BIG_NEG = -1e30
@@ -65,11 +66,11 @@ def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
 
     # carries become device-varying (masks depend on axis_index): mark the
     # constant inits as varying over the ring axis for shard_map's vma typing
-    o0 = lax.pcast(jnp.zeros((B, H, S, D), jnp.float32), axis_name,
+    o0 = compat.pcast(jnp.zeros((B, H, S, D), jnp.float32), axis_name,
                    to='varying')
-    m0 = lax.pcast(jnp.full((B, H, S), _BIG_NEG, jnp.float32), axis_name,
+    m0 = compat.pcast(jnp.full((B, H, S), _BIG_NEG, jnp.float32), axis_name,
                    to='varying')
-    l0 = lax.pcast(jnp.zeros((B, H, S), jnp.float32), axis_name,
+    l0 = compat.pcast(jnp.zeros((B, H, S), jnp.float32), axis_name,
                    to='varying')
     (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(p))
     out = o / jnp.maximum(l, 1e-20)[..., None]
@@ -87,7 +88,7 @@ def ring_attention(q, k, v, mesh=None, axis='sp', causal=False, scale=None):
     body = functools.partial(_ring_attention_local, axis_name=axis,
                              causal=causal, scale=scale)
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = compat.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)
     return fn(q, k, v)
 

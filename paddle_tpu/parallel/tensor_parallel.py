@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core import compat
 from .mesh import get_default_mesh
 
 __all__ = ['megatron_param_spec', 'shard_params', 'column_parallel_matmul',
@@ -49,7 +50,7 @@ def shard_params(params, mesh=None, axis='tp', spec_fn=None):
 
 
 def _smap(body, mesh, in_specs, out_specs):
-    return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+    return compat.shard_map(body, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs)
 
 

@@ -6,7 +6,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from paddle_tpu.core.compat import shard_map
 
 from paddle_tpu.parallel import collective as C
 

@@ -196,6 +196,7 @@ def test_hybrid_mesh_axes_and_collective():
         total = jax.lax.psum(jax.lax.psum(jnp.sum(xs), 'tp'), 'dp')
         return jnp.full_like(xs, total)
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P('dp', 'tp'),
-                        out_specs=P('dp', 'tp'))(x)
+    from paddle_tpu.core import compat
+    out = compat.shard_map(f, mesh=mesh, in_specs=P('dp', 'tp'),
+                           out_specs=P('dp', 'tp'))(x)
     np.testing.assert_allclose(np.asarray(out)[0, 0], float(x.sum()))

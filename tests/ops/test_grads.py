@@ -100,6 +100,12 @@ GRAD_SPECS = {
     'pow': S(lambda r: [pos(r, (3, 4))], attrs={'factor': 1.7}),
     'reciprocal': S(lambda r: [pos(r, (3, 4), 0.5, 2.0)]),
     'relu': S(lambda r: [away(r, (3, 4))]),
+    # fused (add, act) pair from the IR pass pipeline: x + y kept away
+    # from relu's kink by construction
+    'fused_elemwise_add_activation': S(
+        lambda r: [away(r, (3, 4), 1.0, 2.0),
+                   f32(r.uniform(-0.3, 0.3, (3, 4)))],
+        diff=(0, 1), attrs={'functor': 'relu'}),
     'relu6': S(lambda r: [pos(r, (3, 4), 0.5, 5.0)]),
     'rsqrt': S(lambda r: [pos(r, (3, 4))]),
     'scale': S(_std((3, 4)), attrs={'scale': 2.5, 'bias': 0.3}),
@@ -554,6 +560,12 @@ NONDIFF = {
     'ftrl': 'optimizer update (golden-tested)',
     'lamb': 'optimizer update (golden-tested)',
     'dgc_momentum': 'optimizer update (golden-tested)',
+    'fused_sgd': 'multi-tensor optimizer update (bitwise parity vs per-'
+                 'param sgd in test_ir_passes.py)',
+    'fused_momentum': 'multi-tensor optimizer update (bitwise parity vs '
+                      'per-param momentum in test_ir_passes.py)',
+    'fused_adam': 'multi-tensor optimizer update (bitwise parity vs per-'
+                  'param adam in test_ir_passes.py)',
     'check_finite_and_unscale': 'AMP bookkeeping (tested in test_amp.py)',
     'update_loss_scaling': 'AMP bookkeeping (tested in test_amp.py)',
     # control-flow / array plumbing
