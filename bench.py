@@ -354,6 +354,17 @@ def bench_ir_passes(on_tpu):
     return measure_all(iters=3 if on_tpu else 2, smoke=not on_tpu)
 
 
+def bench_serving_batcher(on_tpu):
+    """Serving-path load bench (PERF.md §11): closed-loop clients through
+    the dynamic micro-batcher (paddle_tpu/serving/) vs serial single-request
+    Predictor.run — throughput, p50/p99, padding waste, bitwise parity.
+    Valid on CPU: the quantity under test is dispatch amortization."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools'))
+    from bench_serving import measure_all
+    return measure_all(smoke=not on_tpu)
+
+
 def bench_telemetry_sidecar(on_tpu):
     """Telemetry sidecar for the bench run: the headline benches above run
     with telemetry off (their numbers stay comparable across PRs), then the
@@ -464,6 +475,15 @@ def main():
             ir_pass_eqn_reduction_mlp_adam=p['mlp_adam']['eqn_reduction'],
             ir_pass_trace_lower_speedup_mlp_adam=(
                 p['mlp_adam']['trace_lower_speedup']))
+
+    sv = run("serving_batcher", lambda: bench_serving_batcher(on_tpu))
+    if sv is not None:
+        emit({"metric": "serving_batcher",
+              "serial": sv['serial'], "batcher": sv['batcher'],
+              "overload": sv['overload']})
+        summary.update(
+            serving_batcher_speedup=sv['batcher']['speedup_vs_serial'],
+            serving_batcher_p99_ms=sv['batcher']['p99_ms'])
 
     s = run("telemetry_sidecar", lambda: bench_telemetry_sidecar(on_tpu))
     if s is not None:

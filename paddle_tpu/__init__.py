@@ -51,6 +51,7 @@ from . import parallel
 from . import distributed
 from . import contrib
 from . import observability
+from . import serving
 from . import profiler
 from . import debugger
 from . import log_helper

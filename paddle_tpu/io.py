@@ -92,9 +92,17 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
     program = main_program or default_main_program()
     scope = global_scope()
     if vars is not None:
-        data = {v.name if isinstance(v, Variable) else v:
-                np.asarray(scope.find(v.name if isinstance(v, Variable) else v))
-                for v in vars}
+        data = {}
+        for v in vars:
+            name = v.name if isinstance(v, Variable) else v
+            val = scope.find(name)
+            if val is None:
+                # np.asarray(None) would silently save an object array
+                raise ValueError(
+                    f"save_vars: variable '{name}' has no value in the "
+                    f'scope (run the startup program, or drop it from '
+                    f'vars=)')
+            data[name] = np.asarray(val)
     else:
         data = _collect(program, predicate, scope)
     os.makedirs(dirname, exist_ok=True)
@@ -119,6 +127,14 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
     path = os.path.join(dirname, filename or 'params.npz')
     data = np.load(path)
     names = set(data.files)
+    if vars is not None:
+        # ref io.py load_vars raises when a requested var has no saved
+        # entry; silently skipping would leave it stale/uninitialized
+        requested = [x.name if isinstance(x, Variable) else x for x in vars]
+        missing = sorted(set(requested) - names)
+        if missing:
+            raise ValueError(
+                f'load_vars: requested vars not found in {path}: {missing}')
     for v in program.list_vars():
         want = (vars is not None and any(
             (x.name if isinstance(x, Variable) else x) == v.name for x in vars)) \
@@ -233,12 +249,25 @@ def load_inference_model(dirname, executor, model_filename=None,
     program = _program_from_dict(meta)
     scope = global_scope()
     path = os.path.join(dirname, params_filename or 'params.npz')
+    saved = set()
     if os.path.exists(path):
         data = np.load(path)
+        saved = set(data.files)
         for v in program.list_vars():
-            if v.persistable and v.name in data.files:
+            if v.persistable and v.name in saved:
                 scope.set(v.name, jnp.asarray(data[v.name],
                                               to_jax_dtype(v.dtype)))
+    # a persistable with neither a saved entry nor a pre-set scope value
+    # would flow into the jitted step as garbage — fail here, not at serve
+    # time (scope pre-population is the supported program_only workflow)
+    missing = sorted(v.name for v in program.list_vars()
+                     if v.persistable and v.name not in saved
+                     and scope.find(v.name) is None)
+    if missing:
+        raise RuntimeError(
+            f'load_inference_model: persistable vars have no saved value in '
+            f'{path} and no value in the current scope: {missing} (saved '
+            f'with program_only=True? load/set the parameters first)')
     fetch_vars = [program.global_block().var(n) for n in meta['fetch_names']]
     return program, meta['feed_names'], fetch_vars
 

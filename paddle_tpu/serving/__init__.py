@@ -1,0 +1,41 @@
+"""Serving subsystem: dynamic micro-batching inference (docs/SERVING.md).
+
+Three layers, composable or standalone:
+
+- :class:`InferenceEngine` (engine.py) — a saved model behind a **bucketed
+  batch ladder**: the batch dim pads up to 1/2/4/…/max, so the compile count
+  is bounded and every bucket rides the persistent XLA compile cache;
+  ``warmup()`` precompiles the ladder.
+- :class:`MicroBatcher` (batcher.py) — bounded request queue + worker thread
+  coalescing requests into one device call per batch, with pre-enqueue
+  validation, per-request deadlines, ``Overloaded`` backpressure, and
+  graceful draining shutdown.
+- :class:`ServingServer` (server.py) — stdlib ThreadingHTTPServer front end:
+  ``/predict`` (JSON), ``/healthz``, ``/metrics`` (Prometheus text).
+
+Quick start::
+
+    from paddle_tpu import serving
+    engine = serving.InferenceEngine('/path/to/saved_model',
+                                     max_batch_size=16)
+    engine.warmup()
+    with serving.MicroBatcher(engine, batch_timeout_ms=2) as batcher:
+        out, = batcher.predict({'x': one_row})           # sync
+        fut = batcher.submit({'x': rows}, timeout_ms=50)  # async + deadline
+
+or the whole stack: ``python -m paddle_tpu.serving.server --model-dir …``.
+"""
+from __future__ import annotations
+
+from .errors import (DeadlineExceeded, EngineClosed, InvalidRequest,
+                     Overloaded, ServingError)
+from .engine import DEFAULT_MAX_BATCH, InferenceEngine, bucket_ladder
+from .batcher import (DEFAULT_BATCH_TIMEOUT_MS, DEFAULT_QUEUE_DEPTH,
+                      MicroBatcher, PredictionFuture)
+from .server import ServingServer, create_server
+
+__all__ = ['InferenceEngine', 'MicroBatcher', 'PredictionFuture',
+           'ServingServer', 'create_server', 'bucket_ladder',
+           'ServingError', 'InvalidRequest', 'Overloaded', 'DeadlineExceeded',
+           'EngineClosed', 'DEFAULT_MAX_BATCH', 'DEFAULT_BATCH_TIMEOUT_MS',
+           'DEFAULT_QUEUE_DEPTH']

@@ -1,0 +1,251 @@
+"""InferenceEngine: bucketed-batch inference over a saved model.
+
+The single-request :class:`~paddle_tpu.inference.Predictor` compiles one XLA
+program per feed-shape set — fine for a script, fatal for a server where
+every distinct batch size would be a fresh multi-second compile. The engine
+fixes the shape problem the way TPU serving systems do (cf. Ragged Paged
+Attention, PAPERS.md): it pads the batch dimension up to a small **bucket
+ladder** (1, 2, 4, …, max_batch_size by default), so
+
+- the number of compiled programs is bounded by ``len(buckets)`` forever,
+- every bucket's executable flows through the persistent XLA compile cache
+  (PR 1), so a restarted server deserializes instead of recompiling,
+- :meth:`warmup` precompiles the whole ladder before traffic arrives.
+
+Row results are bitwise-identical to single-request ``Predictor.run``:
+per-row ops (matmul rows, row-wise activations, inference-mode norm) do not
+mix rows, and padding replicates the last real row so pad lanes stay inside
+the data distribution (no log(0)/NaN surprises in models with row-local
+nonlinearities). The parity suite in tests/framework/test_serving.py asserts
+bitwise equality for every bucket.
+
+Thread-safety: :meth:`run_batch` serializes on an internal lock. The
+intended topology is ONE caller — the micro-batcher worker thread
+(batcher.py); the lock only keeps direct multi-threaded use correct, not
+fast.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from . import metrics as _m
+from .errors import InvalidRequest
+from ..inference import Predictor, _to_bf16
+
+__all__ = ['InferenceEngine', 'bucket_ladder', 'DEFAULT_MAX_BATCH']
+
+DEFAULT_MAX_BATCH = int(os.environ.get('PADDLE_TPU_SERVING_MAX_BATCH', '16'))
+
+
+def bucket_ladder(max_batch_size, buckets=None):
+    """The padded batch sizes the engine compiles. Default: powers of two up
+    to ``max_batch_size``, with ``max_batch_size`` always the top rung (e.g.
+    max 12 → [1, 2, 4, 8, 12]). A custom ladder is validated: positive,
+    strictly increasing, topped by ``max_batch_size``."""
+    max_batch_size = int(max_batch_size)
+    if max_batch_size < 1:
+        raise ValueError(f'max_batch_size must be >= 1, got {max_batch_size}')
+    if buckets is None:
+        ladder, b = [], 1
+        while b < max_batch_size:
+            ladder.append(b)
+            b *= 2
+        ladder.append(max_batch_size)
+        return ladder
+    ladder = [int(b) for b in buckets]
+    if not ladder or sorted(set(ladder)) != ladder:
+        raise ValueError(f'buckets must be strictly increasing, got {buckets}')
+    if ladder[0] < 1 or ladder[-1] != max_batch_size:
+        raise ValueError(
+            f'buckets must start >= 1 and end at max_batch_size='
+            f'{max_batch_size}, got {buckets}')
+    return ladder
+
+
+class InferenceEngine:
+    """Bucketed-batch wrapper around a saved inference model.
+
+    ``config_or_dir``: a model directory or :class:`inference.Config` (so the
+    bf16 / weight-only-int8 deployment paths work unchanged). The model loads
+    into a private Scope; device calls pass it explicitly to the Executor —
+    no global scope_guard, so concurrent *training* work in the same process
+    is unaffected.
+    """
+
+    def __init__(self, config_or_dir, executor=None, max_batch_size=None,
+                 buckets=None):
+        self.max_batch_size = int(max_batch_size or DEFAULT_MAX_BATCH)
+        self.buckets = bucket_ladder(self.max_batch_size, buckets)
+        self._predictor = Predictor(config_or_dir, executor)
+        self.config = self._predictor.config
+        self.program = self._predictor.program
+        self.feed_names = list(self._predictor.feed_names)
+        self.fetch_vars = self._predictor.fetch_vars
+        self._exe = self._predictor._exe
+        self._scope = self._predictor._scope
+        self._lock = threading.Lock()
+        self._compiled_buckets = set()
+        block = self.program.global_block()
+        # {feed name: (per-row tail shape with None for free dims, np.dtype)}
+        self.input_spec = {}
+        for name in self.feed_names:
+            v = block.var(name)
+            tail = tuple(None if d == -1 else int(d) for d in v.shape[1:])
+            self.input_spec[name] = (tail, np.dtype(v.dtype))
+
+    # -- request validation (BEFORE enqueue — batcher.py calls this) -------
+    def validate(self, inputs):
+        """Normalize ``inputs`` (dict name→array, or list in feed order) to
+        ``(feed dict of np arrays with a leading batch dim, nrows)``.
+        Raises :class:`InvalidRequest` on anything that could fail inside
+        the compiled step, so one bad request can never poison a batch."""
+        if isinstance(inputs, (list, tuple)):
+            if len(inputs) != len(self.feed_names):
+                raise InvalidRequest(
+                    f'expected {len(self.feed_names)} inputs '
+                    f'{self.feed_names}, got {len(inputs)}')
+            inputs = dict(zip(self.feed_names, inputs))
+        if not isinstance(inputs, dict):
+            raise InvalidRequest(
+                f'inputs must be a dict or list, got {type(inputs).__name__}')
+        missing = set(self.feed_names) - set(inputs)
+        extra = set(inputs) - set(self.feed_names)
+        if missing or extra:
+            raise InvalidRequest(
+                f'feed-name mismatch: missing {sorted(missing)}, '
+                f'unknown {sorted(extra)} (expected {self.feed_names})')
+        feed, nrows = {}, None
+        for name in self.feed_names:
+            tail, dtype = self.input_spec[name]
+            try:
+                arr = np.asarray(inputs[name])
+            except Exception as e:
+                raise InvalidRequest(f"input '{name}' is not array-like: {e}")
+            if arr.dtype == object:
+                raise InvalidRequest(
+                    f"input '{name}' is not numeric (object array)")
+            if arr.ndim != len(tail) + 1:
+                raise InvalidRequest(
+                    f"input '{name}' must have rank {len(tail) + 1} "
+                    f"(batch dim + per-row shape {tail}), got shape "
+                    f"{arr.shape}")
+            for i, (want, have) in enumerate(zip(tail, arr.shape[1:])):
+                if want is not None and want != have:
+                    raise InvalidRequest(
+                        f"input '{name}' dim {i + 1} must be {want}, got "
+                        f"{have} (shape {arr.shape})")
+            try:
+                arr = arr.astype(dtype, copy=False)
+            except (TypeError, ValueError) as e:
+                raise InvalidRequest(
+                    f"input '{name}' does not cast to {dtype}: {e}")
+            if dtype == np.int64:
+                # int64 computes as int32 on device (core/dtypes.py); the
+                # executor would raise mid-batch — reject at the door instead
+                from ..core.dtypes import check_int32_bounds
+                try:
+                    check_int32_bounds(arr, name)
+                except Exception as e:
+                    raise InvalidRequest(str(e))
+            if nrows is None:
+                nrows = arr.shape[0]
+            elif arr.shape[0] != nrows:
+                raise InvalidRequest(
+                    f"inconsistent batch dims: '{name}' has {arr.shape[0]} "
+                    f'rows, earlier inputs have {nrows}')
+            feed[name] = arr
+        if nrows == 0:
+            raise InvalidRequest('empty request (0 rows)')
+        if nrows > self.max_batch_size:
+            raise InvalidRequest(
+                f'request has {nrows} rows > max_batch_size='
+                f'{self.max_batch_size}; split it client-side')
+        return feed, nrows
+
+    def bucket_for(self, nrows):
+        """Smallest ladder rung that fits ``nrows``."""
+        for b in self.buckets:
+            if nrows <= b:
+                return b
+        raise InvalidRequest(
+            f'{nrows} rows exceed the top bucket {self.buckets[-1]}')
+
+    # -- execution ---------------------------------------------------------
+    def run_batch(self, feed, nrows=None):
+        """Run one coalesced batch: pad the batch dim up to the bucket, one
+        device call, slice the padding back off. ``feed``: validated dict of
+        np arrays sharing a leading batch dim. Returns a list of np arrays
+        (fetch order), each with ``nrows`` rows."""
+        if nrows is None:
+            nrows = next(iter(feed.values())).shape[0]
+        bucket = self.bucket_for(nrows)
+        pad = bucket - nrows
+        if pad:
+            # replicate the last real row: keeps pad lanes on-distribution
+            # (an all-zeros row can hit log(0)/0-division in real models)
+            feed = {n: np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
+                    for n, a in feed.items()}
+        if self.config.precision == 'bfloat16':
+            feed = {k: _to_bf16(v) for k, v in feed.items()}
+        with self._lock:
+            first = bucket not in self._compiled_buckets
+            t0 = time.perf_counter()
+            outs = self._exe.run(self.program, feed=feed,
+                                 fetch_list=self.fetch_vars,
+                                 scope=self._scope)
+            dt = time.perf_counter() - t0
+            if first:
+                self._compiled_buckets.add(bucket)
+                _m.bucket_compiled.labels(bucket=bucket).set(1)
+                _m.bucket_compile_seconds.labels(bucket=bucket).set(dt)
+        _m.bucket_runs.labels(bucket=bucket).inc()
+        _m.compute_seconds.labels(bucket=bucket).observe(dt)
+        _m.batch_rows.observe(nrows)
+        _m.padding_waste_ratio.observe(pad / bucket)
+        return [np.asarray(o)[:nrows] for o in outs]
+
+    def infer(self, inputs):
+        """Validate + run one request directly (no batcher). The convenience
+        path for scripts; servers go through :class:`batcher.MicroBatcher`."""
+        feed, nrows = self.validate(inputs)
+        return self.run_batch(feed, nrows)
+
+    def warmup(self, example=None):
+        """Precompile every bucket before traffic arrives. ``example``: a
+        one-row feed dict to tile (required when an input has free non-batch
+        dims — the engine cannot invent those sizes). Returns
+        {bucket: first-run seconds}; re-running is cheap (all cache hits).
+        Each compile goes through the persistent XLA compile cache, so a
+        restarted server warms from disk instead of the compiler."""
+        if example is not None:
+            row, _ = self.validate(example)
+            row = {n: a[:1] for n, a in row.items()}
+        else:
+            row = {}
+            for name, (tail, dtype) in self.input_spec.items():
+                if any(d is None for d in tail):
+                    raise ValueError(
+                        f"input '{name}' has free dims {tail}; pass "
+                        f'warmup(example={{...}}) with a representative row')
+                row[name] = np.zeros((1,) + tail, dtype)
+        timings = {}
+        for bucket in self.buckets:
+            feed = {n: np.repeat(a, bucket, axis=0) for n, a in row.items()}
+            t0 = time.perf_counter()
+            self.run_batch(feed, nrows=bucket)
+            timings[bucket] = time.perf_counter() - t0
+        return timings
+
+    @property
+    def compiled_buckets(self):
+        return sorted(self._compiled_buckets)
+
+    def get_input_names(self):
+        return list(self.feed_names)
+
+    def get_output_names(self):
+        return [v.name if hasattr(v, 'name') else v for v in self.fetch_vars]

@@ -1,0 +1,44 @@
+"""Typed serving errors.
+
+Every failure mode of the serving path maps to exactly one exception type so
+callers (and the HTTP front end) can distinguish *your request is bad*
+(InvalidRequest), *the system is protecting itself* (Overloaded), *you asked
+for a latency we could not meet* (DeadlineExceeded), and *we are going away*
+(EngineClosed). All derive from ServingError; the multiple-inheritance bases
+(ValueError / TimeoutError) keep generic ``except`` clauses working.
+"""
+from __future__ import annotations
+
+__all__ = ['ServingError', 'InvalidRequest', 'Overloaded', 'DeadlineExceeded',
+           'EngineClosed']
+
+
+class ServingError(RuntimeError):
+    """Base class for every serving-layer failure."""
+
+
+class InvalidRequest(ServingError, ValueError):
+    """Request rejected at validation time, BEFORE enqueue — a malformed
+    request never reaches a batch, so it can never poison co-batched
+    requests. Maps to HTTP 400."""
+
+
+class Overloaded(ServingError):
+    """Bounded-queue backpressure: the request queue is full. The request was
+    NOT enqueued; the client should back off and retry. Maps to HTTP 429."""
+
+    def __init__(self, queue_depth):
+        super().__init__(
+            f'serving queue full ({queue_depth} requests waiting); '
+            f'back off and retry')
+        self.queue_depth = queue_depth
+
+
+class DeadlineExceeded(ServingError, TimeoutError):
+    """The request's deadline expired while it waited in the queue — it was
+    dropped before wasting device time. Maps to HTTP 504."""
+
+
+class EngineClosed(ServingError):
+    """Submitted after shutdown began. In-flight requests at shutdown are
+    drained, not dropped; new ones get this. Maps to HTTP 503."""

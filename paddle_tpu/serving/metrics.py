@@ -1,0 +1,124 @@
+"""Serving metric handles (always-on, unlike training telemetry).
+
+Training instrumentation guards on ``observability._ENABLED`` because the
+eager dispatch path is ~10 µs/op; the serving path runs one device call per
+*batch* (ms-scale), so a handful of counter increments per request is noise.
+More importantly the HTTP ``/metrics`` endpoint must work out of the box —
+an operator scraping a serving box should not need PADDLE_TPU_TELEMETRY=1.
+So serving records straight into :data:`observability.registry` and shows up
+in both its exports alongside whatever the training-side telemetry collected.
+
+Every handle here is a :class:`_LazyMetric` proxy that re-resolves through
+the registry ON EACH USE rather than capturing the metric object at import:
+``registry.reset()`` (tests, telemetry teardown) drops all metric objects,
+and a captured handle would keep counting into an orphan that no longer
+appears in any export. The resolve is one dict lookup — noise at ms-scale.
+
+Metric catalog (docs/OBSERVABILITY.md has the full table):
+
+- request lifecycle counters: accepted / rejected_overload / rejected_invalid
+  / completed / failed / deadline_missed
+- serving_queue_depth gauge (sampled at submit/dequeue)
+- serving_queue_wait_seconds / serving_compute_seconds histograms — the
+  queue-wait vs compute split is THE batching-knob tuning signal
+- serving_batch_rows / serving_padding_waste_ratio histograms — how full the
+  coalesced batches are and how much of each padded bucket is thrown away
+- per-bucket gauges/counters: serving_bucket_runs (label bucket),
+  serving_bucket_compiled, serving_bucket_compile_seconds (warmup/first-use)
+"""
+from __future__ import annotations
+
+from ..observability import registry
+
+# padding waste is a ratio in [0, 1): linear buckets
+_WASTE_BOUNDS = tuple(i / 10.0 for i in range(1, 10))
+# batch row counts: powers of two cover any sane bucket ladder
+_ROWS_BOUNDS = tuple(float(2 ** i) for i in range(11))
+
+
+class _LazyMetric:
+    """Registry-resolving proxy: same call surface as Counter/Gauge/Histogram
+    (inc/set/observe/labels/value), but survives registry.reset()."""
+
+    __slots__ = ('_kind', '_name', '_help', '_bounds')
+
+    def __init__(self, kind, name, help, bounds=None):
+        self._kind = kind
+        self._name = name
+        self._help = help
+        self._bounds = bounds
+
+    def _metric(self):
+        if self._kind == 'counter':
+            return registry.counter(self._name, self._help)
+        if self._kind == 'gauge':
+            return registry.gauge(self._name, self._help)
+        if self._bounds is not None:
+            return registry.histogram(self._name, self._help, self._bounds)
+        return registry.histogram(self._name, self._help)
+
+    def inc(self, amount=1.0):
+        self._metric().inc(amount)
+
+    def set(self, value):
+        self._metric().set(value)
+
+    def observe(self, value):
+        self._metric().observe(value)
+
+    def labels(self, **labels):
+        return self._metric().labels(**labels)
+
+    @property
+    def value(self):
+        return self._metric().value
+
+
+requests_accepted = _LazyMetric(
+    'counter', 'serving_requests_accepted',
+    'requests admitted to the serving queue')
+requests_rejected_overload = _LazyMetric(
+    'counter', 'serving_requests_rejected_overload',
+    'requests rejected by bounded-queue backpressure (Overloaded)')
+requests_rejected_invalid = _LazyMetric(
+    'counter', 'serving_requests_rejected_invalid',
+    'requests rejected by pre-enqueue validation (InvalidRequest)')
+requests_completed = _LazyMetric(
+    'counter', 'serving_requests_completed',
+    'requests answered with results')
+requests_failed = _LazyMetric(
+    'counter', 'serving_requests_failed',
+    'requests failed by an engine/runtime error after admission')
+requests_deadline_missed = _LazyMetric(
+    'counter', 'serving_requests_deadline_missed',
+    'requests dropped because their deadline expired in the queue')
+
+queue_depth = _LazyMetric(
+    'gauge', 'serving_queue_depth',
+    'requests waiting in the micro-batcher queue')
+
+queue_wait_seconds = _LazyMetric(
+    'histogram', 'serving_queue_wait_seconds',
+    'enqueue → batch-execution wait per request')
+compute_seconds = _LazyMetric(
+    'histogram', 'serving_compute_seconds',
+    'device call duration per coalesced batch (by padded bucket)')
+batch_rows = _LazyMetric(
+    'histogram', 'serving_batch_rows',
+    'real (unpadded) rows per executed batch', bounds=_ROWS_BOUNDS)
+padding_waste_ratio = _LazyMetric(
+    'histogram', 'serving_padding_waste_ratio',
+    'fraction of the padded bucket that was padding, per executed batch',
+    bounds=_WASTE_BOUNDS)
+
+bucket_runs = _LazyMetric(
+    'counter', 'serving_bucket_runs', 'executed batches per bucket size')
+bucket_compiled = _LazyMetric(
+    'gauge', 'serving_bucket_compiled',
+    '1 once the bucket shape has been compiled (warmup or first use)')
+bucket_compile_seconds = _LazyMetric(
+    'gauge', 'serving_bucket_compile_seconds',
+    'wall seconds of the bucket\'s first (compiling) run')
+http_responses = _LazyMetric(
+    'counter', 'serving_http_responses',
+    'HTTP front-end responses by status code')

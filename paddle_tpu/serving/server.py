@@ -1,0 +1,267 @@
+"""Stdlib HTTP front end for the serving subsystem.
+
+A ``ThreadingHTTPServer`` (one handler thread per connection — the handler
+threads only parse JSON and block on futures; all device work stays on the
+single batcher worker) exposing:
+
+- ``POST /predict`` — body ``{"inputs": {name: nested-list}, "timeout_ms":
+  optional}`` (or inputs as a list in feed order). Reply ``{"outputs":
+  {fetch_name: nested-list}, "rows": n, "latency_ms": ...}``. Typed errors
+  map to status codes: InvalidRequest→400, Overloaded→429 (backpressure —
+  clients retry with backoff), DeadlineExceeded→504, EngineClosed→503,
+  anything else→500. Every error body is ``{"error": type, "message": ...}``.
+- ``GET /healthz`` — 200 ``{"status": "ok"}`` while serving, 503
+  ``{"status": "draining"}`` once shutdown begins (load-balancer eviction).
+- ``GET /metrics`` — Prometheus text exposition from the shared
+  observability registry (serving_* series plus anything telemetry
+  collected).
+
+Run one from the CLI::
+
+    python -m paddle_tpu.serving.server --model-dir /path/to/model \
+        --port 8080 --max-batch-size 16 --batch-timeout-ms 2
+
+Shutdown (SIGINT / :meth:`ServingServer.shutdown`) is graceful: healthz
+flips to draining, the batcher drains every admitted request, then the
+listener stops.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from . import metrics as _m
+from .batcher import (DEFAULT_BATCH_TIMEOUT_MS, DEFAULT_QUEUE_DEPTH,
+                      MicroBatcher)
+from .engine import InferenceEngine
+from .errors import (DeadlineExceeded, EngineClosed, InvalidRequest,
+                     Overloaded)
+from ..log_helper import get_logger
+
+__all__ = ['ServingServer', 'create_server']
+
+_logger = get_logger(
+    __name__, logging.INFO,
+    fmt='%(asctime)s-%(levelname)s: [serving] %(message)s')
+
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+_STATUS_BY_ERROR = ((InvalidRequest, 400), (Overloaded, 429),
+                    (DeadlineExceeded, 504), (EngineClosed, 503))
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = 'HTTP/1.1'
+    server_version = 'paddle-tpu-serving'
+
+    # BaseHTTPRequestHandler writes access logs to stderr with print-style
+    # formatting; route through log_helper instead (never print)
+    def log_message(self, fmt, *args):
+        _logger.debug('%s %s', self.address_string(), fmt % args)
+
+    def _reply(self, code, body, content_type='application/json'):
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header('Content-Type', content_type)
+        self.send_header('Content-Length', str(len(data)))
+        self.end_headers()
+        try:
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass                      # client went away; nothing to salvage
+        _m.http_responses.labels(code=code).inc()
+
+    def _error(self, code, exc):
+        self._reply(code, {'error': type(exc).__name__, 'message': str(exc)})
+
+    def do_GET(self):
+        srv = self.server.serving
+        if self.path == '/healthz':
+            if srv.draining:
+                self._reply(503, {'status': 'draining'})
+            else:
+                self._reply(200, {'status': 'ok',
+                                  'buckets': srv.engine.buckets,
+                                  'compiled': srv.engine.compiled_buckets})
+        elif self.path == '/metrics':
+            from ..observability import registry
+            self._reply(200, registry.prometheus_text().encode(),
+                        content_type='text/plain; version=0.0.4')
+        else:
+            self._reply(404, {'error': 'NotFound', 'message': self.path})
+
+    def do_POST(self):
+        if self.path != '/predict':
+            return self._reply(404, {'error': 'NotFound',
+                                     'message': self.path})
+        srv = self.server.serving
+        try:
+            length = int(self.headers.get('Content-Length') or 0)
+        except ValueError:
+            length = -1
+        if length <= 0:
+            return self._error(400, InvalidRequest('missing request body'))
+        if length > MAX_BODY_BYTES:
+            return self._error(413, InvalidRequest(
+                f'body of {length} bytes exceeds {MAX_BODY_BYTES}'))
+        try:
+            payload = json.loads(self.rfile.read(length))
+        except (ValueError, UnicodeDecodeError) as e:
+            return self._error(400, InvalidRequest(f'bad JSON body: {e}'))
+        if not isinstance(payload, dict) or 'inputs' not in payload:
+            return self._error(400, InvalidRequest(
+                'body must be {"inputs": {...}, "timeout_ms": optional}'))
+        timeout_ms = payload.get('timeout_ms')
+        if timeout_ms is not None and not isinstance(timeout_ms, (int, float)):
+            return self._error(400, InvalidRequest(
+                f'timeout_ms must be a number, got {timeout_ms!r}'))
+        t0 = time.perf_counter()
+        try:
+            fut = srv.batcher.submit(payload['inputs'], timeout_ms)
+            outs = fut.result(srv.request_timeout)
+        except tuple(e for e, _ in _STATUS_BY_ERROR) as e:
+            for etype, code in _STATUS_BY_ERROR:
+                if isinstance(e, etype):
+                    return self._error(code, e)
+        except TimeoutError as e:
+            return self._error(504, e)
+        except Exception as e:     # engine/internal failure: a 500, not a hang
+            _logger.error('predict failed: %s: %s', type(e).__name__, e)
+            return self._error(500, e)
+        names = srv.engine.get_output_names()
+        self._reply(200, {
+            'outputs': {n: np.asarray(o).tolist() for n, o in
+                        zip(names, outs)},
+            'rows': int(np.asarray(outs[0]).shape[0]) if outs else 0,
+            'latency_ms': round((time.perf_counter() - t0) * 1e3, 3)})
+
+
+class ServingServer:
+    """Engine + batcher + ThreadingHTTPServer, wired and lifecycle-managed.
+
+    Pass an :class:`InferenceEngine` (or a model dir, from which one is
+    built). ``port=0`` binds an ephemeral port (tests); read ``.port`` after
+    construction.
+    """
+
+    def __init__(self, engine, host='127.0.0.1', port=8080,
+                 max_batch_size=None, batch_timeout_ms=None, queue_depth=None,
+                 default_timeout_ms=None, request_timeout=60.0, warmup=False):
+        if not isinstance(engine, InferenceEngine):
+            engine = InferenceEngine(engine, max_batch_size=max_batch_size)
+        self.engine = engine
+        if warmup:
+            timings = self.engine.warmup()
+            _logger.info('warmed %d buckets: %s', len(timings),
+                         {b: round(s, 3) for b, s in timings.items()})
+        self.batcher = MicroBatcher(
+            engine,
+            max_batch_size=max_batch_size,
+            batch_timeout_ms=(DEFAULT_BATCH_TIMEOUT_MS
+                              if batch_timeout_ms is None
+                              else batch_timeout_ms),
+            queue_depth=(DEFAULT_QUEUE_DEPTH if queue_depth is None
+                         else queue_depth),
+            default_timeout_ms=default_timeout_ms)
+        self.request_timeout = request_timeout
+        self.draining = False
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.serving = self
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    def start(self):
+        """Serve in a background thread; returns self."""
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name='paddle-tpu-serving-http',
+                                        daemon=True)
+        self._thread.start()
+        _logger.info('serving on %s:%d (buckets %s)',
+                     self._httpd.server_address[0], self.port,
+                     self.engine.buckets)
+        return self
+
+    def serve_forever(self):
+        """Foreground serve (the CLI path); Ctrl-C shuts down gracefully."""
+        _logger.info('serving on %s:%d (buckets %s)',
+                     self._httpd.server_address[0], self.port,
+                     self.engine.buckets)
+        try:
+            self._httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.shutdown()
+
+    def shutdown(self, drain=True):
+        """Graceful stop: healthz flips to draining, admission closes, queued
+        requests run to completion (drain=True), then the listener stops."""
+        if self.draining:
+            return
+        self.draining = True
+        self.batcher.close(drain=drain)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5)
+        _logger.info('serving stopped (drained=%s)', drain)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+
+def create_server(model_dir_or_config, **kwargs):
+    """One-call constructor: ``create_server('/path', port=8080).start()``."""
+    return ServingServer(model_dir_or_config, **kwargs)
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        description='paddle_tpu serving: micro-batched inference over HTTP')
+    ap.add_argument('--model-dir', required=True)
+    ap.add_argument('--model-filename', default=None)
+    ap.add_argument('--params-filename', default=None)
+    ap.add_argument('--host', default='0.0.0.0')
+    ap.add_argument('--port', type=int, default=8080)
+    ap.add_argument('--max-batch-size', type=int, default=None)
+    ap.add_argument('--batch-timeout-ms', type=float, default=None)
+    ap.add_argument('--queue-depth', type=int, default=None)
+    ap.add_argument('--default-timeout-ms', type=float, default=None)
+    ap.add_argument('--buckets', default=None,
+                    help='comma-separated ladder, e.g. 1,2,4,8,16')
+    ap.add_argument('--bf16', action='store_true')
+    ap.add_argument('--no-warmup', action='store_true',
+                    help='skip precompiling the bucket ladder at startup')
+    args = ap.parse_args(argv)
+
+    from ..inference import Config
+    cfg = Config(args.model_dir, args.model_filename, args.params_filename)
+    if args.bf16:
+        cfg.enable_bf16()
+    buckets = [int(b) for b in args.buckets.split(',')] if args.buckets \
+        else None
+    engine = InferenceEngine(cfg, max_batch_size=args.max_batch_size,
+                             buckets=buckets)
+    ServingServer(engine, host=args.host, port=args.port,
+                  max_batch_size=args.max_batch_size,
+                  batch_timeout_ms=args.batch_timeout_ms,
+                  queue_depth=args.queue_depth,
+                  default_timeout_ms=args.default_timeout_ms,
+                  warmup=not args.no_warmup).serve_forever()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,50 @@
+"""tier-1 guard for the serving load bench: tools/bench_serving.py --smoke
+must run end-to-end under JAX_PLATFORMS=cpu, show the micro-batcher beating
+the serial single-request baseline, keep bitwise parity, and produce typed
+overload rejections that surface in the Prometheus export. The full-size
+acceptance margin (≥5× at batch 16 on CPU) is recorded in PERF.md §11; the
+smoke bound here is soft so CI noise cannot flake it."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+BATCHER_FIELDS = {'clients', 'requests', 'max_batch_size', 'batch_timeout_ms',
+                  'throughput_req_s', 'p50_ms', 'p99_ms', 'batches',
+                  'mean_batch_rows', 'mean_padding_waste', 'bitwise_equal',
+                  'speedup_vs_serial'}
+
+
+def test_bench_serving_smoke_runs_on_cpu():
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    r = subprocess.run(
+        [sys.executable, os.path.join('tools', 'bench_serving.py'),
+         '--smoke'],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
+    benches = {d['bench']: d for d in lines if 'bench' in d}
+    assert {'serving_serial_baseline', 'serving_batcher',
+            'serving_overload'} <= set(benches)
+
+    serial = benches['serving_serial_baseline']
+    assert serial['throughput_req_s'] > 0 and serial['p99_ms'] > 0
+
+    b = benches['serving_batcher']
+    assert BATCHER_FIELDS <= set(b), b
+    # hard guarantees: responses bitwise-equal to the serial baseline, and
+    # real coalescing happened (well past a single request per device call)
+    assert b['bitwise_equal'] is True, b
+    assert b['mean_batch_rows'] > 2, b
+    assert 0 <= b['mean_padding_waste'] < 1, b
+    # soft timing bound (PERF.md §11 records 5.4x at full size; smoke noise
+    # still clears 2x comfortably — measured 5.7x)
+    assert b['speedup_vs_serial'] > 2.0, b
+
+    o = benches['serving_overload']
+    # burst > queue_depth: typed rejections, every admitted request answered
+    assert o['rejected'] > 0 and o['answered'] > 0, o
+    assert o['rejected'] + o['answered'] == o['burst'], o
+    assert o['rejections_in_prometheus'] is True, o

@@ -1,0 +1,104 @@
+"""io.py strictness satellites (ISSUE 4): explicit save_vars/load_vars lists
+and load_inference_model must fail loudly instead of silently saving object
+arrays / skipping requested vars / serving uninitialized parameters."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _tiny_program():
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[4], dtype='float32')
+        out = layers.fc(x, 2, act='softmax',
+                        param_attr=fluid.ParamAttr(name='strict_w'))
+    return main, start, out
+
+
+def test_save_vars_missing_var_raises(tmp_path):
+    main, start, _ = _tiny_program()
+    exe = fluid.Executor()
+    exe.run(start)
+    # pre-fix: np.asarray(scope.find('nope')) silently saved an object array
+    with pytest.raises(ValueError, match="'nope'"):
+        fluid.io.save_vars(exe, str(tmp_path / 'm'), main,
+                           vars=['strict_w', 'nope'])
+    # the good path still works
+    fluid.io.save_vars(exe, str(tmp_path / 'm'), main, vars=['strict_w'])
+    with np.load(str(tmp_path / 'm' / 'params.npz')) as data:
+        assert data['strict_w'].dtype == np.float32
+
+
+def test_load_vars_missing_from_archive_raises(tmp_path):
+    main, start, _ = _tiny_program()
+    exe = fluid.Executor()
+    exe.run(start)
+    fluid.io.save_vars(exe, str(tmp_path / 'm'), main, vars=['strict_w'])
+    # requesting a var the archive lacks must raise, listing the names
+    b0 = main.global_block().var('strict_w')
+    with pytest.raises(ValueError, match=r"\['fc_0\.b_0'\]"):
+        fluid.io.load_vars(exe, str(tmp_path / 'm'), main,
+                           vars=[b0, 'fc_0.b_0'])
+    # exact-list round-trip unaffected
+    fluid.io.load_vars(exe, str(tmp_path / 'm'), main, vars=['strict_w'])
+
+
+def test_load_inference_model_missing_params_raises(tmp_path):
+    main, start, out = _tiny_program()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(start)
+        fluid.io.save_inference_model(str(tmp_path / 'po'), ['x'], [out],
+                                      exe, main, program_only=True)
+    # fresh scope, no params file: pre-fix this returned a program whose
+    # persistables were garbage — now it names them and raises
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(RuntimeError, match='strict_w'):
+            fluid.io.load_inference_model(str(tmp_path / 'po'), exe)
+
+
+def test_load_inference_model_program_only_with_preset_scope(tmp_path):
+    """The supported program_only workflow — persistables pre-populated in
+    the scope — keeps working."""
+    main, start, out = _tiny_program()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    X = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(start)
+        ref, = exe.run(main, feed={'x': X}, fetch_list=[out])
+        fluid.io.save_inference_model(str(tmp_path / 'po'), ['x'], [out],
+                                      exe, main, program_only=True)
+        prog, feeds, fetches = fluid.io.load_inference_model(
+            str(tmp_path / 'po'), exe)
+        got, = exe.run(prog, feed={'x': X}, fetch_list=fetches)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_load_inference_model_partial_params_raises(tmp_path):
+    """A params archive missing SOME persistables is the same bug in
+    miniature: raise, naming exactly the uninitialized ones."""
+    import json
+    import os
+    main, start, out = _tiny_program()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(start)
+        fluid.io.save_inference_model(str(tmp_path / 'pp'), ['x'], [out],
+                                      exe, main)
+    # drop one entry from the saved archive
+    path = str(tmp_path / 'pp' / 'params.npz')
+    with np.load(path) as data:
+        kept = {k: data[k] for k in data.files if k != 'strict_w'}
+    os.remove(path)
+    np.savez(path, **kept)
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(RuntimeError, match='strict_w'):
+            fluid.io.load_inference_model(str(tmp_path / 'pp'), exe)
+    # sanity: the meta file is untouched
+    with open(str(tmp_path / 'pp' / '__model__.json')) as f:
+        assert json.load(f)['feed_names'] == ['x']

@@ -1,0 +1,258 @@
+"""Serving-path load benchmark (PERF.md §11).
+
+Closed-loop load generator over the micro-batching serving stack
+(paddle_tpu/serving/): N client threads each fire single-row requests at a
+:class:`MicroBatcher` and wait for their result before firing the next —
+the classic closed-loop model, so measured latency includes queueing. Three
+sections, one JSON line each:
+
+1. ``serving_serial_baseline`` — the pre-subsystem path: one
+   ``Predictor.run`` per request, serially. This is what every request paid
+   before the batcher existed.
+2. ``serving_batcher`` — the same request stream through the dynamic
+   micro-batcher (bucket ladder + padding + one device call per batch).
+   Reports throughput, p50/p99 latency, mean coalesced batch rows, mean
+   padding-waste ratio, and **bitwise parity** of every response against the
+   serial baseline outputs. Acceptance (PERF.md §11): ≥ 5× the serial
+   throughput at max_batch_size=16 on CPU.
+3. ``serving_overload`` — backpressure: a burst larger than the bounded
+   queue against a deliberately slow engine must produce typed
+   ``Overloaded`` rejections (no hangs, no crashes) and leave the admitted
+   requests answered.
+
+Runs on any backend; CPU is the honest configuration (the quantity under
+test is dispatch amortization, not FLOPs):
+
+  JAX_PLATFORMS=cpu python tools/bench_serving.py [--smoke]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+# runnable as `python tools/bench_serving.py` from the repo root
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+FEATURES = 64
+CLASSES = 10
+
+
+def build_model(dirname):
+    """Save a small MLP inference model; returns its directory."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[FEATURES], dtype='float32')
+        h = layers.fc(x, 128, act='relu')
+        h = layers.fc(h, 128, act='relu')
+        out = layers.fc(h, CLASSES, act='softmax')
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(start)
+        fluid.io.save_inference_model(dirname, ['x'], [out], exe, main)
+    return dirname
+
+
+def _pctl(latencies, q):
+    return round(float(np.percentile(np.asarray(latencies) * 1e3, q)), 3)
+
+
+def measure_serial(model_dir, X, requests):
+    """One Predictor.run per request, serially — the baseline every request
+    paid before the serving subsystem. Returns (section dict, row outputs)."""
+    from paddle_tpu.inference import Predictor
+    pred = Predictor(model_dir)
+    pred.run([X[:1]])                       # compile the bucket-1 shape
+    lat, outs = [], []
+    t0 = time.perf_counter()
+    for i in range(requests):
+        row = X[i % len(X):i % len(X) + 1]
+        t1 = time.perf_counter()
+        out, = pred.run([row])
+        lat.append(time.perf_counter() - t1)
+        if i < len(X):
+            outs.append(out)
+    wall = time.perf_counter() - t0
+    return {
+        'bench': 'serving_serial_baseline',
+        'requests': requests,
+        'throughput_req_s': round(requests / wall, 1),
+        'p50_ms': _pctl(lat, 50), 'p99_ms': _pctl(lat, 99),
+    }, outs
+
+
+def _hist_stats(name):
+    from paddle_tpu.observability import registry
+    d = registry.to_dict().get(name)
+    if not d or not d['samples']:
+        return 0.0, 0
+    s = sum(x['sum'] for x in d['samples'])
+    c = sum(x['count'] for x in d['samples'])
+    return s, c
+
+
+def measure_batcher(model_dir, X, refs, clients, requests_per_client,
+                    max_batch_size, batch_timeout_ms):
+    """Closed-loop clients through the micro-batcher; parity-checked against
+    the serial baseline outputs."""
+    from paddle_tpu import serving
+    engine = serving.InferenceEngine(model_dir, max_batch_size=max_batch_size)
+    engine.warmup()
+    rows0, nb0 = _hist_stats('serving_batch_rows')
+    waste0, nw0 = _hist_stats('serving_padding_waste_ratio')
+    lat, mismatches = [], [0]
+    lat_lock = threading.Lock()
+
+    def client(cid):
+        my_lat = []
+        bad = 0
+        for i in range(requests_per_client):
+            ridx = (cid * requests_per_client + i) % len(X)
+            t1 = time.perf_counter()
+            out, = batcher.predict({'x': X[ridx:ridx + 1]})
+            my_lat.append(time.perf_counter() - t1)
+            if not np.array_equal(out, refs[ridx]):
+                bad += 1
+        with lat_lock:
+            lat.extend(my_lat)
+            mismatches[0] += bad
+
+    with serving.MicroBatcher(engine, batch_timeout_ms=batch_timeout_ms,
+                              queue_depth=4 * clients) as batcher:
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+
+    total = clients * requests_per_client
+    rows1, nb1 = _hist_stats('serving_batch_rows')
+    waste1, nw1 = _hist_stats('serving_padding_waste_ratio')
+    batches = max(nb1 - nb0, 1)
+    return {
+        'bench': 'serving_batcher',
+        'clients': clients, 'requests': total,
+        'max_batch_size': max_batch_size,
+        'batch_timeout_ms': batch_timeout_ms,
+        'throughput_req_s': round(total / wall, 1),
+        'p50_ms': _pctl(lat, 50), 'p99_ms': _pctl(lat, 99),
+        'batches': batches,
+        'mean_batch_rows': round((rows1 - rows0) / batches, 2),
+        'mean_padding_waste': round(
+            (waste1 - waste0) / max(nw1 - nw0, 1), 3),
+        'bitwise_equal': mismatches[0] == 0,
+    }
+
+
+class _SlowEngine:
+    """Engine proxy whose device call takes a fixed wall time — makes the
+    overload section deterministic (a fast engine drains any burst)."""
+
+    def __init__(self, engine, delay_s):
+        self._engine = engine
+        self._delay = delay_s
+        self.max_batch_size = engine.max_batch_size
+
+    def validate(self, inputs):
+        return self._engine.validate(inputs)
+
+    def run_batch(self, feed, nrows=None):
+        time.sleep(self._delay)
+        return self._engine.run_batch(feed, nrows)
+
+
+def measure_overload(model_dir, X, queue_depth, burst):
+    """Burst > queue_depth against a slow engine: typed rejections, no
+    hangs, admitted requests all answered."""
+    from paddle_tpu import serving
+    engine = serving.InferenceEngine(model_dir, max_batch_size=4)
+    engine.warmup()
+    slow = _SlowEngine(engine, delay_s=0.05)
+    rejected, futures = 0, []
+    with serving.MicroBatcher(slow, batch_timeout_ms=1,
+                              queue_depth=queue_depth) as batcher:
+        for i in range(burst):
+            try:
+                futures.append(batcher.submit({'x': X[i % len(X):
+                                                      i % len(X) + 1]}))
+            except serving.Overloaded:
+                rejected += 1
+        answered = 0
+        for f in futures:
+            f.result(timeout=30)
+            answered += 1
+    from paddle_tpu.observability import registry
+    prom = registry.prometheus_text()
+    return {
+        'bench': 'serving_overload',
+        'burst': burst, 'queue_depth': queue_depth,
+        'rejected': rejected, 'answered': answered,
+        'rejections_in_prometheus':
+            'paddle_tpu_serving_requests_rejected_overload' in prom,
+    }
+
+
+def measure_all(smoke=False, model_dir=None):
+    """All three sections; returns {'serial': ..., 'batcher': ...,
+    'overload': ...}. ``smoke``: CI sizes (seconds, not minutes)."""
+    tmp = None
+    if model_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix='bench_serving_')
+        model_dir = build_model(os.path.join(tmp.name, 'model'))
+    # closed-loop sizing: clients must exceed 2× the row budget or batches
+    # never fill and every round waits out the whole batch window (the
+    # measured-throughput cliff documented in docs/SERVING.md)
+    clients = 48 if smoke else 64
+    per_client = 25 if smoke else 100
+    max_batch = 16
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, FEATURES).astype(np.float32)
+    try:
+        serial, refs = measure_serial(
+            model_dir, X, requests=200 if smoke else 1000)
+        batcher = measure_batcher(model_dir, X, refs, clients, per_client,
+                                  max_batch_size=max_batch,
+                                  batch_timeout_ms=2)
+        batcher['speedup_vs_serial'] = round(
+            batcher['throughput_req_s'] / serial['throughput_req_s'], 2)
+        overload = measure_overload(model_dir, X, queue_depth=8,
+                                    burst=64 if smoke else 256)
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+    return {'serial': serial, 'batcher': batcher, 'overload': overload}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--smoke', action='store_true',
+                    help='CI sizes: fewer clients/requests')
+    ap.add_argument('--model-dir', default=None,
+                    help='serve an existing saved model instead of the '
+                         'built-in MLP')
+    args = ap.parse_args()
+    results = measure_all(smoke=args.smoke, model_dir=args.model_dir)
+    for section in results.values():
+        print(json.dumps(section), flush=True)
+    ok = (results['batcher']['bitwise_equal']
+          and results['overload']['rejected'] > 0
+          and results['overload']['answered'] > 0)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == '__main__':
+    main()
