@@ -365,6 +365,17 @@ def bench_serving_batcher(on_tpu):
     return measure_all(smoke=not on_tpu)
 
 
+def bench_async_pipeline(on_tpu):
+    """Async train-loop pipeline A/B (PERF.md §12): host-bound reader +
+    compute-bound step, sync (per-step np.asarray) vs the K=2 in-flight
+    FetchHandle window, plus the zero-copy staged-feed check. Valid on
+    CPU: the quantity under test is host/device overlap, not FLOPs."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools'))
+    from bench_pipeline import measure_all
+    return measure_all(smoke=not on_tpu)
+
+
 def bench_telemetry_sidecar(on_tpu):
     """Telemetry sidecar for the bench run: the headline benches above run
     with telemetry off (their numbers stay comparable across PRs), then the
@@ -484,6 +495,16 @@ def main():
         summary.update(
             serving_batcher_speedup=sv['batcher']['speedup_vs_serial'],
             serving_batcher_p99_ms=sv['batcher']['p99_ms'])
+
+    pl = run("async_pipeline", lambda: bench_async_pipeline(on_tpu))
+    if pl is not None:
+        emit({"metric": "async_pipeline",
+              "async_pipeline": pl['async_pipeline'],
+              "staged_feeds": pl['staged_feeds']})
+        summary.update(
+            async_pipeline_speedup=pl['async_pipeline']['speedup'],
+            async_pipeline_bitwise=pl['async_pipeline']
+            ['bitwise_identical'])
 
     s = run("telemetry_sidecar", lambda: bench_telemetry_sidecar(on_tpu))
     if s is not None:

@@ -33,6 +33,7 @@ from . import clip
 from .backward import append_backward, gradients
 from . import optimizer
 from .executor import Executor
+from .core.fetch_handle import FetchHandle
 from . import metrics
 from . import nets
 from .compiler import CompiledProgram

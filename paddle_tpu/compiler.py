@@ -72,16 +72,32 @@ class BuildStrategy:
 
 
 class ExecutionStrategy:
+    """ref: framework/details/execution_strategy.h knobs.
+
+    Live on TPU: `num_inflight_steps` — setting it > 1 turns the
+    Executor's training loop into the async pipeline (executor.py): up to
+    that many dispatched steps stay outstanding, fetches come back as
+    non-blocking :class:`~paddle_tpu.core.fetch_handle.FetchHandle` s, and
+    the executor blocks on the oldest handle only when the window is full.
+    `2` is classic double buffering (host feed prep + dispatch of step N+1
+    overlap device execution of step N — PERF.md §12). The
+    `PADDLE_TPU_ASYNC` env var overrides it either way; `num_threads` /
+    `num_iteration_per_drop_scope` stay accepted-for-compat no-ops (the
+    step is one XLA program; scopes hold no transient kernels)."""
+
     def __init__(self):
         self.num_threads = 1
         self.num_iteration_per_drop_scope = 100
         self.use_experimental_executor = False
+        self.num_inflight_steps = 1
 
 
 class CompiledProgram:
-    def __init__(self, program_or_graph, build_strategy=None):
+    def __init__(self, program_or_graph, build_strategy=None,
+                 exec_strategy=None):
         self._program = program_or_graph
         self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = exec_strategy
         self._data_sharding = None
         self._places = None
 
@@ -92,6 +108,8 @@ class CompiledProgram:
         from .parallel.mesh import get_default_mesh, make_mesh
         if build_strategy is not None:
             self._build_strategy = build_strategy
+        if exec_strategy is not None:
+            self._exec_strategy = exec_strategy
         mesh = get_default_mesh()
         if mesh is None or 'dp' not in mesh.axis_names:
             n = len(jax.devices())

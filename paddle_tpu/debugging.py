@@ -30,7 +30,18 @@ def enable_check_nan_inf(enable=True):
     producing op, like the reference's per-op scan. The instrumented
     Executor additionally scans fetched values each step and reports
     detections as the `nonfinite_detections` telemetry counter plus an
-    `executor/check_nan_inf` trace span (docs/OBSERVABILITY.md)."""
+    `executor/check_nan_inf` trace span (docs/OBSERVABILITY.md).
+
+    Interaction with the async pipeline (PADDLE_TPU_ASYNC /
+    num_inflight_steps / TrainStep(async_fetch=True)): a per-step host
+    scan would force a device→host sync each step and silently
+    re-serialize the pipelined loop, so in async mode the scan runs at
+    FetchHandle MATERIALIZATION time instead — the raise surfaces where
+    the value is first read (up to K steps after the producing dispatch),
+    and the `nonfinite_detections` counter still increments per detection.
+    `jax_debug_nans` remains step-accurate in either mode (it raises from
+    inside the computation). Set PADDLE_TPU_ASYNC=0 to pin the per-step
+    fetch scan while hunting a NaN."""
     global _check_enabled
     _check_enabled = enable
     jax.config.update('jax_debug_nans', bool(enable))

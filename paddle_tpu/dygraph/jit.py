@@ -460,10 +460,25 @@ class TrainStep:
 
         step = TrainStep(model, loss_fn, optimizer)
         loss = step(x_batch, y_batch)          # numpy/jax arrays in
+
+    With `async_fetch=True` the call returns a
+    :class:`~paddle_tpu.core.fetch_handle.FetchHandle` instead of the raw
+    loss array and keeps up to `num_inflight_steps` (default 2) dispatched
+    steps outstanding — `float(handle)` / `np.asarray(handle)` is the sync
+    point, so logging the loss every k steps stops serializing the loop.
+    `PADDLE_TPU_ASYNC=0` forces the synchronous behavior regardless.
+
+    async_fetch composes with donation asymmetrically: `donate=True` (the
+    default) updates params in place, which makes dispatch N+1 wait for
+    step N to finish producing the donated buffers — host-side batch prep
+    still overlaps the running step, but the dispatch window is
+    effectively 1 deep. Pass `donate=False` for a true K-deep window at
+    the cost of the double-buffer transient (2× param HBM).
     """
 
     def __init__(self, layer: Layer, loss_fn, optimizer, data_sharding=None,
-                 remat=False, donate=True, amp_dtype=None, accum_steps=1):
+                 remat=False, donate=True, amp_dtype=None, accum_steps=1,
+                 async_fetch=False, num_inflight_steps=None):
         from ..core.compile_cache import setup_persistent_cache
         setup_persistent_cache()   # second process reuses the compiled step
         self._layer = layer
@@ -492,6 +507,19 @@ class TrainStep:
         self._jitted = None
         self._slots = None
         self._step = 0
+        # async_fetch: non-blocking loss handles + a bounded K-in-flight
+        # dispatch window (executor-style pipelining for the fused step;
+        # the loss output buffer is never donated, so a pending handle is
+        # inherently snapshot-safe here). PADDLE_TPU_ASYNC=0 pins sync; a
+        # numeric PADDLE_TPU_ASYNC sets the default window depth.
+        from ..core.fetch_handle import (InflightWindow,
+                                         resolve_inflight_steps)
+        if async_fetch:
+            self._async_k = resolve_inflight_steps(
+                default=int(num_inflight_steps) if num_inflight_steps else 2)
+        else:
+            self._async_k = 0
+        self._window = InflightWindow() if self._async_k else None
 
     def _build(self):
         layer = self._layer
@@ -643,6 +671,11 @@ class TrainStep:
                 arr = jax.device_put(arr, self._data_sharding)
             batch_vals.append(arr)
         pvals, bvals = self.state()
+        if self._window is not None:
+            # K-in-flight window: block on the oldest pending loss handle
+            # only when the window is full, so this dispatch overlaps the
+            # device still executing earlier steps
+            self._window.admit(self._async_k)
         with _obs.span('train_step/execute'):
             if self._accum_steps > 1:
                 if self._acc is None:
@@ -671,4 +704,11 @@ class TrainStep:
         self._step += 1
         if hasattr(self._opt._learning_rate, 'step'):
             self._opt._learning_rate.step()
+        if self._window is not None:
+            from ..core.fetch_handle import FetchHandle
+            from ..debugging import check_nan_inf_enabled
+            handle = FetchHandle(loss, name='loss',
+                                 check_nan=check_nan_inf_enabled())
+            self._window.push([handle])
+            return handle
         return loss

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from . import observability as _obs
 from .core.compile_cache import record_program_cache
 from .core.dtypes import to_jax_dtype
+from .core.fetch_handle import (FetchHandle, InflightWindow,
+                                resolve_inflight_steps)
 from .core.places import _get_paddle_place
 from .core.scope import global_scope
 from .core.random import default_generator
@@ -755,6 +757,9 @@ class Executor:
         self._cache = {}
         self._step_counter = 0
         self._fsdp_placed = set()
+        # async pipeline bookkeeping: dispatched steps whose FetchHandles
+        # are still pending (K-in-flight window + donation protection)
+        self._window = InflightWindow()
         # persistent cross-process XLA compile cache underneath the
         # in-process program+shape jit cache (core/compile_cache.py)
         from .core.compile_cache import setup_persistent_cache
@@ -764,6 +769,20 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True, feed_var_name='feed',
             fetch_var_name='fetch'):
+        """Run `program` once. Fetch results come back three ways:
+
+        - default (synchronous): numpy arrays, one blocking D2H per fetch —
+          the exact pre-pipeline behavior (`PADDLE_TPU_ASYNC=0` pins this);
+        - `return_numpy=False`: :class:`FetchHandle` s backed by on-device
+          arrays — `np.asarray(handle)` materializes on read, with snapshot
+          semantics (later steps cannot donate-over a pending handle);
+        - async mode (`PADDLE_TPU_ASYNC=1`/`K`, or
+          `ExecutionStrategy.num_inflight_steps > 1` on a CompiledProgram):
+          always returns FetchHandles and keeps up to K dispatched steps
+          outstanding, blocking on the oldest handle only when the window
+          is full — host feed prep and dispatch of step N+1 overlap device
+          execution of step N (PERF.md §12, tools/bench_pipeline.py).
+        """
         if not _obs._ENABLED:
             return self._run_impl(program, feed, fetch_list, scope,
                                   return_numpy)
@@ -779,16 +798,30 @@ class Executor:
         from .compiler import CompiledProgram
         sharding = None
         build_strategy = None
+        exec_strategy = None
         donate = os.environ.get('PADDLE_TPU_DONATE', '1') != '0'
         if isinstance(program, CompiledProgram):
             sharding = program._data_sharding
             bs = build_strategy = program._build_strategy
+            exec_strategy = program._exec_strategy
             # fluid memory knobs map onto donation: enable_inplace=False or
             # memory_optimize=False opts the whole program out of buffer reuse
             if bs is not None and (bs.enable_inplace is False
                                    or bs.memory_optimize is False):
                 donate = False
             program = program._program
+        # K > 0: pipelined loop with up to K dispatched steps outstanding.
+        # Pipelining turns donation OFF for the dispatched steps: donating a
+        # buffer that is still being produced by the PREVIOUS in-flight step
+        # makes the runtime block the dispatch until the producer finishes
+        # (measured: the whole overlap win disappears on the CPU PJRT
+        # client), and K-deep double buffering fundamentally needs the old
+        # and new state live at once. The cost is the classic double-buffer
+        # transient (2× pipelined-state HBM) — PERF.md §12.
+        inflight_k = resolve_inflight_steps(exec_strategy)
+        use_handles = bool(inflight_k) or not return_numpy
+        if inflight_k:
+            donate = False
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = feed or {}
@@ -834,6 +867,7 @@ class Executor:
 
         from .core.lod import LoDTensor
         feed_vals = {}
+        passthrough_bytes = 0
         for name, value in feed.items():
             if isinstance(value, LoDTensor):
                 # ragged feed: bind the padded data plus the companion
@@ -844,15 +878,33 @@ class Executor:
                         check_int32_bounds(value.lengths, name + '@LEN'))
                 value = value.data
             dtype = block.var(name).dtype if block.has_var(name) else None
+            target = to_jax_dtype(dtype) if dtype else None
+            if (isinstance(value, jax.Array)
+                    and not isinstance(value, jax.core.Tracer)
+                    and (target is None or value.dtype == target)
+                    and (sharding is None or value.sharding == sharding)):
+                # zero-copy staged feed: the DataLoader producer thread
+                # already committed this batch to the device (reader.py
+                # device_put) — and ran the int64 bounds check host-side at
+                # staging — so re-converting here would only put H2D (and,
+                # for int64, a device→host bounds scan = a full sync) back
+                # on the critical path
+                passthrough_bytes += getattr(value, 'nbytes', 0)
+                feed_vals[name] = value
+                continue
             if dtype == 'int64':
                 # int64 computes as int32 on device (core/dtypes.py); a
                 # feed that would wrap must fail loudly, not silently
                 from .core.dtypes import check_int32_bounds
                 check_int32_bounds(value, name)
-            arr = jnp.asarray(value, to_jax_dtype(dtype) if dtype else None)
+            arr = jnp.asarray(value, target)
             if sharding is not None:
                 arr = jax.device_put(arr, sharding)
             feed_vals[name] = arr
+        if _obs._ENABLED and passthrough_bytes:
+            _obs.inc('executor_feed_passthrough_bytes', passthrough_bytes,
+                     help='feed bytes recognized as already device-committed '
+                          'and passed through without a second device_put')
         _default_len_feeds(block, feed_vals)
         prep_span.__exit__(None, None, None)
 
@@ -884,14 +936,19 @@ class Executor:
         # Donation guards: a fetch-aliased persistable must survive the call
         # (the caller observes its pre-step buffer), and a buffer shared
         # between two state names — or with a feed — may be donated at most
-        # once. Everything else (params, optimizer slots, BN stats) is
-        # donated so XLA updates it in place instead of doubling live HBM.
+        # once. A persistable fetched by a still-PENDING FetchHandle from an
+        # earlier async step is protected too: donating it would overwrite
+        # the handle's snapshot in place. Everything else (params, optimizer
+        # slots, BN stats) is donated so XLA updates it in place instead of
+        # doubling live HBM.
         fetch_set = frozenset(fetch_names)
+        pending_protected = self._window.protected_names()
         seen_ids = {id(v) for v in feed_vals.values()}
         dstate, kstate = {}, {}
         for n in state_names:
             v = state[n]
-            if donate and n not in fetch_set and id(v) not in seen_ids:
+            if (donate and n not in fetch_set and n not in pending_protected
+                    and id(v) not in seen_ids):
                 dstate[n] = v
                 seen_ids.add(id(v))
             else:
@@ -900,6 +957,14 @@ class Executor:
         self._step_counter += 1
         base_key = jax.random.fold_in(default_generator.base_key(),
                                       self._step_counter)
+        from .debugging import check_nan_inf_enabled
+        check_nan = check_nan_inf_enabled() and bool(fetch_names)
+        if inflight_k:
+            # bounded in-flight window: block on the OLDEST dispatched
+            # step only when K are already outstanding, so this step's
+            # dispatch (and the next step's host feed prep) overlap the
+            # device executing steps N..N-K+1
+            self._window.admit(inflight_k)
         # execute = host-side dispatch of the jitted step (on a cache miss
         # this includes trace + XLA compile); fetch = scope write-back plus
         # the device→host transfer that synchronizes with the computation
@@ -920,11 +985,21 @@ class Executor:
         with fetch_span:
             for n, v in new_state.items():
                 scope.set(n, v)
-            result = [np.asarray(f) for f in fetches] if return_numpy \
-                else fetches
+            if use_handles:
+                # non-blocking fetches: hand back FetchHandles over the
+                # still-on-device arrays; np.asarray(handle) is the sync
+                # point. The window entry records which persistables the
+                # handles alias so later donation can't corrupt them, and
+                # (with FLAGS_check_nan_inf) the non-finite scan moves to
+                # materialization time instead of re-serializing the loop.
+                result = [FetchHandle(f, name=n, check_nan=check_nan)
+                          for n, f in zip(fetch_names, fetches)]
+                self._window.push(result,
+                                  protected=fetch_set & frozenset(state_names))
+            else:
+                result = [np.asarray(f) for f in fetches]
 
-        from .debugging import check_nan_inf_enabled
-        if check_nan_inf_enabled() and fetch_names:
+        if check_nan and not use_handles:
             # FLAGS_check_nan_inf parity on the fused step: scan the fetched
             # host values; detections land in telemetry (counter + instant
             # trace marker) BEFORE the raise so a NaN storm is visible in

@@ -59,17 +59,28 @@ def buffered(reader, size):
     def r():
         q = queue.Queue(maxsize=size)
         end = object()
+        err_box = []
 
         def fill():
-            for item in reader():
-                q.put(item)
-            q.put(end)
+            # an exception in the fill thread must still enqueue the `end`
+            # sentinel and surface in the CONSUMER (as _GeneratorLoader's
+            # producer does) — dying silently leaves the consumer blocked
+            # on q.get() forever
+            try:
+                for item in reader():
+                    q.put(item)
+            except BaseException as e:
+                err_box.append(e)
+            finally:
+                q.put(end)
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
         while True:
             item = q.get()
             if item is end:
+                if err_box:
+                    raise err_box[0]
                 break
             yield item
     return r
@@ -225,64 +236,114 @@ class _GeneratorLoader:
     decorate_tensor_provider = set_batch_generator
     decorate_paddle_reader = set_sample_list_generator
 
+    def _stage(self, feed):
+        """Commit one batch to the device on the PRODUCER thread, so H2D is
+        off the consumer's critical path and the Executor's zero-copy feed
+        passthrough can use the arrays as-is. int64 bounds are checked here,
+        host-side, for the same reason: checking a committed device array
+        later would force a device→host sync per step."""
+        from .core.lod import LoDTensor
+        from .core.dtypes import check_int32_bounds
+        staged = {}
+        for k, v in feed.items():
+            if isinstance(v, LoDTensor):
+                # LoDTensors pass through intact (the Executor unpacks
+                # data + lengths)
+                staged[k] = v
+                continue
+            a = np.ascontiguousarray(v)
+            if a.dtype == np.int64:
+                check_int32_bounds(a, k)
+            staged[k] = jax.device_put(a)
+        return staged
+
     def __iter__(self):
         q = queue.Queue(maxsize=self._capacity)
         end = object()
         err_box = []
+        stop = threading.Event()   # consumer abandoned iteration
 
         def producer():
-            from .core.lod import LoDTensor
             try:
                 for feed in self._batch_reader():
-                    # LoDTensors pass through intact (the Executor unpacks
-                    # data + lengths); dense arrays stage onto the device
-                    staged = {k: (v if isinstance(v, LoDTensor) else
-                                  jax.device_put(np.ascontiguousarray(v)))
-                              for k, v in feed.items()}
+                    if stop.is_set():
+                        return
+                    staged = self._stage(feed)
                     if _obs._ENABLED:
                         _obs.inc('dataloader_staged_bytes',
                                  sum(getattr(v, 'nbytes', 0)
                                      for v in staged.values()),
                                  help='bytes staged host→device by the '
                                       'DataLoader producer thread')
-                    q.put(staged)
+                    # bounded put that notices abandonment: a consumer that
+                    # broke out of iteration early must not leave this
+                    # thread blocked on a full ring holding staged device
+                    # buffers forever
+                    while True:
+                        try:
+                            q.put(staged, timeout=0.05)
+                            break
+                        except queue.Full:
+                            if stop.is_set():
+                                return
             except BaseException as e:   # surface in the consumer, not stderr
                 err_box.append(e)
             finally:
-                q.put(end)
+                # the `end` sentinel must reach a still-listening consumer
+                # even after an exception (never deadlock its q.get());
+                # with the consumer gone, stop is set and we just exit
+                while not stop.is_set():
+                    try:
+                        q.put(end, timeout=0.05)
+                        break
+                    except queue.Full:
+                        pass
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name='paddle_tpu_dataloader_producer')
         t.start()
-        while True:
-            if _obs._ENABLED:
-                # consumer-side input starvation: time blocked on the ring.
-                # A well-fed loop keeps this near zero; a starved one makes
-                # the device wait on the host (arXiv:1909.09756's per-step
-                # input-wait signal). wait_seconds_total / wall time is the
-                # starvation fraction telemetry_report.py prints.
-                t0 = time.perf_counter()
-                item = q.get()
-                wait = time.perf_counter() - t0
-                _obs.observe('dataloader_wait_seconds', wait,
-                             help='consumer wait per batch on the prefetch '
-                                  'ring (input starvation)')
-                _obs.inc('dataloader_wait_seconds_total', wait,
-                         help='cumulative consumer input-starvation wait')
-                _obs.set_gauge('dataloader_last_wait_seconds', wait,
-                               help='most recent per-batch input wait')
-                if item is not end:
-                    _obs.inc('dataloader_batches',
-                             help='batches yielded by DataLoader')
-            else:
-                item = q.get()
-            if item is end:
-                if err_box:
-                    raise err_box[0]
-                break
-            if self._return_list:
-                yield [item[k] for k in item]
-            else:
-                yield item
+        try:
+            while True:
+                if _obs._ENABLED:
+                    # consumer-side input starvation: time blocked on the
+                    # ring. A well-fed loop keeps this near zero; a starved
+                    # one makes the device wait on the host
+                    # (arXiv:1909.09756's per-step input-wait signal).
+                    # wait_seconds_total / wall time is the starvation
+                    # fraction telemetry_report.py prints.
+                    t0 = time.perf_counter()
+                    item = q.get()
+                    wait = time.perf_counter() - t0
+                    _obs.observe('dataloader_wait_seconds', wait,
+                                 help='consumer wait per batch on the '
+                                      'prefetch ring (input starvation)')
+                    _obs.inc('dataloader_wait_seconds_total', wait,
+                             help='cumulative consumer input-starvation wait')
+                    _obs.set_gauge('dataloader_last_wait_seconds', wait,
+                                   help='most recent per-batch input wait')
+                    if item is not end:
+                        _obs.inc('dataloader_batches',
+                                 help='batches yielded by DataLoader')
+                else:
+                    item = q.get()
+                if item is end:
+                    if err_box:
+                        raise err_box[0]
+                    break
+                if self._return_list:
+                    yield [item[k] for k in item]
+                else:
+                    yield item
+        finally:
+            # normal exhaustion, an exception, or GeneratorExit (consumer
+            # broke early): signal the producer and drain the ring so its
+            # staged buffers free and the thread exits promptly
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
 
     def __call__(self):
         return iter(self)

@@ -107,15 +107,18 @@ def test_uninitialized_persistable_raises():
                     fetch_list=[out])
 
 
-def test_return_numpy_false_returns_device_arrays():
+def test_return_numpy_false_returns_fetch_handles():
     main, startup, out = _linear_prog('rn')
     exe = fluid.Executor()
     exe.run(startup)
     r = exe.run(main, feed={'rn_x': np.ones((2, 3), 'float32')},
                 fetch_list=[out], return_numpy=False)[0]
-    import jax
-    assert isinstance(r, jax.Array)
+    # non-blocking fetch: a FetchHandle over the on-device array —
+    # np.asarray is the materialization point
+    assert isinstance(r, fluid.FetchHandle)
+    assert not r.materialized
     np.testing.assert_allclose(np.asarray(r), 3.0)
+    assert r.materialized
 
 
 def test_prune_keeps_only_needed_ops():

@@ -1,0 +1,168 @@
+"""Reader/DataLoader thread-lifecycle regressions (async-pipeline PR):
+
+- `reader.buffered()` deadlock: an exception in the fill thread used to die
+  without enqueuing the `end` sentinel, leaving the consumer blocked on
+  q.get() forever — it must now propagate to the consumer;
+- DataLoader producer-thread leak: a consumer that breaks out of iteration
+  early used to leave the producer blocked on q.put holding staged device
+  buffers — it must now notice abandonment and exit."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import reader as R
+
+
+def _run_with_deadline(fn, seconds=10.0):
+    """Run `fn` on a worker so a regression deadlock fails the test instead
+    of hanging the suite. Returns fn's result, re-raises its exception."""
+    box = {}
+
+    def work():
+        try:
+            box['result'] = fn()
+        except BaseException as e:
+            box['error'] = e
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(seconds)
+    assert not t.is_alive(), 'deadlock: worker still blocked at deadline'
+    if 'error' in box:
+        raise box['error']
+    return box.get('result')
+
+
+# ---------------------------------------------------------------------------
+# buffered(): producer exception propagation
+# ---------------------------------------------------------------------------
+
+def test_buffered_propagates_producer_exception():
+    def bad_reader():
+        yield 1
+        yield 2
+        raise ValueError('reader exploded')
+
+    def consume():
+        got = []
+        with pytest.raises(ValueError, match='reader exploded'):
+            for item in R.buffered(bad_reader, size=2)():
+                got.append(item)
+        return got
+
+    got = _run_with_deadline(consume)
+    assert got == [1, 2]          # items before the failure still arrive
+
+
+def test_buffered_immediate_failure_does_not_deadlock():
+    def bad_reader():
+        raise RuntimeError('fails before first item')
+        yield  # pragma: no cover
+
+    def consume():
+        with pytest.raises(RuntimeError, match='fails before first item'):
+            list(R.buffered(bad_reader, size=1)())
+
+    _run_with_deadline(consume)
+
+
+def test_buffered_normal_path_unchanged():
+    out = _run_with_deadline(
+        lambda: list(R.buffered(lambda: iter(range(7)), size=3)()))
+    assert out == list(range(7))
+
+
+# ---------------------------------------------------------------------------
+# DataLoader: producer thread exits when the consumer abandons iteration
+# ---------------------------------------------------------------------------
+
+def _producer_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith('paddle_tpu_dataloader_producer')
+            and t.is_alive()]
+
+
+def _wait_no_producers(timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not _producer_threads():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_dataloader_early_break_releases_producer():
+    produced = []
+
+    def gen():
+        for i in range(100):
+            produced.append(i)
+            yield {'z': np.full((2, 2), i, np.float32)}
+
+    # capacity 1 guarantees the producer is parked in q.put when the
+    # consumer walks away
+    loader = fluid.DataLoader.from_generator(capacity=1)
+    loader.set_batch_generator(gen)
+
+    def consume():
+        for i, batch in enumerate(loader()):
+            if i == 1:
+                break                      # abandon mid-stream
+        return True
+
+    _run_with_deadline(consume)
+    assert _wait_no_producers(), \
+        'producer thread leaked after consumer break'
+    # the producer stopped early instead of draining all 100 batches
+    assert len(produced) < 100
+
+
+def test_dataloader_generator_close_releases_producer():
+    loader = fluid.DataLoader.from_generator(capacity=1)
+    loader.set_batch_generator(
+        lambda: ({'z': np.zeros((2,), np.float32)} for _ in range(50)))
+
+    def consume():
+        it = iter(loader())
+        next(it)
+        it.close()                        # explicit GeneratorExit
+        return True
+
+    _run_with_deadline(consume)
+    assert _wait_no_producers(), \
+        'producer thread leaked after generator close'
+
+
+def test_dataloader_exception_still_surfaces_in_consumer():
+    def gen():
+        yield {'z': np.zeros((2,), np.float32)}
+        raise ValueError('producer failed mid-stream')
+
+    loader = fluid.DataLoader.from_generator(capacity=2)
+    loader.set_batch_generator(gen)
+
+    def consume():
+        with pytest.raises(ValueError, match='producer failed mid-stream'):
+            for _ in loader():
+                pass
+
+    _run_with_deadline(consume)
+    assert _wait_no_producers()
+
+
+def test_dataloader_int64_bounds_checked_at_staging():
+    # staging-time bounds check (reader.py _stage): values beyond int32
+    # must fail loudly in the consumer, not wrap silently on device
+    loader = fluid.DataLoader.from_generator(capacity=2)
+    loader.set_batch_generator(
+        lambda: iter([{'ids': np.array([2 ** 40], np.int64)}]))
+
+    def consume():
+        with pytest.raises(OverflowError, match='int32'):
+            for _ in loader():
+                pass
+
+    _run_with_deadline(consume)

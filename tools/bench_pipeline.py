@@ -1,0 +1,222 @@
+"""Async train-loop pipeline A/B (PERF.md §12).
+
+The scenario the pipeline targets: a HOST-BOUND reader (each batch costs
+I/O-shaped host latency — disk/network/decode time, simulated here with a
+sleep sized relative to the step's device time) feeding a COMPUTE-BOUND
+static training step. The synchronous loop serializes the two — every
+`Executor.run` ends in a blocking `np.asarray` per fetch, so a step costs
+reader + compute + D2H. The async pipeline (`PADDLE_TPU_ASYNC`,
+executor.py) returns non-blocking FetchHandles and keeps K=2 dispatched
+steps in flight, so reader time for step N+1 overlaps device execution of
+step N: steady state approaches max(reader, compute) instead of the sum.
+
+Measures, on the SAME program/executor/feeds (one compile, shared by both
+modes since async is not part of the step-cache key):
+
+- steady-state steps/s, sync (`PADDLE_TPU_ASYNC=0`, `return_numpy=True`)
+  vs async (K in flight, handles materialized at the end);
+- bitwise identity of every fetched loss between the modes (the pipeline
+  reorders HOST work only — the dispatched computation stream, its RNG
+  folding, and the donation schedule are identical);
+- the measured per-step compute and the injected reader latency, so the
+  theoretical ceiling ((reader + compute) / max(reader, compute)) is
+  printed next to the achieved speedup.
+
+Valid on CPU — the quantity under test is host/device overlap, not FLOPs:
+
+  JAX_PLATFORMS=cpu python tools/bench_pipeline.py [--smoke] [--steps N]
+      [--io-scale 1.0] [--k 2]
+
+Acceptance (tier-1, tests/framework/test_bench_pipeline.py): async ≥ 1.3×
+sync steps/s at smoke sizes with bitwise-identical losses.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable as `python tools/bench_pipeline.py` from the repo root
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_mlp(smoke=False):
+    """MNIST-shaped MLP regression under SGD — compute-bound, RNG-free
+    (no dropout), so sync/async parity is bitwise by construction.
+    Returns (main, startup, feeds(list), loss)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers as L
+    # sized so per-step device compute dominates the executor's host-side
+    # dispatch cost (a few ms) — the overlap under test needs a
+    # compute-bound step, not a dispatch-bound one
+    width, depth, bs = (1024, 8, 256) if smoke else (1536, 8, 384)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data('pipe_x', [784], dtype='float32')
+        y = L.data('pipe_y', [1], dtype='float32')
+        h = x
+        for _ in range(depth):
+            h = L.fc(h, size=width, act='relu')
+        pred = L.fc(h, size=1)
+        loss = L.reduce_mean(L.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=1e-3).minimize(loss)
+    return main, startup, bs, loss
+
+
+def _make_feeds(bs, steps, seed=0):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    return [{'pipe_x': rng.randn(bs, 784).astype(np.float32),
+             'pipe_y': rng.randn(bs, 1).astype(np.float32)}
+            for _ in range(steps)]
+
+
+def _snapshot_state(program, scope):
+    import numpy as np
+    return {v.name: np.asarray(scope.find(v.name))
+            for v in program.list_vars() if v.persistable}
+
+
+def _restore_state(snap, scope):
+    import jax.numpy as jnp
+    for n, v in snap.items():
+        scope.set(n, jnp.asarray(v))
+
+
+def _run_phase(exe, main, loss, feeds, io_s, mode_env):
+    """One timed loop: simulated-I/O reader + Executor.run per step, all
+    fetches materialized before the clock stops. Returns (seconds,
+    [loss bytes])."""
+    import numpy as np
+    os.environ['PADDLE_TPU_ASYNC'] = mode_env
+    results = []
+    t0 = time.perf_counter()
+    for feed in feeds:
+        time.sleep(io_s)          # host-bound reader: simulated I/O latency
+        results.append(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    got = [np.asarray(r) for r in results]     # async: drain the window
+    dt = time.perf_counter() - t0
+    return dt, [g.tobytes() for g in got]
+
+
+def measure_pipeline(smoke=False, steps=None, io_scale=1.0, k=2):
+    import numpy as np
+    import paddle_tpu as fluid
+
+    main, startup, bs, loss = build_mlp(smoke)
+    steps = steps or (8 if smoke else 16)
+    feeds = _make_feeds(bs, steps)
+    old_env = os.environ.get('PADDLE_TPU_ASYNC')
+    scope = fluid.Scope()
+    try:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            snap = _snapshot_state(main, scope)
+
+            # warm BOTH compiled variants (async runs copy-in/copy-out, and
+            # donation is part of the step-cache key, so sync and async
+            # compile separately) + measure per-step device compute (sync)
+            os.environ['PADDLE_TPU_ASYNC'] = '0'
+            exe.run(main, feed=feeds[0], fetch_list=[loss])
+            t0 = time.perf_counter()
+            for _ in range(2):
+                exe.run(main, feed=feeds[0], fetch_list=[loss])
+            compute_s = (time.perf_counter() - t0) / 2
+            io_s = max(compute_s * io_scale, 1e-3)
+            os.environ['PADDLE_TPU_ASYNC'] = str(k)
+            np.asarray(exe.run(main, feed=feeds[0], fetch_list=[loss])[0])
+
+            _restore_state(snap, scope)
+            sync_s, sync_losses = _run_phase(exe, main, loss, feeds, io_s,
+                                             '0')
+            _restore_state(snap, scope)
+            async_s, async_losses = _run_phase(exe, main, loss, feeds, io_s,
+                                               str(k))
+    finally:
+        if old_env is None:
+            os.environ.pop('PADDLE_TPU_ASYNC', None)
+        else:
+            os.environ['PADDLE_TPU_ASYNC'] = old_env
+
+    identical = sync_losses == async_losses
+    sync_sps = steps / sync_s
+    async_sps = steps / async_s
+    return {'bench': 'async_pipeline',
+            'steps': steps, 'k': k, 'batch': bs,
+            'io_ms': round(io_s * 1e3, 3),
+            'compute_ms': round(compute_s * 1e3, 3),
+            'sync_steps_per_s': round(sync_sps, 3),
+            'async_steps_per_s': round(async_sps, 3),
+            'speedup': round(async_sps / sync_sps, 3),
+            'theoretical_ceiling': round(
+                (io_s + compute_s) / max(io_s, compute_s), 3),
+            'bitwise_identical': bool(identical)}
+
+
+def measure_staged_feeds(smoke=False):
+    """Zero-copy staged-feed passthrough: a DataLoader loop under telemetry
+    must show every staged byte passed through the Executor without a
+    second device_put (`executor_feed_passthrough_bytes` ==
+    `dataloader_staged_bytes`)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import observability as obs
+
+    main, startup, bs, loss = build_mlp(smoke=True)
+    feeds = _make_feeds(bs, 4, seed=1)
+    x = main.global_block().var('pipe_x')
+    y = main.global_block().var('pipe_y')
+    loader = fluid.DataLoader.from_generator(feed_list=[x, y], capacity=4)
+    loader.set_batch_generator(
+        lambda: iter([(f['pipe_x'], f['pipe_y']) for f in feeds]))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        with obs.telemetry_guard(True):
+            obs.reset()
+            for batch in loader():
+                exe.run(main, feed=batch, fetch_list=[loss])
+            m = obs.registry.to_dict()
+    staged = sum(s['value']
+                 for s in m['dataloader_staged_bytes']['samples'])
+    passed = sum(s['value']
+                 for s in m.get('executor_feed_passthrough_bytes',
+                                {'samples': []})['samples'])
+    return {'bench': 'staged_feed_passthrough',
+            'staged_bytes': int(staged),
+            'passthrough_bytes': int(passed),
+            'zero_copy': bool(staged > 0 and passed == staged)}
+
+
+def measure_all(smoke=False, steps=None, io_scale=1.0, k=2):
+    return {'async_pipeline': measure_pipeline(smoke=smoke, steps=steps,
+                                               io_scale=io_scale, k=k),
+            'staged_feeds': measure_staged_feeds(smoke=smoke)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--smoke', action='store_true',
+                    help='tiny shapes / CI smoke sizes')
+    ap.add_argument('--steps', type=int, default=None,
+                    help='timed steps per mode')
+    ap.add_argument('--io-scale', type=float, default=1.0,
+                    help='reader latency as a fraction of measured step '
+                         'compute time')
+    ap.add_argument('--k', type=int, default=2,
+                    help='in-flight window depth for the async phase')
+    args = ap.parse_args()
+    for res in measure_all(smoke=args.smoke, steps=args.steps,
+                           io_scale=args.io_scale, k=args.k).values():
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -131,6 +131,41 @@ def summarize(metrics, trace, steps, top=10):
         lines.append('(no DataLoader batches recorded)')
     lines.append('')
 
+    # ---- async pipeline (non-blocking fetch handles) ----
+    mat = (metrics.get('fetch_materialize_seconds') or {}).get('samples', [])
+    mat_n = sum(s['count'] for s in mat)
+    lines.append('## Async pipeline')
+    if mat_n:
+        mat_s = sum(s['sum'] for s in mat)
+        passthrough = _counter(metrics, 'executor_feed_passthrough_bytes')
+        feed_bytes = _counter(metrics, 'executor_feed_bytes')
+        inflight = (metrics.get('executor_inflight_steps') or
+                    {}).get('samples', [])
+        # host time NOT hidden by the pipeline = D2H materialization waits
+        # + input starvation; the rest of the wall clock overlapped device
+        # compute with host work — the quantity the K-in-flight window
+        # exists to maximize (PERF.md §12)
+        blocked = mat_s + wait_total
+        lines += [f"materializations:      {int(mat_n)} "
+                  f"(total wait {mat_s:.4f}s, "
+                  f"mean {_ms(mat_s / mat_n)})",
+                  f"in-flight window:      "
+                  f"{int(inflight[0]['value']) if inflight else 0} "
+                  f"at last export"]
+        if feed_bytes:
+            lines.append(f"zero-copy staged feeds:"
+                         f" {passthrough / feed_bytes:.1%} of feed bytes "
+                         f"passed through without a second device_put")
+        if wall > 0:
+            lines.append(f"overlap fraction:      "
+                         f"{max(0.0, 1.0 - blocked / wall):.1%} of traced "
+                         f"wall time (1 − (materialize+input waits)/wall)")
+    else:
+        lines.append('(no FetchHandle materializations recorded — '
+                     'synchronous loop; set PADDLE_TPU_ASYNC=1 or '
+                     'ExecutionStrategy.num_inflight_steps>1)')
+    lines.append('')
+
     # ---- compile-time breakdown ----
     lines.append('## Compile-time breakdown')
     any_compile = False
