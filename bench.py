@@ -365,6 +365,18 @@ def bench_serving_batcher(on_tpu):
     return measure_all(smoke=not on_tpu)
 
 
+def bench_decode_engine(on_tpu):
+    """Stateful decode engine bench (PERF.md §13): uncached whole-sequence
+    greedy vs the paged-KV continuous-batching engine vs drain-then-refill
+    wave batching, on a heavy-tailed mixed-length workload — tokens/s,
+    slot occupancy, prefill/decode split, bitwise token parity. Valid on
+    CPU: the quantity under test is scheduling + shape discipline."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools'))
+    from bench_decode import measure_all
+    return measure_all(smoke=not on_tpu)
+
+
 def bench_async_pipeline(on_tpu):
     """Async train-loop pipeline A/B (PERF.md §12): host-bound reader +
     compute-bound step, sync (per-step np.asarray) vs the K=2 in-flight
@@ -495,6 +507,16 @@ def main():
         summary.update(
             serving_batcher_speedup=sv['batcher']['speedup_vs_serial'],
             serving_batcher_p99_ms=sv['batcher']['p99_ms'])
+
+    de = run("decode_engine", lambda: bench_decode_engine(on_tpu))
+    if de is not None:
+        emit({"metric": "decode_engine",
+              "uncached": de['uncached'], "continuous": de['continuous'],
+              "drain": de['drain']})
+        summary.update(
+            decode_continuous_vs_drain=de['continuous']['speedup_vs_drain'],
+            decode_tokens_per_s=de['continuous']['tokens_per_s'],
+            decode_bitwise=de['continuous']['bitwise_equal'])
 
     pl = run("async_pipeline", lambda: bench_async_pipeline(on_tpu))
     if pl is not None:

@@ -3,6 +3,8 @@ from .lenet import LeNet, build_static_lenet
 from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet101,
                      ResNet152)
 from .bert import (BertConfig, BertModel, BertForPretraining, pretrain_loss)
+from .causal_lm import (CausalLMConfig, TransformerLM, lm_loss,
+                        greedy_generate)
 from .transformer import (TransformerConfig, Transformer, transformer_loss,
                           greedy_decode, beam_search_decode)
 from .vision import (MobileNetV1, MobileNetV2, VGG, TSM, DCGenerator,

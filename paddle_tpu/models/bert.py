@@ -84,7 +84,13 @@ class MultiHeadAttention(Layer):
                 f"{cfg.attention_probs_dropout_prob} is ignored); set it "
                 "to 0 to silence this warning", stacklevel=2)
 
-    def forward(self, x, attn_bias=None):
+    def forward(self, x, attn_bias=None, causal=False, cache=None):
+        """``causal``: additive upper-triangular mask (decoder-only LMs —
+        models/causal_lm.py). ``cache``: a duck-typed paged-KV-cache context
+        (serving/decode/kv_cache.py) whose ``attend(q, k, v, sm_scale=...)``
+        writes this layer's K/V into cache blocks and attends through the
+        block table — prefill writes the whole prompt, decode steps run at
+        fixed single-token shape, so generation never re-runs the prefix."""
         b, s, h = x.shape
 
         def heads(t):
@@ -95,18 +101,29 @@ class MultiHeadAttention(Layer):
         q = heads(self.q(x))
         k = heads(self.k(x))
         v = heads(self.v(x))
-        if self._fused:
+        if cache is not None:
+            # incremental-decode path: K/V land in the paged cache; the
+            # cache context picks prefill vs decode attention (causal is
+            # implied by the cache's context lengths)
+            ctx = cache.attend(q, k, v,
+                               sm_scale=1.0 / math.sqrt(self.d_head))
+        elif self._fused:
             # one fused kernel (ops/nn_ops.py:fused_attention — pallas
             # flash attention on TPU); attention-prob dropout is skipped
             ctx = dispatch_op('fused_attention',
                               {'q': q, 'k': k, 'v': v, 'bias': attn_bias},
-                              {'sm_scale': 1.0 / math.sqrt(self.d_head)})
+                              {'sm_scale': 1.0 / math.sqrt(self.d_head),
+                               'causal': causal})
         else:
             scores = dispatch_op('matmul', {'x': q, 'y': k},
                                  {'transpose_y': True,
                                   'alpha': 1.0 / math.sqrt(self.d_head)})
             if attn_bias is not None:
                 scores = scores + attn_bias
+            if causal:
+                mask = np.triu(np.full((s, s), -1e9, 'float32'), 1)
+                scores = scores + Tensor(mask[None, None],
+                                         stop_gradient=True)
             probs = dispatch_op('softmax', {'x': scores}, {})
             probs = self.drop(probs)
             ctx = dispatch_op('matmul', {'x': probs, 'y': v}, {})
@@ -128,8 +145,8 @@ class TransformerLayer(Layer):
         self.drop = Dropout(cfg.hidden_dropout_prob,
                             dropout_implementation='upscale_in_train')
 
-    def forward(self, x, attn_bias=None):
-        a = self.attn(x, attn_bias)
+    def forward(self, x, attn_bias=None, causal=False, cache=None):
+        a = self.attn(x, attn_bias, causal=causal, cache=cache)
         x = self.attn_ln(x + self.drop(a))
         f = self.ffn2(self.ffn1(x))
         return self.ffn_ln(x + self.drop(f))

@@ -261,17 +261,28 @@ def transformer_loss(logits, labels, pad_id=0, label_smooth_eps=0.1):
 
 
 def greedy_decode(model, src_ids, bos_id, eos_id, max_len=32, src_mask=None):
-    """Fixed-trip greedy decode; returns (B, max_len) int64 ids."""
+    """Fixed-trip greedy decode; returns (B, max_len) int64 ids (eos-padded
+    past each row's stop).
+
+    Shape discipline: the decoder runs every step at ONE fixed
+    (B, max_len+1) shape and step t reads the logits column t — the causal
+    mask makes that column depend only on tokens 0..t, so the eos padding
+    in the unwritten tail never leaks in. The original grew ``ys`` by one
+    token per step, which re-traced and re-compiled a fresh program for
+    every generated length (tests/models/test_decode_retrace.py asserts
+    the compile count now stays flat via the eager kernel-cache
+    counters)."""
     enc = model.encode(src_ids, src_mask)
     B = src_ids.shape[0]
-    ys = np.full((B, 1), bos_id, np.int64)
+    ys = np.full((B, max_len + 1), eos_id, np.int64)
+    ys[:, 0] = bos_id
     done = np.zeros(B, bool)
-    for _ in range(max_len):
+    for t in range(max_len):
         logits = model.decode(Tensor(ys, stop_gradient=True), enc, src_mask)
-        nxt = np.asarray(logits.numpy())[:, -1].argmax(-1)
+        nxt = np.asarray(logits.numpy())[:, t].argmax(-1)
         nxt = np.where(done, eos_id, nxt)
         done |= (nxt == eos_id)
-        ys = np.concatenate([ys, nxt[:, None].astype(np.int64)], 1)
+        ys[:, t + 1] = nxt
         if done.all():
             break
     return ys[:, 1:]
@@ -280,7 +291,11 @@ def greedy_decode(model, src_ids, bos_id, eos_id, max_len=32, src_mask=None):
 def beam_search_decode(model, src_ids, bos_id, eos_id, beam_size=4,
                        max_len=32, src_mask=None, alpha=0.6):
     """Beam search over the decoder (ref: the transformer model's
-    fast_decode path). Dense (B*W) beams, fixed max_len trip count."""
+    fast_decode path). Dense (B*W) beams, fixed max_len trip count, and —
+    like greedy_decode above — ONE fixed (B*W, max_len+1) decoder shape
+    for every step (step t reads logits column t; beam reordering gathers
+    host-side rows of the fixed buffer), so the whole search costs a
+    single decoder compile instead of one per generated length."""
     enc = model.encode(src_ids, src_mask)
     B = src_ids.shape[0]
     W = beam_size
@@ -291,14 +306,15 @@ def beam_search_decode(model, src_ids, bos_id, eos_id, beam_size=4,
         m_np = np.asarray(src_mask.numpy() if hasattr(src_mask, 'numpy')
                           else src_mask)
         mask_t = Tensor(np.repeat(m_np, W, axis=0), stop_gradient=True)
-    ys = np.full((B * W, 1), bos_id, np.int64)
+    ys = np.full((B * W, max_len + 1), eos_id, np.int64)
+    ys[:, 0] = bos_id
     scores = np.tile(np.array([0.0] + [-1e9] * (W - 1), np.float32), B)
     finished = np.zeros(B * W, bool)
     for t in range(max_len):
         logits = model.decode(Tensor(ys, stop_gradient=True), enc_t, mask_t)
         logp = np.asarray(
             dispatch_op('log_softmax',
-                        {'x': logits}, {}).numpy())[:, -1]    # (B*W, V)
+                        {'x': logits}, {}).numpy())[:, t]     # (B*W, V)
         V = logp.shape[-1]
         # finished beams only extend with eos at score 0
         fin_row = np.full(V, -1e9, np.float32)
@@ -310,8 +326,8 @@ def beam_search_decode(model, src_ids, bos_id, eos_id, beam_size=4,
         scores = np.take_along_axis(total, top, 1).reshape(-1)
         beam_idx = top // V + np.arange(B)[:, None] * W
         tok = (top % V).astype(np.int64)
-        ys = np.concatenate([ys[beam_idx.reshape(-1)],
-                             tok.reshape(-1, 1)], 1)
+        ys = ys[beam_idx.reshape(-1)]
+        ys[:, t + 1] = tok.reshape(-1)
         finished = finished[beam_idx.reshape(-1)] | \
             (tok.reshape(-1) == eos_id)
         if finished.all():

@@ -581,7 +581,68 @@ def norm(x, *, axis=-1, epsilon=1e-10):
     return x / n, n
 
 
-_FLASH_FALLBACK_WARNED = False
+# ---------------------------------------------------------------------------
+# Fused / paged attention and their pallas-unavailable fallback accounting.
+#
+# Both attention ops prefer a pallas TPU kernel and fall back to an XLA
+# formulation elsewhere (or on kernel shape rejection). The fallback is
+# counted, not shouted: ONE process-wide warning through log_helper (the op
+# bodies run at trace time under the eager kernel cache / jit, so a warning
+# per call would really be a warning per compiled shape — still log spam in
+# a server that compiles a prefill ladder), and a counter of fallback traces
+# exposed via pallas_fallback_stats() plus an at-export `attention_pallas_
+# fallbacks` gauge in the telemetry registry.
+# ---------------------------------------------------------------------------
+
+_PALLAS_FALLBACKS = {'warned': False, 'count': 0, 'last': ''}
+
+
+def _pallas_fallback(kernel_name, exc, shape):
+    _PALLAS_FALLBACKS['count'] += 1
+    _PALLAS_FALLBACKS['last'] = (
+        f'{kernel_name} q{tuple(shape)} {type(exc).__name__}: '
+        f'{str(exc)[:200]}')
+    if not _PALLAS_FALLBACKS['warned']:
+        _PALLAS_FALLBACKS['warned'] = True
+        import logging
+        from ..log_helper import get_logger
+        get_logger(__name__, logging.WARNING).warning(
+            "%s: pallas kernel unavailable for q%s (%s: %s); falling back "
+            "to the XLA formulation. Warning once per process; further "
+            "fallbacks are counted (ops.nn_ops.pallas_fallback_stats / the "
+            "attention_pallas_fallbacks gauge).",
+            kernel_name, tuple(shape), type(exc).__name__, str(exc)[:200])
+
+
+def pallas_fallback_stats():
+    """{'count': fallback traces (≈ one per compiled shape), 'warned': bool,
+    'last': last fallback reason} for fused_attention + paged_attention."""
+    return dict(_PALLAS_FALLBACKS)
+
+
+def reset_pallas_fallback_stats():
+    _PALLAS_FALLBACKS.update(warned=False, count=0, last='')
+
+
+def _collect_pallas_fallback_gauge():
+    from .. import observability as _obs
+    g = _obs.registry.gauge(
+        'attention_pallas_fallbacks',
+        'attention ops (fused_attention / paged_attention) that fell back '
+        'from the pallas TPU kernel to the XLA formulation, counted per '
+        'compiled shape')
+    g.set(float(_PALLAS_FALLBACKS['count']))
+
+
+def _register_fallback_collector():
+    try:
+        from .. import observability as _obs
+        _obs.registry.register_collector(_collect_pallas_fallback_gauge)
+    except Exception:   # circular-import-safe: the gauge is best-effort
+        pass
+
+
+_register_fallback_collector()
 
 
 @register_op('fused_attention')
@@ -609,15 +670,7 @@ def fused_attention(q, k, v, bias=None, *, sm_scale=1.0, causal=False):
             return flash_attention(q, k, v, ab=ab, causal=causal,
                                    sm_scale=float(sm_scale))
         except Exception as e:   # kernel shape rejection → XLA fallback
-            global _FLASH_FALLBACK_WARNED
-            if not _FLASH_FALLBACK_WARNED:
-                _FLASH_FALLBACK_WARNED = True
-                import logging
-                logging.getLogger(__name__).warning(
-                    "fused_attention: pallas flash kernel unavailable for "
-                    "q%s (%s: %s); falling back to XLA attention, which "
-                    "materializes the SxS score tensor",
-                    tuple(q.shape), type(e).__name__, str(e)[:200])
+            _pallas_fallback('fused_attention', e, q.shape)
     scores = jnp.einsum('bhqd,bhkd->bhqk', q, k) * sm_scale
     if bias is not None:
         scores = scores + jnp.asarray(bias)
@@ -627,3 +680,120 @@ def fused_attention(q, k, v, bias=None, *, sm_scale=1.0, causal=False):
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum('bhqk,bhkd->bhqd', probs, v)
+
+
+@register_op('paged_attention')
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
+                    sm_scale=1.0, pages_per_compute_block=4):
+    """Single-token decode attention over a paged KV cache (the decode half
+    of the serving decode engine — docs/SERVING.md "Stateful decode";
+    kernel blueprint: Ragged Paged Attention, PAPERS.md arxiv 2604.15464).
+
+    - ``q``: (S, H, D) — one query token per decode slot.
+    - ``k_pages`` / ``v_pages``: (H, num_blocks, block_size, D) — the cache
+      pool. Block 0 is the scratch block (inactive slots point at it).
+    - ``block_tables``: (S, max_blocks_per_seq) int32 — each slot's cache
+      blocks in sequence order; tail entries beyond the context are
+      arbitrary valid block ids (masked by ``context_lens``).
+    - ``context_lens``: (S,) int32 — tokens to attend per slot, INCLUDING
+      the token written at position context_len-1 this step.
+
+    On TPU this dispatches the pallas paged-attention kernel
+    (jax.experimental.pallas.ops.tpu.paged_attention — ragged block walk,
+    no dense gather); elsewhere (and on kernel rejection, counted via
+    pallas_fallback_stats) the XLA fallback gathers the slot's blocks into
+    a dense (S, H, T, D) view and runs the batched-matmul → mask →
+    softmax → matmul sequence the unfused MultiHeadAttention path uses.
+    Masked key positions get *exactly-zero* probability mass (the mask
+    value underflows exp), and `jnp.matmul` rows are extent-independent on
+    XLA CPU (measured; einsum dot_general is NOT), so a decode step is
+    bitwise-identical to the matching row of a whole-sequence forward at
+    the same padded key extent, and stale values in reused blocks can
+    never bleed (0.0 × finite == 0.0)."""
+    import jax as _jax
+    q = jnp.asarray(q)
+    k_pages = jnp.asarray(k_pages)
+    v_pages = jnp.asarray(v_pages)
+    block_tables = jnp.asarray(block_tables, jnp.int32)
+    context_lens = jnp.asarray(context_lens, jnp.int32)
+    if _jax.default_backend() == 'tpu':
+        try:
+            from jax.experimental.pallas.ops.tpu.paged_attention import (
+                paged_attention as _tpu_paged_attention)
+            ppcb = min(int(pages_per_compute_block), block_tables.shape[1])
+            return _tpu_paged_attention(
+                q * jnp.asarray(sm_scale, q.dtype), k_pages, v_pages,
+                context_lens, block_tables,
+                pages_per_compute_block=max(ppcb, 1))
+        except Exception as e:   # kernel shape rejection → XLA fallback
+            _pallas_fallback('paged_attention', e, q.shape)
+    s, h, d = q.shape
+    k = _gather_pages(k_pages, block_tables, s, h, d)
+    v = _gather_pages(v_pages, block_tables, s, h, d)
+    t_pad = k.shape[2]
+    # same op sequence as the unfused MHA path (matmul·α → mask → softmax
+    # → matmul), q extent 1: bitwise-equal to the whole-sequence rows
+    scores = jnp.matmul(q[:, :, None, :], jnp.swapaxes(k, -1, -2))
+    if sm_scale != 1.0:
+        scores = scores * jnp.asarray(sm_scale, scores.dtype)
+    valid = jnp.arange(t_pad, dtype=jnp.int32)[None, None, None, :] \
+        < context_lens[:, None, None, None]
+    scores = jnp.where(valid, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.matmul(probs, v)
+    return out.reshape(s, h, d)
+
+
+def _gather_pages(pages, block_tables, s, h, d):
+    """(H, NB, BS, D) cache pool + (S, nbs) tables → dense (S, H, nbs·BS, D)
+    per-slot key/value view (the XLA stand-in for the kernel's block walk)."""
+    nb = block_tables.shape[1]
+    bs = pages.shape[2]
+    g = jnp.take(pages, block_tables.reshape(-1), axis=1)
+    g = g.reshape(h, s, nb, bs, d).transpose(1, 0, 2, 3, 4)
+    return g.reshape(s, h, nb * bs, d)
+
+
+@register_op('paged_prefill_attention')
+def paged_prefill_attention(q, k, v, k_pages, v_pages, block_tables, *,
+                            sm_scale=1.0):
+    """Prefill-phase attention for the decode engine: causal whole-prompt
+    attention whose KEY EXTENT is the paged-cache view, so prefill rows are
+    bitwise-identical to the decode steps (and to a whole-sequence forward
+    at the engine's padded context length) that later attend to the same
+    cache through `paged_attention`.
+
+    - ``q``/``k``/``v``: (B, H, Lq, D) — the bucket-padded prompt's
+      projections (the caller has ALREADY written k/v into the cache
+      blocks; they are passed for the TPU kernel path, which attends the
+      raw whole sequence without the gather).
+    - ``k_pages``/``v_pages``/``block_tables``: the cache view, as in
+      :func:`paged_attention` (tables (B, max_blocks_per_seq)).
+
+    Row r attends keys 0..r (causal). Rows past the real prompt length are
+    garbage-in-garbage-out: finite, never read, and overwritten by decode
+    steps before any masked read could see them."""
+    import jax as _jax
+    q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    if _jax.default_backend() == 'tpu':
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention)
+            return flash_attention(q, k, v, causal=True,
+                                   sm_scale=float(sm_scale))
+        except Exception as e:
+            _pallas_fallback('paged_prefill_attention', e, q.shape)
+    b, h, lq, d = q.shape
+    kd = _gather_pages(jnp.asarray(k_pages),
+                       jnp.asarray(block_tables, jnp.int32), b, h, d)
+    vd = _gather_pages(jnp.asarray(v_pages),
+                       jnp.asarray(block_tables, jnp.int32), b, h, d)
+    t_pad = kd.shape[2]
+    scores = jnp.matmul(q, jnp.swapaxes(kd, -1, -2))
+    if sm_scale != 1.0:
+        scores = scores * jnp.asarray(sm_scale, scores.dtype)
+    causal = jnp.arange(t_pad, dtype=jnp.int32)[None, None, None, :] \
+        <= jnp.arange(lq, dtype=jnp.int32)[None, None, :, None]
+    scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.matmul(probs, vd)

@@ -11,7 +11,12 @@ Three layers, composable or standalone:
   validation, per-request deadlines, ``Overloaded`` backpressure, and
   graceful draining shutdown.
 - :class:`ServingServer` (server.py) — stdlib ThreadingHTTPServer front end:
-  ``/predict`` (JSON), ``/healthz``, ``/metrics`` (Prometheus text).
+  ``/predict`` (JSON), ``/generate`` (chunked per-token streaming),
+  ``/healthz``, ``/metrics`` (Prometheus text).
+- **stateful decode** (decode/ — docs/SERVING.md "Stateful decode"):
+  :class:`DecodeEngine` + :class:`DecodeScheduler`, autoregressive
+  generation over a paged KV cache with slot-based continuous batching
+  and per-request :class:`GenerationStream` token streams.
 
 Quick start::
 
@@ -28,14 +33,18 @@ or the whole stack: ``python -m paddle_tpu.serving.server --model-dir …``.
 from __future__ import annotations
 
 from .errors import (DeadlineExceeded, EngineClosed, InvalidRequest,
-                     Overloaded, ServingError)
+                     Overloaded, OutOfBlocks, ServingError)
 from .engine import DEFAULT_MAX_BATCH, InferenceEngine, bucket_ladder
 from .batcher import (DEFAULT_BATCH_TIMEOUT_MS, DEFAULT_QUEUE_DEPTH,
                       MicroBatcher, PredictionFuture)
 from .server import ServingServer, create_server
+from .decode import (DecodeEngine, DecodeScheduler, GenerationStream,
+                     KVCachePool)
 
 __all__ = ['InferenceEngine', 'MicroBatcher', 'PredictionFuture',
            'ServingServer', 'create_server', 'bucket_ladder',
+           'DecodeEngine', 'DecodeScheduler', 'GenerationStream',
+           'KVCachePool',
            'ServingError', 'InvalidRequest', 'Overloaded', 'DeadlineExceeded',
-           'EngineClosed', 'DEFAULT_MAX_BATCH', 'DEFAULT_BATCH_TIMEOUT_MS',
-           'DEFAULT_QUEUE_DEPTH']
+           'EngineClosed', 'OutOfBlocks', 'DEFAULT_MAX_BATCH',
+           'DEFAULT_BATCH_TIMEOUT_MS', 'DEFAULT_QUEUE_DEPTH']

@@ -54,6 +54,8 @@ class PredictionFuture:
         self._done = threading.Event()
         self._value = None
         self._exc = None
+        self._callbacks = []
+        self._cb_lock = threading.Lock()
 
     def done(self):
         return self._done.is_set()
@@ -68,14 +70,41 @@ class PredictionFuture:
             raise self._exc
         return self._value
 
+    def add_done_callback(self, fn):
+        """``fn(future)`` runs when the outcome lands — on the completing
+        (batcher worker) thread, or immediately on the caller if already
+        done. Open-loop load generators use this to timestamp completions
+        without a waiter thread per in-flight request (tools/
+        bench_serving.py's Poisson section). Keep callbacks cheap: they
+        run on the serving hot path. Callback exceptions are swallowed."""
+        with self._cb_lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        self._run_callback(fn)
+
+    def _run_callback(self, fn):
+        try:
+            fn(self)
+        except Exception:
+            pass                     # a bench/observer bug must not poison
+                                     # the batch that completed this future
+
     # -- batcher-side completion (exactly once) ---------------------------
+    def _finish(self):
+        with self._cb_lock:
+            self._done.set()
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            self._run_callback(fn)
+
     def _set_result(self, value):
         self._value = value
-        self._done.set()
+        self._finish()
 
     def _set_exception(self, exc):
         self._exc = exc
-        self._done.set()
+        self._finish()
 
 
 class _Request:

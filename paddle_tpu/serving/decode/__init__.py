@@ -1,0 +1,40 @@
+"""Stateful decode engine: paged KV cache + slot-based continuous batching
++ streaming generation (docs/SERVING.md "Stateful decode").
+
+Layered on the PR-4 serving stack the way the ROADMAP's item 2 describes:
+
+- :class:`KVCachePool` (kv_cache.py) — fixed-size cache blocks, free-list
+  allocator, per-request :class:`BlockTable`s; sized once at engine start
+  (`PADDLE_TPU_DECODE_{SLOTS,BLOCK_SIZE,MAX_BLOCKS}`).
+- :class:`DecodeEngine` (engine.py) — prefill through a prompt bucket
+  ladder writes K/V into cache blocks; decode steps all S slots in
+  lockstep at ONE fixed shape through the ``paged_attention`` op, so the
+  compile count is independent of generated length.
+- :class:`DecodeScheduler` (scheduler.py) — slot-based continuous
+  batching: new requests admitted into freed slots every step (vs
+  drain-then-refill), bounded-queue backpressure, waiting deadlines,
+  graceful drain; per-request :class:`GenerationStream` token streams.
+- HTTP: ``POST /generate`` on :class:`serving.ServingServer` streams
+  tokens as chunked NDJSON (server.py).
+
+Quick start::
+
+    from paddle_tpu import serving
+    from paddle_tpu.models.causal_lm import CausalLMConfig, TransformerLM
+
+    engine = serving.DecodeEngine(TransformerLM(cfg), slots=8)
+    engine.warmup()
+    with serving.DecodeScheduler(engine) as sched:
+        for tok in sched.submit([1, 17, 4], max_new_tokens=32):
+            print(tok)                     # streams as they decode
+"""
+from __future__ import annotations
+
+from .kv_cache import (BlockAllocator, BlockTable, CacheContext, KVCachePool,
+                       DEFAULT_BLOCK_SIZE, DEFAULT_MAX_BLOCKS, DEFAULT_SLOTS)
+from .engine import DecodeEngine
+from .scheduler import DecodeScheduler, GenerationStream
+
+__all__ = ['BlockAllocator', 'BlockTable', 'CacheContext', 'KVCachePool',
+           'DecodeEngine', 'DecodeScheduler', 'GenerationStream',
+           'DEFAULT_SLOTS', 'DEFAULT_BLOCK_SIZE', 'DEFAULT_MAX_BLOCKS']

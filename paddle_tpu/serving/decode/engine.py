@@ -1,0 +1,210 @@
+"""DecodeEngine: phase-split stateful generation over a paged KV cache.
+
+The compile-count story (the whole point — models/transformer.py's original
+decode re-compiled per generated length):
+
+- **prefill** runs the prompt once at a bucket-ladder shape (reusing
+  serving.engine.bucket_ladder — powers of two up to ``max_prompt_len``),
+  writing its K/V into cache blocks: ≤ ``len(prompt_buckets)`` compiles,
+  ever.
+- **decode** steps all S slots in lockstep at ONE fixed shape
+  ((S, 1) tokens + (S, max_blocks_per_seq) tables + (S,) context lengths):
+  exactly one compile, regardless of how long any sequence runs.
+
+tests/framework/test_decode_engine.py asserts both bounds through the eager
+kernel-cache counters.
+
+Bitwise contract (CPU): each decode step's logits row equals the matching
+row of an uncached whole-sequence forward padded to ``padded_context`` —
+see ops/nn_ops.py:paged_attention and models/causal_lm.py for why the
+extent and the matmul formulation matter. ``check_parity`` in the tests and
+tools/bench_decode.py asserts it per request.
+
+The engine is single-threaded by design (one scheduler worker owns it);
+it holds no queueing or lifecycle logic — that is scheduler.py.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .. import metrics as _m
+from ..engine import bucket_ladder
+from ..errors import InvalidRequest
+from .kv_cache import (CacheContext, KVCachePool, DEFAULT_BLOCK_SIZE,
+                       DEFAULT_MAX_BLOCKS, DEFAULT_SLOTS)
+
+__all__ = ['DecodeEngine']
+
+
+class DecodeEngine:
+    """Stateful generation over ``model`` (anything with the
+    models/causal_lm.py forward contract: ``model(ids, pos_ids=None,
+    cache=None) -> logits``; attention layers must route ``cache=`` into
+    MultiHeadAttention).
+
+    - ``slots``: fixed lockstep decode batch size S.
+    - ``block_size`` / ``max_blocks``: KV-cache pool geometry.
+    - ``max_prompt_len``: top rung of the prefill bucket ladder.
+    - ``max_new_tokens_cap``: per-request generation budget cap (block
+      reservations are taken against prompt + budget at admission, so the
+      cap bounds what one request can strand).
+    """
+
+    def __init__(self, model, slots=None, block_size=None, max_blocks=None,
+                 max_prompt_len=64, max_new_tokens_cap=64,
+                 prompt_buckets=None, eos_id=None):
+        self.model = model
+        if hasattr(model, 'eval'):
+            model.eval()           # generation is inference: no dropout
+        self.slots = int(slots or DEFAULT_SLOTS)
+        self.max_prompt_len = int(max_prompt_len)
+        self.max_new_tokens_cap = int(max_new_tokens_cap)
+        self.eos_id = eos_id
+        self.prompt_buckets = bucket_ladder(self.max_prompt_len,
+                                            prompt_buckets)
+        block_size = int(block_size or DEFAULT_BLOCK_SIZE)
+        max_total = self.max_prompt_len + self.max_new_tokens_cap
+        max_bps = -(-max_total // block_size)
+        self.pool = KVCachePool(block_size=block_size,
+                                num_blocks=max_blocks or DEFAULT_MAX_BLOCKS,
+                                max_blocks_per_seq=max_bps)
+        if self.pool.allocator.capacity < max_bps:
+            # an empty pool must always cover one maximal request, or the
+            # scheduler's FIFO head could wait forever
+            raise ValueError(
+                f'max_blocks={self.pool.num_blocks} cannot hold one '
+                f'maximal request ({max_bps} blocks for '
+                f'{max_total} tokens at block_size={block_size})')
+        _m.decode_slots_total.set(self.slots)
+        _m.decode_cache_blocks_total.set(self.pool.allocator.capacity)
+        self._prefill_compiled = set()
+
+    # -- geometry ----------------------------------------------------------
+    @property
+    def block_size(self):
+        return self.pool.block_size
+
+    @property
+    def padded_context(self):
+        """The key extent every attention read pads to — run the uncached
+        reference (models/causal_lm.greedy_generate) at this pad_len for
+        bitwise-identical tokens."""
+        return self.pool.padded_context
+
+    def validate(self, prompt_ids, max_new_tokens):
+        """Typed admission checks; returns (prompt list, max_new int)."""
+        try:
+            prompt = [int(t) for t in prompt_ids]
+        except (TypeError, ValueError) as e:
+            raise InvalidRequest(f'prompt must be a sequence of ints: {e}')
+        if not prompt:
+            raise InvalidRequest('empty prompt')
+        if len(prompt) > self.max_prompt_len:
+            raise InvalidRequest(
+                f'prompt of {len(prompt)} tokens exceeds max_prompt_len='
+                f'{self.max_prompt_len}')
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise InvalidRequest(f'max_new_tokens must be >= 1, got '
+                                 f'{max_new}')
+        if max_new > self.max_new_tokens_cap:
+            raise InvalidRequest(
+                f'max_new_tokens={max_new} exceeds the engine cap '
+                f'{self.max_new_tokens_cap}')
+        return prompt, max_new
+
+    def reserve_table(self, prompt_len, max_new_tokens):
+        """Block reservation for prompt + budget (raises OutOfBlocks — the
+        scheduler treats that as 'wait for a finishing slot')."""
+        return self.pool.new_table(int(prompt_len) + int(max_new_tokens))
+
+    def release_table(self, table):
+        self.pool.free_table(table)
+        _m.decode_cache_blocks_used.set(self.pool.allocator.used)
+
+    # -- phases ------------------------------------------------------------
+    def prefill(self, prompt, table):
+        """Run the bucket-padded prompt once, writing K/V into ``table``'s
+        blocks, and return the FIRST generated token (greedy). Sets
+        ``table.context_len = len(prompt)``."""
+        from ...dygraph.tape import Tensor, no_grad_guard
+        P = len(prompt)
+        bucket = next(b for b in self.prompt_buckets if P <= b)
+        ids = np.zeros((1, bucket), np.int64)
+        ids[0, :P] = prompt
+        table.context_len = P
+        ctx = CacheContext(self.pool, 'prefill', [table])
+        t0 = time.perf_counter()
+        with no_grad_guard():
+            logits = self.model(Tensor(ids, stop_gradient=True), cache=ctx)
+            row = np.asarray(logits.numpy())[0, P - 1]
+        dt = time.perf_counter() - t0
+        _m.decode_prefill_seconds.observe(dt)
+        if bucket not in self._prefill_compiled:
+            self._prefill_compiled.add(bucket)
+            _m.decode_prefill_compiles.inc()
+        _m.decode_cache_blocks_used.set(self.pool.allocator.used)
+        return int(row.argmax())
+
+    def decode_step(self, tokens, tables):
+        """One lockstep step over all S slots at fixed shape.
+
+        ``tokens``: length-S list, the token to feed per slot (None =
+        inactive). ``tables``: length-S list of BlockTables (None =
+        inactive). For an active slot with context c, the fed token is the
+        one at position c (it was sampled from the previous step/prefill
+        but not yet cached); its K/V are written and attended this step.
+        Returns (S,) next-token ids (greedy; garbage on inactive slots) and
+        advances each active table's context_len by 1."""
+        from ...dygraph.tape import Tensor, no_grad_guard
+        S = self.slots
+        assert len(tokens) == S and len(tables) == S
+        ids = np.zeros((S, 1), np.int64)
+        pos = np.zeros((S, 1), np.int64)
+        ctx_lens = []
+        for s in range(S):
+            if tables[s] is None:
+                ctx_lens.append(1)          # scratch read, masked + ignored
+                continue
+            c = tables[s].context_len
+            ids[s, 0] = tokens[s]
+            pos[s, 0] = c
+            tables[s].context_len = c + 1   # the fed token becomes cached
+            ctx_lens.append(c + 1)
+        ctx = CacheContext(self.pool, 'decode', tables, ctx_lens)
+        t0 = time.perf_counter()
+        with no_grad_guard():
+            logits = self.model(Tensor(ids, stop_gradient=True),
+                                pos_ids=Tensor(pos, stop_gradient=True),
+                                cache=ctx)
+            out = np.asarray(logits.numpy())[:, 0].argmax(-1)
+        dt = time.perf_counter() - t0
+        _m.decode_step_seconds.observe(dt)
+        _m.decode_steps.inc()
+        active = sum(t is not None for t in tables)
+        _m.decode_slots_active.set(active)
+        _m.decode_slot_occupancy.observe(active / max(S, 1))
+        return out
+
+    # -- warmup ------------------------------------------------------------
+    def warmup(self):
+        """Precompile the prefill ladder + the decode-step shape before
+        traffic arrives (same contract as InferenceEngine.warmup). Returns
+        {phase: seconds}. Uses temporary blocks; the pool ends unchanged."""
+        timings = {}
+        for bucket in self.prompt_buckets:
+            table = self.reserve_table(bucket, 1)
+            t0 = time.perf_counter()
+            tok = self.prefill([1] * bucket, table)
+            timings[f'prefill_{bucket}'] = time.perf_counter() - t0
+            # one decode step over slot 0 also warms the step shape
+            tokens = [tok] + [None] * (self.slots - 1)
+            tables = [table] + [None] * (self.slots - 1)
+            t0 = time.perf_counter()
+            self.decode_step(tokens, tables)
+            timings.setdefault('decode_step',
+                               time.perf_counter() - t0)
+            self.release_table(table)
+        return timings

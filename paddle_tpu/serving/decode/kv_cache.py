@@ -1,0 +1,300 @@
+"""Paged KV-cache pool: fixed-size blocks, free-list allocator, per-request
+block tables (docs/SERVING.md "Stateful decode"; layout per the TPU paged-
+attention kernel: (num_kv_heads, num_blocks, block_size, head_dim)).
+
+Why paged: a contiguous per-request KV buffer must be sized for the WORST
+CASE length at admission, so short requests strand memory and long ones
+fragment it. Blocks fix both — a request holds exactly
+``ceil(context / block_size)`` blocks (plus its reservation), the free list
+recycles them the moment a slot finishes, and the attention ops read
+through the block table so the cache never moves.
+
+Sizing happens ONCE at engine start (`PADDLE_TPU_DECODE_{SLOTS,BLOCK_SIZE,
+MAX_BLOCKS}`); per-layer arrays allocate lazily on the first prefill (head
+count / head dim are discovered from the model's first K projection, so the
+pool needs no model config duplicated into it).
+
+Block 0 is the **scratch block**: never allocated, the padding target for
+inactive decode slots and short block tables. Writes to it are harmless
+(masked by context lengths — and masked probabilities are *exactly* zero in
+the XLA fallback, so stale block contents can never bleed between requests;
+tests/ops/test_paged_attention.py proves reuse-after-free is clean).
+
+Functional updates: jax arrays are immutable, so writes go through jitted
+scatters with the pool array DONATED — XLA updates in place instead of
+copying the pool per token (the same donation lever as PR 1's executor).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+import jax
+import numpy as np
+
+from ..errors import InvalidRequest, OutOfBlocks
+
+__all__ = ['BlockAllocator', 'BlockTable', 'KVCachePool', 'CacheContext',
+           'DEFAULT_SLOTS', 'DEFAULT_BLOCK_SIZE', 'DEFAULT_MAX_BLOCKS',
+           'SCRATCH_BLOCK']
+
+DEFAULT_SLOTS = int(os.environ.get('PADDLE_TPU_DECODE_SLOTS', '8'))
+DEFAULT_BLOCK_SIZE = int(os.environ.get('PADDLE_TPU_DECODE_BLOCK_SIZE', '16'))
+DEFAULT_MAX_BLOCKS = int(os.environ.get('PADDLE_TPU_DECODE_MAX_BLOCKS',
+                                        '256'))
+
+SCRATCH_BLOCK = 0
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_blocks(pages, block_ids, vals):
+    """pages (H, NB, BS, D) ← vals (H, nb, BS, D) at block_ids (nb,)."""
+    return pages.at[:, block_ids].set(vals)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_tokens(pages, block_ids, offsets, vals):
+    """pages (H, NB, BS, D) ← vals (H, S, D) at (block_ids, offsets) (S,)."""
+    return pages.at[:, block_ids, offsets].set(vals)
+
+
+class BlockAllocator:
+    """Free-list block allocator. Block 0 (scratch) is never handed out."""
+
+    def __init__(self, num_blocks):
+        if num_blocks < 2:
+            raise ValueError(f'need >= 2 blocks (1 scratch), got '
+                             f'{num_blocks}')
+        self.num_blocks = int(num_blocks)
+        self._free = list(range(self.num_blocks - 1, 0, -1))  # pop() -> 1..
+        self._lock = threading.Lock()
+
+    @property
+    def capacity(self):
+        return self.num_blocks - 1
+
+    @property
+    def available(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used(self):
+        return self.capacity - self.available
+
+    def allocate(self, n):
+        """n block ids, or raise :class:`OutOfBlocks` (nothing allocated)."""
+        n = int(n)
+        with self._lock:
+            if n > len(self._free):
+                raise OutOfBlocks(n, len(self._free))
+            return [self._free.pop() for _ in range(n)]
+
+    def free(self, block_ids):
+        with self._lock:
+            for b in block_ids:
+                b = int(b)
+                if b == SCRATCH_BLOCK:
+                    raise ValueError('freeing the scratch block')
+                if b in self._free:
+                    raise ValueError(f'double free of block {b}')
+                self._free.append(b)
+
+
+class BlockTable:
+    """One request's cache blocks, in sequence order. ``context_len`` is the
+    number of cached tokens (prompt + generated so far)."""
+
+    __slots__ = ('blocks', 'block_size', 'context_len')
+
+    def __init__(self, blocks, block_size):
+        self.blocks = list(blocks)
+        self.block_size = int(block_size)
+        self.context_len = 0
+
+    @property
+    def capacity_tokens(self):
+        return len(self.blocks) * self.block_size
+
+    def slot_for(self, position):
+        """(block_id, offset) holding token ``position``."""
+        if position >= self.capacity_tokens:
+            raise IndexError(
+                f'position {position} beyond the table\'s '
+                f'{self.capacity_tokens} reserved token slots')
+        return (self.blocks[position // self.block_size],
+                position % self.block_size)
+
+    def padded(self, max_blocks_per_seq):
+        """Block ids padded to the engine-wide table width with scratch."""
+        if len(self.blocks) > max_blocks_per_seq:
+            raise ValueError(
+                f'{len(self.blocks)} blocks exceed max_blocks_per_seq='
+                f'{max_blocks_per_seq}')
+        return self.blocks + [SCRATCH_BLOCK] * (max_blocks_per_seq
+                                                - len(self.blocks))
+
+
+class KVCachePool:
+    """Per-layer paged K/V arrays + the shared allocator.
+
+    ``max_blocks_per_seq`` fixes the batched block-table width — and with
+    it ``padded_context = max_blocks_per_seq * block_size``, the key extent
+    every attention read uses. The bitwise contract with whole-sequence
+    decode holds at exactly that padded length (see ops/nn_ops.py).
+    """
+
+    def __init__(self, block_size=None, num_blocks=None,
+                 max_blocks_per_seq=None, dtype='float32'):
+        self.block_size = int(block_size or DEFAULT_BLOCK_SIZE)
+        self.num_blocks = int(num_blocks or DEFAULT_MAX_BLOCKS)
+        self.max_blocks_per_seq = int(max_blocks_per_seq or 8)
+        self.dtype = dtype
+        self.allocator = BlockAllocator(self.num_blocks)
+        self._layers = {}          # layer idx -> [k_pages, v_pages]
+
+    @property
+    def padded_context(self):
+        return self.max_blocks_per_seq * self.block_size
+
+    @property
+    def num_layers(self):
+        return len(self._layers)
+
+    def new_table(self, total_tokens):
+        """Allocate a table holding ``total_tokens`` (prompt + budget).
+        Raises OutOfBlocks when the pool cannot cover it right now."""
+        nb = -(-int(total_tokens) // self.block_size)
+        if nb > self.max_blocks_per_seq:
+            raise InvalidRequest(
+                f'{total_tokens} tokens need {nb} blocks > '
+                f'max_blocks_per_seq={self.max_blocks_per_seq}')
+        return BlockTable(self.allocator.allocate(nb), self.block_size)
+
+    def free_table(self, table):
+        if table.blocks:
+            self.allocator.free(table.blocks)
+            table.blocks = []
+
+    def ensure_layer(self, layer, n_heads, head_dim):
+        if layer not in self._layers:
+            import jax.numpy as jnp
+            shape = (n_heads, self.num_blocks, self.block_size, head_dim)
+            self._layers[layer] = [jnp.zeros(shape, self.dtype),
+                                   jnp.zeros(shape, self.dtype)]
+        return self._layers[layer]
+
+    def pages(self, layer):
+        return self._layers[layer]
+
+    def write_prefill(self, layer, table, k, v):
+        """Write the prompt's K/V rows. ``k``/``v``: (H, L, D) — the bucket-
+        padded projections; rows are written for ``ceil(context/bs)`` whole
+        blocks (tail rows inside the last block are masked garbage until
+        decode overwrites them)."""
+        import jax.numpy as jnp
+        h, L, d = k.shape
+        pages = self.ensure_layer(layer, h, d)
+        nb_w = min(-(-table.context_len // self.block_size),
+                   len(table.blocks))
+        target = nb_w * self.block_size
+        if L < target:
+            pad = ((0, 0), (0, target - L), (0, 0))
+            k = jnp.pad(k, pad)
+            v = jnp.pad(v, pad)
+        ids = np.asarray(table.blocks[:nb_w], np.int32)
+        kb = k[:, :target].reshape(h, nb_w, self.block_size, d)
+        vb = v[:, :target].reshape(h, nb_w, self.block_size, d)
+        pages[0] = _scatter_blocks(pages[0], ids, kb)
+        pages[1] = _scatter_blocks(pages[1], ids, vb)
+
+    def write_tokens(self, layer, block_ids, offsets, k, v):
+        """One decode step's K/V: ``k``/``v`` (H, S, D) written at
+        (block_ids[s], offsets[s]) per slot. Inactive slots point at the
+        scratch block."""
+        h, s, d = k.shape
+        pages = self.ensure_layer(layer, h, d)
+        ids = np.asarray(block_ids, np.int32)
+        offs = np.asarray(offsets, np.int32)
+        pages[0] = _scatter_tokens(pages[0], ids, offs, k)
+        pages[1] = _scatter_tokens(pages[1], ids, offs, v)
+
+    # -- observability -----------------------------------------------------
+    def utilization(self):
+        return self.allocator.used / max(self.allocator.capacity, 1)
+
+
+class CacheContext:
+    """The duck-typed ``cache=`` object MultiHeadAttention calls into
+    (models/bert.py). One context per model forward; each attention layer's
+    ``attend(q, k, v, sm_scale=)`` call consumes the next layer index.
+
+    mode='prefill': q/k/v are (1, H, Lb, D) for one bucket-padded prompt —
+    K/V are written into the request's blocks, attention runs causal over
+    the paged view (`paged_prefill_attention`).
+
+    mode='decode': q/k/v are (S, H, 1, D), one token per slot — K/V land at
+    each slot's next position, attention reads through the batched block
+    tables (`paged_attention`) at fixed shape.
+    """
+
+    def __init__(self, pool, mode, tables, context_lens=None):
+        self.pool = pool
+        self.mode = mode
+        self.tables = tables          # prefill: [BlockTable]; decode: list
+        self.context_lens = context_lens
+        self._layer = 0
+        if mode == 'decode':
+            ids, offs, padded = [], [], []
+            for t, c in zip(tables, context_lens):
+                if t is None:                       # inactive slot
+                    ids.append(SCRATCH_BLOCK)
+                    offs.append(0)
+                    padded.append([SCRATCH_BLOCK]
+                                  * pool.max_blocks_per_seq)
+                else:
+                    b, o = t.slot_for(int(c) - 1)   # token written this step
+                    ids.append(b)
+                    offs.append(o)
+                    padded.append(t.padded(pool.max_blocks_per_seq))
+            self._write_ids = np.asarray(ids, np.int32)
+            self._write_offs = np.asarray(offs, np.int32)
+            self._batched_tables = np.asarray(padded, np.int32)
+            self._ctx = np.asarray(
+                [max(int(c), 1) for c in context_lens], np.int32)
+
+    def attend(self, q, k, v, sm_scale=1.0):
+        from ...dygraph.tape import Tensor, dispatch_op
+        layer = self._layer
+        self._layer += 1
+        kv = k.value if isinstance(k, Tensor) else k
+        vv = v.value if isinstance(v, Tensor) else v
+        if self.mode == 'prefill':
+            table = self.tables[0]
+            # (1, H, L, D) -> (H, L, D) rows for the block scatter
+            self.pool.write_prefill(layer, table, kv[0], vv[0])
+            k_pages, v_pages = self.pool.pages(layer)
+            bt = np.asarray([table.padded(self.pool.max_blocks_per_seq)],
+                            np.int32)
+            return dispatch_op(
+                'paged_prefill_attention',
+                {'q': q, 'k': k, 'v': v, 'k_pages': k_pages,
+                 'v_pages': v_pages, 'block_tables': bt},
+                {'sm_scale': float(sm_scale)})
+        # decode: (S, H, 1, D) -> (H, S, D) token rows
+        self.pool.write_tokens(layer, self._write_ids, self._write_offs,
+                               kv[:, :, 0].transpose(1, 0, 2),
+                               vv[:, :, 0].transpose(1, 0, 2))
+        k_pages, v_pages = self.pool.pages(layer)
+        q3 = dispatch_op('reshape', {'x': q},
+                         {'shape': [q.shape[0], q.shape[1], q.shape[3]]})
+        out = dispatch_op(
+            'paged_attention',
+            {'q': q3, 'k_pages': k_pages, 'v_pages': v_pages,
+             'block_tables': self._batched_tables,
+             'context_lens': self._ctx},
+            {'sm_scale': float(sm_scale)})
+        return dispatch_op('reshape', {'x': out},
+                           {'shape': [q.shape[0], q.shape[1], 1,
+                                      q.shape[3]]})

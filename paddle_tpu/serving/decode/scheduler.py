@@ -1,0 +1,379 @@
+"""Slot-based continuous-batching scheduler + per-request token streams.
+
+The scheduling model is S fixed decode slots stepped in lockstep:
+
+    submit() ─ validate ─▶ bounded waiting queue ─▶ worker loop, per step:
+                  │               │                   1. expire deadlines
+           InvalidRequest     Overloaded              2. admit waiting →
+           (never queued)    (queue full)                free slots (prefill)
+                                                      3. ONE decode step,
+                                                         all S slots
+                                                      4. emit tokens, free
+                                                         finished slots
+                                                      ▼
+                                          per-request GenerationStream
+
+**Continuous vs drain** (``admission=``): 'continuous' admits into freed
+slots every step — the batch never drains, so slot occupancy stays near 1
+under backlog. 'drain' (the strawman tools/bench_decode.py measures
+against) only admits when ALL slots are free: short requests finish early
+and their slots idle until the longest in the wave completes. The measured
+gap on a mixed-length workload is the PR's ≥1.5× acceptance bar
+(PERF.md §13).
+
+Admission takes the request's full block reservation (prompt + token
+budget) up front, so a generation can never die of OutOfBlocks mid-flight;
+when the pool can't cover the next waiting request the scheduler simply
+keeps stepping until a finishing slot frees blocks (FIFO admission — no
+starvation of big requests behind small ones).
+
+Deadlines bound WAITING only: once a request holds a slot it runs to
+completion (aborting mid-generation would waste the prefill — the
+ROADMAP's preemption item is about checkpointed resume, not dropping
+work). Backpressure and drain/fail-fast close mirror MicroBatcher.
+"""
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+
+from .. import metrics as _m
+from ..errors import (DeadlineExceeded, EngineClosed, Overloaded,
+                      OutOfBlocks, ServingError)
+from ..batcher import DEFAULT_QUEUE_DEPTH
+
+__all__ = ['DecodeScheduler', 'GenerationStream']
+
+_END = object()
+
+
+class GenerationStream:
+    """Per-request handle: iterate tokens as they decode, or block for the
+    full result.
+
+        for tok in stream:            # per-token streaming
+            ...
+        toks = stream.result(30)      # or: block until done
+
+    ``finish_reason``: 'stop' (eos) | 'length' (budget) | None while
+    running. Failures (engine error, deadline, shutdown) raise from both
+    the iterator and ``result()``."""
+
+    def __init__(self, prompt_len, max_new_tokens):
+        self.prompt_len = prompt_len
+        self.max_new_tokens = max_new_tokens
+        self._q = queue.Queue()
+        self._tokens = []
+        self._done = threading.Event()
+        self._exc = None
+        self.finish_reason = None
+
+    # -- consumer side -----------------------------------------------------
+    def __iter__(self):
+        return self.iter_tokens()
+
+    def iter_tokens(self, timeout=None):
+        """Yield token ids as they decode. ``timeout`` bounds the wait for
+        EACH token (TimeoutError) — the HTTP handler uses it so a stuck
+        stream cannot pin a connection thread forever."""
+        while True:
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f'no token within {timeout}s (generated '
+                    f'{len(self._tokens)} so far)')
+            if item is _END:
+                if self._exc is not None:
+                    raise self._exc
+                return
+            yield item
+
+    def result(self, timeout=None):
+        """All generated token ids; raises the request's failure."""
+        if not self._done.wait(timeout):
+            raise TimeoutError('generation not completed in time')
+        if self._exc is not None:
+            raise self._exc
+        return list(self._tokens)
+
+    def done(self):
+        return self._done.is_set()
+
+    @property
+    def tokens(self):
+        """Snapshot of tokens emitted so far."""
+        return list(self._tokens)
+
+    # -- scheduler side ----------------------------------------------------
+    def _emit(self, token):
+        self._tokens.append(int(token))
+        self._q.put(int(token))
+
+    def _finish(self, reason):
+        self.finish_reason = reason
+        self._done.set()
+        self._q.put(_END)
+
+    def _fail(self, exc):
+        self._exc = exc
+        self._done.set()
+        self._q.put(_END)
+
+
+class _Request:
+    __slots__ = ('prompt', 'max_new_tokens', 'eos_id', 'stream', 'deadline',
+                 'enqueued_at', 'table', 'next_token', 'generated')
+
+    def __init__(self, prompt, max_new_tokens, eos_id, deadline):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.stream = GenerationStream(len(prompt), max_new_tokens)
+        self.deadline = deadline
+        self.enqueued_at = time.monotonic()
+        self.table = None
+        self.next_token = None        # sampled but not yet cached/emitted?
+        self.generated = 0
+
+    def expired(self, now):
+        return self.deadline is not None and now > self.deadline
+
+
+class DecodeScheduler:
+    """Continuous-batching front end over a :class:`DecodeEngine`.
+
+    - ``queue_depth``: waiting-queue bound → typed ``Overloaded``.
+    - ``admission``: 'continuous' (default) | 'drain' (bench strawman).
+    - ``default_timeout_ms``: waiting deadline applied when submit() gets
+      none (None = wait forever).
+    """
+
+    def __init__(self, engine, queue_depth=DEFAULT_QUEUE_DEPTH,
+                 admission='continuous', default_timeout_ms=None,
+                 start=True):
+        if admission not in ('continuous', 'drain'):
+            raise ValueError(f"admission must be 'continuous' or 'drain', "
+                             f"got {admission!r}")
+        self.engine = engine
+        self.queue_depth = int(queue_depth)
+        self.admission = admission
+        self.default_timeout_ms = default_timeout_ms
+        self._waiting = collections.deque()
+        self._slots = [None] * engine.slots      # _Request | None
+        self._cv = threading.Condition()
+        self._closing = False
+        self._abort = False
+        self._closed = False
+        self._worker = threading.Thread(target=self._worker_loop,
+                                        name='paddle-tpu-decode-scheduler',
+                                        daemon=True)
+        if start:
+            self._worker.start()
+
+    # -- client side -------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=16, eos_id=None,
+               timeout_ms=None):
+        """Validate and enqueue one generation; returns its
+        :class:`GenerationStream`. Raises InvalidRequest / Overloaded /
+        EngineClosed (all pre-enqueue)."""
+        try:
+            prompt, max_new = self.engine.validate(prompt_ids,
+                                                   max_new_tokens)
+        except Exception:
+            _m.decode_requests_rejected_invalid.inc()
+            raise
+        if timeout_ms is None:
+            timeout_ms = self.default_timeout_ms
+        deadline = None if timeout_ms is None \
+            else time.monotonic() + float(timeout_ms) / 1e3
+        req = _Request(prompt, max_new,
+                       self.engine.eos_id if eos_id is None else eos_id,
+                       deadline)
+        with self._cv:
+            if self._closing:
+                raise EngineClosed('decode scheduler is shutting down')
+            if len(self._waiting) >= self.queue_depth:
+                _m.decode_requests_rejected_overload.inc()
+                raise Overloaded(len(self._waiting))
+            self._waiting.append(req)
+            _m.decode_requests_accepted.inc()
+            _m.decode_queue_depth.set(len(self._waiting))
+            self._cv.notify()
+        return req.stream
+
+    def generate(self, prompt_ids, max_new_tokens=16, eos_id=None,
+                 timeout_ms=None, result_timeout=120.0):
+        """Synchronous convenience: submit + wait for the full token list."""
+        return self.submit(prompt_ids, max_new_tokens, eos_id,
+                           timeout_ms).result(result_timeout)
+
+    def pending(self):
+        with self._cv:
+            return len(self._waiting)
+
+    def active(self):
+        with self._cv:
+            return sum(r is not None for r in self._slots)
+
+    # -- worker side -------------------------------------------------------
+    def _expire_waiting(self, now):
+        kept = collections.deque()
+        for req in self._waiting:
+            if req.expired(now):
+                _m.decode_requests_deadline_missed.inc()
+                req.stream._fail(DeadlineExceeded(
+                    f'deadline expired after {now - req.enqueued_at:.3f}s '
+                    f'waiting for a decode slot'))
+            else:
+                kept.append(req)
+        self._waiting = kept
+        _m.decode_queue_depth.set(len(self._waiting))
+
+    def _admit_locked(self):
+        """Move waiting requests into free slots (FIFO; stops at the first
+        one the pool cannot cover). Returns the admitted requests — their
+        prefill runs OUTSIDE the lock."""
+        if self.admission == 'drain' and any(
+                r is not None for r in self._slots):
+            return []
+        admitted = []
+        for i, slot in enumerate(self._slots):
+            if slot is not None or not self._waiting:
+                continue
+            req = self._waiting[0]
+            try:
+                req.table = self.engine.reserve_table(len(req.prompt),
+                                                      req.max_new_tokens)
+            except OutOfBlocks:
+                break                 # FIFO: wait for blocks, don't skip
+            self._waiting.popleft()
+            self._slots[i] = req
+            admitted.append(req)
+        _m.decode_queue_depth.set(len(self._waiting))
+        return admitted
+
+    def _prefill(self, req):
+        try:
+            first = self.engine.prefill(req.prompt, req.table)
+        except Exception as e:
+            self._fail_request(req, e)
+            return
+        self._emit_token(req, first)
+
+    def _emit_token(self, req, token):
+        """Account one sampled token; marks the request finished when it
+        hits eos or its budget. The token still needs to be FED to the next
+        decode step (its K/V are uncached) unless the request finished."""
+        req.generated += 1
+        req.stream._emit(token)
+        _m.decode_tokens_generated.inc()
+        if req.eos_id is not None and int(token) == int(req.eos_id):
+            self._retire(req, 'stop')
+        elif req.generated >= req.max_new_tokens:
+            self._retire(req, 'length')
+        else:
+            req.next_token = int(token)
+
+    def _retire(self, req, reason):
+        self.engine.release_table(req.table)
+        req.table = None
+        self._slots[self._slots.index(req)] = None
+        req.stream._finish(reason)
+        _m.decode_requests_completed.inc()
+
+    def _fail_request(self, req, exc):
+        if req.table is not None:
+            self.engine.release_table(req.table)
+            req.table = None
+        if req in self._slots:
+            self._slots[self._slots.index(req)] = None
+        _m.decode_requests_failed.inc()
+        req.stream._fail(exc if isinstance(exc, ServingError)
+                         else ServingError(
+                             f'generation failed: '
+                             f'{type(exc).__name__}: {exc}'))
+
+    def _step(self):
+        """One lockstep decode step over the current slots."""
+        live = [r for r in self._slots if r is not None]
+        if not live:
+            return False
+        tokens = [r.next_token if r is not None else None
+                  for r in self._slots]
+        tables = [r.table if r is not None else None for r in self._slots]
+        try:
+            out = self.engine.decode_step(tokens, tables)
+        except Exception as e:
+            for req in live:        # isolate: fail the batch, keep serving
+                self._fail_request(req, e)
+            return True
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                self._emit_token(req, int(out[i]))
+        return True
+
+    def _fail_all_locked(self):
+        """Fail-fast shutdown: error every waiting and in-flight request.
+        Runs on the WORKER thread (slot state is worker-owned; the close()
+        caller only raises the abort flag), so no step can race a release."""
+        while self._waiting:
+            self._waiting.popleft().stream._fail(EngineClosed(
+                'decode scheduler shut down before this request ran'))
+        _m.decode_queue_depth.set(0)
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                self.engine.release_table(req.table)
+                req.table = None
+                self._slots[i] = None
+                req.stream._fail(EngineClosed(
+                    'decode scheduler shut down mid-generation'))
+        _m.decode_slots_active.set(0)
+
+    def _worker_loop(self):
+        while True:
+            with self._cv:
+                if self._closing and self._abort:
+                    self._fail_all_locked()
+                    break
+                self._expire_waiting(time.monotonic())
+                admitted = self._admit_locked()
+            for req in admitted:
+                self._prefill(req)
+            stepped = self._step()
+            if not stepped and not admitted:
+                with self._cv:
+                    if self._closing:
+                        if self._abort:
+                            self._fail_all_locked()
+                        if not self._waiting:
+                            break
+                    else:
+                        self._cv.wait(timeout=0.05)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, drain=True, timeout=None):
+        """Stop admission; ``drain=True`` runs every admitted AND waiting
+        generation to completion, ``drain=False`` fails waiting requests
+        and in-flight generations fast with EngineClosed (the failing
+        itself happens on the worker thread — slot state has one owner)."""
+        with self._cv:
+            if not self._closing:
+                self._closing = True
+                self._abort = not drain
+            self._cv.notify_all()
+        if self._worker.is_alive():
+            self._worker.join(timeout)
+        self._closed = True
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

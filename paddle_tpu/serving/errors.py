@@ -10,7 +10,7 @@ for a latency we could not meet* (DeadlineExceeded), and *we are going away*
 from __future__ import annotations
 
 __all__ = ['ServingError', 'InvalidRequest', 'Overloaded', 'DeadlineExceeded',
-           'EngineClosed']
+           'EngineClosed', 'OutOfBlocks']
 
 
 class ServingError(RuntimeError):
@@ -42,3 +42,18 @@ class DeadlineExceeded(ServingError, TimeoutError):
 class EngineClosed(ServingError):
     """Submitted after shutdown began. In-flight requests at shutdown are
     drained, not dropped; new ones get this. Maps to HTTP 503."""
+
+
+class OutOfBlocks(ServingError):
+    """The paged KV-cache pool cannot cover a block reservation right now.
+    Inside the decode scheduler this is a WAIT signal (the request stays
+    queued until finishing slots free their blocks), never a client error;
+    it only escapes to callers driving a DecodeEngine directly."""
+
+    def __init__(self, requested, available):
+        super().__init__(
+            f'KV cache pool exhausted: need {requested} blocks, '
+            f'{available} free (raise PADDLE_TPU_DECODE_MAX_BLOCKS or '
+            f'lower concurrency)')
+        self.requested = requested
+        self.available = available

@@ -122,3 +122,68 @@ bucket_compile_seconds = _LazyMetric(
 http_responses = _LazyMetric(
     'counter', 'serving_http_responses',
     'HTTP front-end responses by status code')
+
+
+# -- stateful decode engine (serving/decode/, docs/SERVING.md) -------------
+# Same always-on discipline as the rest of serving: decode steps are
+# ms-scale device calls, and /metrics on a generation server must work
+# without PADDLE_TPU_TELEMETRY.
+
+# slot occupancy is a ratio in [0, 1]: linear buckets
+_OCCUPANCY_BOUNDS = tuple(i / 10.0 for i in range(1, 10))
+
+decode_requests_accepted = _LazyMetric(
+    'counter', 'decode_requests_accepted',
+    'generation requests admitted to the decode queue')
+decode_requests_completed = _LazyMetric(
+    'counter', 'decode_requests_completed',
+    'generations finished (eos or token budget)')
+decode_requests_failed = _LazyMetric(
+    'counter', 'decode_requests_failed',
+    'generations failed by an engine/runtime error after admission')
+decode_requests_rejected_overload = _LazyMetric(
+    'counter', 'decode_requests_rejected_overload',
+    'generation requests rejected by bounded-queue backpressure')
+decode_requests_rejected_invalid = _LazyMetric(
+    'counter', 'decode_requests_rejected_invalid',
+    'generation requests rejected by pre-enqueue validation')
+decode_requests_deadline_missed = _LazyMetric(
+    'counter', 'decode_requests_deadline_missed',
+    'generation requests dropped because their deadline expired while '
+    'waiting for a slot')
+decode_queue_depth = _LazyMetric(
+    'gauge', 'decode_queue_depth',
+    'generation requests waiting for a decode slot')
+
+decode_slots_total = _LazyMetric(
+    'gauge', 'decode_slots_total', 'configured lockstep decode slots (S)')
+decode_slots_active = _LazyMetric(
+    'gauge', 'decode_slots_active',
+    'slots holding a live generation, sampled each decode step')
+decode_slot_occupancy = _LazyMetric(
+    'histogram', 'decode_slot_occupancy',
+    'active/total slot ratio per decode step — the continuous-batching '
+    'efficiency signal', bounds=_OCCUPANCY_BOUNDS)
+
+decode_cache_blocks_total = _LazyMetric(
+    'gauge', 'decode_cache_blocks_total',
+    'allocatable KV-cache blocks (pool size minus the scratch block)')
+decode_cache_blocks_used = _LazyMetric(
+    'gauge', 'decode_cache_blocks_used',
+    'KV-cache blocks currently reserved by live generations')
+
+decode_prefill_seconds = _LazyMetric(
+    'histogram', 'decode_prefill_seconds',
+    'wall seconds per prompt prefill (bucket-padded, one per admission)')
+decode_step_seconds = _LazyMetric(
+    'histogram', 'decode_step_seconds',
+    'wall seconds per lockstep decode step (all S slots) — with '
+    'decode_prefill_seconds this is the prefill-vs-decode time split')
+decode_steps = _LazyMetric(
+    'counter', 'decode_steps', 'lockstep decode steps executed')
+decode_tokens_generated = _LazyMetric(
+    'counter', 'decode_tokens_generated',
+    'tokens emitted to generation streams (rate = tokens/s)')
+decode_prefill_compiles = _LazyMetric(
+    'counter', 'decode_prefill_compiles',
+    'prefill bucket shapes compiled (bounded by the prompt ladder length)')

@@ -85,9 +85,21 @@ class _Handler(BaseHTTPRequestHandler):
             if srv.draining:
                 self._reply(503, {'status': 'draining'})
             else:
-                self._reply(200, {'status': 'ok',
-                                  'buckets': srv.engine.buckets,
-                                  'compiled': srv.engine.compiled_buckets})
+                body = {'status': 'ok'}
+                if srv.engine is not None:
+                    body['buckets'] = srv.engine.buckets
+                    body['compiled'] = srv.engine.compiled_buckets
+                if srv.generator is not None:
+                    eng = srv.generator.engine
+                    body['decode'] = {
+                        'slots': eng.slots,
+                        'active': srv.generator.active(),
+                        'waiting': srv.generator.pending(),
+                        'cache_blocks_used': eng.pool.allocator.used,
+                        'cache_blocks_total': eng.pool.allocator.capacity,
+                        'prompt_buckets': eng.prompt_buckets,
+                    }
+                self._reply(200, body)
         elif self.path == '/metrics':
             from ..observability import registry
             self._reply(200, registry.prometheus_text().encode(),
@@ -95,11 +107,48 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply(404, {'error': 'NotFound', 'message': self.path})
 
+    def _read_json_body(self):
+        """Parse the request body; returns the payload dict or None after
+        replying with the 4xx itself."""
+        try:
+            length = int(self.headers.get('Content-Length') or 0)
+        except ValueError:
+            length = -1
+        if length <= 0:
+            self._error(400, InvalidRequest('missing request body'))
+            return None
+        if length > MAX_BODY_BYTES:
+            self._error(413, InvalidRequest(
+                f'body of {length} bytes exceeds {MAX_BODY_BYTES}'))
+            return None
+        try:
+            payload = json.loads(self.rfile.read(length))
+        except (ValueError, UnicodeDecodeError) as e:
+            self._error(400, InvalidRequest(f'bad JSON body: {e}'))
+            return None
+        if not isinstance(payload, dict):
+            self._error(400, InvalidRequest('body must be a JSON object'))
+            return None
+        return payload
+
+    def _write_chunk(self, obj):
+        """One chunked-transfer NDJSON line."""
+        data = json.dumps(obj).encode() + b'\n'
+        self.wfile.write(b'%x\r\n' % len(data) + data + b'\r\n')
+        self.wfile.flush()
+
     def do_POST(self):
+        if self.path == '/generate':
+            return self._do_generate()
         if self.path != '/predict':
             return self._reply(404, {'error': 'NotFound',
                                      'message': self.path})
         srv = self.server.serving
+        if srv.batcher is None:
+            return self._reply(404, {
+                'error': 'NotFound',
+                'message': 'no predict engine configured (decode-only '
+                           'server; use POST /generate)'})
         try:
             length = int(self.headers.get('Content-Length') or 0)
         except ValueError:
@@ -140,6 +189,94 @@ class _Handler(BaseHTTPRequestHandler):
             'rows': int(np.asarray(outs[0]).shape[0]) if outs else 0,
             'latency_ms': round((time.perf_counter() - t0) * 1e3, 3)})
 
+    def _do_generate(self):
+        """POST /generate — stateful streaming generation (docs/SERVING.md
+        "Stateful decode"). Body::
+
+            {"prompt": [token ids], "max_new_tokens": 16,
+             "eos_id": optional, "stream": true, "timeout_ms": optional}
+
+        ``stream=true`` (default) replies 200 with chunked NDJSON: one
+        ``{"token": id, "index": i}`` line per decoded token, then a final
+        ``{"done": true, "finish_reason": ..., "tokens": [...],
+        "latency_ms": ...}`` line. A failure after streaming began arrives
+        as an ``{"error": ..., "message": ...}`` line (the 200 status is
+        already on the wire — chunked streaming's standard caveat).
+        ``stream=false`` blocks and returns the whole generation as one
+        JSON reply. Pre-admission failures map like /predict:
+        InvalidRequest→400, Overloaded→429, DeadlineExceeded→504,
+        EngineClosed→503."""
+        srv = self.server.serving
+        if srv.generator is None:
+            return self._reply(404, {
+                'error': 'NotFound',
+                'message': 'no decode engine configured (predict-only '
+                           'server; use POST /predict)'})
+        payload = self._read_json_body()
+        if payload is None:
+            return
+        prompt = payload.get('prompt')
+        if not isinstance(prompt, list):
+            return self._error(400, InvalidRequest(
+                'body must include "prompt": [token ids]'))
+        t0 = time.perf_counter()
+        try:
+            stream = srv.generator.submit(
+                prompt,
+                max_new_tokens=payload.get('max_new_tokens', 16),
+                eos_id=payload.get('eos_id'),
+                timeout_ms=payload.get('timeout_ms'))
+        except tuple(e for e, _ in _STATUS_BY_ERROR) as e:
+            for etype, code in _STATUS_BY_ERROR:
+                if isinstance(e, etype):
+                    return self._error(code, e)
+        except Exception as e:
+            _logger.error('generate failed: %s: %s', type(e).__name__, e)
+            return self._error(500, e)
+
+        if payload.get('stream', True) is False:
+            try:
+                toks = stream.result(srv.request_timeout)
+            except tuple(e for e, _ in _STATUS_BY_ERROR) as e:
+                for etype, code in _STATUS_BY_ERROR:
+                    if isinstance(e, etype):
+                        return self._error(code, e)
+            except TimeoutError as e:
+                return self._error(504, e)
+            except Exception as e:
+                _logger.error('generate failed: %s: %s',
+                              type(e).__name__, e)
+                return self._error(500, e)
+            return self._reply(200, {
+                'tokens': toks, 'finish_reason': stream.finish_reason,
+                'latency_ms': round((time.perf_counter() - t0) * 1e3, 3)})
+
+        # chunked per-token streaming
+        self.send_response(200)
+        self.send_header('Content-Type', 'application/x-ndjson')
+        self.send_header('Transfer-Encoding', 'chunked')
+        self.end_headers()
+        try:
+            try:
+                for i, tok in enumerate(
+                        stream.iter_tokens(srv.request_timeout)):
+                    self._write_chunk({'token': int(tok), 'index': i})
+                self._write_chunk({
+                    'done': True, 'finish_reason': stream.finish_reason,
+                    'tokens': stream.tokens,
+                    'latency_ms': round((time.perf_counter() - t0) * 1e3,
+                                        3)})
+            except (BrokenPipeError, ConnectionResetError):
+                raise                 # client went away: just stop
+            except Exception as e:    # failure mid-stream: error line
+                self._write_chunk({'error': type(e).__name__,
+                                   'message': str(e)})
+            self.wfile.write(b'0\r\n\r\n')
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass                      # generation continues server-side
+        _m.http_responses.labels(code=200).inc()
+
 
 class ServingServer:
     """Engine + batcher + ThreadingHTTPServer, wired and lifecycle-managed.
@@ -151,23 +288,39 @@ class ServingServer:
 
     def __init__(self, engine, host='127.0.0.1', port=8080,
                  max_batch_size=None, batch_timeout_ms=None, queue_depth=None,
-                 default_timeout_ms=None, request_timeout=60.0, warmup=False):
-        if not isinstance(engine, InferenceEngine):
-            engine = InferenceEngine(engine, max_batch_size=max_batch_size)
-        self.engine = engine
-        if warmup:
-            timings = self.engine.warmup()
-            _logger.info('warmed %d buckets: %s', len(timings),
-                         {b: round(s, 3) for b, s in timings.items()})
-        self.batcher = MicroBatcher(
-            engine,
-            max_batch_size=max_batch_size,
-            batch_timeout_ms=(DEFAULT_BATCH_TIMEOUT_MS
-                              if batch_timeout_ms is None
-                              else batch_timeout_ms),
-            queue_depth=(DEFAULT_QUEUE_DEPTH if queue_depth is None
-                         else queue_depth),
-            default_timeout_ms=default_timeout_ms)
+                 default_timeout_ms=None, request_timeout=60.0, warmup=False,
+                 generator=None):
+        """``generator``: an optional :class:`decode.DecodeScheduler` —
+        enables ``POST /generate`` streaming generation beside (or, with
+        ``engine=None``, instead of) the stateless ``/predict`` path."""
+        if engine is None:
+            if generator is None:
+                raise ValueError('need an engine, a generator, or both')
+            self.engine = None
+            self.batcher = None
+        else:
+            if not isinstance(engine, InferenceEngine):
+                engine = InferenceEngine(engine,
+                                         max_batch_size=max_batch_size)
+            self.engine = engine
+            if warmup:
+                timings = self.engine.warmup()
+                _logger.info('warmed %d buckets: %s', len(timings),
+                             {b: round(s, 3) for b, s in timings.items()})
+            self.batcher = MicroBatcher(
+                engine,
+                max_batch_size=max_batch_size,
+                batch_timeout_ms=(DEFAULT_BATCH_TIMEOUT_MS
+                                  if batch_timeout_ms is None
+                                  else batch_timeout_ms),
+                queue_depth=(DEFAULT_QUEUE_DEPTH if queue_depth is None
+                             else queue_depth),
+                default_timeout_ms=default_timeout_ms)
+        self.generator = generator
+        if generator is not None and warmup:
+            timings = generator.engine.warmup()
+            _logger.info('warmed decode engine: %s',
+                         {k: round(s, 3) for k, s in timings.items()})
         self.request_timeout = request_timeout
         self.draining = False
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
@@ -187,14 +340,14 @@ class ServingServer:
         self._thread.start()
         _logger.info('serving on %s:%d (buckets %s)',
                      self._httpd.server_address[0], self.port,
-                     self.engine.buckets)
+                     self.engine.buckets if self.engine else '[decode-only]')
         return self
 
     def serve_forever(self):
         """Foreground serve (the CLI path); Ctrl-C shuts down gracefully."""
         _logger.info('serving on %s:%d (buckets %s)',
                      self._httpd.server_address[0], self.port,
-                     self.engine.buckets)
+                     self.engine.buckets if self.engine else '[decode-only]')
         try:
             self._httpd.serve_forever()
         except KeyboardInterrupt:
@@ -208,7 +361,10 @@ class ServingServer:
         if self.draining:
             return
         self.draining = True
-        self.batcher.close(drain=drain)
+        if self.batcher is not None:
+            self.batcher.close(drain=drain)
+        if self.generator is not None:
+            self.generator.close(drain=drain)
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:

@@ -1,0 +1,50 @@
+"""tier-1 guard for the decode-engine bench: tools/bench_decode.py --smoke
+must run end-to-end on CPU, keep per-request BITWISE token parity between
+the paged continuous-batching engine and the uncached whole-sequence
+baseline, and show continuous batching beating drain-then-refill. The
+full-size acceptance margin (≥1.5× tokens/s, measured 1.78×) is recorded in
+PERF.md §13; the smoke bound here is soft so CI noise cannot flake it
+(smoke measures ~1.4×)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+ENGINE_FIELDS = {'requests', 'tokens', 'slots', 'tokens_per_s', 'wall_s',
+                 'steps', 'mean_slot_occupancy', 'prefill_s', 'decode_s',
+                 'bitwise_equal'}
+
+
+def test_bench_decode_smoke_runs_on_cpu():
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    r = subprocess.run(
+        [sys.executable, os.path.join('tools', 'bench_decode.py'),
+         '--smoke'],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
+    benches = {d['bench']: d for d in lines if 'bench' in d}
+    assert {'decode_uncached_baseline', 'decode_engine_continuous',
+            'decode_engine_drain'} <= set(benches)
+
+    base = benches['decode_uncached_baseline']
+    assert base['tokens'] > 0 and base['tokens_per_s'] > 0
+
+    cont = benches['decode_engine_continuous']
+    drain = benches['decode_engine_drain']
+    assert ENGINE_FIELDS <= set(cont), cont
+    # hard guarantees: every request's streamed tokens equal the uncached
+    # whole-sequence decode, under BOTH admission policies
+    assert cont['bitwise_equal'] is True, cont
+    assert drain['bitwise_equal'] is True, drain
+    assert cont['tokens'] == base['tokens'] == drain['tokens']
+    # continuous batching admits into freed slots: structurally fewer
+    # lockstep steps and higher occupancy than drain-then-refill. These are
+    # DETERMINISTIC for the seeded workload (smoke measures 37 vs 73), so
+    # they gate hard; wall-clock ratios (1.78x full size, PERF.md §13) are
+    # reported but not asserted — a loaded CI box cannot flake them.
+    assert cont['steps'] * 1.3 <= drain['steps'], (cont, drain)
+    assert cont['mean_slot_occupancy'] > drain['mean_slot_occupancy']
+    assert 'speedup_vs_drain' in cont and 'speedup_vs_uncached' in cont

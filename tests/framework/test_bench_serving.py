@@ -27,7 +27,7 @@ def test_bench_serving_smoke_runs_on_cpu():
     lines = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
     benches = {d['bench']: d for d in lines if 'bench' in d}
     assert {'serving_serial_baseline', 'serving_batcher',
-            'serving_overload'} <= set(benches)
+            'serving_open_loop', 'serving_overload'} <= set(benches)
 
     serial = benches['serving_serial_baseline']
     assert serial['throughput_req_s'] > 0 and serial['p99_ms'] > 0
@@ -42,6 +42,14 @@ def test_bench_serving_smoke_runs_on_cpu():
     # soft timing bound (PERF.md §11 records 5.4x at full size; smoke noise
     # still clears 2x comfortably — measured 5.7x)
     assert b['speedup_vs_serial'] > 2.0, b
+
+    ol = benches['serving_open_loop']
+    # open-loop Poisson: completion-stamped tail latency, every submitted
+    # request accounted for (answered + rejected + failed == offered)
+    assert ol['p99_ms'] is not None and ol['p99_ms'] >= ol['p50_ms']
+    assert ol['answered'] > 0 and ol['failed'] == 0
+    assert ol['answered'] + ol['rejected_overload'] == ol['requests']
+    assert ol['achieved_req_s'] > 0
 
     o = benches['serving_overload']
     # burst > queue_depth: typed rejections, every admitted request answered

@@ -621,6 +621,13 @@ NONDIFF = {
     'fake_quantize_dequantize_moving_average_abs_max':
         'STE surrogate gradient',
     'reduce_all': 'boolean output', 'reduce_any': 'boolean output',
+    'paged_attention':
+        'inference-only decode-phase cache read (serving/decode/); training '
+        'gradients flow through whole-sequence attention, parity tested in '
+        'tests/ops/test_paged_attention.py',
+    'paged_prefill_attention':
+        'inference-only prefill-phase cache read (serving/decode/); '
+        'parity tested in tests/ops/test_paged_attention.py',
 }
 
 

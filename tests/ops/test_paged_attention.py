@@ -1,0 +1,166 @@
+"""Op-level contract of ops/nn_ops.py paged_attention /
+paged_prefill_attention: bitwise parity vs whole-sequence attention at the
+same padded key extent, across ragged length mixes and block-boundary
+lengths, plus clean block reuse (no stale-cache bleed) and the
+pallas-fallback accounting."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.nn_ops import (paged_attention, paged_prefill_attention,
+                                   pallas_fallback_stats,
+                                   reset_pallas_fallback_stats)
+
+H, D, BS, MAXBPS = 2, 16, 4, 4
+E = MAXBPS * BS          # padded context extent
+SCALE = 1.0 / np.sqrt(D)
+NEG = -1e9
+
+
+def whole_seq_reference(q_rows, k_rows, v_rows):
+    """The unfused MultiHeadAttention chain at extent E: matmul·α +
+    additive causal bias, softmax, matmul — per-row ground truth."""
+    q4, k4, v4 = (jnp.asarray(x[None]) for x in (q_rows, k_rows, v_rows))
+    s = jnp.matmul(q4, jnp.swapaxes(k4, -1, -2)) \
+        * jnp.asarray(SCALE, jnp.float32)
+    s = s + jnp.asarray(np.triu(np.full((E, E), NEG, 'float32'),
+                                1)[None, None])
+    return np.asarray(jnp.matmul(jax.nn.softmax(s, -1), v4))[0]
+
+
+def build_cache(rng, num_blocks, tables_rows):
+    """Fill per-slot rows into distinct blocks; returns (pages, tables,
+    per-slot row arrays)."""
+    k_pages = np.zeros((H, num_blocks, BS, D), 'float32')
+    v_pages = np.zeros_like(k_pages)
+    tables, k_rows, v_rows = [], [], []
+    nxt = 1
+    for nb in tables_rows:
+        kr = rng.randn(H, E, D).astype('float32')
+        vr = rng.randn(H, E, D).astype('float32')
+        table = []
+        for j in range(nb):
+            table.append(nxt)
+            k_pages[:, nxt] = kr[:, j * BS:(j + 1) * BS]
+            v_pages[:, nxt] = vr[:, j * BS:(j + 1) * BS]
+            nxt += 1
+        table += [0] * (MAXBPS - nb)
+        tables.append(table)
+        k_rows.append(kr)
+        v_rows.append(vr)
+    return k_pages, v_pages, np.asarray(tables, np.int32), k_rows, v_rows
+
+
+def test_decode_parity_ragged_mix():
+    """Slots with wildly different context lengths in ONE batched call each
+    match their own whole-sequence reference row bitwise."""
+    rng = np.random.RandomState(0)
+    lens = [1, 3, 7, 12, 16]          # ragged, includes min and max context
+    k_pages, v_pages, tables, k_rows, v_rows = build_cache(
+        rng, 64, [MAXBPS] * len(lens))
+    q_rows = [rng.randn(H, E, D).astype('float32') for _ in lens]
+    q = np.stack([qr[:, c - 1] for qr, c in zip(q_rows, lens)])
+    out = np.asarray(paged_attention(q, k_pages, v_pages, tables,
+                                     np.asarray(lens, np.int32),
+                                     sm_scale=float(SCALE)))
+    for i, c in enumerate(lens):
+        ref = whole_seq_reference(q_rows[i], k_rows[i], v_rows[i])
+        assert np.array_equal(out[i], ref[:, c - 1]), f'slot {i} (c={c})'
+
+
+@pytest.mark.parametrize('c', [BS, BS + 1, 2 * BS - 1, 2 * BS, E])
+def test_decode_parity_block_boundaries(c):
+    """len % block_size ∈ {0, 1, block_size-1} and the full-table case."""
+    rng = np.random.RandomState(c)
+    k_pages, v_pages, tables, k_rows, v_rows = build_cache(rng, 16, [MAXBPS])
+    q_rows = rng.randn(H, E, D).astype('float32')
+    q = q_rows[:, c - 1][None]
+    out = np.asarray(paged_attention(q, k_pages, v_pages, tables,
+                                     np.asarray([c], np.int32),
+                                     sm_scale=float(SCALE)))
+    ref = whole_seq_reference(q_rows, k_rows[0], v_rows[0])
+    assert np.array_equal(out[0], ref[:, c - 1])
+
+
+def test_prefill_parity_rows():
+    """paged_prefill_attention rows 0..P-1 equal the whole-sequence rows,
+    at a bucket extent SMALLER than the padded context."""
+    rng = np.random.RandomState(1)
+    k_pages, v_pages, tables, k_rows, v_rows = build_cache(rng, 16, [MAXBPS])
+    q_rows = rng.randn(H, E, D).astype('float32')
+    Lq = 8                             # bucket < E
+    out = np.asarray(paged_prefill_attention(
+        q_rows[None, :, :Lq], k_rows[0][None, :, :Lq],
+        v_rows[0][None, :, :Lq], k_pages, v_pages, tables[:1],
+        sm_scale=float(SCALE)))
+    ref = whole_seq_reference(q_rows, k_rows[0], v_rows[0])
+    assert np.array_equal(out[0], ref[:, :Lq])
+
+
+def test_block_reuse_no_stale_bleed():
+    """A freed block refilled with garbage, then reused by a new request,
+    contributes NOTHING beyond the new context: outputs with clean vs
+    garbage pool tails are bitwise identical (masked probabilities are
+    exactly zero in the XLA fallback)."""
+    rng = np.random.RandomState(2)
+    c = 5                              # context: block 0 full + 1 token
+    k_rows = rng.randn(H, E, D).astype('float32')
+    v_rows = rng.randn(H, E, D).astype('float32')
+    q = rng.randn(1, H, D).astype('float32')
+    table = np.asarray([[1, 2, 0, 0]], np.int32)
+    lens = np.asarray([c], np.int32)
+
+    def run(fill):
+        k_pages = np.full((H, 8, BS, D), fill, 'float32')
+        v_pages = np.full_like(k_pages, fill)
+        for j in range(2):
+            k_pages[:, j + 1] = k_rows[:, j * BS:(j + 1) * BS]
+            v_pages[:, j + 1] = v_rows[:, j * BS:(j + 1) * BS]
+        # stale garbage INSIDE the table beyond the context: positions
+        # c.. of block 2 keep whatever the previous tenant wrote
+        k_pages[:, 2, c - BS:] = fill
+        v_pages[:, 2, c - BS:] = fill
+        return np.asarray(paged_attention(q, k_pages, v_pages, table, lens,
+                                          sm_scale=float(SCALE)))
+
+    clean = run(0.0)
+    stale = run(1e6)                   # previous request's leftovers
+    assert np.array_equal(clean, stale)
+
+
+def test_fallback_stats_count_and_warn_once():
+    """The pallas-unavailable fallback warns ONCE per process through
+    log_helper and counts every fallback trace afterwards."""
+    import logging
+    from paddle_tpu.ops import nn_ops
+    reset_pallas_fallback_stats()
+    records = []
+
+    class Grab(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = logging.getLogger('paddle_tpu.ops.nn_ops')
+    h = Grab()
+    logger.addHandler(h)
+    try:
+        nn_ops._pallas_fallback('fused_attention', ValueError('no kernel'),
+                                (1, 2, 8, 16))
+        nn_ops._pallas_fallback('paged_attention', ValueError('no kernel'),
+                                (4, 2, 16))
+        nn_ops._pallas_fallback('fused_attention', ValueError('again'),
+                                (1, 2, 16, 16))
+    finally:
+        logger.removeHandler(h)
+    stats = pallas_fallback_stats()
+    assert stats['count'] == 3
+    assert stats['warned'] is True
+    assert 'paged_attention' not in stats['last']  # last was fused again
+    assert len(records) == 1, 'must warn exactly once per process'
+    # the at-export collector surfaces the count as a gauge
+    from paddle_tpu.observability import registry
+    d = registry.to_dict()
+    g = d.get('attention_pallas_fallbacks')
+    assert g and g['samples'][0]['value'] == 3.0
+    reset_pallas_fallback_stats()

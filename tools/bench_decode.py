@@ -1,0 +1,165 @@
+"""Stateful decode-engine benchmark (PERF.md §13).
+
+Three sections over one mixed-length generation workload (seeded prompt and
+budget draws — the ragged mix is the point: uniform lengths would hide the
+drain policy's idle-slot waste), one JSON line each:
+
+1. ``decode_uncached_baseline`` — per-request whole-sequence greedy decode
+   (models/causal_lm.greedy_generate at the engine's padded context): every
+   token re-runs the full prefix. One compile total, but O(L²) work and no
+   cross-request batching. Produces the reference token streams.
+2. ``decode_engine_continuous`` — the same requests through the
+   DecodeScheduler with slot-based continuous batching (admit into freed
+   slots every step). Reports tokens/s, speedups, mean slot occupancy, the
+   prefill-vs-decode time split, and **per-request bitwise token parity**
+   against section 1 (the engine acceptance bar).
+3. ``decode_engine_drain`` — identical except ``admission='drain'``
+   (refill only when ALL slots finish — the wave-batching strawman).
+   Acceptance (PERF.md §13): continuous ≥ 1.5× drain tokens/s on this
+   workload, parity again bitwise.
+
+Runs on any backend; CPU is the honest configuration (the quantity under
+test is scheduling + shape discipline, not FLOPs):
+
+  JAX_PLATFORMS=cpu python tools/bench_decode.py [--smoke]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# runnable as `python tools/bench_decode.py` from the repo root
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_workload(requests, max_prompt, max_new_cap, seed=0):
+    """Seeded mixed-length workload: ragged prompts and HEAVY-TAILED
+    generation budgets (3 of 4 requests short, 1 of 4 near the cap — the
+    shape of real LLM traffic, and exactly what wave batching is worst at:
+    one long request pins the whole drained wave while S-1 slots idle)."""
+    rng = np.random.RandomState(seed)
+    work = []
+    for i in range(requests):
+        plen = int(rng.randint(2, max_prompt + 1))
+        prompt = [int(t) for t in rng.randint(3, 120, plen)]
+        if i % 4 == 3:      # deterministic tail: every 4th request is long
+            max_new = int(rng.randint(2 * max_new_cap // 3,
+                                      max_new_cap + 1))
+        else:
+            max_new = int(rng.randint(4, max(max_new_cap // 4, 5)))
+        work.append((prompt, max_new))
+    return work
+
+
+def _hist_sum(name):
+    from paddle_tpu.observability import registry
+    d = registry.to_dict().get(name)
+    if not d or not d['samples']:
+        return 0.0, 0
+    return (sum(s['sum'] for s in d['samples']),
+            sum(s['count'] for s in d['samples']))
+
+
+def measure_uncached(model, work, padded_context):
+    from paddle_tpu.models.causal_lm import greedy_generate
+    # warm the single fixed shape so the baseline wall is steady-state
+    greedy_generate(model, work[0][0], 1, pad_len=padded_context)
+    refs = []
+    t0 = time.perf_counter()
+    for prompt, max_new in work:
+        refs.append(greedy_generate(model, prompt, max_new,
+                                    pad_len=padded_context))
+    wall = time.perf_counter() - t0
+    tokens = sum(len(r) for r in refs)
+    return {
+        'bench': 'decode_uncached_baseline',
+        'requests': len(work), 'tokens': tokens,
+        'tokens_per_s': round(tokens / wall, 1),
+        'wall_s': round(wall, 3),
+    }, refs
+
+
+def measure_engine(engine, work, refs, admission):
+    from paddle_tpu.serving.decode import DecodeScheduler
+    pre0, _ = _hist_sum('decode_prefill_seconds')
+    step0, nstep0 = _hist_sum('decode_step_seconds')
+    occ0, nocc0 = _hist_sum('decode_slot_occupancy')
+    with DecodeScheduler(engine, queue_depth=len(work) + 1,
+                         admission=admission) as sched:
+        t0 = time.perf_counter()
+        streams = [sched.submit(p, max_new_tokens=m) for p, m in work]
+        outs = [s.result(600) for s in streams]
+        wall = time.perf_counter() - t0
+    tokens = sum(len(o) for o in outs)
+    mismatches = sum(o != r for o, r in zip(outs, refs))
+    pre1, _ = _hist_sum('decode_prefill_seconds')
+    step1, nstep1 = _hist_sum('decode_step_seconds')
+    occ1, nocc1 = _hist_sum('decode_slot_occupancy')
+    return {
+        'bench': f'decode_engine_{admission}',
+        'requests': len(work), 'tokens': tokens,
+        'slots': engine.slots,
+        'tokens_per_s': round(tokens / wall, 1),
+        'wall_s': round(wall, 3),
+        'steps': nstep1 - nstep0,
+        'mean_slot_occupancy': round(
+            (occ1 - occ0) / max(nocc1 - nocc0, 1), 3),
+        'prefill_s': round(pre1 - pre0, 3),
+        'decode_s': round(step1 - step0, 3),
+        'bitwise_equal': mismatches == 0,
+    }
+
+
+def measure_all(smoke=False, seed=0):
+    from paddle_tpu.dygraph import guard
+    from paddle_tpu.models.causal_lm import CausalLMConfig, TransformerLM
+    from paddle_tpu.serving.decode import DecodeEngine
+    requests = 12 if smoke else 32
+    slots = 4 if smoke else 8
+    max_prompt = 12
+    max_new_cap = 32 if smoke else 48
+    with guard():
+        model = TransformerLM(CausalLMConfig.tiny())
+        model.eval()
+        engine = DecodeEngine(model, slots=slots, block_size=8,
+                              max_blocks=256, max_prompt_len=16,
+                              max_new_tokens_cap=64)
+        work = build_workload(requests, max_prompt, max_new_cap, seed)
+        baseline, refs = measure_uncached(model, work,
+                                          engine.padded_context)
+        engine.warmup()
+        cont = measure_engine(engine, work, refs, 'continuous')
+        drain = measure_engine(engine, work, refs, 'drain')
+    cont['speedup_vs_uncached'] = round(
+        cont['tokens_per_s'] / baseline['tokens_per_s'], 2)
+    cont['speedup_vs_drain'] = round(
+        cont['tokens_per_s'] / drain['tokens_per_s'], 2)
+    return {'uncached': baseline, 'continuous': cont, 'drain': drain}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--smoke', action='store_true',
+                    help='CI sizes: fewer/shorter generations')
+    args = ap.parse_args()
+    results = measure_all(smoke=args.smoke)
+    for section in results.values():
+        print(json.dumps(section), flush=True)
+    # gate on correctness and STRUCTURE (step counts are deterministic for
+    # the seeded workload); wall-clock ratios live in PERF.md §13 and stay
+    # out of the exit code so a loaded CI box cannot flake the bench
+    ok = (results['continuous']['bitwise_equal']
+          and results['drain']['bitwise_equal']
+          and results['continuous']['steps'] < results['drain']['steps'])
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,10 +1,12 @@
 """Serving-path load benchmark (PERF.md §11).
 
-Closed-loop load generator over the micro-batching serving stack
-(paddle_tpu/serving/): N client threads each fire single-row requests at a
-:class:`MicroBatcher` and wait for their result before firing the next —
-the classic closed-loop model, so measured latency includes queueing. Three
-sections, one JSON line each:
+Load generator over the micro-batching serving stack (paddle_tpu/serving/):
+N client threads each fire single-row requests at a :class:`MicroBatcher`
+and wait for their result before firing the next — the classic closed-loop
+model, so measured latency includes queueing — plus an OPEN-LOOP Poisson
+section whose arrivals don't wait for completions (closed-loop load
+coordinates with the server and understates tail latency — 'coordinated
+omission'). Four sections, one JSON line each:
 
 1. ``serving_serial_baseline`` — the pre-subsystem path: one
    ``Predictor.run`` per request, serially. This is what every request paid
@@ -15,7 +17,10 @@ sections, one JSON line each:
    padding-waste ratio, and **bitwise parity** of every response against the
    serial baseline outputs. Acceptance (PERF.md §11): ≥ 5× the serial
    throughput at max_batch_size=16 on CPU.
-3. ``serving_overload`` — backpressure: a burst larger than the bounded
+3. ``serving_open_loop`` — seeded Poisson arrivals at ~3× the serial rate:
+   offered vs achieved throughput, completion-stamped p50/p99 (via
+   ``PredictionFuture.add_done_callback``), typed rejections.
+4. ``serving_overload`` — backpressure: a burst larger than the bounded
    queue against a deliberately slow engine must produce typed
    ``Overloaded`` rejections (no hangs, no crashes) and leave the admitted
    requests answered.
@@ -175,6 +180,72 @@ class _SlowEngine:
         return self._engine.run_batch(feed, nrows)
 
 
+def measure_open_loop(model_dir, X, rate_rps, requests, max_batch_size=16,
+                      batch_timeout_ms=2, timeout_ms=None):
+    """Open-loop Poisson load (the tail-latency-honest model the ROADMAP
+    asked for): arrivals follow a seeded exponential inter-arrival process
+    at ``rate_rps`` REGARDLESS of completions, so queueing delay shows up
+    in the latency distribution instead of throttling the offered load
+    (closed-loop clients hide it — 'coordinated omission'). Latency is
+    stamped at completion via PredictionFuture.add_done_callback, not when
+    the caller polls. Reports offered vs achieved rate, p50/p99, and typed
+    rejections."""
+    import random
+    from paddle_tpu import serving
+    engine = serving.InferenceEngine(model_dir, max_batch_size=max_batch_size)
+    engine.warmup()
+    rng = random.Random(0)
+    lat, lat_lock = [], threading.Lock()
+    rejected = [0]
+    failed = [0]
+    pending = []
+
+    def on_done(submit_t, fut):
+        dt = time.perf_counter() - submit_t
+        with lat_lock:
+            lat.append(dt)
+
+    with serving.MicroBatcher(engine, batch_timeout_ms=batch_timeout_ms,
+                              queue_depth=max(2 * max_batch_size, 32)) \
+            as batcher:
+        t0 = time.perf_counter()
+        next_arrival = t0
+        for i in range(requests):
+            now = time.perf_counter()
+            if next_arrival > now:
+                time.sleep(next_arrival - now)
+            ridx = i % len(X)
+            submit_t = time.perf_counter()
+            try:
+                fut = batcher.submit({'x': X[ridx:ridx + 1]}, timeout_ms)
+                fut.add_done_callback(
+                    lambda f, s=submit_t: on_done(s, f))
+                pending.append(fut)
+            except serving.Overloaded:
+                rejected[0] += 1
+            except serving.ServingError:
+                failed[0] += 1
+            next_arrival += rng.expovariate(rate_rps)
+        for f in pending:
+            try:
+                f.result(timeout=60)
+            except serving.ServingError:
+                failed[0] += 1
+        wall = time.perf_counter() - t0
+    answered = len(lat) - failed[0]
+    return {
+        'bench': 'serving_open_loop',
+        'offered_rate_req_s': rate_rps,
+        'requests': requests,
+        'achieved_req_s': round(answered / wall, 1),
+        'answered': answered,
+        'rejected_overload': rejected[0],
+        'failed': failed[0],
+        'p50_ms': _pctl(lat, 50) if lat else None,
+        'p99_ms': _pctl(lat, 99) if lat else None,
+    }
+
+
 def measure_overload(model_dir, X, queue_depth, burst):
     """Burst > queue_depth against a slow engine: typed rejections, no
     hangs, admitted requests all answered."""
@@ -229,12 +300,19 @@ def measure_all(smoke=False, model_dir=None):
                                   batch_timeout_ms=2)
         batcher['speedup_vs_serial'] = round(
             batcher['throughput_req_s'] / serial['throughput_req_s'], 2)
+        # open-loop Poisson arrivals at ~3x the serial rate: comfortably
+        # inside the batcher's capacity (~5x serial) so the p99 reflects
+        # batching delay, not saturation collapse
+        open_loop = measure_open_loop(
+            model_dir, X, rate_rps=3.0 * serial['throughput_req_s'],
+            requests=300 if smoke else 2000, max_batch_size=max_batch)
         overload = measure_overload(model_dir, X, queue_depth=8,
                                     burst=64 if smoke else 256)
     finally:
         if tmp is not None:
             tmp.cleanup()
-    return {'serial': serial, 'batcher': batcher, 'overload': overload}
+    return {'serial': serial, 'batcher': batcher, 'open_loop': open_loop,
+            'overload': overload}
 
 
 def main():
