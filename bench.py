@@ -388,6 +388,16 @@ def bench_async_pipeline(on_tpu):
     return measure_all(smoke=not on_tpu)
 
 
+def bench_resilience(on_tpu):
+    """Checkpoint stall + restart lost-work (PERF.md §14): async
+    checkpointing must add < 1 step of stall and never perturb the losses.
+    Valid on CPU: the quantity under test is host/IO overlap."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools'))
+    from bench_resilience import measure_all
+    return measure_all(smoke=not on_tpu)
+
+
 def bench_telemetry_sidecar(on_tpu):
     """Telemetry sidecar for the bench run: the headline benches above run
     with telemetry off (their numbers stay comparable across PRs), then the
@@ -527,6 +537,15 @@ def main():
             async_pipeline_speedup=pl['async_pipeline']['speedup'],
             async_pipeline_bitwise=pl['async_pipeline']
             ['bitwise_identical'])
+
+    rz = run("resilience", lambda: bench_resilience(on_tpu))
+    if rz is not None:
+        emit({"metric": "resilience",
+              "stall": rz['resilience_stall'],
+              "restart": rz['resilience_restart']})
+        summary.update(
+            ckpt_stall_steps=rz['resilience_stall']['async_stall_steps'],
+            ckpt_bitwise=rz['resilience_stall']['bitwise_identical'])
 
     s = run("telemetry_sidecar", lambda: bench_telemetry_sidecar(on_tpu))
     if s is not None:

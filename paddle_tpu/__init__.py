@@ -53,6 +53,7 @@ from . import distributed
 from . import contrib
 from . import observability
 from . import serving
+from . import resilience
 from . import profiler
 from . import debugger
 from . import log_helper

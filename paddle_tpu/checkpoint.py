@@ -4,6 +4,14 @@ Parity target: the reference's save_persistables/load_persistables for
 training state, upgraded the TPU way: orbax handles sharded arrays (each
 host writes its shards), atomic step directories, and async save so the
 train loop overlaps checkpoint IO with compute.
+
+NOTE: the production train-loop checkpointing path is
+``paddle_tpu/resilience/`` (docs/RESILIENCE.md) — non-stalling FetchHandle
+capture, torn-write-proof manifest commit, SIGTERM handling, bitwise
+deterministic resume, fault injection, goodput. This module remains the
+low-level orbax surface for MULTI-HOST sharded pytrees (each host writes
+its shards), which the resilience manager will key off the unified
+partitioner once ROADMAP item 1 lands.
 """
 from __future__ import annotations
 

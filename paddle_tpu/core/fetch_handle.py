@@ -240,6 +240,16 @@ class InflightWindow:
                 help='dispatched steps whose fetch handles are still '
                      'pending (async pipeline window occupancy)')
 
+    def protect(self, handles):
+        """Register snapshot protection WITHOUT occupying the dispatch
+        window: each handle's named buffer stays out of the donated set
+        until the handle materializes or is dropped. This is the zero-copy
+        checkpoint capture path (resilience/state.py) — the handles are
+        point-in-time state snapshots a background writer will materialize,
+        not step outputs, so they must not gate `admit`."""
+        for h in handles:
+            self._snapshots.append(weakref.ref(h))
+
     def protected_names(self):
         """Persistable names snapshotted by a live, not-yet-materialized
         handle: the executor must not donate their buffers this step."""

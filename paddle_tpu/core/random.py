@@ -39,6 +39,18 @@ class KeyGenerator:
         self._counter += 1
         return jax.random.fold_in(self._key, self._counter)
 
+    def state(self):
+        """Resumable generator state (resilience checkpoints): the stream
+        is fully determined by (seed, counter)."""
+        return {'seed': self._seed, 'counter': self._counter}
+
+    def set_state(self, state):
+        """Restore a :meth:`state` snapshot — the next `next_key()` draws
+        exactly what the captured process would have drawn."""
+        self._seed = int(state['seed'])
+        self._base = None            # lazily rebuilt from the seed
+        self._counter = int(state['counter'])
+
     def base_key(self):
         return self._key
 

@@ -22,9 +22,11 @@ def save_dygraph(state_dict, model_path):
         arr = np.asarray(v.value) if isinstance(v, Tensor) else np.asarray(v)
         arrays[k] = arr
         meta[k] = {'shape': list(arr.shape), 'dtype': str(arr.dtype)}
-    np.savez(model_path + '.pdparams.npz', **arrays)
-    with open(model_path + '.pdparams.json', 'w') as f:
-        json.dump(meta, f)
+    # atomic commit (temp + os.replace, io.py helpers): a kill mid-save
+    # can't leave a torn .npz that a later load would crash on
+    from ..io import _atomic_savez, _atomic_write_text
+    _atomic_savez(model_path + '.pdparams.npz', arrays)
+    _atomic_write_text(model_path + '.pdparams.json', json.dumps(meta))
 
 
 def load_dygraph(model_path, keep_name_table=False):

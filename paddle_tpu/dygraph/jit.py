@@ -642,6 +642,74 @@ class TrainStep:
         return ({n: p.value for n, p in self._params.items()},
                 {n: b.value for n, b in self._buffers.items()})
 
+    # -- checkpoint/resume (paddle_tpu/resilience/) --------------------
+    def snapshot(self):
+        """Non-blocking point-in-time capture for async checkpointing:
+        → ({flat_key: FetchHandle}, meta). With donation on, the fused
+        step donates its WHOLE state pytree every call — per-name
+        protection is impossible — so each array is first cloned on-device
+        (async dispatch, no host sync) and the handle wraps the clone; the
+        checkpoint writer materializes D2H in the background while
+        subsequent steps donate the originals freely."""
+        from ..core.fetch_handle import FetchHandle
+
+        def wrap(key, v):
+            if self._donate and hasattr(v, 'block_until_ready'):
+                v = jnp.copy(v)
+            return FetchHandle(v, name=key)
+
+        arrays = {}
+        for n, p in self._params.items():
+            arrays[f'param/{n}'] = wrap(f'param/{n}', p.value)
+        for n, b in self._buffers.items():
+            arrays[f'buffer/{n}'] = wrap(f'buffer/{n}', b.value)
+        for n, slots in (self._slots or {}).items():
+            for s, v in slots.items():
+                arrays[f'slot/{s}/{n}'] = wrap(f'slot/{s}/{n}', v)
+        if self._acc is not None:
+            for n, v in self._acc.items():
+                arrays[f'acc/{n}'] = wrap(f'acc/{n}', v)
+            arrays['accum_count'] = wrap('accum_count', self._count)
+        meta = {'step': self._step, 'accum_steps': self._accum_steps}
+        lr = self._opt._learning_rate
+        if hasattr(lr, 'step_num'):
+            meta['lr_step_num'] = int(lr.step_num)
+        return arrays, meta
+
+    def set_state(self, arrays, meta=None):
+        """Restore a :meth:`snapshot`. Call before or after the first step
+        — restored optimizer slots/accumulators survive the lazy build.
+        Unrecognized keys (e.g. ``scope/``-prefixed executor state in a
+        combined capture) are ignored."""
+        meta = dict(meta or {})
+        slots = {}
+        acc = {}
+        for key, arr in arrays.items():
+            if key.startswith('param/'):
+                n = key[len('param/'):]
+                if n in self._params:
+                    self._params[n].value = jnp.asarray(arr)
+            elif key.startswith('buffer/'):
+                n = key[len('buffer/'):]
+                if n in self._buffers:
+                    self._buffers[n].value = jnp.asarray(arr)
+            elif key.startswith('slot/'):
+                _, s, n = key.split('/', 2)
+                slots.setdefault(n, {})[s] = jnp.asarray(arr)
+            elif key.startswith('acc/'):
+                acc[key[len('acc/'):]] = jnp.asarray(arr)
+            elif key == 'accum_count':
+                self._count = jnp.asarray(arr, jnp.int32)
+        if slots:
+            self._slots = slots
+        if acc:
+            self._acc = acc
+        if 'step' in meta:
+            self._step = int(meta['step'])
+        lr = self._opt._learning_rate
+        if 'lr_step_num' in meta and hasattr(lr, 'step_num'):
+            lr.step_num = meta['lr_step_num']
+
     def __call__(self, *batch):
         if not _obs._ENABLED:
             return self._call_impl(batch)
@@ -659,6 +727,10 @@ class TrainStep:
         if self._jitted is None:
             with _obs.span('train_step/build'):
                 self._jitted = self._build()
+        if self._slots is None:
+            # skipped when set_state() restored checkpointed slots before
+            # the first call — a resumed step must continue the restored
+            # optimizer trajectory, not a fresh one
             self._slots = {
                 n: {s: jnp.full(shp, fill, jnp.float32)
                     for s, (shp, fill) in

@@ -1060,6 +1060,36 @@ class Executor:
             check_numerics(bad, 'fetches')
 
     # ------------------------------------------------------------------
+    def snapshot_persistables(self, program=None, scope=None):
+        """Zero-copy, non-blocking snapshot of the program's persistable
+        state for async checkpointing (paddle_tpu/resilience/): each value
+        is wrapped in a :class:`FetchHandle` registered as
+        donation-PROTECTED on this executor's inflight window — subsequent
+        `run` calls keep those exact buffers out of the donated set (they
+        run copy-in/copy-out for that state) until the checkpoint writer
+        materializes the handles, at which point donation resumes. The
+        step loop therefore never waits on checkpoint D2H.
+
+        Note the protected set changes the donated/kept pytree split, so
+        the first run after a snapshot (and the first run after the
+        handles drain) each hit their own step-cache entry — two compiled
+        variants total, both reused across checkpoints."""
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        handles = {}
+        for v in program.list_vars():
+            if not v.persistable:
+                continue
+            val = scope.find(v.name)
+            if val is None:
+                raise RuntimeError(
+                    f"snapshot_persistables: '{v.name}' is uninitialized; "
+                    f"run the startup program first")
+            handles[v.name] = FetchHandle(val, name=v.name)
+        self._window.protect(handles.values())
+        return handles
+
+    # ------------------------------------------------------------------
     def _run_from_dataset(self, program, dataset, scope, debug, fetch_list,
                           fetch_info, print_period, fetch_handler):
         if dataset is None:

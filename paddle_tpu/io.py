@@ -27,6 +27,24 @@ __all__ = ['save_params', 'save_persistables', 'load_params',
            'save', 'load', 'load_program_state', 'set_program_state']
 
 
+def _atomic_savez(path, data):
+    """np.savez via temp-in-target-dir + fsync + os.replace: a `kill -9`
+    mid-save can never leave a torn npz at `path` (docs/RESILIENCE.md).
+    Writing through a file object also pins the EXACT filename — np.savez
+    given a str would append '.npz', silently desyncing save/load names."""
+    import io as _io
+    from .resilience.snapshot import atomic_write_bytes
+    buf = _io.BytesIO()
+    np.savez(buf, **data)
+    atomic_write_bytes(path, buf.getvalue())
+
+
+def _atomic_write_text(path, text):
+    """Same torn-write guarantee for the JSON model/manifest artifacts."""
+    from .resilience.snapshot import atomic_write_bytes
+    atomic_write_bytes(path, text.encode())
+
+
 def is_parameter(var):
     """ref io.py:67 — var is a trainable Parameter."""
     return isinstance(var, Parameter)
@@ -106,7 +124,7 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
     else:
         data = _collect(program, predicate, scope)
     os.makedirs(dirname, exist_ok=True)
-    np.savez(os.path.join(dirname, filename or 'params.npz'), **data)
+    _atomic_savez(os.path.join(dirname, filename or 'params.npz'), data)
 
 
 def save_params(executor, dirname, main_program=None, filename=None):
@@ -233,9 +251,9 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     meta['feed_names'] = list(feeded_var_names)
     meta['fetch_names'] = [t.name if isinstance(t, Variable) else t
                            for t in target_vars]
-    with open(os.path.join(dirname, model_filename or '__model__.json'),
-              'w') as f:
-        json.dump(meta, f)
+    _atomic_write_text(
+        os.path.join(dirname, model_filename or '__model__.json'),
+        json.dumps(meta))
     if not program_only:
         save_persistables(executor, dirname, inference_program,
                           params_filename or 'params.npz')
@@ -310,14 +328,12 @@ def save(program, model_path):
            for v in program.list_vars()
            if is_persistable(v) and not is_parameter(v)
            and scope.find(v.name) is not None}
-    # open the exact filename: np.savez(str) would append '.npz', breaking
-    # the documented `{path}.pdparams` artifact layout
-    with open(model_path + '.pdparams', 'wb') as f:
-        np.savez(f, **params)
-    with open(model_path + '.pdopt', 'wb') as f:
-        np.savez(f, **opt)
-    with open(model_path + '.pdmodel', 'w') as f:
-        json.dump(_program_to_dict(program), f)
+    # atomic + exact filenames (np.savez(str) would append '.npz', breaking
+    # the documented `{path}.pdparams` artifact layout)
+    _atomic_savez(model_path + '.pdparams', params)
+    _atomic_savez(model_path + '.pdopt', opt)
+    _atomic_write_text(model_path + '.pdmodel',
+                       json.dumps(_program_to_dict(program)))
 
 
 def load(program, model_path, executor=None, var_list=None):
@@ -378,10 +394,10 @@ def set_program_state(program, state_dict):
 
 def _save_jit_model(dirname, layer, params, buffers):
     os.makedirs(dirname, exist_ok=True)
-    np.savez(os.path.join(dirname, 'jit_params.npz'),
-             **{k: np.asarray(v) for k, v in params.items()})
-    np.savez(os.path.join(dirname, 'jit_buffers.npz'),
-             **{k: np.asarray(v) for k, v in buffers.items()})
+    _atomic_savez(os.path.join(dirname, 'jit_params.npz'),
+                  {k: np.asarray(v) for k, v in params.items()})
+    _atomic_savez(os.path.join(dirname, 'jit_buffers.npz'),
+                  {k: np.asarray(v) for k, v in buffers.items()})
 
 
 # parity: the reference exposes DataLoader under fluid.io as well

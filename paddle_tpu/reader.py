@@ -168,6 +168,13 @@ class _GeneratorLoader:
         self._places = None
         self._feeder = None
         self._drop_last = drop_last
+        # resume cursor (paddle_tpu/resilience/): epoch = completed passes,
+        # consumed = batches YIELDED to the consumer this epoch (batches
+        # staged in the prefetch ring but never consumed don't count — a
+        # resumed run replays them). Assumes one active iteration at a time.
+        self._epoch = 0
+        self._consumed = 0
+        self._skip = 0
 
     # -- configuration (ref API) --
     def set_sample_generator(self, reader, batch_size, drop_last=True,
@@ -257,17 +264,43 @@ class _GeneratorLoader:
             staged[k] = jax.device_put(a)
         return staged
 
+    # -- resume cursor (docs/RESILIENCE.md) --
+    @property
+    def epoch(self):
+        """Completed passes over the reader (0-based current epoch).
+        Readable from inside a batch generator closure, so per-epoch data
+        (shuffles, shards) can key off it and stay resume-deterministic."""
+        return self._epoch
+
+    def state_dict(self):
+        """Checkpointable cursor: where the CONSUMER is in the data
+        stream."""
+        return {'epoch': self._epoch, 'batch': self._consumed}
+
+    def set_state_dict(self, state):
+        """Restore a :meth:`state_dict`. The next iteration re-runs the
+        (deterministic) reader for `epoch` and skips the first `batch`
+        batches on the producer side — before any device staging — so the
+        consumer resumes exactly where the checkpointed run stood."""
+        self._epoch = int(state['epoch'])
+        self._consumed = int(state['batch'])
+        self._skip = int(state['batch'])
+
     def __iter__(self):
         q = queue.Queue(maxsize=self._capacity)
         end = object()
         err_box = []
         stop = threading.Event()   # consumer abandoned iteration
+        skip = self._skip          # latch the resume skip for this pass
+        self._skip = 0
 
         def producer():
             try:
-                for feed in self._batch_reader():
+                for i, feed in enumerate(self._batch_reader()):
                     if stop.is_set():
                         return
+                    if i < skip:   # resume fast-forward: no staging cost
+                        continue
                     staged = self._stage(feed)
                     if _obs._ENABLED:
                         _obs.inc('dataloader_staged_bytes',
@@ -329,7 +362,15 @@ class _GeneratorLoader:
                 if item is end:
                     if err_box:
                         raise err_box[0]
+                    # clean exhaustion: advance the resume cursor one epoch
+                    self._epoch += 1
+                    self._consumed = 0
                     break
+                # count BEFORE yielding: while the consumer processes batch
+                # i the cursor already reads i+1, so a checkpoint taken at
+                # that step's boundary resumes AFTER the batch whose effects
+                # are in the state — never replaying it
+                self._consumed += 1
                 if self._return_list:
                     yield [item[k] for k in item]
                 else:

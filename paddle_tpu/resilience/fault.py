@@ -1,0 +1,122 @@
+"""Fault injection: the test harness for the resilience claims.
+
+``PADDLE_TPU_FAULT_INJECT`` holds a comma-separated list of fault clauses;
+each clause is ``<action>@<key>=<value>``:
+
+- ``kill@step=N`` — SIGKILL this process (a literal ``kill -9``, no atexit,
+  no flushing) at the step-N boundary. This is how the crash/resume tests
+  create a mid-run hard failure without cooperating code paths.
+- ``io_fail@times=N`` — the first N checkpoint IO attempts raise
+  ``OSError`` (then IO succeeds); exercises the retry-with-backoff path
+  deterministically.
+- ``io_fail@prob=P`` — each checkpoint IO attempt fails independently with
+  probability P, drawn from a generator seeded by ``PADDLE_TPU_FAULT_SEED``
+  (default 0) so a given run is reproducible.
+
+The hooks are called from the resilience subsystem only (step boundaries in
+:meth:`CheckpointManager.end_of_step`, IO attempts in the background
+writer) — the training hot path never reads the env. Injections are counted
+as ``fault_injections{site=...}`` through the telemetry registry.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import signal
+
+from .. import observability as _obs
+from ..log_helper import get_logger
+
+__all__ = ['FaultInjector', 'get_injector', 'reset_injector']
+
+_logger = get_logger(
+    __name__, logging.INFO,
+    fmt='%(asctime)s-%(levelname)s: [resilience] %(message)s')
+
+ENV_SPEC = 'PADDLE_TPU_FAULT_INJECT'
+ENV_SEED = 'PADDLE_TPU_FAULT_SEED'
+
+
+class FaultInjector:
+    """Parsed fault plan. An empty/absent spec is a no-op injector whose
+    hooks cost one attribute read."""
+
+    def __init__(self, spec=None, seed=None):
+        self._kill_step = None
+        self._io_times = 0
+        self._io_prob = 0.0
+        self._rng = random.Random(
+            int(seed if seed is not None
+                else os.environ.get(ENV_SEED, '0') or 0))
+        self.active = False
+        for clause in (spec or '').split(','):
+            clause = clause.strip()
+            if not clause:
+                continue
+            try:
+                action, cond = clause.split('@', 1)
+                key, value = cond.split('=', 1)
+            except ValueError:
+                raise ValueError(
+                    f"{ENV_SPEC}: bad clause {clause!r} (want "
+                    f"'<action>@<key>=<value>', e.g. 'kill@step=8')")
+            action, key = action.strip(), key.strip()
+            if action == 'kill' and key == 'step':
+                self._kill_step = int(value)
+            elif action == 'io_fail' and key == 'times':
+                self._io_times = int(value)
+            elif action == 'io_fail' and key == 'prob':
+                self._io_prob = float(value)
+            else:
+                raise ValueError(
+                    f"{ENV_SPEC}: unknown clause {clause!r} (supported: "
+                    f"kill@step=N, io_fail@times=N, io_fail@prob=P)")
+            self.active = True
+
+    @classmethod
+    def from_env(cls):
+        return cls(os.environ.get(ENV_SPEC, ''))
+
+    # -- hooks ----------------------------------------------------------
+    def on_step(self, step):
+        """Step-boundary hook: hard-kills the process when the configured
+        step is reached. SIGKILL, not sys.exit — the point is that NOTHING
+        below (checkpoint flush, atexit, finally blocks) gets to run."""
+        if self._kill_step is not None and step == self._kill_step:
+            _obs.inc('fault_injections', site='kill_step',
+                     help='injected faults by site (PADDLE_TPU_FAULT_INJECT)')
+            _logger.warning('fault injection: SIGKILL at step %d', step)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_io(self, what='checkpoint'):
+        """Checkpoint-IO hook: raises OSError per the io_fail clauses."""
+        if self._io_times > 0:
+            self._io_times -= 1
+            _obs.inc('fault_injections', site='io_fail',
+                     help='injected faults by site (PADDLE_TPU_FAULT_INJECT)')
+            raise OSError(f'fault injection: {what} IO failed '
+                          f'({self._io_times} more scripted failures)')
+        if self._io_prob > 0.0 and self._rng.random() < self._io_prob:
+            _obs.inc('fault_injections', site='io_fail',
+                     help='injected faults by site (PADDLE_TPU_FAULT_INJECT)')
+            raise OSError(f'fault injection: {what} IO failed '
+                          f'(prob={self._io_prob})')
+
+
+_injector = None
+
+
+def get_injector():
+    """Process-wide injector parsed once from the environment."""
+    global _injector
+    if _injector is None:
+        _injector = FaultInjector.from_env()
+    return _injector
+
+
+def reset_injector():
+    """Re-read the env on next use (tests that mutate PADDLE_TPU_FAULT_INJECT
+    in-process)."""
+    global _injector
+    _injector = None

@@ -1,0 +1,127 @@
+"""Goodput accounting: productive step time / wall time, across restarts.
+
+Definitions (docs/RESILIENCE.md):
+
+- **productive seconds** — wall time spent between step boundaries whose
+  work SURVIVED (i.e. was either checkpointed or is in the live process).
+  Work done after the last committed checkpoint in a run that then crashed
+  is reclassified as **lost** on the next restart.
+- **wall seconds** — everything since the job first started, across every
+  incarnation, including checkpoint stalls, restart downtime, and replayed
+  steps.
+- **goodput** = productive / wall. A job that never checkpoints and never
+  crashes has goodput ≈ 1; every crash subtracts the replay and the
+  downtime.
+
+The tracker itself is process-local; cross-restart continuity comes from
+two places the :class:`~paddle_tpu.resilience.manager.CheckpointManager`
+maintains: the checkpoint manifest (cumulative counters as of the last
+COMMITTED step) and a tiny ``progress.json`` heartbeat (cumulative counters
+as of the last boundary the crashed run actually reached). Their difference
+is exactly the lost work.
+"""
+from __future__ import annotations
+
+import time
+
+from .. import observability as _obs
+
+__all__ = ['GoodputTracker']
+
+
+class GoodputTracker:
+    def __init__(self):
+        self._start_monotonic = time.monotonic()
+        self._start_unix = time.time()
+        # carried over from previous incarnations (restored checkpoints)
+        self.prior_productive_s = 0.0
+        self.prior_wall_s = 0.0
+        self.prior_steps = 0
+        # this incarnation
+        self.productive_s = 0.0
+        self.steps = 0
+        # restart accounting
+        self.restarts = 0
+        self.lost_steps = 0
+        self.lost_s = 0.0
+
+    # -- recording ------------------------------------------------------
+    def record_step(self, seconds):
+        self.productive_s += float(seconds)
+        self.steps += 1
+
+    def record_restart(self, ckpt_meta, progress):
+        """Called once at restore time. `ckpt_meta` is the restored
+        checkpoint's goodput block (counters at its commit); `progress` is
+        the crashed run's last heartbeat (or None). Restores the cumulative
+        counters and books the delta — everything the crashed run did past
+        the checkpoint — as lost work, plus the crash→restart downtime."""
+        self.restarts += 1
+        restored = (ckpt_meta or {})
+        self.prior_productive_s = float(restored.get('productive_s', 0.0))
+        self.prior_wall_s = float(restored.get('wall_s', 0.0))
+        self.prior_steps = int(restored.get('steps', 0))
+        self.restarts += int(restored.get('restarts', 0))
+        self.lost_steps += int(restored.get('lost_steps', 0))
+        self.lost_s += float(restored.get('lost_s', 0.0))
+        if progress:
+            lost_steps = max(0, int(progress.get('steps', 0))
+                             - self.prior_steps)
+            lost_s = max(0.0, float(progress.get('productive_s', 0.0))
+                         - self.prior_productive_s)
+            self.lost_steps += lost_steps
+            self.lost_s += lost_s
+            # downtime: crash (last heartbeat) → this process's start. Wall
+            # time the job paid but nobody computed in.
+            # the crashed run's FULL wall (not just up to the checkpoint),
+            # plus the crash → restart downtime, is wall the job paid
+            hb = progress.get('unix_time')
+            downtime = max(0.0, self._start_unix - float(hb)) if hb else 0.0
+            self.prior_wall_s = max(
+                self.prior_wall_s,
+                float(progress.get('wall_s', 0.0))) + downtime
+            if _obs._ENABLED:
+                _obs.inc('restart_lost_steps', lost_steps,
+                         help='steps of work lost to restarts (executed '
+                              'after the restored checkpoint, replayed)')
+                _obs.inc('restart_lost_seconds', lost_s,
+                         help='productive seconds lost to restarts')
+        if _obs._ENABLED:
+            _obs.inc('restarts_total',
+                     help='training restarts that restored a checkpoint')
+
+    # -- reading --------------------------------------------------------
+    def wall_seconds(self):
+        return self.prior_wall_s + (time.monotonic() - self._start_monotonic)
+
+    def total_productive_seconds(self):
+        return self.prior_productive_s + self.productive_s
+
+    def total_steps(self):
+        return self.prior_steps + self.steps
+
+    def goodput(self):
+        wall = self.wall_seconds()
+        return self.total_productive_seconds() / wall if wall > 0 else 0.0
+
+    def export_metrics(self):
+        if _obs._ENABLED:
+            _obs.set_gauge('goodput_ratio', self.goodput(),
+                           help='productive step seconds / wall seconds '
+                                '(cross-restart; docs/RESILIENCE.md)')
+            _obs.set_gauge('goodput_productive_seconds',
+                           self.total_productive_seconds(),
+                           help='cumulative productive step seconds')
+            _obs.set_gauge('goodput_wall_seconds', self.wall_seconds(),
+                           help='cumulative wall seconds since job start')
+
+    def meta(self):
+        """Cumulative counters for the checkpoint manifest / heartbeat."""
+        return {
+            'productive_s': round(self.total_productive_seconds(), 6),
+            'wall_s': round(self.wall_seconds(), 6),
+            'steps': self.total_steps(),
+            'restarts': self.restarts,
+            'lost_steps': self.lost_steps,
+            'lost_s': round(self.lost_s, 6),
+        }

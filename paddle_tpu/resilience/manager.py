@@ -1,0 +1,396 @@
+"""CheckpointManager: async non-stalling saves, keep-N, preemption, goodput.
+
+The train-loop contract (docs/RESILIENCE.md)::
+
+    mgr = resilience.CheckpointManager('ckpts', every_n_steps=100)
+    ck = mgr.latest()
+    if ck is not None:
+        arrays, meta = mgr.restore(ck)
+        resilience.restore_training_state(arrays, meta, executor=exe,
+                                          program=main, loader=loader)
+        step = meta['step']
+    for batch in loader():
+        ...run one step...
+        step += 1
+        if mgr.end_of_step(step, lambda: resilience.capture_training_state(
+                executor=exe, program=main, loader=loader)):
+            break            # preempted: final checkpoint committed, exit 0
+    mgr.close()
+
+Why the step loop never stalls: ``end_of_step`` captures state as
+NON-BLOCKING :class:`~paddle_tpu.core.fetch_handle.FetchHandle` s (the
+capture helpers either register donation protection with the executor's
+inflight window or clone on-device — both are dispatch-cost-only) and hands
+them to a background writer thread, which performs the device→host
+materialization, the ``np.savez``, the CRC, and the atomic
+temp→``os.replace``→manifest commit while the main thread is already
+dispatching the next steps. The only synchronous cost at a checkpoint
+boundary is handle creation plus — if a previous checkpoint is somehow
+still in flight — waiting for it; both are recorded as
+``checkpoint_stall_seconds`` and asserted < 1 step by
+``tools/bench_resilience.py``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .. import observability as _obs
+from ..log_helper import get_logger
+from . import snapshot as _snap
+from .fault import get_injector
+from .goodput import GoodputTracker
+from .preemption import PreemptionGuard
+
+__all__ = ['CheckpointManager']
+
+_logger = get_logger(
+    __name__, logging.INFO,
+    fmt='%(asctime)s-%(levelname)s: [resilience] %(message)s')
+
+ENV_DIR = 'PADDLE_TPU_CKPT_DIR'
+ENV_EVERY = 'PADDLE_TPU_CKPT_EVERY_N_STEPS'
+ENV_KEEP = 'PADDLE_TPU_CKPT_KEEP'
+ENV_RETRIES = 'PADDLE_TPU_CKPT_RETRIES'
+
+PROGRESS_FILE = 'progress.json'
+_TMP_MAX_AGE_S = 600.0
+
+
+def _env_int(name, default):
+    raw = os.environ.get(name, '').strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f'{name} must be an integer, got {raw!r}')
+
+
+class _SaveJob:
+    __slots__ = ('step', 'arrays', 'meta', 'done', 'error')
+
+    def __init__(self, step, arrays, meta):
+        self.step = step
+        self.arrays = arrays        # {flat_key: FetchHandle | array}
+        self.meta = meta
+        self.done = threading.Event()
+        self.error = None
+
+
+class CheckpointManager:
+    """Rolling async checkpointer with preemption + goodput accounting.
+
+    Parameters (env fallbacks in parentheses): `directory`
+    (``PADDLE_TPU_CKPT_DIR``), `every_n_steps` — periodic-save cadence for
+    :meth:`end_of_step` (``PADDLE_TPU_CKPT_EVERY_N_STEPS``), `keep` — last-N
+    retention (``PADDLE_TPU_CKPT_KEEP``, default 3), `retries` — attempts
+    per checkpoint IO failure with exponential backoff
+    (``PADDLE_TPU_CKPT_RETRIES``, default 3). ``async_save=False`` commits
+    on the calling thread (simplest-possible mode, and the bench baseline
+    the stall numbers are measured against)."""
+
+    def __init__(self, directory=None, every_n_steps=None, keep=None,
+                 async_save=True, retries=None, backoff_s=0.05,
+                 install_signal_handlers=True):
+        directory = directory or os.environ.get(ENV_DIR)
+        if not directory:
+            raise ValueError(
+                f'CheckpointManager needs a directory (argument or {ENV_DIR})')
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.every_n_steps = (every_n_steps if every_n_steps is not None
+                              else _env_int(ENV_EVERY, 0)) or None
+        self.keep = max(1, keep if keep is not None else _env_int(ENV_KEEP, 3))
+        self.retries = max(0, retries if retries is not None
+                           else _env_int(ENV_RETRIES, 3))
+        self.backoff_s = float(backoff_s)
+        self.async_save = bool(async_save)
+        self.goodput = GoodputTracker()
+        self._fault = get_injector()
+        self._preemption = PreemptionGuard()
+        if install_signal_handlers:
+            self._preemption.install()
+        self._queue = queue.Queue(maxsize=1)
+        self._inflight = None         # last submitted _SaveJob
+        self._writer = None
+        self._error = None            # first unrecovered write failure
+        self._last_boundary = None
+        self._last_saved_step = None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # discovery / restore
+    # ------------------------------------------------------------------
+    def latest(self):
+        """Newest VALID checkpoint (torn/corrupt ones are skipped with a
+        logged warning), or None on a fresh directory."""
+        return _snap.latest_checkpoint(self.directory)
+
+    def all_checkpoints(self):
+        return _snap.list_checkpoints(self.directory)
+
+    def restore(self, ckpt=None):
+        """→ (arrays, meta) from `ckpt` (default: latest). Books restart +
+        lost-work accounting from the previous incarnation's heartbeat.
+        Returns None when there is nothing to restore."""
+        ckpt = ckpt if ckpt is not None else self.latest()
+        if ckpt is None:
+            return None
+        arrays, meta = _snap.read_checkpoint(ckpt)
+        self.goodput.record_restart(meta.get('goodput'),
+                                    self._read_progress())
+        self.goodput.export_metrics()
+        self._last_saved_step = ckpt.step
+        _logger.info('restored checkpoint step %d from %s (lost work: '
+                     '%d step(s))', ckpt.step, self.directory,
+                     self.goodput.lost_steps)
+        return arrays, meta
+
+    # ------------------------------------------------------------------
+    # saving
+    # ------------------------------------------------------------------
+    def save(self, step, arrays, meta=None, block=False):
+        """Queue one checkpoint. `arrays` values may be FetchHandles (the
+        non-stalling path — D2H happens on the writer thread), jax arrays,
+        or numpy. Raises the previous save's error, if any, rather than
+        silently dropping checkpoints after the writer broke."""
+        if self._closed:
+            raise RuntimeError('CheckpointManager is closed')
+        self._raise_pending_error()
+        meta = dict(meta or {})
+        meta.setdefault('step', int(step))
+        job = _SaveJob(int(step), dict(arrays), meta)
+        t0 = time.perf_counter()
+        if not self.async_save or block:
+            # commit on the calling thread (final/preemption checkpoints
+            # must be durable before the process exits)
+            if self._inflight is not None:
+                self._inflight.done.wait()
+            self._write(job)
+            if job.error is not None:
+                self._error = None
+                raise job.error
+        else:
+            self._ensure_writer()
+            if self._inflight is not None and not self._inflight.done.is_set():
+                # one checkpoint in flight at a time bounds host memory to
+                # 1× state; waiting here (rare: save cadence outpacing disk)
+                # is counted as stall
+                self._inflight.done.wait()
+                self._raise_pending_error()
+            self._inflight = job
+            self._queue.put(job)
+        stall = time.perf_counter() - t0
+        if _obs._ENABLED:
+            _obs.observe('checkpoint_stall_seconds', stall,
+                         help='time the step loop was blocked per '
+                              'checkpoint request (capture + enqueue; the '
+                              'write itself is off-thread)')
+        self._last_saved_step = int(step)
+        return job
+
+    def wait(self):
+        """Block until the in-flight save (if any) committed; re-raise its
+        failure."""
+        if self._inflight is not None:
+            self._inflight.done.wait()
+        self._raise_pending_error()
+
+    def _raise_pending_error(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def _ensure_writer(self):
+        if self._writer is None or not self._writer.is_alive():
+            self._writer = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name='paddle_tpu_checkpoint_writer')
+            self._writer.start()
+
+    def _writer_loop(self):
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            self._write(job)
+
+    def _write(self, job):
+        t0 = time.perf_counter()
+        try:
+            # materialize: for FetchHandles this is the device→host wait +
+            # copy, overlapped with the main thread's subsequent steps
+            arrays = {k: np.asarray(v) for k, v in job.arrays.items()}
+            job.arrays = None          # drop handles → donation unblocks
+            nbytes = None
+            for attempt in range(self.retries + 1):
+                try:
+                    self._fault.on_io()
+                    ck = _snap.write_checkpoint(
+                        self.directory, job.step, arrays, job.meta,
+                        saved_unix_time=time.time())
+                    nbytes = ck.manifest['payload_bytes']
+                    break
+                except OSError as e:
+                    if attempt >= self.retries:
+                        raise
+                    delay = self.backoff_s * (2 ** attempt)
+                    _logger.warning(
+                        'checkpoint step %d attempt %d/%d failed (%s); '
+                        'retrying in %.3fs', job.step, attempt + 1,
+                        self.retries + 1, e, delay)
+                    if _obs._ENABLED:
+                        _obs.inc('checkpoint_retries',
+                                 help='checkpoint IO attempts retried '
+                                      'after a failure')
+                    time.sleep(delay)
+            self._gc()
+            if _obs._ENABLED:
+                _obs.inc('checkpoint_saves',
+                         help='checkpoints committed (manifest written)')
+                _obs.inc('checkpoint_bytes', nbytes,
+                         help='checkpoint payload bytes written')
+                _obs.observe('checkpoint_save_seconds',
+                             time.perf_counter() - t0,
+                             help='materialize + write + commit time per '
+                                  'checkpoint (background thread)')
+                _obs.set_gauge('checkpoint_last_step', job.step,
+                               help='step of the newest committed '
+                                    'checkpoint')
+        except BaseException as e:      # surface on the next save()/wait()
+            job.error = e
+            self._error = e
+            _logger.error('checkpoint step %d FAILED after %d attempt(s): '
+                          '%s: %s', job.step, self.retries + 1,
+                          type(e).__name__, e)
+            if _obs._ENABLED:
+                _obs.inc('checkpoint_failures',
+                         help='checkpoints abandoned after exhausting '
+                              'retries')
+        finally:
+            job.done.set()
+
+    def _gc(self):
+        """Keep the newest `keep` valid checkpoints; delete manifest FIRST
+        (decommit), then payload — a crash mid-gc can only leave an orphan
+        payload, never a manifest pointing at nothing valid. Stale temp
+        litter from crashed writers is swept too."""
+        ckpts = _snap.list_checkpoints(self.directory)
+        for ck in ckpts[:-self.keep] if len(ckpts) > self.keep else []:
+            try:
+                os.unlink(ck.manifest_path)
+                os.unlink(ck.payload_path)
+            except OSError:
+                pass
+        now = time.time()
+        for name in os.listdir(self.directory):
+            if '.tmp-' in name:
+                p = os.path.join(self.directory, name)
+                try:
+                    if now - os.path.getmtime(p) > _TMP_MAX_AGE_S:
+                        os.unlink(p)
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------------
+    # the step-boundary hook
+    # ------------------------------------------------------------------
+    @property
+    def preemption_requested(self):
+        return self._preemption.requested
+
+    def request_preemption(self):
+        """Programmatic SIGTERM equivalent (tests, external agents)."""
+        self._preemption.request()
+
+    def end_of_step(self, step, state_fn, meta=None):
+        """Call once per completed training step. Runs the fault-injection
+        step hook, books goodput, saves when the cadence is due — and, on a
+        pending SIGTERM/SIGINT, saves a FINAL checkpoint synchronously and
+        returns True (the loop should exit cleanly).
+
+        `state_fn` is called only when a save actually happens; it returns
+        either an arrays dict or an ``(arrays, meta)`` tuple (the shape
+        :func:`~paddle_tpu.resilience.state.capture_training_state`
+        produces)."""
+        self._fault.on_step(step)      # may SIGKILL (that is the point)
+        now = time.perf_counter()
+        # the first boundary has no prior timestamp: the step still COUNTS
+        # (lost-work deltas are in steps), its duration is just unknown
+        self.goodput.record_step(
+            now - self._last_boundary if self._last_boundary is not None
+            else 0.0)
+        preempt = self._preemption.requested
+        due = (self.every_n_steps is not None
+               and step % self.every_n_steps == 0)
+        if due or preempt:
+            got = state_fn()
+            arrays, cap_meta = got if isinstance(got, tuple) else (got, {})
+            cap_meta = dict(cap_meta)
+            if meta:
+                cap_meta.update(meta)
+            cap_meta['step'] = int(step)
+            cap_meta['goodput'] = self.goodput.meta()
+            cap_meta['preempted'] = bool(preempt)
+            self.save(step, arrays, cap_meta, block=preempt)
+        self._write_progress(step)
+        self.goodput.export_metrics()
+        self._last_boundary = time.perf_counter()
+        if preempt:
+            self.wait()
+            _logger.info('preemption checkpoint committed at step %d; '
+                         'stopping', step)
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    # heartbeat
+    # ------------------------------------------------------------------
+    def _write_progress(self, step):
+        """Tiny atomic heartbeat: how far THIS incarnation actually got.
+        On restart, (heartbeat − restored checkpoint) is the lost work."""
+        doc = {'step': int(step),
+               'last_checkpoint_step': self._last_saved_step,
+               'unix_time': time.time()}
+        doc.update(self.goodput.meta())
+        try:
+            _snap.atomic_write_bytes(
+                os.path.join(self.directory, PROGRESS_FILE),
+                json.dumps(doc).encode())
+        except OSError as e:
+            _logger.warning('progress heartbeat failed: %s', e)
+
+    def _read_progress(self):
+        try:
+            with open(os.path.join(self.directory, PROGRESS_FILE)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    # ------------------------------------------------------------------
+    def close(self):
+        """Flush the writer, uninstall signal handlers. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            if self._inflight is not None:
+                self._inflight.done.wait()
+        finally:
+            if self._writer is not None and self._writer.is_alive():
+                self._queue.put(None)
+                self._writer.join(5)
+            self._preemption.uninstall()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

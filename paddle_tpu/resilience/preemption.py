@@ -1,0 +1,98 @@
+"""SIGTERM/SIGINT → graceful-stop flag for training loops.
+
+Pod schedulers preempt with SIGTERM and a grace window; Ctrl-C is SIGINT.
+Either way the correct move is the same: finish the in-flight step, write a
+final checkpoint at the next step boundary, exit 0 — never die mid-write.
+:class:`PreemptionGuard` converts the signal into a flag the
+:class:`~paddle_tpu.resilience.manager.CheckpointManager` polls at step
+boundaries; the handler itself does nothing slow or unsafe (signal context).
+
+A second SIGINT while a stop is already pending restores the previous
+handler and re-raises — an impatient Ctrl-C Ctrl-C still kills the process
+the way users expect.
+"""
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+from .. import observability as _obs
+from ..log_helper import get_logger
+
+__all__ = ['PreemptionGuard']
+
+_logger = get_logger(
+    __name__, logging.INFO,
+    fmt='%(asctime)s-%(levelname)s: [resilience] %(message)s')
+
+
+class PreemptionGuard:
+    """Installable SIGTERM/SIGINT trap with a thread-safe `requested` flag.
+
+    Installation only works from the main thread (a Python constraint);
+    elsewhere the guard degrades to an inert flag that :meth:`request` can
+    still set programmatically — so code using a CheckpointManager inside a
+    worker thread keeps working, just without signal wiring."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._old = {}
+        self._event = threading.Event()
+        self.installed = False
+
+    # -- lifecycle ------------------------------------------------------
+    def install(self):
+        try:
+            for s in self._signals:
+                self._old[s] = signal.signal(s, self._handler)
+            self.installed = True
+        except ValueError:
+            # not the main thread: signal.signal refuses. Stay inert.
+            self._old.clear()
+            _logger.warning(
+                'PreemptionGuard: not on the main thread, signal handlers '
+                'not installed (preemption must be requested '
+                'programmatically)')
+        return self
+
+    def uninstall(self):
+        if self.installed:
+            for s, old in self._old.items():
+                try:
+                    signal.signal(s, old)
+                except (ValueError, TypeError):
+                    pass
+            self._old.clear()
+            self.installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+
+    # -- signal path ----------------------------------------------------
+    def _handler(self, signum, frame):
+        if self._event.is_set() and signum == signal.SIGINT:
+            # second Ctrl-C: the user wants OUT, now
+            self.uninstall()
+            raise KeyboardInterrupt
+        self._event.set()
+        _obs.inc('preemption_requests',
+                 help='SIGTERM/SIGINT preemption notices received')
+        _logger.warning(
+            'received signal %d: will checkpoint at the next step boundary '
+            'and stop', signum)
+
+    # -- flag -----------------------------------------------------------
+    @property
+    def requested(self):
+        return self._event.is_set()
+
+    def request(self):
+        """Programmatic preemption (tests, cluster agents without signals)."""
+        self._event.set()
+
+    def clear(self):
+        self._event.clear()

@@ -1,0 +1,211 @@
+"""Checkpoint on-disk format: torn-write-proof payload + manifest commit.
+
+Layout inside a checkpoint directory::
+
+    ckpt-00000042.npz    payload — every state array, flat string keys
+    ckpt-00000042.json   manifest — written LAST via temp + os.replace
+
+The manifest is the commit marker. A checkpoint exists iff its manifest
+parses AND the payload it names matches the recorded byte size and CRC32 —
+so a ``kill -9`` at ANY instant leaves either a fully committed checkpoint
+or something :func:`list_checkpoints` skips (with a logged warning), never
+a loadable torn file. Both files are themselves written to a temp name in
+the target directory and atomically ``os.replace``d, so a crash mid-write
+leaves only ``.tmp-*`` litter (cleaned opportunistically by the manager's
+GC), never a half-written final name.
+
+Arrays are stored as raw numpy; dtypes numpy cannot serialize natively
+(bf16 & friends from ml_dtypes) are widened to float32 for the file — an
+exact, information-preserving widening — and the original dtype is recorded
+in the manifest so restore casts back bitwise.
+"""
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+import tempfile
+import zlib
+
+import numpy as np
+
+from ..log_helper import get_logger
+
+__all__ = ['Checkpoint', 'write_checkpoint', 'read_checkpoint',
+           'list_checkpoints', 'latest_checkpoint', 'atomic_write_bytes',
+           'FORMAT_VERSION']
+
+_logger = get_logger(
+    __name__, logging.INFO,
+    fmt='%(asctime)s-%(levelname)s: [resilience] %(message)s')
+
+FORMAT_VERSION = 1
+_PREFIX = 'ckpt-'
+
+# dtypes np.save round-trips without pickle; anything else is widened to
+# float32 (exact for the 16-bit float family) and cast back at restore
+_SAVEZ_KINDS = frozenset('fiub')
+
+
+def _payload_name(step):
+    return f'{_PREFIX}{int(step):08d}.npz'
+
+
+def _manifest_name(step):
+    return f'{_PREFIX}{int(step):08d}.json'
+
+
+def atomic_write_bytes(path, data):
+    """Write bytes to `path` via temp-in-same-dir + fsync + os.replace: a
+    reader can never observe a partially written `path`."""
+    directory = os.path.dirname(os.path.abspath(path)) or '.'
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + '.tmp-', dir=directory)
+    try:
+        with os.fdopen(fd, 'wb') as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class Checkpoint:
+    """One committed checkpoint (a validated manifest + payload pair)."""
+
+    __slots__ = ('step', 'directory', 'manifest')
+
+    def __init__(self, step, directory, manifest):
+        self.step = int(step)
+        self.directory = directory
+        self.manifest = manifest
+
+    @property
+    def payload_path(self):
+        return os.path.join(self.directory, self.manifest['payload'])
+
+    @property
+    def manifest_path(self):
+        return os.path.join(self.directory, _manifest_name(self.step))
+
+    @property
+    def meta(self):
+        return self.manifest.get('meta', {})
+
+    def __repr__(self):
+        return (f"Checkpoint(step={self.step}, "
+                f"bytes={self.manifest.get('payload_bytes')})")
+
+
+def write_checkpoint(directory, step, arrays, meta=None, saved_unix_time=None):
+    """Serialize `arrays` ({flat_key: ndarray-like}) + commit the manifest.
+    Returns the :class:`Checkpoint`. `arrays` values must already be host
+    numpy (the async writer materializes FetchHandles before calling this).
+    """
+    os.makedirs(directory, exist_ok=True)
+    meta = dict(meta or {})
+    narrow = {}
+    stored = {}
+    for key, value in arrays.items():
+        arr = np.asarray(value)
+        if arr.dtype.kind not in _SAVEZ_KINDS:
+            narrow[key] = str(arr.dtype)
+            arr = arr.astype(np.float32)
+        stored[key] = arr
+    if narrow:
+        meta['_widened_dtypes'] = narrow
+
+    buf = io.BytesIO()
+    np.savez(buf, **stored)
+    payload = buf.getvalue()
+
+    payload_path = os.path.join(directory, _payload_name(step))
+    atomic_write_bytes(payload_path, payload)
+
+    manifest = {
+        'format': FORMAT_VERSION,
+        'step': int(step),
+        'payload': _payload_name(step),
+        'payload_bytes': len(payload),
+        'payload_crc32': zlib.crc32(payload) & 0xFFFFFFFF,
+        'keys': sorted(stored),
+        'saved_unix_time': saved_unix_time,
+        'meta': meta,
+    }
+    atomic_write_bytes(os.path.join(directory, _manifest_name(step)),
+                       json.dumps(manifest, indent=1).encode())
+    return Checkpoint(step, directory, manifest)
+
+
+def _validate(directory, manifest):
+    """→ error string, or None when the payload matches the manifest."""
+    payload_path = os.path.join(directory, manifest.get('payload', ''))
+    if not os.path.isfile(payload_path):
+        return 'payload missing'
+    size = os.path.getsize(payload_path)
+    if size != manifest.get('payload_bytes'):
+        return (f"payload is {size} bytes, manifest recorded "
+                f"{manifest.get('payload_bytes')} (torn write?)")
+    with open(payload_path, 'rb') as f:
+        crc = zlib.crc32(f.read()) & 0xFFFFFFFF
+    if crc != manifest.get('payload_crc32'):
+        return 'payload CRC mismatch (corrupt write?)'
+    return None
+
+
+def list_checkpoints(directory):
+    """All VALID checkpoints in `directory`, oldest first. Manifests that
+    fail to parse, or whose payload is missing/truncated/corrupt, are
+    skipped with a logged warning — a torn checkpoint must never crash (or
+    win) discovery."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in sorted(os.listdir(directory)):
+        if not (name.startswith(_PREFIX) and name.endswith('.json')):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+            step = int(manifest['step'])
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            _logger.warning('skipping unreadable checkpoint manifest %s: %s',
+                            path, e)
+            continue
+        err = _validate(directory, manifest)
+        if err:
+            _logger.warning('skipping checkpoint step %d at %s: %s',
+                            step, directory, err)
+            continue
+        out.append(Checkpoint(step, directory, manifest))
+    out.sort(key=lambda c: c.step)
+    return out
+
+
+def latest_checkpoint(directory):
+    """Newest valid checkpoint, or None."""
+    ckpts = list_checkpoints(directory)
+    return ckpts[-1] if ckpts else None
+
+
+def read_checkpoint(ckpt):
+    """Checkpoint → ({flat_key: np.ndarray}, meta dict). Widened dtypes are
+    cast back to their recorded originals (bitwise — the widening was
+    exact)."""
+    with np.load(ckpt.payload_path) as data:
+        arrays = {k: data[k] for k in data.files}
+    meta = dict(ckpt.meta)
+    narrow = meta.pop('_widened_dtypes', None) or {}
+    if narrow:
+        import ml_dtypes  # noqa: F401 — registers bf16 etc. with numpy
+        for key, dtype in narrow.items():
+            if key in arrays:
+                arrays[key] = arrays[key].astype(np.dtype(dtype))
+    return arrays, meta

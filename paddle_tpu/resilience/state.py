@@ -1,0 +1,162 @@
+"""Full-state capture/restore: everything a bitwise-identical resume needs.
+
+A training step's output is a pure function of (persistable state, batch,
+per-step PRNG key). The key for step N is ``fold_in(base_key(seed),
+executor_step_counter)`` with per-op ``_rng_salt`` folds below it, and the
+batch is a pure function of (reader definition, epoch, batch index). So the
+complete resume state is:
+
+- every persistable (params, optimizer slots, BN stats, lr vars) — the
+  ``scope/<name>`` keys;
+- the fused-TrainStep equivalents (``param/ buffer/ slot/ acc/`` keys +
+  its step/accumulation counters) when training through
+  :class:`~paddle_tpu.dygraph.jit.TrainStep`;
+- the RNG plumbing: global seed, :class:`KeyGenerator` counter, the
+  Executor's run counter (meta ``rng``), plus the host-side ``random`` /
+  ``np.random`` generator states (meta ``python_rng``) for shuffling
+  readers;
+- the DataLoader cursor (meta ``loader``: epoch + batch index).
+
+Capture is NON-BLOCKING: scope state is wrapped in
+:class:`~paddle_tpu.core.fetch_handle.FetchHandle` s that are either
+donation-protected through the executor's inflight window (zero-copy; the
+executor keeps those buffers un-donated until the writer materializes them)
+or cloned on-device first (`mode='copy'` — the TrainStep-with-donation
+path, where per-name protection is impossible because the fused step
+donates its whole pytree).
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.random import default_generator
+
+__all__ = ['capture_training_state', 'restore_training_state',
+           'rng_state', 'restore_rng_state']
+
+SCOPE_PREFIX = 'scope/'
+
+
+# ---------------------------------------------------------------------------
+# RNG
+# ---------------------------------------------------------------------------
+
+def rng_state(executor=None):
+    from .. import framework
+    st = {'generator': default_generator.state(),
+          'global_seed': framework.get_global_seed()}
+    if executor is not None:
+        st['executor_steps'] = executor._step_counter
+    return st
+
+
+def restore_rng_state(st, executor=None):
+    from .. import framework
+    if 'generator' in st:
+        default_generator.set_state(st['generator'])
+    if 'global_seed' in st:
+        framework.manual_seed(st['global_seed'])
+    if executor is not None and 'executor_steps' in st:
+        executor._step_counter = int(st['executor_steps'])
+
+
+def _python_rng_state():
+    version, internal, gauss = _pyrandom.getstate()
+    alg, keys, pos, has_gauss, cached = np.random.get_state()
+    return {'random': [version, list(internal), gauss],
+            'numpy': {'alg': alg, 'keys': np.asarray(keys).tolist(),
+                      'pos': int(pos), 'has_gauss': int(has_gauss),
+                      'cached': float(cached)}}
+
+
+def _restore_python_rng_state(st):
+    if 'random' in st:
+        version, internal, gauss = st['random']
+        _pyrandom.setstate((version, tuple(internal), gauss))
+    if 'numpy' in st:
+        ns = st['numpy']
+        np.random.set_state((ns['alg'],
+                             np.asarray(ns['keys'], np.uint32),
+                             ns['pos'], ns['has_gauss'], ns['cached']))
+
+
+# ---------------------------------------------------------------------------
+# capture / restore
+# ---------------------------------------------------------------------------
+
+def capture_training_state(executor=None, program=None, scope=None,
+                           train_step=None, loader=None, extra=None,
+                           mode=None):
+    """→ (arrays, meta) for :meth:`CheckpointManager.save`.
+
+    Pass the pieces the run actually uses: `executor`+`program` for the
+    static spine (persistables captured zero-copy, donation-protected),
+    `train_step` for the fused dygraph spine, `loader` for the DataLoader
+    cursor. `mode='copy'` forces on-device clones instead of donation
+    protection (e.g. capturing without an executor). `extra` merges
+    caller-specific arrays in under their own keys."""
+    arrays = {}
+    meta = {'rng': rng_state(executor=executor),
+            'python_rng': _python_rng_state()}
+
+    if train_step is not None:
+        ts_arrays, ts_meta = train_step.snapshot()
+        arrays.update(ts_arrays)
+        meta['train_step'] = ts_meta
+
+    if program is not None:
+        if executor is not None and mode != 'copy':
+            handles = executor.snapshot_persistables(program, scope)
+        else:
+            from ..core.fetch_handle import FetchHandle
+            from ..core.scope import global_scope
+            scope_ = scope if scope is not None else global_scope()
+            handles = {}
+            for v in program.list_vars():
+                if not v.persistable:
+                    continue
+                val = scope_.find(v.name)
+                if val is None:
+                    continue
+                if hasattr(val, 'block_until_ready'):   # device array: clone
+                    val = jnp.copy(val)
+                handles[v.name] = FetchHandle(val, name=v.name)
+        arrays.update({SCOPE_PREFIX + n: h for n, h in handles.items()})
+
+    if loader is not None:
+        meta['loader'] = loader.state_dict()
+    if extra:
+        arrays.update(extra)
+    return arrays, meta
+
+
+def restore_training_state(arrays, meta, executor=None, program=None,
+                           scope=None, train_step=None, loader=None):
+    """Inverse of :func:`capture_training_state`. Restore AFTER the startup
+    program ran (the scope must hold every persistable's slot; restored
+    values then overwrite the fresh initialization — and the RNG counters
+    overwrite whatever startup consumed)."""
+    meta = meta or {}
+    if program is not None:
+        from ..core.dtypes import to_jax_dtype
+        from ..core.scope import global_scope
+        scope_ = scope if scope is not None else global_scope()
+        by_name = {v.name: v for v in program.list_vars() if v.persistable}
+        for key, arr in arrays.items():
+            if not key.startswith(SCOPE_PREFIX):
+                continue
+            name = key[len(SCOPE_PREFIX):]
+            v = by_name.get(name)
+            dtype = to_jax_dtype(v.dtype) if v is not None else None
+            scope_.set(name, jnp.asarray(arr, dtype))
+    if train_step is not None and 'train_step' in meta:
+        train_step.set_state(arrays, meta['train_step'])
+    if loader is not None and 'loader' in meta:
+        loader.set_state_dict(meta['loader'])
+    if 'rng' in meta:
+        restore_rng_state(meta['rng'], executor=executor)
+    if 'python_rng' in meta:
+        _restore_python_rng_state(meta['python_rng'])

@@ -288,17 +288,19 @@ class MicroBatcher:
     # -- lifecycle ---------------------------------------------------------
     def close(self, drain=True, timeout=None):
         """Stop admission, then either drain queued requests (default) or
-        fail them fast with EngineClosed. Idempotent; joins the worker."""
+        fail them fast with EngineClosed. Idempotent; joins the worker.
+        A later ``close(drain=False)`` while a drain is still running
+        ESCALATES it: remaining queued requests fail fast (the SIGTERM
+        drain-timeout path in server.py)."""
         with self._cv:
-            if not self._closing:
-                self._closing = True
-                if not drain:
-                    while self._queue:
-                        req = self._queue.popleft()
-                        req.future._set_exception(
-                            EngineClosed('serving engine shut down before '
-                                         'this request ran'))
-                    _m.queue_depth.set(0)
+            self._closing = True
+            if not drain:
+                while self._queue:
+                    req = self._queue.popleft()
+                    req.future._set_exception(
+                        EngineClosed('serving engine shut down before '
+                                     'this request ran'))
+                _m.queue_depth.set(0)
             self._cv.notify_all()
         if self._worker.is_alive():
             self._worker.join(timeout)

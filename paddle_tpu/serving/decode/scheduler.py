@@ -360,9 +360,14 @@ class DecodeScheduler:
         and in-flight generations fast with EngineClosed (the failing
         itself happens on the worker thread — slot state has one owner)."""
         with self._cv:
-            if not self._closing:
-                self._closing = True
+            first = not self._closing
+            self._closing = True
+            if first:
                 self._abort = not drain
+            elif not drain:
+                # escalation: a drain already in progress is converted to
+                # fail-fast (server.py's SIGTERM drain-timeout cap)
+                self._abort = True
             self._cv.notify_all()
         if self._worker.is_alive():
             self._worker.join(timeout)

@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import json
 import logging
+import os
+import signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -323,6 +325,9 @@ class ServingServer:
                          {k: round(s, 3) for k, s in timings.items()})
         self.request_timeout = request_timeout
         self.draining = False
+        self._shutdown_started = False
+        self._shutdown_lock = threading.Lock()
+        self._old_handlers = {}
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.serving = self
@@ -344,30 +349,86 @@ class ServingServer:
         return self
 
     def serve_forever(self):
-        """Foreground serve (the CLI path); Ctrl-C shuts down gracefully."""
+        """Foreground serve (the CLI path). SIGTERM (pod preemption) and
+        SIGINT (Ctrl-C) both trigger the graceful, timeout-capped drain —
+        see :meth:`install_signal_handlers`."""
         _logger.info('serving on %s:%d (buckets %s)',
                      self._httpd.server_address[0], self.port,
                      self.engine.buckets if self.engine else '[decode-only]')
+        try:
+            self.install_signal_handlers()
+        except ValueError:
+            pass                       # not the main thread: Ctrl-C only
         try:
             self._httpd.serve_forever()
         except KeyboardInterrupt:
             pass
         finally:
+            self.uninstall_signal_handlers()
             self.shutdown()
 
-    def shutdown(self, drain=True):
+    def install_signal_handlers(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        """SIGTERM-safe shutdown (docs/RESILIENCE.md): on signal, /healthz
+        flips to draining immediately (load-balancer eviction) and a
+        background thread runs the graceful ``shutdown(drain=True)`` — the
+        handler itself returns right away (signal context must stay cheap).
+        The drain is capped by ``PADDLE_TPU_DRAIN_TIMEOUT_S`` (default 30);
+        past the cap, remaining queued work fails fast with EngineClosed
+        rather than holding the pod through its kill grace period.
+
+        Must be called from the main thread; returns self. The CLI path
+        (`serve_forever`) installs these automatically."""
+        self._old_handlers = {}
+        for s in signals:
+            self._old_handlers[s] = signal.signal(s, self._on_signal)
+        return self
+
+    def uninstall_signal_handlers(self):
+        for s, old in getattr(self, '_old_handlers', {}).items():
+            try:
+                signal.signal(s, old)
+            except (ValueError, TypeError):
+                pass
+        self._old_handlers = {}
+
+    def _on_signal(self, signum, frame):
+        _logger.warning('signal %d: draining (healthz now 503)', signum)
+        self.draining = True           # visible to /healthz immediately
+        threading.Thread(target=self.shutdown, kwargs={'drain': True},
+                         name='paddle-tpu-serving-drain',
+                         daemon=True).start()
+
+    def shutdown(self, drain=True, timeout=None):
         """Graceful stop: healthz flips to draining, admission closes, queued
-        requests run to completion (drain=True), then the listener stops."""
-        if self.draining:
-            return
+        requests run to completion (drain=True), then the listener stops.
+        `timeout` (default ``PADDLE_TPU_DRAIN_TIMEOUT_S``, 30s) caps the
+        drain: components still busy at the deadline are re-closed with
+        drain=False, failing their remaining queue fast."""
+        with self._shutdown_lock:
+            if self._shutdown_started:
+                return
+            self._shutdown_started = True
         self.draining = True
-        if self.batcher is not None:
-            self.batcher.close(drain=drain)
-        if self.generator is not None:
-            self.generator.close(drain=drain)
+        if timeout is None:
+            timeout = float(
+                os.environ.get('PADDLE_TPU_DRAIN_TIMEOUT_S', '') or 30.0)
+        deadline = time.monotonic() + timeout
+        for comp in (self.batcher, self.generator):
+            if comp is None:
+                continue
+            remaining = max(0.0, deadline - time.monotonic())
+            comp.close(drain=drain, timeout=remaining if drain else None)
+            if comp._worker.is_alive():
+                # drain exceeded its budget: escalate to fail-fast so the
+                # process exits inside the kill grace period
+                _logger.warning(
+                    'drain timeout (%.1fs) exceeded; failing remaining '
+                    'queued work fast', timeout)
+                comp.close(drain=False, timeout=5)
         self._httpd.shutdown()
         self._httpd.server_close()
-        if self._thread is not None:
+        if self._thread is not None and \
+                self._thread is not threading.current_thread():
             self._thread.join(5)
         _logger.info('serving stopped (drained=%s)', drain)
 

@@ -1,0 +1,226 @@
+"""Resilience bench (PERF.md §14): checkpoint stall + restart lost work.
+
+Two claims under measurement (docs/RESILIENCE.md):
+
+1. **Async checkpointing adds < 1 step of stall.** The same compute-bound
+   static training loop runs three ways from one initial state: no
+   checkpointing (baseline), async checkpointing every K steps (the
+   production path: non-blocking donation-protected capture + background
+   writer), and BLOCKING checkpointing every K steps (the strawman: the
+   loop materializes and writes synchronously). We report per-step p99 and
+   the stall attributable to checkpoint steps; acceptance is
+   ``async stall < 1 × baseline median step`` — and the checkpointed run's
+   losses must stay BITWISE equal to the baseline's (checkpointing must
+   observe the state, never perturb it).
+
+2. **Restart lost work is bounded by the cadence.** A run that
+   checkpoints every K steps and dies at step N loses N − K⌊N/K⌋ steps;
+   we restore in a fresh manager and report the lost-work accounting the
+   goodput tracker books from the progress heartbeat.
+
+Valid on CPU — both quantities are host/IO behavior, not FLOPs:
+
+  JAX_PLATFORMS=cpu python tools/bench_resilience.py [--smoke] [--steps N]
+      [--every K]
+
+Acceptance (tier-1, tests/framework/test_bench_resilience.py): async
+stall_steps < 1.0 with bitwise-identical losses, and measured lost work ==
+expected from the cadence.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_mlp(smoke=False):
+    """Compute-bound RNG-free MLP + SGD (bitwise parity by construction)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers as L
+    width, depth, bs = (512, 4, 128) if smoke else (1024, 8, 256)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data('res_x', [784], dtype='float32')
+        y = L.data('res_y', [1], dtype='float32')
+        h = x
+        for _ in range(depth):
+            h = L.fc(h, size=width, act='relu')
+        pred = L.fc(h, size=1)
+        loss = L.reduce_mean(L.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=1e-3).minimize(loss)
+    return main, startup, bs, loss
+
+
+def _feeds(bs, steps, seed=0):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    return [{'res_x': rng.randn(bs, 784).astype(np.float32),
+             'res_y': rng.randn(bs, 1).astype(np.float32)}
+            for _ in range(steps)]
+
+
+def _p(times, q):
+    s = sorted(times)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def _loop(exe, main, loss, feeds, mgr=None, every=0, capture=None):
+    """One timed loop; returns (per-step seconds, loss bytes)."""
+    import numpy as np
+    times, losses = [], []
+    step = 0
+    for feed in feeds:
+        t0 = time.perf_counter()
+        lv = exe.run(main, feed=feed, fetch_list=[loss])[0]
+        step += 1
+        if mgr is not None and every and step % every == 0:
+            mgr.end_of_step(step, capture)
+        times.append(time.perf_counter() - t0)
+        losses.append(np.asarray(lv).tobytes())
+    return times, losses
+
+
+def measure_stall(smoke=False, steps=None, every=None):
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import resilience
+    import tempfile
+
+    main, startup, bs, loss = build_mlp(smoke)
+    steps = steps or (24 if smoke else 48)
+    every = every or 6
+    feeds = _feeds(bs, steps)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        snap0 = {v.name: np.asarray(scope.find(v.name))
+                 for v in main.list_vars() if v.persistable}
+
+        def restore0():
+            import jax.numpy as jnp
+            for n, v in snap0.items():
+                scope.set(n, jnp.asarray(v))
+
+        def capture():
+            return resilience.capture_training_state(
+                executor=exe, program=main, scope=scope)
+
+        # warm BOTH compiled variants: the plain donating step AND the
+        # snapshot-protected (nothing-donated) step the first checkpoint
+        # boundary switches to
+        exe.run(main, feed=feeds[0], fetch_list=[loss])
+        handles = exe.snapshot_persistables(main, scope)
+        exe.run(main, feed=feeds[0], fetch_list=[loss])
+        for h in handles.values():
+            np.asarray(h)
+        exe.run(main, feed=feeds[0], fetch_list=[loss])
+
+        restore0()
+        base_t, base_l = _loop(exe, main, loss, feeds)
+
+        restore0()
+        with tempfile.TemporaryDirectory() as d:
+            mgr = resilience.CheckpointManager(
+                d, keep=2, async_save=True, install_signal_handlers=False)
+            async_t, async_l = _loop(exe, main, loss, feeds, mgr, every,
+                                     capture)
+            mgr.wait()
+            mgr.close()
+
+        restore0()
+        with tempfile.TemporaryDirectory() as d:
+            mgr = resilience.CheckpointManager(
+                d, keep=2, async_save=False, install_signal_handlers=False)
+            block_t, block_l = _loop(exe, main, loss, feeds, mgr, every,
+                                     capture)
+            mgr.close()
+
+    base_med = _p(base_t, 0.5)
+    ck_steps = [i for i in range(steps) if (i + 1) % every == 0]
+    async_ck_max = max(async_t[i] for i in ck_steps)
+    block_ck_max = max(block_t[i] for i in ck_steps)
+    async_stall = max(0.0, async_ck_max - base_med)
+    block_stall = max(0.0, block_ck_max - base_med)
+    return {
+        'bench': 'resilience_stall',
+        'steps': steps, 'ckpt_every': every,
+        'state_mb': round(sum(v.nbytes for v in snap0.values()) / 2**20, 2),
+        'base_median_ms': round(base_med * 1e3, 3),
+        'base_p99_ms': round(_p(base_t, 0.99) * 1e3, 3),
+        'async_p99_ms': round(_p(async_t, 0.99) * 1e3, 3),
+        'blocking_p99_ms': round(_p(block_t, 0.99) * 1e3, 3),
+        'async_ckpt_step_max_ms': round(async_ck_max * 1e3, 3),
+        'blocking_ckpt_step_max_ms': round(block_ck_max * 1e3, 3),
+        'async_stall_ms': round(async_stall * 1e3, 3),
+        'blocking_stall_ms': round(block_stall * 1e3, 3),
+        # the acceptance number: checkpoint stall in units of one step
+        'async_stall_steps': round(async_stall / base_med, 3),
+        'blocking_stall_steps': round(block_stall / base_med, 3),
+        'stall_lt_one_step': bool(async_stall < base_med),
+        'bitwise_identical': bool(base_l == async_l == block_l),
+    }
+
+
+def measure_restart(smoke=False):
+    """Lost-work accounting: run N steps checkpointing every K, 'crash'
+    (fresh manager), restore → lost = N mod K steps, booked from the
+    heartbeat."""
+    import numpy as np
+    from paddle_tpu import resilience
+    import tempfile
+
+    n, k = 13, 5
+    state = {'w': np.ones((256,), np.float32)}
+    with tempfile.TemporaryDirectory() as d:
+        mgr = resilience.CheckpointManager(
+            d, every_n_steps=k, keep=2, install_signal_handlers=False)
+        for s in range(1, n + 1):
+            mgr.end_of_step(s, lambda: (state, {}))
+        mgr.wait()
+        # simulated preemption: a new incarnation restores
+        mgr2 = resilience.CheckpointManager(
+            d, every_n_steps=k, keep=2, install_signal_handlers=False)
+        arrays, meta = mgr2.restore()
+        got = {
+            'bench': 'resilience_restart',
+            'steps_run': n, 'ckpt_every': k,
+            'restored_step': meta['step'],
+            'lost_steps': mgr2.goodput.lost_steps,
+            'expected_lost_steps': n - k * (n // k),
+            'goodput': round(mgr2.goodput.goodput(), 4),
+            'restarts': mgr2.goodput.restarts,
+        }
+        mgr.close()
+        mgr2.close()
+    return got
+
+
+def measure_all(smoke=False, steps=None, every=None):
+    return {'resilience_stall': measure_stall(smoke=smoke, steps=steps,
+                                              every=every),
+            'resilience_restart': measure_restart(smoke=smoke)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--smoke', action='store_true',
+                    help='tiny shapes / CI smoke sizes')
+    ap.add_argument('--steps', type=int, default=None)
+    ap.add_argument('--every', type=int, default=None,
+                    help='checkpoint cadence in steps')
+    args = ap.parse_args()
+    for res in measure_all(smoke=args.smoke, steps=args.steps,
+                           every=args.every).values():
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == '__main__':
+    main()

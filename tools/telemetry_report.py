@@ -166,6 +166,63 @@ def summarize(metrics, trace, steps, top=10):
                      'ExecutionStrategy.num_inflight_steps>1)')
     lines.append('')
 
+    # ---- resilience / goodput ----
+    saves = _counter(metrics, 'checkpoint_saves')
+    goodput = (metrics.get('goodput_ratio') or {}).get('samples', [])
+    lines.append('## Resilience / goodput')
+    if saves or goodput:
+        ck_bytes = _counter(metrics, 'checkpoint_bytes')
+        save_s = (metrics.get('checkpoint_save_seconds')
+                  or {}).get('samples', [])
+        stall_s = (metrics.get('checkpoint_stall_seconds')
+                   or {}).get('samples', [])
+        last = (metrics.get('checkpoint_last_step') or {}).get('samples', [])
+        lines.append(
+            f"checkpoints:           {int(saves)} committed "
+            f"({ck_bytes / 2**20:.1f} MiB"
+            + (f", latest step {int(last[0]['value'])}" if last else '')
+            + ')')
+        if save_s and save_s[0]['count']:
+            s = save_s[0]
+            lines.append(f"background write:      mean "
+                         f"{_ms(s['sum'] / s['count'])}, "
+                         f"max {_ms(s['max'] or 0)}")
+        if stall_s and stall_s[0]['count']:
+            s = stall_s[0]
+            lines.append(
+                f"step-loop stall:       mean {_ms(s['sum'] / s['count'])}, "
+                f"max {_ms(s['max'] or 0)} per checkpoint (the async "
+                f"writer hides the rest)")
+        retries = _counter(metrics, 'checkpoint_retries')
+        failures = _counter(metrics, 'checkpoint_failures')
+        if retries or failures:
+            lines.append(f"IO retries/failures:   {int(retries)} retried, "
+                         f"{int(failures)} abandoned")
+        if goodput:
+            prod = (metrics.get('goodput_productive_seconds')
+                    or {}).get('samples', [{'value': 0.0}])[0]['value']
+            gwall = (metrics.get('goodput_wall_seconds')
+                     or {}).get('samples', [{'value': 0.0}])[0]['value']
+            lines.append(f"goodput:               {goodput[0]['value']:.1%} "
+                         f"(productive {prod:.1f}s / wall {gwall:.1f}s)")
+        restarts = _counter(metrics, 'restarts_total')
+        if restarts:
+            lines.append(
+                f"restarts:              {int(restarts)}, lost "
+                f"{int(_counter(metrics, 'restart_lost_steps'))} step(s) / "
+                f"{_counter(metrics, 'restart_lost_seconds'):.2f}s of "
+                f"replayed work")
+        preempt = _counter(metrics, 'preemption_requests')
+        faults = _counter(metrics, 'fault_injections')
+        if preempt or faults:
+            lines.append(f"preemptions/faults:    {int(preempt)} preemption "
+                         f"notice(s), {int(faults)} injected fault(s)")
+    else:
+        lines.append('(no checkpoints recorded — wire a '
+                     'resilience.CheckpointManager into the loop; '
+                     'docs/RESILIENCE.md)')
+    lines.append('')
+
     # ---- compile-time breakdown ----
     lines.append('## Compile-time breakdown')
     any_compile = False
