@@ -389,9 +389,10 @@ def bench_async_pipeline(on_tpu):
 
 
 def bench_resilience(on_tpu):
-    """Checkpoint stall + restart lost-work (PERF.md §14): async
-    checkpointing must add < 1 step of stall and never perturb the losses.
-    Valid on CPU: the quantity under test is host/IO overlap."""
+    """Checkpoint stall + restart lost-work (PERF.md §14) and self-healing
+    (PERF.md §15): async checkpointing must add < 1 step of stall, the
+    supervisor+watchdog must be ≤2% on the healthy path, and neither may
+    ever perturb the losses. Valid on CPU: host/IO overlap under test."""
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), 'tools'))
     from bench_resilience import measure_all
@@ -542,10 +543,17 @@ def main():
     if rz is not None:
         emit({"metric": "resilience",
               "stall": rz['resilience_stall'],
-              "restart": rz['resilience_restart']})
+              "restart": rz['resilience_restart'],
+              "supervised": rz['resilience_supervised'],
+              "nan_recovery": rz['resilience_nan_recovery']})
         summary.update(
             ckpt_stall_steps=rz['resilience_stall']['async_stall_steps'],
-            ckpt_bitwise=rz['resilience_stall']['bitwise_identical'])
+            ckpt_bitwise=rz['resilience_stall']['bitwise_identical'],
+            supervisor_overhead_frac=rz['resilience_supervised']
+            ['overhead_frac'],
+            supervisor_bitwise=rz['resilience_supervised']
+            ['bitwise_identical'],
+            nan_recovery_ok=rz['resilience_nan_recovery']['recovered'])
 
     s = run("telemetry_sidecar", lambda: bench_telemetry_sidecar(on_tpu))
     if s is not None:

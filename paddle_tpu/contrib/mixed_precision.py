@@ -5,13 +5,48 @@ TPU-first: the fast dtype is bfloat16 (no loss scaling needed — bf16 keeps
 fp32's exponent range), but the reference's fp16 dynamic loss scaling
 machinery is kept for API parity and for fp16 compat runs. Master weights
 stay fp32; the cast list mirrors the ref's white/black lists.
+
+Observability (docs/OBSERVABILITY.md): the dynamic loss scale is exported
+as the ``amp_loss_scale`` gauge and overflow-skipped steps as the
+``amp_overflow_skipped_steps`` counter, on BOTH paths — the dygraph wrapper
+counts host-side at the skip, the static path accumulates an in-graph skip
+counter var that an at-export registry collector drains. The process-wide
+:func:`total_overflow_skips` / :meth:`OptimizerWithMixedPrecision.
+overflow_steps` feed the training supervisor's benignity check
+(resilience/supervisor.py): an AMP overflow skip is the optimizer
+ABSORBING a transient, by design — it must never be mistaken for
+divergence and trigger a rollback.
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
 from ..framework import in_dygraph_mode
+
+# host-visible overflow accounting, independent of PADDLE_TPU_TELEMETRY:
+# the supervisor consults this every boundary, so it must be a plain
+# attribute read, not a registry lookup
+_overflow_skips_total = 0
+
+
+def total_overflow_skips():
+    """Process-wide count of optimizer updates skipped on gradient overflow
+    (dygraph path host-observed; static-path skips are per-optimizer, see
+    :meth:`OptimizerWithMixedPrecision.overflow_steps`)."""
+    return _overflow_skips_total
+
+
+def _record_overflow_skip(loss_scale):
+    global _overflow_skips_total
+    _overflow_skips_total += 1
+    if _obs._ENABLED:
+        _obs.inc('amp_overflow_skipped_steps',
+                 help='optimizer updates skipped on non-finite gradients '
+                      '(dynamic loss scaling)')
+        _obs.set_gauge('amp_loss_scale', loss_scale,
+                       help='current dynamic loss scale')
 
 # ref: fp16_lists.py
 white_list = {'conv2d', 'conv3d', 'matmul', 'mul', 'conv2d_transpose'}
@@ -55,19 +90,59 @@ class OptimizerWithMixedPrecision:
         self._dtype = dtype
         self._good_steps = 0
         self._bad_steps = 0
+        self._skip_count = 0          # dygraph host-observed skips
         self._scale_var = None
+        self._skip_var = None         # static in-graph skip counter
+        self._exported_skips = 0      # collector high-water mark
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
 
-    def get_loss_scaling(self):
+    def get_loss_scaling(self, scope=None):
         if self._scale_var is not None:
             from ..core.scope import global_scope
-            val = global_scope().find(self._scale_var.name)
+            scope = scope if scope is not None else global_scope()
+            val = scope.find(self._scale_var.name)
             if val is not None:
                 import numpy as np
                 return float(np.asarray(val).reshape(())[()])
         return self._loss_scale
+
+    def overflow_steps(self, scope=None):
+        """Cumulative optimizer updates this optimizer skipped on gradient
+        overflow. Dygraph: host-counted at the skip. Static: reads the
+        in-graph skip counter var from the scope — a device→host read, so
+        callers (the supervisor's benignity check, the export collector)
+        only consult it off the hot path."""
+        if self._skip_var is not None:
+            from ..core.scope import global_scope
+            scope = scope if scope is not None else global_scope()
+            val = scope.find(self._skip_var.name)
+            if val is not None:
+                import numpy as np
+                return int(np.asarray(val).reshape(())[()])
+        return self._skip_count
+
+    def _register_export_collector(self):
+        """Static path: surface the in-graph scale/skip state through the
+        registry at export time (scrapes, dump_artifacts) — zero cost per
+        step, one scope read per export."""
+        from ..observability import registry
+
+        def collect():
+            registry.gauge(
+                'amp_loss_scale',
+                'current dynamic loss scale').set(self.get_loss_scaling())
+            skips = self.overflow_steps()
+            delta = skips - self._exported_skips
+            if delta > 0:
+                self._exported_skips = skips
+                registry.counter(
+                    'amp_overflow_skipped_steps',
+                    'optimizer updates skipped on non-finite gradients '
+                    '(dynamic loss scaling)').inc(delta)
+
+        registry.register_collector(collect)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -126,6 +201,20 @@ class OptimizerWithMixedPrecision:
             type='check_finite_and_unscale',
             inputs={'xs': gnames, 'scale': scale_var.name},
             outputs={'Out': gnames, 'FoundInfinite': found.name})
+        # monotonic in-graph skip counter: `bad` decays to 0 on each scale
+        # decrease, so observability needs its own accumulator. One cast +
+        # add fused into the step; drained by the export collector and read
+        # by the supervisor's benignity check (overflow_steps).
+        skip_var = T.create_global_var(
+            [1], 0, 'int32', persistable=True,
+            name=un.generate('loss_scaling_skips'))
+        self._skip_var = skip_var
+        found_i32 = apply_op_layer('cast', {'x': found}, {'dtype': 'int32'})
+        helper.append_op(
+            type='elementwise_add',
+            inputs={'x': skip_var.name, 'y': found_i32.name},
+            outputs={'Out': skip_var.name})
+        self._register_export_collector()
         if self._dynamic:
             helper.append_op(
                 type='update_loss_scaling',
@@ -162,6 +251,8 @@ class OptimizerWithMixedPrecision:
                     self._loss_scale = max(
                         self._loss_scale * self._decr_ratio, 1.0)
                     self._bad_steps = 0
+            self._skip_count += 1
+            _record_overflow_skip(self._loss_scale)
             for p in params:
                 p.clear_gradient()
             return None, []
@@ -170,6 +261,9 @@ class OptimizerWithMixedPrecision:
         if self._dynamic and self._good_steps >= self._incr_every:
             self._loss_scale *= self._incr_ratio
             self._good_steps = 0
+        if _obs._ENABLED:
+            _obs.set_gauge('amp_loss_scale', self._loss_scale,
+                           help='current dynamic loss scale')
         return self._inner.minimize(loss, parameter_list=params)
 
 

@@ -114,6 +114,14 @@ class FetchHandle:
         except (AttributeError, RuntimeError):
             return True          # non-jax value: nothing pending
 
+    def device_array(self):
+        """The wrapped value WITHOUT forcing a device→host copy: the
+        still-on-device jax array while unmaterialized, the cached host
+        array after. The supervisor's skip policy uses this to write a
+        pre-step snapshot back into the scope as a device-to-device
+        assignment instead of a D2H+H2D round trip."""
+        return self._value if self._value is not None else self._host
+
     # -- synchronization -----------------------------------------------
     def block_until_ready(self):
         """Wait for the device computation; the value stays on device."""

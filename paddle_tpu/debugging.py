@@ -41,7 +41,13 @@ def enable_check_nan_inf(enable=True):
     and the `nonfinite_detections` counter still increments per detection.
     `jax_debug_nans` remains step-accurate in either mode (it raises from
     inside the computation). Set PADDLE_TPU_ASYNC=0 to pin the per-step
-    fetch scan while hunting a NaN."""
+    fetch scan while hunting a NaN.
+
+    A supervised loop (resilience/supervisor.py) rides the same machinery:
+    the supervisor materializes the loss it judges, ABSORBS the
+    FloatingPointError a check_nan-armed handle raises, and converts it
+    into a non-finite detection handled by the configured skip/rollback
+    policy instead of a dead run."""
     global _check_enabled
     _check_enabled = enable
     jax.config.update('jax_debug_nans', bool(enable))
@@ -51,6 +57,19 @@ def check_nan_inf_enabled():
     return _check_enabled
 
 
+def nonfinite_summary(value):
+    """→ ``{'nan': n, 'inf': n, 'size': n}`` for a host array, or None when
+    every element is finite (or the dtype is non-float). The shared
+    detection primitive behind :func:`check_numerics`, the executor's fetch
+    scan, and the supervisor's quarantine records."""
+    arr = np.asarray(value)
+    if arr.dtype.kind != 'f' or np.isfinite(arr).all():
+        return None
+    return {'nan': int(np.isnan(arr).sum()),
+            'inf': int(np.isinf(arr).sum()),
+            'size': int(arr.size)}
+
+
 def check_numerics(value, name='tensor'):
     """Raise if `value` (array or pytree) has NaN/Inf. Usable on fetched
     numpy results or inside eager code."""
@@ -58,10 +77,9 @@ def check_numerics(value, name='tensor'):
 
     def visit(path, v):
         arr = np.asarray(v)
-        if arr.dtype.kind == 'f' and not np.isfinite(arr).all():
-            n_nan = int(np.isnan(arr).sum())
-            n_inf = int(np.isinf(arr).sum())
-            bad.append(f"{path}: {n_nan} NaN, {n_inf} Inf "
+        summary = nonfinite_summary(arr)
+        if summary is not None:
+            bad.append(f"{path}: {summary['nan']} NaN, {summary['inf']} Inf "
                        f"(shape {arr.shape})")
 
     leaves = jax.tree_util.tree_leaves_with_path(value) \

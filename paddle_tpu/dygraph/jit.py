@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import observability as _obs
+from ..resilience import watchdog as _watchdog
 from .tape import Tensor, Parameter, no_grad_guard
 from .layers import Layer
 
@@ -478,7 +479,7 @@ class TrainStep:
 
     def __init__(self, layer: Layer, loss_fn, optimizer, data_sharding=None,
                  remat=False, donate=True, amp_dtype=None, accum_steps=1,
-                 async_fetch=False, num_inflight_steps=None):
+                 async_fetch=False, num_inflight_steps=None, supervisor=None):
         from ..core.compile_cache import setup_persistent_cache
         setup_persistent_cache()   # second process reuses the compiled step
         self._layer = layer
@@ -520,6 +521,13 @@ class TrainStep:
         else:
             self._async_k = 0
         self._window = InflightWindow() if self._async_k else None
+        # supervisor (resilience/supervisor.py): every call's loss is judged
+        # at this boundary — a skip verdict restores the pre-step snapshot
+        # via set_state, a rollback verdict surfaces on supervisor
+        # .last_verdict; escalations raise TrainingDiverged out of the call.
+        self._supervisor = supervisor
+        if supervisor is not None and supervisor._train_step is None:
+            supervisor._train_step = self
 
     def _build(self):
         layer = self._layer
@@ -711,16 +719,27 @@ class TrainStep:
             lr.step_num = meta['lr_step_num']
 
     def __call__(self, *batch):
-        if not _obs._ENABLED:
-            return self._call_impl(batch)
-        # span tree per fused step: build (first call only) + execute nest
-        # under train_step/call; one steps.jsonl record per call
-        with _obs.span('train_step/call', step=self._step + 1):
-            loss = self._call_impl(batch)
-        _obs.inc('train_step_calls', help='fused TrainStep invocations')
-        _obs.log_step(kind='train_step', step=self._step,
-                      accum_steps=self._accum_steps,
-                      donate=self._donate)
+        # hang watchdog lease over the fused dispatch (free when no process
+        # watchdog is armed; see resilience/watchdog.py)
+        lease = _watchdog.arm_step('train_step')
+        try:
+            if not _obs._ENABLED:
+                loss = self._call_impl(batch)
+            else:
+                # span tree per fused step: build (first call only) +
+                # execute nest under train_step/call; one steps.jsonl
+                # record per call
+                with _obs.span('train_step/call', step=self._step + 1):
+                    loss = self._call_impl(batch)
+                _obs.inc('train_step_calls',
+                         help='fused TrainStep invocations')
+                _obs.log_step(kind='train_step', step=self._step,
+                              accum_steps=self._accum_steps,
+                              donate=self._donate)
+        finally:
+            _watchdog.disarm(lease)
+        if self._supervisor is not None:
+            self._supervisor.end_of_step(self._step, loss)
         return loss
 
     def _call_impl(self, batch):

@@ -33,6 +33,7 @@ from .core.random import default_generator
 from .framework import (BACKWARD_OP_TYPE, Program, Variable,
                         default_main_program)
 from .ops.registry import NON_KERNEL_ATTRS, get_op
+from .resilience import watchdog as _watchdog
 
 
 class _OpRunner:
@@ -783,16 +784,25 @@ class Executor:
           is full — host feed prep and dispatch of step N+1 overlap device
           execution of step N (PERF.md §12, tools/bench_pipeline.py).
         """
-        if not _obs._ENABLED:
-            return self._run_impl(program, feed, fetch_list, scope,
-                                  return_numpy)
-        # telemetry on: every run is one span tree — prepare / lower /
-        # execute / fetch phases nest under executor/run (trace.json), the
-        # phase durations + donation/byte counts land in the metrics
-        # registry and one steps.jsonl record (docs/OBSERVABILITY.md)
-        with _obs.span('executor/run', step=self._step_counter + 1):
-            return self._run_impl(program, feed, fetch_list, scope,
-                                  return_numpy)
+        # hang watchdog (resilience/watchdog.py, PADDLE_TPU_WATCHDOG): a
+        # wedged device step breaches the 'executor_step' lease — deadline
+        # tracks this executor's own rolling-median run time (the first,
+        # compiling run gets the larger cold deadline). Free when no
+        # process watchdog is armed.
+        lease = _watchdog.arm_step('executor_step')
+        try:
+            if not _obs._ENABLED:
+                return self._run_impl(program, feed, fetch_list, scope,
+                                      return_numpy)
+            # telemetry on: every run is one span tree — prepare / lower /
+            # execute / fetch phases nest under executor/run (trace.json),
+            # the phase durations + donation/byte counts land in the metrics
+            # registry and one steps.jsonl record (docs/OBSERVABILITY.md)
+            with _obs.span('executor/run', step=self._step_counter + 1):
+                return self._run_impl(program, feed, fetch_list, scope,
+                                      return_numpy)
+        finally:
+            _watchdog.disarm(lease)
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy):
         from .compiler import CompiledProgram

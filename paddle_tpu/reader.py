@@ -18,6 +18,7 @@ import numpy as np
 import jax
 
 from . import observability as _obs
+from .resilience import watchdog as _watchdog
 
 __all__ = ['DataLoader', 'batch', 'shuffle', 'buffered', 'map_readers',
            'xmap_readers', 'chain', 'compose', 'firstn', 'cache',
@@ -296,12 +297,26 @@ class _GeneratorLoader:
 
         def producer():
             try:
-                for i, feed in enumerate(self._batch_reader()):
-                    if stop.is_set():
-                        return
-                    if i < skip:   # resume fast-forward: no staging cost
-                        continue
-                    staged = self._stage(feed)
+                it = enumerate(self._batch_reader())
+                while True:
+                    # hang watchdog: a wedged reader / device_put breaches
+                    # the producer's IO lease (resilience/watchdog.py; free
+                    # when no process watchdog is armed). Blocking on a FULL
+                    # ring below is the consumer's pace, not a hang — the
+                    # lease is released before the put.
+                    lease = _watchdog.arm_io('dataloader_producer')
+                    try:
+                        try:
+                            i, feed = next(it)
+                        except StopIteration:
+                            return
+                        if stop.is_set():
+                            return
+                        if i < skip:   # resume fast-forward: no staging cost
+                            continue
+                        staged = self._stage(feed)
+                    finally:
+                        _watchdog.disarm(lease)
                     if _obs._ENABLED:
                         _obs.inc('dataloader_staged_bytes',
                                  sum(getattr(v, 'nbytes', 0)

@@ -12,11 +12,23 @@ each clause is ``<action>@<key>=<value>``:
 - ``io_fail@prob=P`` — each checkpoint IO attempt fails independently with
   probability P, drawn from a generator seeded by ``PADDLE_TPU_FAULT_SEED``
   (default 0) so a given run is reproducible.
+- ``nan@step=N`` / ``spike@step=N`` — the loss observed by the
+  :class:`~paddle_tpu.resilience.supervisor.TrainingSupervisor` at step N
+  is replaced by NaN / multiplied by 1e9 (once per process), driving the
+  divergence-recovery paths (skip / rollback / escalate) deterministically.
+- ``hang@step=N`` — the step-N boundary blocks (default: effectively
+  forever; add ``hang@secs=S`` to bound it), simulating a wedged step so
+  the watchdog's dump-and-abort path is subprocess-testable.
+
+Unknown actions or keys raise ``ValueError`` listing the supported clauses
+— a typo like ``kil@step=3`` must fail the run at injector construction,
+not make a fault-injection test vacuously pass.
 
 The hooks are called from the resilience subsystem only (step boundaries in
-:meth:`CheckpointManager.end_of_step`, IO attempts in the background
-writer) — the training hot path never reads the env. Injections are counted
-as ``fault_injections{site=...}`` through the telemetry registry.
+:meth:`CheckpointManager.end_of_step`, loss observation in the supervisor,
+IO attempts in the background writer) — the training hot path never reads
+the env. Injections are counted as ``fault_injections{site=...}`` through
+the telemetry registry.
 """
 from __future__ import annotations
 
@@ -24,6 +36,7 @@ import logging
 import os
 import random
 import signal
+import time
 
 from .. import observability as _obs
 from ..log_helper import get_logger
@@ -42,10 +55,18 @@ class FaultInjector:
     """Parsed fault plan. An empty/absent spec is a no-op injector whose
     hooks cost one attribute read."""
 
+    SUPPORTED = ('kill@step=N, io_fail@times=N, io_fail@prob=P, nan@step=N, '
+                 'spike@step=N, hang@step=N, hang@secs=S')
+
     def __init__(self, spec=None, seed=None):
         self._kill_step = None
         self._io_times = 0
         self._io_prob = 0.0
+        self._nan_step = None
+        self._spike_step = None
+        self._hang_step = None
+        self._hang_secs = None        # None = effectively forever
+        self._fired = set()           # single-fire step clauses by action
         self._rng = random.Random(
             int(seed if seed is not None
                 else os.environ.get(ENV_SEED, '0') or 0))
@@ -60,7 +81,8 @@ class FaultInjector:
             except ValueError:
                 raise ValueError(
                     f"{ENV_SPEC}: bad clause {clause!r} (want "
-                    f"'<action>@<key>=<value>', e.g. 'kill@step=8')")
+                    f"'<action>@<key>=<value>', e.g. 'kill@step=8'; "
+                    f"supported: {self.SUPPORTED})")
             action, key = action.strip(), key.strip()
             if action == 'kill' and key == 'step':
                 self._kill_step = int(value)
@@ -68,10 +90,18 @@ class FaultInjector:
                 self._io_times = int(value)
             elif action == 'io_fail' and key == 'prob':
                 self._io_prob = float(value)
+            elif action == 'nan' and key == 'step':
+                self._nan_step = int(value)
+            elif action == 'spike' and key == 'step':
+                self._spike_step = int(value)
+            elif action == 'hang' and key == 'step':
+                self._hang_step = int(value)
+            elif action == 'hang' and key == 'secs':
+                self._hang_secs = float(value)
             else:
                 raise ValueError(
                     f"{ENV_SPEC}: unknown clause {clause!r} (supported: "
-                    f"kill@step=N, io_fail@times=N, io_fail@prob=P)")
+                    f"{self.SUPPORTED})")
             self.active = True
 
     @classmethod
@@ -82,12 +112,49 @@ class FaultInjector:
     def on_step(self, step):
         """Step-boundary hook: hard-kills the process when the configured
         step is reached. SIGKILL, not sys.exit — the point is that NOTHING
-        below (checkpoint flush, atexit, finally blocks) gets to run."""
+        below (checkpoint flush, atexit, finally blocks) gets to run.
+        A configured ``hang`` blocks here instead (once), simulating a
+        wedged step the watchdog must detect."""
         if self._kill_step is not None and step == self._kill_step:
             _obs.inc('fault_injections', site='kill_step',
                      help='injected faults by site (PADDLE_TPU_FAULT_INJECT)')
             _logger.warning('fault injection: SIGKILL at step %d', step)
             os.kill(os.getpid(), signal.SIGKILL)
+        if (self._hang_step is not None and step == self._hang_step
+                and 'hang' not in self._fired):
+            self._fired.add('hang')
+            secs = self._hang_secs if self._hang_secs is not None else 86400.0
+            _obs.inc('fault_injections', site='hang_step',
+                     help='injected faults by site (PADDLE_TPU_FAULT_INJECT)')
+            _logger.warning('fault injection: hanging %.1fs at step %d',
+                            secs, step)
+            time.sleep(secs)
+
+    def wants_loss(self, step):
+        """Whether :meth:`on_loss` would alter the loss at `step` — lets the
+        supervisor materialize a pending FetchHandle early only when an
+        injection actually targets this step."""
+        return (self._nan_step == step and 'nan' not in self._fired) or \
+               (self._spike_step == step and 'spike' not in self._fired)
+
+    def on_loss(self, step, value):
+        """Loss-observation hook (called by the supervisor with the
+        materialized host value): returns the possibly-poisoned loss.
+        Single-fire — after a rollback the replayed window is clean, so a
+        recovery cannot loop on its own injection."""
+        if self._nan_step == step and 'nan' not in self._fired:
+            self._fired.add('nan')
+            _obs.inc('fault_injections', site='nan_step',
+                     help='injected faults by site (PADDLE_TPU_FAULT_INJECT)')
+            _logger.warning('fault injection: NaN loss at step %d', step)
+            return float('nan')
+        if self._spike_step == step and 'spike' not in self._fired:
+            self._fired.add('spike')
+            _obs.inc('fault_injections', site='spike_step',
+                     help='injected faults by site (PADDLE_TPU_FAULT_INJECT)')
+            _logger.warning('fault injection: loss spike at step %d', step)
+            return float(value) * 1e9 + 1e9
+        return value
 
     def on_io(self, what='checkpoint'):
         """Checkpoint-IO hook: raises OSError per the io_fail clauses."""

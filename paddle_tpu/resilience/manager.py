@@ -44,6 +44,7 @@ import numpy as np
 from .. import observability as _obs
 from ..log_helper import get_logger
 from . import snapshot as _snap
+from . import watchdog as _wdg
 from .fault import get_injector
 from .goodput import GoodputTracker
 from .preemption import PreemptionGuard
@@ -124,6 +125,12 @@ class CheckpointManager:
         self._last_boundary = None
         self._last_saved_step = None
         self._closed = False
+        # runtime-health integration (supervisor.py): a TrainingSupervisor
+        # constructed with manager=self attaches here; end_of_step(...,
+        # loss=) then judges the step before any save decision, and the
+        # verdict is readable as `last_verdict`
+        self._supervisor = None
+        self.last_verdict = None
 
     # ------------------------------------------------------------------
     # discovery / restore
@@ -224,6 +231,10 @@ class CheckpointManager:
 
     def _write(self, job):
         t0 = time.perf_counter()
+        # a wedged write (dead NFS mount, stuck D2H) must not silently stop
+        # all future checkpoints: the process watchdog, when armed, holds an
+        # IO lease over the materialize+commit (watchdog.py)
+        lease = _wdg.arm_io('checkpoint_writer')
         try:
             # materialize: for FetchHandles this is the device→host wait +
             # copy, overlapped with the main thread's subsequent steps
@@ -275,6 +286,7 @@ class CheckpointManager:
                          help='checkpoints abandoned after exhausting '
                               'retries')
         finally:
+            _wdg.disarm(lease)
             job.done.set()
 
     def _gc(self):
@@ -310,26 +322,50 @@ class CheckpointManager:
         """Programmatic SIGTERM equivalent (tests, external agents)."""
         self._preemption.request()
 
-    def end_of_step(self, step, state_fn, meta=None):
+    def end_of_step(self, step, state_fn, meta=None, loss=None,
+                    batch_desc=None):
         """Call once per completed training step. Runs the fault-injection
-        step hook, books goodput, saves when the cadence is due — and, on a
+        step hook, judges health when a supervisor is attached and `loss`
+        is given, books goodput, saves when the cadence is due — and, on a
         pending SIGTERM/SIGINT, saves a FINAL checkpoint synchronously and
         returns True (the loop should exit cleanly).
 
         `state_fn` is called only when a save actually happens; it returns
         either an arrays dict or an ``(arrays, meta)`` tuple (the shape
         :func:`~paddle_tpu.resilience.state.capture_training_state`
-        produces)."""
-        self._fault.on_step(step)      # may SIGKILL (that is the point)
+        produces).
+
+        Supervision (docs/RESILIENCE.md "Self-healing"): pass the step's
+        `loss` (host value or FetchHandle). The attached
+        :class:`~paddle_tpu.resilience.supervisor.TrainingSupervisor` runs
+        FIRST — a quarantined boundary never checkpoints the poisoned
+        state — and its verdict lands in ``self.last_verdict``; on
+        ``action == 'rollback'`` the caller must reset its step counter to
+        ``last_verdict.resume_step`` and restart its DataLoader iteration.
+        Escalations raise ``TrainingDiverged`` out of this call."""
+        self._fault.on_step(step)      # may SIGKILL or hang (that's the point)
         now = time.perf_counter()
         # the first boundary has no prior timestamp: the step still COUNTS
         # (lost-work deltas are in steps), its duration is just unknown
         self.goodput.record_step(
             now - self._last_boundary if self._last_boundary is not None
             else 0.0)
+        self.last_verdict = None
+        if self._supervisor is not None and loss is not None:
+            verdict = self._supervisor.end_of_step(step, loss, batch_desc)
+            self.last_verdict = verdict
+            if verdict.action == 'rollback':
+                # state/RNG/step are back at the restored checkpoint: no
+                # save, no heartbeat at the now-bogus step number
+                self.goodput.export_metrics()
+                self._last_boundary = time.perf_counter()
+                return False
         preempt = self._preemption.requested
         due = (self.every_n_steps is not None
                and step % self.every_n_steps == 0)
+        if self.last_verdict is not None and \
+                self.last_verdict.action == 'skip':
+            due = False                # never checkpoint a dropped update
         if due or preempt:
             got = state_fn()
             arrays, cap_meta = got if isinstance(got, tuple) else (got, {})

@@ -1,0 +1,338 @@
+"""Hang watchdog: a monitor thread that turns a silent wedge into a
+diagnosable, recoverable event.
+
+A crashed step is cheap to survive (PR 7: kill -9 → bitwise resume); a HUNG
+step is worse — the process keeps its slot, `/healthz`-style external checks
+see a live pid, and the run burns wall clock producing nothing. The watchdog
+covers the single-host hang modes the fleet retrospective (PAPERS.md,
+arxiv 2606.15870) calls out: a wedged device step, a stalled DataLoader
+producer, and a stuck checkpoint writer.
+
+Mechanics: guarded activities hold a named **lease** (`arm`/`disarm`). Step
+leases get a deadline of ``max(floor, factor × rolling-median duration)``
+from that lease name's own history (the first arms, before any history —
+typically the compiling cold step — use the larger ``cold`` deadline); IO
+leases (checkpoint writer, DataLoader producer) use the fixed ``io``
+deadline. A daemon monitor thread polls; when a lease overruns it:
+
+1. dumps **all-thread stacks** via :mod:`faulthandler` to
+   ``$PADDLE_TPU_METRICS_DIR/watchdog_stacks_<name>_<pid>.txt`` (plus a
+   ``watchdog_breach.json`` record) so the wedge is diagnosable post-mortem;
+2. increments ``watchdog_breaches{name=...}`` / ``watchdog_stack_dumps``
+   through the telemetry registry;
+3. with ``abort`` on (the default), exits the process with
+   :data:`WATCHDOG_EXIT_CODE` — a supervised restart then rides PR 7's
+   deterministic resume instead of hanging forever.
+
+Enable process-wide with ``PADDLE_TPU_WATCHDOG=1`` (the Executor, TrainStep,
+DataLoader producer, and checkpoint writer all self-guard when a process
+watchdog is active; `TrainingSupervisor` additionally holds a
+boundary-to-boundary ``train_loop`` lease), or programmatically via
+:func:`enable`. Disabled, every guard site costs one module-attribute read.
+"""
+from __future__ import annotations
+
+import faulthandler
+import json
+import logging
+import os
+import statistics
+import threading
+import time
+
+from .. import observability as _obs
+from ..log_helper import get_logger
+
+__all__ = ['Watchdog', 'WatchdogLease', 'WATCHDOG_EXIT_CODE', 'enable',
+           'disable', 'active_watchdog', 'arm_step', 'arm_io', 'disarm']
+
+_logger = get_logger(
+    __name__, logging.INFO,
+    fmt='%(asctime)s-%(levelname)s: [watchdog] %(message)s')
+
+ENV_ENABLE = 'PADDLE_TPU_WATCHDOG'
+ENV_FLOOR = 'PADDLE_TPU_WATCHDOG_FLOOR_S'
+ENV_FACTOR = 'PADDLE_TPU_WATCHDOG_FACTOR'
+ENV_COLD = 'PADDLE_TPU_WATCHDOG_COLD_S'
+ENV_IO = 'PADDLE_TPU_WATCHDOG_IO_S'
+ENV_ABORT = 'PADDLE_TPU_WATCHDOG_ABORT'
+ENV_POLL = 'PADDLE_TPU_WATCHDOG_POLL_S'
+
+#: process exit code on an aborted breach — distinguishable from a crash
+#: (nonzero, not a signal) so a supervising restarter can count hangs
+#: separately from kills.
+WATCHDOG_EXIT_CODE = 70
+
+_HISTORY = 32          # rolling per-lease-name duration samples
+
+
+def _env_float(name, default):
+    raw = os.environ.get(name, '').strip()
+    if not raw:
+        return float(default)
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f'{name} must be a number, got {raw!r}')
+
+
+class WatchdogLease:
+    """One armed activity. Holding it means 'I should finish within
+    deadline_s of armed_at'; `disarm` releases it and (for step leases)
+    feeds the duration back into the rolling history."""
+
+    __slots__ = ('name', 'armed_at', 'deadline_s', 'kind', 'breached',
+                 '_owner')
+
+    def __init__(self, owner, name, deadline_s, kind):
+        self._owner = owner
+        self.name = name
+        self.armed_at = time.monotonic()
+        self.deadline_s = float(deadline_s)
+        self.kind = kind              # 'step' (history-fed) | 'io'
+        self.breached = False
+
+
+class Watchdog:
+    """Deadline monitor for named activities (see module docstring).
+
+    Parameters (env fallbacks in parentheses): `floor_s` — minimum deadline
+    (``PADDLE_TPU_WATCHDOG_FLOOR_S``, 30), `factor` — deadline multiple of
+    the rolling-median duration (``PADDLE_TPU_WATCHDOG_FACTOR``, 10),
+    `cold_s` — deadline before any history exists, sized for a cold XLA
+    compile (``PADDLE_TPU_WATCHDOG_COLD_S``, 600), `io_s` — fixed deadline
+    for writer/producer leases (``PADDLE_TPU_WATCHDOG_IO_S``, 600),
+    `abort` — exit the process on breach (``PADDLE_TPU_WATCHDOG_ABORT``, 1),
+    `dump_dir` — stack-dump directory (``PADDLE_TPU_METRICS_DIR``, '.').
+    """
+
+    def __init__(self, floor_s=None, factor=None, cold_s=None, io_s=None,
+                 abort=None, dump_dir=None, poll_s=None):
+        self.floor_s = (float(floor_s) if floor_s is not None
+                        else _env_float(ENV_FLOOR, 30.0))
+        self.factor = (float(factor) if factor is not None
+                       else _env_float(ENV_FACTOR, 10.0))
+        self.cold_s = (float(cold_s) if cold_s is not None
+                       else _env_float(ENV_COLD, 600.0))
+        self.io_s = (float(io_s) if io_s is not None
+                     else _env_float(ENV_IO, 600.0))
+        self.abort = (bool(abort) if abort is not None
+                      else os.environ.get(ENV_ABORT, '1') not in ('0', ''))
+        self.dump_dir = dump_dir or os.environ.get(
+            'PADDLE_TPU_METRICS_DIR') or '.'
+        self.poll_s = (float(poll_s) if poll_s is not None
+                       else _env_float(ENV_POLL,
+                                       max(0.02, min(0.25,
+                                                     self.floor_s / 5.0))))
+        self._lock = threading.Lock()
+        self._leases = {}              # name -> WatchdogLease
+        self._history = {}             # name -> [durations]
+        self._monitor = None
+        self._stop = threading.Event()
+        self.breaches = []             # breach records (non-abort mode)
+
+    # ------------------------------------------------------------------
+    # deadlines
+    # ------------------------------------------------------------------
+    def deadline_for(self, name):
+        """Step-lease deadline: ``max(floor, factor × rolling median)`` of
+        this lease name's own observed durations; `cold_s` before any
+        history (first call usually carries the XLA compile)."""
+        with self._lock:
+            hist = self._history.get(name)
+            if not hist:
+                return max(self.floor_s, self.cold_s)
+            return max(self.floor_s, self.factor * statistics.median(hist))
+
+    def observe(self, name, seconds):
+        """Feed one duration sample into `name`'s rolling history (leases
+        disarmed with ``observe=True`` do this automatically)."""
+        with self._lock:
+            hist = self._history.setdefault(name, [])
+            hist.append(float(seconds))
+            if len(hist) > _HISTORY:
+                del hist[0]
+
+    # ------------------------------------------------------------------
+    # leases
+    # ------------------------------------------------------------------
+    def arm(self, name, deadline_s=None, kind='step'):
+        """Arm (or re-arm) the named lease; returns the
+        :class:`WatchdogLease`. `deadline_s` defaults to
+        :meth:`deadline_for` for step leases and `io_s` for IO leases."""
+        if deadline_s is None:
+            deadline_s = self.io_s if kind == 'io' else self.deadline_for(name)
+        lease = WatchdogLease(self, name, deadline_s, kind)
+        with self._lock:
+            self._leases[name] = lease
+        if _obs._ENABLED:
+            _obs.set_gauge('watchdog_deadline_seconds', lease.deadline_s,
+                           lease=name,
+                           help='current per-lease watchdog deadline')
+            _obs.set_gauge('watchdog_armed', 1, lease=name,
+                           help='1 while the named activity holds a lease')
+        self._ensure_monitor()
+        return lease
+
+    def disarm(self, lease, observe=True):
+        """Release a lease; returns its held duration. Feeding the duration
+        into the history (step leases) keeps the next deadline tracking the
+        actual step time."""
+        if lease is None:
+            return 0.0
+        dt = time.monotonic() - lease.armed_at
+        with self._lock:
+            if self._leases.get(lease.name) is lease:
+                del self._leases[lease.name]
+        if observe and lease.kind == 'step' and not lease.breached:
+            self.observe(lease.name, dt)
+        if _obs._ENABLED:
+            _obs.set_gauge('watchdog_armed', 0, lease=lease.name,
+                           help='1 while the named activity holds a lease')
+        return dt
+
+    class _Guard:
+        __slots__ = ('_wd', '_name', '_deadline', '_kind', '_lease')
+
+        def __init__(self, wd, name, deadline_s, kind):
+            self._wd = wd
+            self._name = name
+            self._deadline = deadline_s
+            self._kind = kind
+
+        def __enter__(self):
+            self._lease = self._wd.arm(self._name, self._deadline, self._kind)
+            return self._lease
+
+        def __exit__(self, *exc):
+            self._wd.disarm(self._lease)
+
+    def guard(self, name, deadline_s=None, kind='step'):
+        """Context-manager form of arm/disarm."""
+        return Watchdog._Guard(self, name, deadline_s, kind)
+
+    # ------------------------------------------------------------------
+    # monitor
+    # ------------------------------------------------------------------
+    def _ensure_monitor(self):
+        if self._monitor is None or not self._monitor.is_alive():
+            self._stop.clear()
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, daemon=True,
+                name='paddle_tpu_watchdog')
+            self._monitor.start()
+
+    def _monitor_loop(self):
+        while not self._stop.wait(self.poll_s):
+            now = time.monotonic()
+            with self._lock:
+                expired = [l for l in self._leases.values()
+                           if not l.breached
+                           and now - l.armed_at > l.deadline_s]
+            for lease in expired:
+                self._breach(lease, now)
+
+    def _breach(self, lease, now):
+        lease.breached = True
+        held = now - lease.armed_at
+        record = {'name': lease.name, 'kind': lease.kind,
+                  'held_seconds': round(held, 3),
+                  'deadline_seconds': round(lease.deadline_s, 3),
+                  'pid': os.getpid(), 'unix_time': time.time(),
+                  'aborting': self.abort}
+        _logger.error(
+            'HANG: lease %r held %.1fs (deadline %.1fs) — dumping all-thread '
+            'stacks%s', lease.name, held, lease.deadline_s,
+            '; aborting' if self.abort else '')
+        dump_path = self._dump_stacks(lease, record)
+        record['stack_dump'] = dump_path
+        self.breaches.append(record)
+        if _obs._ENABLED:
+            _obs.inc('watchdog_breaches', lease=lease.name,
+                     help='watchdog deadline breaches by lease name')
+            if dump_path:
+                _obs.inc('watchdog_stack_dumps',
+                         help='faulthandler all-thread stack dumps written '
+                              'on watchdog breach')
+        if self.abort:
+            # hard exit (skips atexit/finally — the process is wedged; a
+            # graceful unwind would hang on the same thing the watchdog
+            # fired about). PR 7 resume makes this recoverable.
+            os._exit(WATCHDOG_EXIT_CODE)
+
+    def _dump_stacks(self, lease, record):
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            path = os.path.join(
+                self.dump_dir,
+                f'watchdog_stacks_{lease.name}_{os.getpid()}.txt')
+            with open(path, 'w') as f:
+                f.write(f'# paddle_tpu watchdog breach: {json.dumps(record)}\n')
+                faulthandler.dump_traceback(file=f, all_threads=True)
+            with open(os.path.join(self.dump_dir, 'watchdog_breach.json'),
+                      'w') as f:
+                json.dump(record, f)
+            return path
+        except OSError as e:           # diagnostics must not mask the hang
+            _logger.error('stack dump failed: %s', e)
+            return None
+
+    def stop(self):
+        """Stop the monitor thread (tests / disable)."""
+        self._stop.set()
+        if self._monitor is not None and self._monitor.is_alive():
+            self._monitor.join(2)
+
+
+# ---------------------------------------------------------------------------
+# process-wide watchdog: guard sites (executor, TrainStep, DataLoader
+# producer, checkpoint writer) check `_ACTIVE` — one attribute read when off.
+# ---------------------------------------------------------------------------
+
+_ACTIVE = None
+
+
+def enable(**kwargs):
+    """Install a process-wide watchdog (the programmatic form of
+    ``PADDLE_TPU_WATCHDOG=1``); returns it. Idempotent-replace: an existing
+    watchdog is stopped first."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.stop()
+    _ACTIVE = Watchdog(**kwargs)
+    return _ACTIVE
+
+
+def disable():
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.stop()
+    _ACTIVE = None
+
+
+def active_watchdog():
+    """The process-wide watchdog, or None."""
+    return _ACTIVE
+
+
+def arm_step(name):
+    """Guard-site helper: arm a history-deadline step lease on the process
+    watchdog (None and free when no watchdog is active)."""
+    w = _ACTIVE
+    return w.arm(name, kind='step') if w is not None else None
+
+
+def arm_io(name):
+    """Guard-site helper: arm a fixed-IO-deadline lease."""
+    w = _ACTIVE
+    return w.arm(name, kind='io') if w is not None else None
+
+
+def disarm(lease):
+    if lease is not None:
+        lease._owner.disarm(lease)
+
+
+if os.environ.get(ENV_ENABLE, '0') not in ('0', ''):
+    # env-enabled process: every guard site is armed with zero script changes
+    enable()

@@ -32,19 +32,22 @@ or the whole stack: ``python -m paddle_tpu.serving.server --model-dir …``.
 """
 from __future__ import annotations
 
-from .errors import (DeadlineExceeded, EngineClosed, InvalidRequest,
-                     Overloaded, OutOfBlocks, ServingError)
+from .errors import (DeadlineExceeded, EngineClosed, EngineUnhealthy,
+                     InvalidRequest, Overloaded, OutOfBlocks, ServingError)
 from .engine import DEFAULT_MAX_BATCH, InferenceEngine, bucket_ladder
 from .batcher import (DEFAULT_BATCH_TIMEOUT_MS, DEFAULT_QUEUE_DEPTH,
                       MicroBatcher, PredictionFuture)
+from .breaker import CircuitBreaker
 from .server import ServingServer, create_server
 from .decode import (DecodeEngine, DecodeScheduler, GenerationStream,
                      KVCachePool)
 
 __all__ = ['InferenceEngine', 'MicroBatcher', 'PredictionFuture',
            'ServingServer', 'create_server', 'bucket_ladder',
+           'CircuitBreaker',
            'DecodeEngine', 'DecodeScheduler', 'GenerationStream',
            'KVCachePool',
            'ServingError', 'InvalidRequest', 'Overloaded', 'DeadlineExceeded',
-           'EngineClosed', 'OutOfBlocks', 'DEFAULT_MAX_BATCH',
-           'DEFAULT_BATCH_TIMEOUT_MS', 'DEFAULT_QUEUE_DEPTH']
+           'EngineClosed', 'EngineUnhealthy', 'OutOfBlocks',
+           'DEFAULT_MAX_BATCH', 'DEFAULT_BATCH_TIMEOUT_MS',
+           'DEFAULT_QUEUE_DEPTH']

@@ -22,6 +22,12 @@ Robustness invariants, each tested in tests/framework/test_serving.py:
   is dropped (``DeadlineExceeded``) before it wastes device time;
 - **failure isolation**: an engine error fails exactly the requests in that
   batch — the worker survives and keeps serving;
+- **circuit breaker** (breaker.py): `breaker_failures` CONSECUTIVE
+  engine-failure batches trip the breaker — queued requests fail
+  immediately and new ones are rejected in O(µs) with the typed
+  ``EngineUnhealthy`` instead of waiting out their deadlines against a
+  broken engine; after the cooldown a half-open probe batch re-admits
+  traffic once the engine answers again (no restart);
 - **graceful shutdown**: ``close(drain=True)`` stops admission, drains every
   queued request, then joins the worker. ``drain=False`` fails the queue
   fast with ``EngineClosed``.
@@ -36,7 +42,9 @@ import time
 import numpy as np
 
 from . import metrics as _m
-from .errors import (DeadlineExceeded, EngineClosed, Overloaded, ServingError)
+from .breaker import CircuitBreaker
+from .errors import (DeadlineExceeded, EngineClosed, EngineUnhealthy,
+                     Overloaded, ServingError)
 
 __all__ = ['MicroBatcher', 'PredictionFuture', 'DEFAULT_BATCH_TIMEOUT_MS',
            'DEFAULT_QUEUE_DEPTH']
@@ -136,8 +144,11 @@ class MicroBatcher:
     def __init__(self, engine, max_batch_size=None,
                  batch_timeout_ms=DEFAULT_BATCH_TIMEOUT_MS,
                  queue_depth=DEFAULT_QUEUE_DEPTH, default_timeout_ms=None,
-                 start=True):
+                 breaker_failures=None, breaker_reset_s=None, start=True):
         self.engine = engine
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_failures, reset_after_s=breaker_reset_s,
+            metrics=_m.PREDICT_BREAKER_METRICS, name='predict engine')
         engine_max = int(getattr(engine, 'max_batch_size', 0) or 0)
         self.max_batch_size = int(max_batch_size or engine_max or 16)
         if engine_max:
@@ -163,8 +174,13 @@ class MicroBatcher:
     def submit(self, inputs, timeout_ms=None):
         """Validate and enqueue one request; returns a
         :class:`PredictionFuture`. Raises InvalidRequest (bad request, not
-        enqueued), Overloaded (queue full, not enqueued), or EngineClosed
+        enqueued), Overloaded (queue full, not enqueued), EngineUnhealthy
+        (circuit breaker open — reject BEFORE validation so clients fail
+        over in O(µs) regardless of payload size), or EngineClosed
         (shutdown begun)."""
+        if not self.breaker.allow():
+            raise EngineUnhealthy('predict engine',
+                                  self.breaker.consecutive_failures)
         try:
             feed, nrows = self.engine.validate(inputs)
         except Exception:
@@ -271,12 +287,33 @@ class MicroBatcher:
                 f'inference failed: {type(e).__name__}: {e}')
             for req in live:
                 req.future._set_exception(err)
+            if self.breaker.record_failure():
+                # just tripped: everything still queued would only wait out
+                # its deadline against a broken engine — fail it all NOW
+                self._fail_queued(EngineUnhealthy(
+                    'predict engine', self.breaker.consecutive_failures))
             return
+        self.breaker.record_success()
         off = 0
         for req in live:
             req.future._set_result([o[off:off + req.nrows] for o in outs])
             off += req.nrows
         _m.requests_completed.inc(len(live))
+
+    def _fail_queued(self, exc):
+        """Fail every queued (and carried-over) request with `exc`."""
+        with self._cv:
+            failed = 0
+            if self._carry is not None:
+                self._carry.future._set_exception(exc)
+                self._carry = None
+                failed += 1
+            while self._queue:
+                self._queue.popleft().future._set_exception(exc)
+                failed += 1
+            _m.queue_depth.set(0)
+        if failed:
+            _m.requests_failed.inc(failed)
 
     def _worker_loop(self):
         while True:

@@ -1,0 +1,153 @@
+"""Circuit breaker: stop feeding a broken engine one batch at a time.
+
+Failure isolation (batcher.py) keeps the worker alive through an engine
+error, but with a PERSISTENTLY broken engine (driver wedged after a device
+reset, model buffers poisoned, OOM loop) isolation alone is the worst of
+both worlds: every queued request waits out its full deadline only to fail,
+``/healthz`` still answers "ok", and the load balancer keeps routing
+traffic in. The breaker is the standard three-state remedy:
+
+- **closed** — healthy. Engine failures increment a consecutive-failure
+  count; any success resets it.
+- **open** — tripped after `failure_threshold` CONSECUTIVE engine-failure
+  batches. Queued requests are failed immediately and new submissions are
+  rejected in O(µs) with the typed :class:`EngineUnhealthy` (HTTP 503) —
+  clients fail over instead of waiting out deadlines, and ``/healthz``
+  reports ``degraded`` so the balancer stops routing here.
+- **half-open** — after `reset_after_s` the next submission is admitted as
+  a **probe**: its batch actually runs. Success closes the breaker (full
+  service resumes, no restart needed); failure re-opens it and restarts
+  the cooldown.
+
+State + trip counts are exported through the always-on serving metric
+handles the caller passes in (`serving_breaker_*` / `decode_breaker_*`,
+docs/OBSERVABILITY.md). Thread-safe; `allow()` is called on submitter
+threads, the record hooks on the single worker thread.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from ..log_helper import get_logger
+
+__all__ = ['CircuitBreaker', 'DEFAULT_FAILURE_THRESHOLD',
+           'DEFAULT_RESET_AFTER_S']
+
+_logger = get_logger(
+    __name__, logging.INFO,
+    fmt='%(asctime)s-%(levelname)s: [serving] %(message)s')
+
+DEFAULT_FAILURE_THRESHOLD = int(
+    os.environ.get('PADDLE_TPU_SERVING_BREAKER_FAILURES', '5'))
+DEFAULT_RESET_AFTER_S = float(
+    os.environ.get('PADDLE_TPU_SERVING_BREAKER_RESET_S', '5'))
+
+#: numeric encoding of the state gauge (docs/OBSERVABILITY.md)
+STATE_CODES = {'closed': 0, 'half_open': 1, 'open': 2}
+
+
+class CircuitBreaker:
+    """See module docstring. `metrics` is a dict of always-on lazy metric
+    handles: ``state`` (gauge), ``trips`` / ``rejected`` / ``probes``
+    (counters) — passed in so the predict and decode paths export under
+    their own prefixes."""
+
+    def __init__(self, failure_threshold=None, reset_after_s=None,
+                 metrics=None, name='engine'):
+        self.failure_threshold = int(
+            failure_threshold if failure_threshold is not None
+            else DEFAULT_FAILURE_THRESHOLD)
+        self.reset_after_s = float(
+            reset_after_s if reset_after_s is not None
+            else DEFAULT_RESET_AFTER_S)
+        self.name = name
+        self._m = metrics or {}
+        self._lock = threading.Lock()
+        self._state = 'closed'
+        self._consecutive_failures = 0
+        self._opened_at = None
+        self.trips = 0
+
+    # ------------------------------------------------------------------
+    @property
+    def state(self):
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    @property
+    def consecutive_failures(self):
+        return self._consecutive_failures
+
+    def _set_state_locked(self, state):
+        self._state = state
+        m = self._m.get('state')
+        if m is not None:
+            m.set(STATE_CODES[state])
+
+    def _maybe_half_open_locked(self):
+        if (self._state == 'open'
+                and time.monotonic() - self._opened_at >= self.reset_after_s):
+            self._set_state_locked('half_open')
+            m = self._m.get('probes')
+            if m is not None:
+                m.inc()
+            _logger.info('%s breaker half-open: admitting a probe batch',
+                         self.name)
+
+    # ------------------------------------------------------------------
+    def allow(self):
+        """Submission gate: True = admit. False only while OPEN (and still
+        cooling down) — the caller rejects with EngineUnhealthy without
+        touching the queue, which is what makes rejection O(µs)."""
+        with self._lock:
+            if self._state == 'closed':
+                return True
+            self._maybe_half_open_locked()
+            if self._state == 'half_open':
+                return True
+            m = self._m.get('rejected')
+            if m is not None:
+                m.inc()
+            return False
+
+    def record_success(self):
+        """One engine batch answered. Closes a half-open breaker."""
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state != 'closed':
+                self._set_state_locked('closed')
+                _logger.info('%s breaker closed: probe succeeded, service '
+                             'restored', self.name)
+
+    def record_failure(self):
+        """One engine batch failed. → True exactly when this failure TRIPS
+        the breaker (closed→open past the threshold, or a failed half-open
+        probe) — the caller then fails its queued work fast."""
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == 'half_open':
+                self._trip_locked('probe batch failed')
+                return True
+            if (self._state == 'closed'
+                    and self._consecutive_failures >= self.failure_threshold):
+                self._trip_locked(
+                    f'{self._consecutive_failures} consecutive '
+                    f'engine-failure batches')
+                return True
+            return False
+
+    def _trip_locked(self, why):
+        self._set_state_locked('open')
+        self._opened_at = time.monotonic()
+        self.trips += 1
+        m = self._m.get('trips')
+        if m is not None:
+            m.inc()
+        _logger.error(
+            '%s breaker OPEN (%s): failing queued requests, rejecting new '
+            'ones for %.1fs, then probing', self.name, why,
+            self.reset_after_s)

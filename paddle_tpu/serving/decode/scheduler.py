@@ -40,8 +40,9 @@ import threading
 import time
 
 from .. import metrics as _m
-from ..errors import (DeadlineExceeded, EngineClosed, Overloaded,
-                      OutOfBlocks, ServingError)
+from ..breaker import CircuitBreaker
+from ..errors import (DeadlineExceeded, EngineClosed, EngineUnhealthy,
+                      Overloaded, OutOfBlocks, ServingError)
 from ..batcher import DEFAULT_QUEUE_DEPTH
 
 __all__ = ['DecodeScheduler', 'GenerationStream']
@@ -153,11 +154,18 @@ class DecodeScheduler:
 
     def __init__(self, engine, queue_depth=DEFAULT_QUEUE_DEPTH,
                  admission='continuous', default_timeout_ms=None,
-                 start=True):
+                 breaker_failures=None, breaker_reset_s=None, start=True):
         if admission not in ('continuous', 'drain'):
             raise ValueError(f"admission must be 'continuous' or 'drain', "
                              f"got {admission!r}")
         self.engine = engine
+        # circuit breaker (serving/breaker.py): consecutive engine failures
+        # (prefill or lockstep step) trip it — waiting requests fail fast
+        # with EngineUnhealthy, /healthz reports degraded, a half-open probe
+        # re-admits traffic once the engine answers
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_failures, reset_after_s=breaker_reset_s,
+            metrics=_m.DECODE_BREAKER_METRICS, name='decode engine')
         self.queue_depth = int(queue_depth)
         self.admission = admission
         self.default_timeout_ms = default_timeout_ms
@@ -178,7 +186,10 @@ class DecodeScheduler:
                timeout_ms=None):
         """Validate and enqueue one generation; returns its
         :class:`GenerationStream`. Raises InvalidRequest / Overloaded /
-        EngineClosed (all pre-enqueue)."""
+        EngineUnhealthy (breaker open) / EngineClosed (all pre-enqueue)."""
+        if not self.breaker.allow():
+            raise EngineUnhealthy('decode engine',
+                                  self.breaker.consecutive_failures)
         try:
             prompt, max_new = self.engine.validate(prompt_ids,
                                                    max_new_tokens)
@@ -260,8 +271,27 @@ class DecodeScheduler:
             first = self.engine.prefill(req.prompt, req.table)
         except Exception as e:
             self._fail_request(req, e)
+            self._record_engine_failure()
             return
+        self.breaker.record_success()
         self._emit_token(req, first)
+
+    def _record_engine_failure(self):
+        """Book one engine-failure batch with the breaker; on a trip, fail
+        everything still waiting — it would only burn its deadline against
+        a broken engine (in-flight slots were already failed by
+        isolation)."""
+        if not self.breaker.record_failure():
+            return
+        exc = EngineUnhealthy('decode engine',
+                              self.breaker.consecutive_failures)
+        with self._cv:
+            failed = len(self._waiting)
+            while self._waiting:
+                self._waiting.popleft().stream._fail(exc)
+            _m.decode_queue_depth.set(0)
+        if failed:
+            _m.decode_requests_failed.inc(failed)
 
     def _emit_token(self, req, token):
         """Account one sampled token; marks the request finished when it
@@ -309,7 +339,9 @@ class DecodeScheduler:
         except Exception as e:
             for req in live:        # isolate: fail the batch, keep serving
                 self._fail_request(req, e)
+            self._record_engine_failure()
             return True
+        self.breaker.record_success()
         for i, req in enumerate(self._slots):
             if req is not None:
                 self._emit_token(req, int(out[i]))

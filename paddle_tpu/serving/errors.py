@@ -10,7 +10,7 @@ for a latency we could not meet* (DeadlineExceeded), and *we are going away*
 from __future__ import annotations
 
 __all__ = ['ServingError', 'InvalidRequest', 'Overloaded', 'DeadlineExceeded',
-           'EngineClosed', 'OutOfBlocks']
+           'EngineClosed', 'EngineUnhealthy', 'OutOfBlocks']
 
 
 class ServingError(RuntimeError):
@@ -42,6 +42,23 @@ class DeadlineExceeded(ServingError, TimeoutError):
 class EngineClosed(ServingError):
     """Submitted after shutdown began. In-flight requests at shutdown are
     drained, not dropped; new ones get this. Maps to HTTP 503."""
+
+
+class EngineUnhealthy(ServingError):
+    """The circuit breaker is OPEN: the engine failed enough consecutive
+    batches that feeding it more requests would only burn their deadlines
+    (serving/breaker.py). Rejected in O(µs), BEFORE the queue; the client
+    should fail over to another replica — a half-open probe re-admits
+    traffic automatically once the engine answers again. Maps to HTTP 503
+    (and flips ``/healthz`` to ``degraded``)."""
+
+    def __init__(self, name='engine', failures=None):
+        detail = (f' after {failures} consecutive failed batches'
+                  if failures else '')
+        super().__init__(
+            f'{name} circuit breaker is open{detail}; '
+            f'failing fast instead of queueing onto a broken engine')
+        self.failures = failures
 
 
 class OutOfBlocks(ServingError):

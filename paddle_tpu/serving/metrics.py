@@ -123,6 +123,27 @@ http_responses = _LazyMetric(
     'counter', 'serving_http_responses',
     'HTTP front-end responses by status code')
 
+# -- circuit breaker (serving/breaker.py) ----------------------------------
+# state encoding: 0 = closed, 1 = half-open (probing), 2 = open (tripped)
+
+breaker_state = _LazyMetric(
+    'gauge', 'serving_breaker_state',
+    'predict-path circuit breaker state (0 closed / 1 half-open / 2 open)')
+breaker_trips = _LazyMetric(
+    'counter', 'serving_breaker_trips',
+    'predict-path breaker trips (consecutive-failure threshold or failed '
+    'probe)')
+breaker_rejected = _LazyMetric(
+    'counter', 'serving_breaker_rejected',
+    'requests rejected fast with EngineUnhealthy while the breaker was open')
+breaker_probes = _LazyMetric(
+    'counter', 'serving_breaker_probes',
+    'half-open probe windows opened after the breaker cooldown')
+
+PREDICT_BREAKER_METRICS = {'state': breaker_state, 'trips': breaker_trips,
+                           'rejected': breaker_rejected,
+                           'probes': breaker_probes}
+
 
 # -- stateful decode engine (serving/decode/, docs/SERVING.md) -------------
 # Same always-on discipline as the rest of serving: decode steps are
@@ -187,3 +208,23 @@ decode_tokens_generated = _LazyMetric(
 decode_prefill_compiles = _LazyMetric(
     'counter', 'decode_prefill_compiles',
     'prefill bucket shapes compiled (bounded by the prompt ladder length)')
+
+decode_breaker_state = _LazyMetric(
+    'gauge', 'decode_breaker_state',
+    'decode-path circuit breaker state (0 closed / 1 half-open / 2 open)')
+decode_breaker_trips = _LazyMetric(
+    'counter', 'decode_breaker_trips',
+    'decode-path breaker trips (consecutive-failure threshold or failed '
+    'probe)')
+decode_breaker_rejected = _LazyMetric(
+    'counter', 'decode_breaker_rejected',
+    'generation requests rejected fast with EngineUnhealthy while the '
+    'decode breaker was open')
+decode_breaker_probes = _LazyMetric(
+    'counter', 'decode_breaker_probes',
+    'half-open probe windows opened after the decode breaker cooldown')
+
+DECODE_BREAKER_METRICS = {'state': decode_breaker_state,
+                          'trips': decode_breaker_trips,
+                          'rejected': decode_breaker_rejected,
+                          'probes': decode_breaker_probes}

@@ -41,8 +41,8 @@ from . import metrics as _m
 from .batcher import (DEFAULT_BATCH_TIMEOUT_MS, DEFAULT_QUEUE_DEPTH,
                       MicroBatcher)
 from .engine import InferenceEngine
-from .errors import (DeadlineExceeded, EngineClosed, InvalidRequest,
-                     Overloaded)
+from .errors import (DeadlineExceeded, EngineClosed, EngineUnhealthy,
+                     InvalidRequest, Overloaded)
 from ..log_helper import get_logger
 
 __all__ = ['ServingServer', 'create_server']
@@ -54,7 +54,8 @@ _logger = get_logger(
 MAX_BODY_BYTES = 64 * 1024 * 1024
 
 _STATUS_BY_ERROR = ((InvalidRequest, 400), (Overloaded, 429),
-                    (DeadlineExceeded, 504), (EngineClosed, 503))
+                    (DeadlineExceeded, 504), (EngineUnhealthy, 503),
+                    (EngineClosed, 503))
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -86,6 +87,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == '/healthz':
             if srv.draining:
                 self._reply(503, {'status': 'draining'})
+            elif srv.breaker_states():
+                # a tripped (or probing) circuit breaker: this replica is
+                # alive but should not receive traffic — 503 'degraded'
+                # evicts it from the balancer until the probe closes the
+                # breaker again (docs/SERVING.md "Circuit breaker")
+                self._reply(503, {'status': 'degraded',
+                                  'breakers': srv.breaker_states()})
             else:
                 body = {'status': 'ok'}
                 if srv.engine is not None:
@@ -336,6 +344,19 @@ class ServingServer:
     @property
     def port(self):
         return self._httpd.server_address[1]
+
+    def breaker_states(self):
+        """{component: breaker state} for every NON-closed circuit breaker
+        (empty dict = fully healthy)."""
+        states = {}
+        if self.batcher is not None and \
+                self.batcher.breaker.state != 'closed':
+            states['predict'] = self.batcher.breaker.state
+        if self.generator is not None:
+            breaker = getattr(self.generator, 'breaker', None)
+            if breaker is not None and breaker.state != 'closed':
+                states['decode'] = breaker.state
+        return states
 
     def start(self):
         """Serve in a background thread; returns self."""

@@ -1,0 +1,326 @@
+"""Serving circuit breaker (paddle_tpu/serving/breaker.py, ISSUE 8): a
+persistently failing engine trips the breaker — queued + new requests fail
+FAST with the typed EngineUnhealthy instead of waiting out their deadlines,
+/healthz reports degraded — and a recovered engine restores service through
+the half-open probe without a restart. Covers the state machine, the
+MicroBatcher and DecodeScheduler wirings, and the HTTP front end."""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observability
+from paddle_tpu.serving import (CircuitBreaker, EngineUnhealthy,
+                                InferenceEngine, InvalidRequest, MicroBatcher,
+                                ServingServer)
+from paddle_tpu.serving.decode.scheduler import DecodeScheduler
+
+
+def _metric(name):
+    d = observability.registry.to_dict().get(name)
+    if not d or not d['samples']:
+        return 0.0
+    return sum(s['value'] for s in d['samples'])
+
+
+# ---------------------------------------------------------------------------
+# state machine
+# ---------------------------------------------------------------------------
+
+def test_breaker_state_machine():
+    b = CircuitBreaker(failure_threshold=3, reset_after_s=0.15)
+    assert b.state == 'closed' and b.allow()
+    assert not b.record_failure()
+    assert not b.record_failure()
+    b.record_success()                     # non-consecutive: counter resets
+    assert not b.record_failure()
+    assert not b.record_failure()
+    assert b.record_failure()              # 3rd consecutive → trips
+    assert b.state == 'open' and b.trips == 1
+    assert not b.allow()                   # open: reject
+    time.sleep(0.2)
+    assert b.allow()                       # cooldown elapsed → half-open probe
+    assert b.state == 'half_open'
+    assert b.record_failure()              # failed probe → re-open (a trip)
+    assert b.state == 'open' and b.trips == 2
+    time.sleep(0.2)
+    assert b.allow()
+    b.record_success()                     # probe succeeded → closed
+    assert b.state == 'closed' and b.allow()
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher wiring
+# ---------------------------------------------------------------------------
+
+class _FlakyEngine:
+    """Duck-typed engine whose failure mode is a switch."""
+
+    def __init__(self, max_batch_size=4):
+        self.max_batch_size = max_batch_size
+        self.fail = False
+        self.runs = 0
+
+    def validate(self, inputs):
+        arr = np.asarray(inputs['x'], np.float32)
+        if arr.ndim != 2:
+            raise InvalidRequest('rank')
+        return {'x': arr}, arr.shape[0]
+
+    def run_batch(self, feed, nrows=None):
+        self.runs += 1
+        if self.fail:
+            raise RuntimeError('device on fire')
+        return [feed['x'][:nrows] * 2.0]
+
+
+def _one(value=1.0):
+    return {'x': np.full((1, 3), value, np.float32)}
+
+
+def test_batcher_trips_fails_queued_fast_and_recovers():
+    eng = _FlakyEngine()
+    b = MicroBatcher(eng, batch_timeout_ms=0, breaker_failures=3,
+                     breaker_reset_s=0.2)
+    try:
+        assert np.array_equal(b.predict(_one())[0], np.full((1, 3), 2.0))
+        eng.fail = True
+        # three separate failed BATCHES (submit+wait serially so they can't
+        # coalesce into one)
+        for _ in range(3):
+            f = b.submit(_one())
+            with pytest.raises(Exception):
+                f.result(10)
+        assert b.breaker.state == 'open'
+
+        # new submissions reject FAST (typed, pre-queue) — the <10ms bar
+        t0 = time.perf_counter()
+        with pytest.raises(EngineUnhealthy):
+            b.submit(_one())
+        assert time.perf_counter() - t0 < 0.010
+        runs_when_open = eng.runs
+
+        # recovery: engine heals, cooldown passes, the next request is the
+        # half-open probe and service resumes — no restart
+        eng.fail = False
+        time.sleep(0.25)
+        out, = b.predict(_one(3.0))
+        assert np.array_equal(out, np.full((1, 3), 6.0))
+        assert b.breaker.state == 'closed'
+        assert eng.runs == runs_when_open + 1
+        assert np.array_equal(b.predict(_one())[0], np.full((1, 3), 2.0))
+    finally:
+        b.close(drain=False)
+
+
+def test_batcher_trip_fails_already_queued_requests_immediately():
+    """Requests sitting in the queue when the breaker trips must not wait
+    out their deadlines — they fail with EngineUnhealthy at the trip."""
+    eng = _FlakyEngine()
+    eng.fail = True
+    b = MicroBatcher(eng, batch_timeout_ms=0, breaker_failures=1,
+                     breaker_reset_s=30, start=False)
+    # 8 single-row requests > max_batch_size=4: the first coalesced batch
+    # fails and trips; the rest are still queued at the trip
+    futures = [b.submit(_one(), timeout_ms=60_000) for _ in range(8)]
+    b._worker.start()
+    # first batch fails → trips → the rest of the queue fails immediately,
+    # despite 60s deadlines
+    t0 = time.perf_counter()
+    outcomes = []
+    for f in futures:
+        with pytest.raises(Exception) as ei:
+            f.result(10)
+        outcomes.append(ei.value)
+    assert time.perf_counter() - t0 < 5.0
+    assert any(isinstance(e, EngineUnhealthy) for e in outcomes)
+    assert _metric('serving_breaker_trips') >= 1
+    b.close(drain=False)
+
+
+def test_breaker_metrics_exported():
+    eng = _FlakyEngine()
+    eng.fail = True
+    before_trips = _metric('serving_breaker_trips')
+    b = MicroBatcher(eng, batch_timeout_ms=0, breaker_failures=1,
+                     breaker_reset_s=30)
+    try:
+        f = b.submit(_one())
+        with pytest.raises(Exception):
+            f.result(10)
+        deadline = time.monotonic() + 5
+        while b.breaker.state != 'open' and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(EngineUnhealthy):
+            b.submit(_one())
+        assert _metric('serving_breaker_trips') == before_trips + 1
+        assert _metric('serving_breaker_rejected') >= 1
+        assert _metric('serving_breaker_state') == 2.0   # open
+    finally:
+        b.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# decode-scheduler wiring
+# ---------------------------------------------------------------------------
+
+class _FlakyDecodeEngine:
+    """Duck-typed decode engine: echoes prompt-token+1 until the budget."""
+
+    def __init__(self, slots=2):
+        self.slots = slots
+        self.eos_id = None
+        self.fail = False
+        self._tables = 0
+
+    def validate(self, prompt_ids, max_new_tokens):
+        return [int(t) for t in prompt_ids], int(max_new_tokens)
+
+    def reserve_table(self, prompt_len, max_new_tokens):
+        self._tables += 1
+        return {'id': self._tables}
+
+    def release_table(self, table):
+        pass
+
+    def prefill(self, prompt, table):
+        if self.fail:
+            raise RuntimeError('decode engine on fire')
+        return prompt[-1] + 1
+
+    def decode_step(self, tokens, tables):
+        if self.fail:
+            raise RuntimeError('decode engine on fire')
+        return [0 if t is None else t + 1 for t in tokens]
+
+
+def test_decode_scheduler_trips_and_recovers_via_probe():
+    eng = _FlakyDecodeEngine()
+    sched = DecodeScheduler(eng, breaker_failures=2, breaker_reset_s=0.2)
+    try:
+        assert sched.generate([5], max_new_tokens=3,
+                              result_timeout=30) == [6, 7, 8]
+        eng.fail = True
+        for _ in range(2):
+            s = sched.submit([5], max_new_tokens=2)
+            with pytest.raises(Exception):
+                s.result(10)
+        deadline = time.monotonic() + 5
+        while sched.breaker.state != 'open' and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sched.breaker.state == 'open'
+        t0 = time.perf_counter()
+        with pytest.raises(EngineUnhealthy):
+            sched.submit([5], max_new_tokens=2)
+        assert time.perf_counter() - t0 < 0.010
+        # heal + cooldown → probe generation closes the breaker
+        eng.fail = False
+        time.sleep(0.25)
+        assert sched.generate([9], max_new_tokens=2,
+                              result_timeout=30) == [10, 11]
+        assert sched.breaker.state == 'closed'
+    finally:
+        sched.close(drain=False)
+
+
+def test_decode_trip_fails_waiting_requests_fast():
+    eng = _FlakyDecodeEngine(slots=1)
+    eng.fail = True
+    sched = DecodeScheduler(eng, breaker_failures=1, breaker_reset_s=30,
+                            start=False)
+    streams = [sched.submit([5], max_new_tokens=2, timeout_ms=60_000)
+               for _ in range(3)]
+    sched._worker.start()
+    t0 = time.perf_counter()
+    errors = []
+    for s in streams:
+        with pytest.raises(Exception) as ei:
+            s.result(10)
+        errors.append(ei.value)
+    assert time.perf_counter() - t0 < 5.0
+    assert any(isinstance(e, EngineUnhealthy) for e in errors)
+    sched.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end: /healthz degraded + 503 mapping
+# ---------------------------------------------------------------------------
+
+FEATURES = 6
+
+
+@pytest.fixture(scope='module')
+def saved_model(tmp_path_factory):
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[FEATURES], dtype='float32')
+        out = layers.fc(x, 3, act='softmax')
+    exe = fluid.Executor()
+    path = str(tmp_path_factory.mktemp('breaker') / 'model')
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(start)
+        fluid.io.save_inference_model(path, ['x'], [out], exe, main)
+    return path
+
+
+def _get(url):
+    try:
+        r = urllib.request.urlopen(url, timeout=30)
+        return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_healthz_degraded_while_tripped_then_ok_after_probe(saved_model):
+    eng = InferenceEngine(saved_model, max_batch_size=4)
+    eng.warmup()
+    srv = ServingServer(eng, port=0, batch_timeout_ms=0).start()
+    batcher = srv.batcher
+    batcher.breaker.failure_threshold = 2
+    batcher.breaker.reset_after_s = 0.2
+    url = f'http://127.0.0.1:{srv.port}'
+    try:
+        code, body = _get(url + '/healthz')
+        assert code == 200 and body['status'] == 'ok'
+
+        real_run = eng.run_batch
+        eng.run_batch = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError('device on fire'))
+        for _ in range(2):
+            f = batcher.submit({'x': np.zeros((1, FEATURES), np.float32)})
+            with pytest.raises(Exception):
+                f.result(10)
+        deadline = time.monotonic() + 5
+        while batcher.breaker.state != 'open' and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+
+        code, body = _get(url + '/healthz')
+        assert code == 503 and body['status'] == 'degraded'
+        assert body['breakers'] == {'predict': 'open'}
+
+        # POST /predict while open → typed 503 EngineUnhealthy
+        req = urllib.request.Request(
+            url + '/predict',
+            data=json.dumps(
+                {'inputs': {'x': np.zeros((1, FEATURES)).tolist()}}).encode(),
+            headers={'Content-Type': 'application/json'})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())['error'] == 'EngineUnhealthy'
+
+        # heal → cooldown → probe through the real engine → healthy again
+        eng.run_batch = real_run
+        time.sleep(0.25)
+        out = batcher.predict({'x': np.zeros((1, FEATURES), np.float32)})
+        assert out[0].shape == (1, 3)
+        code, body = _get(url + '/healthz')
+        assert code == 200 and body['status'] == 'ok'
+    finally:
+        srv.shutdown(drain=False)

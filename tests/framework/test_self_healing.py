@@ -1,0 +1,195 @@
+"""Self-healing acceptance tests (ISSUE 8), in the test_crash_resume.py
+style — each run is a separate interpreter driven purely by env knobs:
+
+1. ``nan@step=N`` under ``policy=rollback``: the supervisor restores the
+   last good checkpoint, skips the poisoned window via the DataLoader
+   cursor (data moves FORWARD), the run completes, and two identically-
+   faulted runs produce BITWISE-identical trajectories.
+2. ``hang@step=N`` with the watchdog armed: the wedged boundary produces a
+   faulthandler all-thread stack-dump artifact and a nonzero exit
+   (WATCHDOG_EXIT_CODE) within deadline+grace — and a fresh process then
+   resumes from ``latest()`` and finishes with the reference trajectory.
+"""
+import json
+import os
+import subprocess
+import sys
+
+from paddle_tpu.resilience.watchdog import WATCHDOG_EXIT_CODE
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+# Deterministic supervised training program: dropout (per-step RNG), Adam
+# (slot state), epoch-keyed batches (DataLoader cursor), checkpoint every 3
+# steps, supervisor policy=rollback wired through mgr.end_of_step(loss=...).
+TRAIN_SCRIPT = r'''
+import json, os, sys
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import layers as L
+from paddle_tpu import resilience
+
+ckpt_dir, log_path, total_steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+fluid.seed(4321)
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = L.data('hx', [8], dtype='float32')
+    y = L.data('hy', [1], dtype='float32')
+    h = L.fc(x, size=16, act='relu')
+    h = L.dropout(h, dropout_prob=0.3)
+    pred = L.fc(h, size=1)
+    loss = L.reduce_mean(L.square_error_cost(pred, y))
+    fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+exe = fluid.Executor()
+exe.run(startup)
+
+blk = main.global_block()
+loader = fluid.DataLoader.from_generator(
+    feed_list=[blk.var('hx'), blk.var('hy')], capacity=4)
+
+def epoch_batches(epoch, n=5):
+    rng = np.random.RandomState(200 + epoch)
+    return [(rng.randn(4, 8).astype(np.float32),
+             rng.randn(4, 1).astype(np.float32)) for _ in range(n)]
+
+loader.set_batch_generator(lambda: iter(epoch_batches(loader.epoch)))
+
+mgr = resilience.CheckpointManager(ckpt_dir, every_n_steps=3, keep=2)
+sup = resilience.TrainingSupervisor(policy='rollback', manager=mgr,
+                                    executor=exe, program=main,
+                                    loader=loader)
+step = 0
+got = mgr.restore()
+if got is not None:
+    arrays, meta = got
+    resilience.restore_training_state(arrays, meta, executor=exe,
+                                      program=main, loader=loader)
+    step = meta['step']
+
+log = open(log_path, 'a')
+stopped = False
+while step < total_steps and not stopped:
+    for batch in loader():
+        lv = exe.run(main, feed=batch, fetch_list=[loss])[0]
+        step += 1
+        log.write(json.dumps({'step': step,
+                              'loss': np.asarray(lv).tobytes().hex()}) + '\n')
+        log.flush()
+        stopped = mgr.end_of_step(
+            step, lambda: resilience.capture_training_state(
+                executor=exe, program=main, loader=loader), loss=lv)
+        v = mgr.last_verdict
+        if v is not None and v.action == 'rollback':
+            log.write(json.dumps({'rollback_at': step,
+                                  'resume': v.resume_step}) + '\n')
+            log.flush()
+            step = v.resume_step
+            break            # restart loader(): cursor already moved past
+                             # the poisoned window
+        if stopped or step >= total_steps:
+            break
+sup.close()
+mgr.wait()
+mgr.close()
+log.close()
+'''
+
+
+def _run(tmp_path, name, ckpt_dir, total_steps, extra_env=None, timeout=300):
+    script = tmp_path / 'train.py'
+    if not script.exists():
+        script.write_text(TRAIN_SCRIPT)
+    log = tmp_path / f'{name}.jsonl'
+    env = dict(os.environ, JAX_PLATFORMS='cpu', PYTHONPATH=REPO)
+    for k in ('PADDLE_TPU_FAULT_INJECT', 'PADDLE_TPU_ASYNC',
+              'PADDLE_TPU_SUPERVISOR', 'PADDLE_TPU_WATCHDOG',
+              'PADDLE_TPU_METRICS_DIR', 'PADDLE_TPU_TELEMETRY'):
+        env.pop(k, None)
+    env.update(extra_env or {})
+    r = subprocess.run(
+        [sys.executable, str(script), str(ckpt_dir), str(log),
+         str(total_steps)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+    lines = []
+    if log.exists():
+        lines = [json.loads(ln) for ln in log.read_text().splitlines()
+                 if ln.strip()]
+    return r, lines
+
+
+def test_nan_rollback_recovers_and_is_bitwise_deterministic(tmp_path):
+    """nan@step=8 under policy=rollback: checkpoints land at 3 and 6; the
+    poisoned step 8 rolls back to 6 with the data cursor skipping forward;
+    the run completes — and two identically-faulted runs are BITWISE
+    identical, line for line."""
+    total = 12
+    fault = {'PADDLE_TPU_FAULT_INJECT': 'nan@step=8'}
+    r1, lines1 = _run(tmp_path, 'faulted1', tmp_path / 'ck1', total,
+                      extra_env=fault)
+    assert r1.returncode == 0, r1.stderr[-3000:]
+    r2, lines2 = _run(tmp_path, 'faulted2', tmp_path / 'ck2', total,
+                      extra_env=fault)
+    assert r2.returncode == 0, r2.stderr[-3000:]
+
+    rollbacks = [ln for ln in lines1 if 'rollback_at' in ln]
+    assert rollbacks == [{'rollback_at': 8, 'resume': 6}], rollbacks
+    steps = [ln['step'] for ln in lines1 if 'step' in ln]
+    assert steps[-1] == total                 # recovered and finished
+    assert steps.count(7) == 2                # 7, 8 replayed after rollback
+
+    # THE acceptance: identically-faulted runs are bitwise identical
+    assert lines1 == lines2
+
+    # the poisoned batch descriptor was quarantined
+    q = (tmp_path / 'ck1' / 'quarantine.jsonl').read_text().splitlines()
+    rec = json.loads(q[0])
+    assert rec['step'] == 8 and rec['reason'] == 'nonfinite'
+    assert rec['action'] == 'rollback' and rec['batch'] is not None
+
+
+def test_hang_watchdog_dumps_stacks_aborts_and_resume_succeeds(tmp_path):
+    """hang@step=6: the wedged boundary breaches the train_loop lease →
+    all-thread stack dump + exit WATCHDOG_EXIT_CODE, well inside
+    deadline+grace; a fresh process resumes from latest() and replays the
+    reference trajectory bitwise (a hang corrupts nothing)."""
+    total = 9
+    r_ref, ref_lines = _run(tmp_path, 'ref', tmp_path / 'ck_ref', total)
+    assert r_ref.returncode == 0, r_ref.stderr[-3000:]
+    ref = {ln['step']: ln['loss'] for ln in ref_lines if 'step' in ln}
+
+    metrics_dir = tmp_path / 'artifacts'
+    ck = tmp_path / 'ck_hang'
+    r_hang, hang_lines = _run(
+        tmp_path, 'hang', ck, total, timeout=240,
+        extra_env={'PADDLE_TPU_FAULT_INJECT': 'hang@step=6',
+                   'PADDLE_TPU_WATCHDOG': '1',
+                   'PADDLE_TPU_WATCHDOG_FLOOR_S': '2',
+                   'PADDLE_TPU_WATCHDOG_COLD_S': '120',
+                   'PADDLE_TPU_WATCHDOG_POLL_S': '0.1',
+                   'PADDLE_TPU_METRICS_DIR': str(metrics_dir)})
+    assert r_hang.returncode == WATCHDOG_EXIT_CODE, \
+        f'rc={r_hang.returncode}: {r_hang.stderr[-2000:]}'
+    hung = {ln['step']: ln['loss'] for ln in hang_lines if 'step' in ln}
+    assert max(hung) == 6                     # wedged at the step-6 boundary
+
+    # the breach is diagnosable post-mortem: all-thread stacks + record
+    dumps = [p for p in os.listdir(metrics_dir)
+             if p.startswith('watchdog_stacks_')]
+    assert dumps, os.listdir(metrics_dir)
+    text = (metrics_dir / dumps[0]).read_text()
+    assert 'Thread' in text or 'File' in text
+    breach = json.loads((metrics_dir / 'watchdog_breach.json').read_text())
+    assert breach['name'] == 'train_loop' and breach['aborting'] is True
+    assert breach['held_seconds'] >= breach['deadline_seconds']
+
+    # resume: a fresh process finishes the job with the reference
+    # trajectory (checkpoints at 3 and 6 exist; the hang corrupted nothing)
+    r_res, res_lines = _run(tmp_path, 'resume', ck, total)
+    assert r_res.returncode == 0, r_res.stderr[-3000:]
+    resumed = {ln['step']: ln['loss'] for ln in res_lines if 'step' in ln}
+    assert max(resumed) == total
+    mismatches = {s: (resumed[s], ref[s]) for s in resumed
+                  if resumed[s] != ref[s]}
+    assert not mismatches, mismatches

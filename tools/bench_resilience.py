@@ -1,6 +1,7 @@
-"""Resilience bench (PERF.md §14): checkpoint stall + restart lost work.
+"""Resilience bench (PERF.md §14 + §15): checkpoint stall, restart lost
+work, supervised healthy-path overhead, and NaN-recovery time.
 
-Two claims under measurement (docs/RESILIENCE.md):
+Four claims under measurement (docs/RESILIENCE.md):
 
 1. **Async checkpointing adds < 1 step of stall.** The same compute-bound
    static training loop runs three ways from one initial state: no
@@ -17,6 +18,16 @@ Two claims under measurement (docs/RESILIENCE.md):
    checkpoints every K steps and dies at step N loses N − K⌊N/K⌋ steps;
    we restore in a fresh manager and report the lost-work accounting the
    goodput tracker books from the progress heartbeat.
+
+3. **Supervision is ~free on the healthy path.** The same loop runs bare
+   vs supervised (divergence detector on, watchdog armed with per-step
+   leases on the executor AND the boundary): acceptance is ≤ 2% median
+   step-time overhead at full size, with BITWISE-identical losses
+   (ISSUE 8; PERF.md §15).
+
+4. **Recovery from an injected NaN is fast and exact.** `nan@step=N`
+   under policy=rollback restores the last good checkpoint; we report the
+   restore wall time and the resumed-from step.
 
 Valid on CPU — both quantities are host/IO behavior, not FLOPs:
 
@@ -203,10 +214,161 @@ def measure_restart(smoke=False):
     return got
 
 
+def measure_supervised(smoke=False, steps=None):
+    """Healthy-path A/B: bare loop vs supervised loop (spike/NaN detector
+    on, watchdog armed: executor per-run lease + supervisor boundary
+    lease). Same feeds, same initial state → losses must stay bitwise."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import resilience
+    from paddle_tpu.resilience import watchdog as wdg
+    import tempfile
+
+    main, startup, bs, loss = build_mlp(smoke)
+    steps = steps or (24 if smoke else 48)
+    feeds = _feeds(bs, steps, seed=3)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        snap0 = {v.name: np.asarray(scope.find(v.name))
+                 for v in main.list_vars() if v.persistable}
+
+        def restore0():
+            import jax.numpy as jnp
+            for n, v in snap0.items():
+                scope.set(n, jnp.asarray(v))
+
+        exe.run(main, feed=feeds[0], fetch_list=[loss])   # warm compile
+
+        def supervised_loop():
+            wdg.enable(floor_s=60.0, abort=False)  # arm the per-run guards
+            try:
+                with tempfile.TemporaryDirectory() as d:
+                    mgr = resilience.CheckpointManager(
+                        d, keep=2, install_signal_handlers=False)
+                    sup = resilience.TrainingSupervisor(
+                        policy='rollback', manager=mgr, executor=exe,
+                        program=main, scope=scope)
+                    times, losses = [], []
+                    step = 0
+                    for feed in feeds:
+                        t0 = time.perf_counter()
+                        lv = exe.run(main, feed=feed, fetch_list=[loss])[0]
+                        step += 1
+                        sup.end_of_step(step, lv)
+                        times.append(time.perf_counter() - t0)
+                        losses.append(np.asarray(lv).tobytes())
+                    sup.close()
+                    mgr.close()
+                    return times, losses
+            finally:
+                wdg.disable()
+
+        # host-timing drift between back-to-back identical loops is ±2% on
+        # a busy CI box — alternate bare/supervised rounds and compare
+        # min-of-medians so the overhead number measures the supervisor,
+        # not the machine
+        base_meds, sup_meds = [], []
+        base_l = sup_l = None
+        for _ in range(2):
+            restore0()
+            base_t, base_l = _loop(exe, main, loss, feeds)
+            base_meds.append(_p(base_t, 0.5))
+            restore0()
+            sup_t, sup_l = supervised_loop()
+            sup_meds.append(_p(sup_t, 0.5))
+
+    base_med, sup_med = min(base_meds), min(sup_meds)
+    overhead = (sup_med - base_med) / base_med
+    return {
+        'bench': 'resilience_supervised',
+        'steps': steps,
+        'base_median_ms': round(base_med * 1e3, 3),
+        'supervised_median_ms': round(sup_med * 1e3, 3),
+        'base_p99_ms': round(_p(base_t, 0.99) * 1e3, 3),
+        'supervised_p99_ms': round(_p(sup_t, 0.99) * 1e3, 3),
+        # the ISSUE 8 acceptance number: ≤ 0.02 at full size
+        'overhead_frac': round(overhead, 4),
+        'overhead_lt_2pct': bool(overhead < 0.02),
+        'bitwise_identical': bool(base_l == sup_l),
+    }
+
+
+def measure_nan_recovery(smoke=False):
+    """Injected `nan@step=N` under policy=rollback: report detection →
+    restored wall time and the exactness of the resume point."""
+    import os
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import resilience
+    from paddle_tpu.resilience import fault
+    import tempfile
+
+    main, startup, bs, loss = build_mlp(smoke=True)   # recovery is IO-bound
+    feeds = _feeds(bs, 14, seed=5)
+    nan_step, every = 9, 4
+    old = os.environ.get(fault.ENV_SPEC)
+    os.environ[fault.ENV_SPEC] = f'nan@step={nan_step}'
+    fault.reset_injector()
+    try:
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+
+            def capture():
+                return resilience.capture_training_state(
+                    executor=exe, program=main, scope=scope)
+
+            with tempfile.TemporaryDirectory() as d:
+                mgr = resilience.CheckpointManager(
+                    d, every_n_steps=every, keep=2,
+                    install_signal_handlers=False)
+                sup = resilience.TrainingSupervisor(
+                    policy='rollback', manager=mgr, executor=exe,
+                    program=main, scope=scope)
+                step, i, event = 0, 0, None
+                while step < 12 and i < len(feeds):
+                    feed = feeds[i]
+                    i += 1
+                    lv = exe.run(main, feed=feed, fetch_list=[loss])[0]
+                    step += 1
+                    t0 = time.perf_counter()
+                    mgr.end_of_step(step, capture, loss=lv)
+                    boundary_s = time.perf_counter() - t0
+                    v = mgr.last_verdict
+                    if v is not None and v.action == 'rollback':
+                        event = {'detected_at': step,
+                                 'resumed_from': v.resume_step,
+                                 'boundary_ms': round(boundary_s * 1e3, 3),
+                                 'restore_ms': round(
+                                     sup.last_recovery_seconds * 1e3, 3)}
+                        step = v.resume_step
+                mgr.wait()
+                mgr.close()
+    finally:
+        if old is None:
+            os.environ.pop(fault.ENV_SPEC, None)
+        else:
+            os.environ[fault.ENV_SPEC] = old
+        fault.reset_injector()
+
+    got = {'bench': 'resilience_nan_recovery',
+           'nan_step': nan_step, 'ckpt_every': every,
+           'recovered': bool(event is not None and step >= 12),
+           'expected_resume': every * ((nan_step - 1) // every)}
+    got.update(event or {})
+    return got
+
+
 def measure_all(smoke=False, steps=None, every=None):
     return {'resilience_stall': measure_stall(smoke=smoke, steps=steps,
                                               every=every),
-            'resilience_restart': measure_restart(smoke=smoke)}
+            'resilience_restart': measure_restart(smoke=smoke),
+            'resilience_supervised': measure_supervised(smoke=smoke,
+                                                        steps=steps),
+            'resilience_nan_recovery': measure_nan_recovery(smoke=smoke)}
 
 
 def main():

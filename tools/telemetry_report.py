@@ -223,6 +223,49 @@ def summarize(metrics, trace, steps, top=10):
                      'docs/RESILIENCE.md)')
     lines.append('')
 
+    # ---- self-healing (supervisor + watchdog, docs/RESILIENCE.md) ----
+    detections = _counter(metrics, 'supervisor_detections')
+    breaches = _counter(metrics, 'watchdog_breaches')
+    if detections or breaches:
+        lines.append('## Self-healing')
+        if detections:
+            by_kind = {
+                (s['labels'].get('kind') or '?'): int(s['value'])
+                for s in (metrics.get('supervisor_detections')
+                          or {}).get('samples', [])}
+            lines.append(
+                f"detections:            {int(detections)} unhealthy "
+                f"step(s) ({', '.join(f'{k}: {v}' for k, v in sorted(by_kind.items()))})")
+            skips = _counter(metrics, 'supervisor_skipped_updates')
+            rollbacks = _counter(metrics, 'supervisor_rollbacks')
+            benign = _counter(metrics, 'supervisor_amp_benign_skips')
+            lines.append(
+                f"recoveries:            {int(skips)} update(s) dropped, "
+                f"{int(rollbacks)} rollback(s), {int(benign)} benign AMP "
+                f"overflow skip(s)")
+            rec = (metrics.get('supervisor_recovery_seconds')
+                   or {}).get('samples', [])
+            if rec and rec[0]['count']:
+                s = rec[0]
+                lines.append(f"rollback restore:      mean "
+                             f"{_ms(s['sum'] / s['count'])}, "
+                             f"max {_ms(s['max'] or 0)}")
+            quarantined = _counter(metrics, 'supervisor_quarantined_batches')
+            if quarantined:
+                lines.append(f"quarantined:           {int(quarantined)} "
+                             f"batch descriptor(s) (quarantine.jsonl)")
+        if breaches:
+            by_lease = {
+                (s['labels'].get('lease') or '?'): int(s['value'])
+                for s in (metrics.get('watchdog_breaches')
+                          or {}).get('samples', [])}
+            lines.append(
+                f"WATCHDOG BREACHES:     {int(breaches)} hang(s) "
+                f"({', '.join(f'{k}: {v}' for k, v in sorted(by_lease.items()))}), "
+                f"{int(_counter(metrics, 'watchdog_stack_dumps'))} stack "
+                f"dump(s) written")
+        lines.append('')
+
     # ---- compile-time breakdown ----
     lines.append('## Compile-time breakdown')
     any_compile = False
