@@ -399,6 +399,37 @@ def bench_resilience(on_tpu):
     return measure_all(smoke=not on_tpu)
 
 
+def bench_collectives_section(on_tpu):
+    """Quantized + bucketed gradient collectives (PERF.md §16). Runs in a
+    SUBPROCESS: the 8-device virtual CPU mesh needs XLA_FLAGS set before
+    backend init, which this process has already done. Valid on CPU: the
+    headline number is telemetry-counted bytes-on-wire reduction (≥3.5×
+    int8 acceptance), which is backend-independent."""
+    import subprocess
+    env = dict(os.environ)
+    if not on_tpu:
+        env['JAX_PLATFORMS'] = 'cpu'
+    flags = env.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in flags:
+        env['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=8').strip()
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)), 'tools',
+                      'bench_collectives.py')]
+        + ([] if on_tpu else ['--smoke']),
+        env=env, capture_output=True, text=True, timeout=1200)
+    if r.returncode != 0:
+        raise RuntimeError(f'bench_collectives failed: {r.stderr[-2000:]}')
+    out = {}
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith('{'):
+            d = json.loads(line)
+            out[d['bench']] = d
+    return out
+
+
 def bench_telemetry_sidecar(on_tpu):
     """Telemetry sidecar for the bench run: the headline benches above run
     with telemetry off (their numbers stay comparable across PRs), then the
@@ -554,6 +585,21 @@ def main():
             supervisor_bitwise=rz['resilience_supervised']
             ['bitwise_identical'],
             nan_recovery_ok=rz['resilience_nan_recovery']['recovered'])
+
+    co = run("collectives", lambda: bench_collectives_section(on_tpu))
+    if co is not None:
+        emit({"metric": "collectives",
+              "bytes": co['collectives_bytes'],
+              "steps": co['collectives_steps'],
+              "convergence": co['collectives_convergence'],
+              "bucketing": co['collectives_bucketing']})
+        summary.update(
+            collective_bytes_reduction_int8=co['collectives_bytes']
+            ['bytes_reduction_int8'],
+            collective_convergence_parity=co['collectives_convergence']
+            ['parity'],
+            collective_bucketing_bitwise=co['collectives_bucketing']
+            ['bitwise_identical'])
 
     s = run("telemetry_sidecar", lambda: bench_telemetry_sidecar(on_tpu))
     if s is not None:

@@ -6,15 +6,16 @@ the feed batch over the mesh 'dp' axis, replicate params, and let XLA insert
 AllReduce over ICI inside the already-jitted step.
 
 BuildStrategy knobs fall in three groups on TPU:
-- `fuse_elewise_add_act_ops` / `fuse_all_optimizer_ops` drive the
-  program-level IR pass pipeline (paddle_tpu/ir/): the Program's op list
-  is rewritten BEFORE the Executor traces it, cutting trace/lower time
-  and jaxpr size (XLA would fuse the kernels anyway; the pass removes the
-  front-end cost of op-granular tracing);
+- `fuse_elewise_add_act_ops` / `fuse_all_optimizer_ops` /
+  `fuse_all_reduce_ops` drive the program-level IR pass pipeline
+  (paddle_tpu/ir/): the Program's op list is rewritten BEFORE the
+  Executor traces it — op fusion cuts trace/lower time and jaxpr size,
+  and the allreduce bucketing pass regroups gradient sync for
+  comm/compute overlap (ir/bucket_allreduce.py);
 - `enable_inplace` / `memory_optimize` map onto XLA buffer donation of
   the training state (executor.py);
-- the rest (reduce_strategy, fuse_all_reduce_ops, …) are subsumed by
-  XLA/GSPMD and accepted for API compat only.
+- the rest (reduce_strategy, …) are subsumed by XLA/GSPMD and accepted
+  for API compat only.
 """
 from __future__ import annotations
 
@@ -33,10 +34,17 @@ class BuildStrategy:
       sgd/momentum/adam update ops into one multi-tensor op over a
       flattened param bundle (ir/fuse_optimizer.py) — traced op count and
       jaxpr size drop by O(#params);
+    - `fuse_all_reduce_ops` (default True): IR pass splitting the
+      per-gradient `c_allreduce_sum` ops fleet's minimize emits into
+      size-capped buckets (`PADDLE_TPU_ALLREDUCE_BUCKET_MB`, one fused
+      collective per bucket dispatched right after its gradients exist,
+      ir/bucket_allreduce.py) so bucket comm overlaps the remaining
+      backward compute instead of one tail-synchronous reduction;
+      bitwise-identical to the unbucketed ops at `comm_dtype=f32`;
     - `enable_inplace` / `memory_optimize`, which map onto XLA buffer
       donation as described below.
-    `fuse_all_reduce_ops` / reduce_strategy etc. are XLA's job and remain
-    accepted-for-compat no-ops.
+    reduce_strategy etc. are XLA's job and remain accepted-for-compat
+    no-ops.
 
     `enable_inplace` and `memory_optimize` map
     onto XLA buffer donation of the training state. The default (None) lets

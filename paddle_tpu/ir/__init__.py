@@ -21,16 +21,18 @@ import os
 
 from .pass_base import (Pass, PassContext, PassManager, all_passes,  # noqa: F401
                         get_pass, register_pass, stamp_rng_salts)
-from . import constant_fold, dce, fuse_act, fuse_optimizer  # noqa: F401  (registration)
+from . import (constant_fold, dce, fuse_act,  # noqa: F401  (registration)
+               fuse_optimizer, bucket_allreduce)
 
 __all__ = ['Pass', 'PassContext', 'PassManager', 'register_pass',
            'get_pass', 'all_passes', 'apply_pipeline', 'build_pipeline',
            'pipeline_signature', 'passes_env']
 
 # always-safe passes, on by default; the fuse passes additionally gate on
-# their BuildStrategy flag inside apply_impl
+# their BuildStrategy flag (or, for bucket_allreduce, the fleet
+# DistributedStrategy stamp) inside apply_impl
 _DEFAULT_PASSES = ('constant_fold', 'fuse_elewise_add_act',
-                   'fuse_all_optimizer_ops', 'dce')
+                   'bucket_allreduce', 'fuse_all_optimizer_ops', 'dce')
 
 
 def passes_env():
@@ -51,6 +53,11 @@ def build_pipeline():
     return PassManager([get_pass(n) for n in _selected_names()])
 
 
+_FLAG_GATED = {'fuse_elewise_add_act': 'fuse_elewise_add_act_ops',
+               'fuse_all_optimizer_ops': 'fuse_all_optimizer_ops',
+               'bucket_allreduce': 'fuse_all_reduce_ops'}
+
+
 def pipeline_signature(build_strategy=None):
     """Hashable 'which rewrites apply' tuple for the compile-cache key."""
     names = _selected_names()
@@ -58,15 +65,19 @@ def pipeline_signature(build_strategy=None):
         return ()
     env = passes_env().strip()
     if env == '1':
-        # flag-gated passes only count when their flag is live
+        # flag-gated passes only count when their flag is live (the fleet
+        # program-stamp path for bucket_allreduce is per-program and thus
+        # already covered by the cache key's program id+version)
         bs = build_strategy
         names = tuple(
             n for n in names
-            if n not in ('fuse_elewise_add_act', 'fuse_all_optimizer_ops')
-            or (bs is not None and getattr(
-                bs, 'fuse_elewise_add_act_ops'
-                if n == 'fuse_elewise_add_act'
-                else 'fuse_all_optimizer_ops', False)))
+            if n not in _FLAG_GATED
+            or (bs is not None and getattr(bs, _FLAG_GATED[n], False)))
+    if 'bucket_allreduce' in names:
+        # the cap changes the rewrite, so it must re-lower on change
+        names = tuple(
+            f'bucket_allreduce@{bucket_allreduce.bucket_cap_bytes()}'
+            if n == 'bucket_allreduce' else n for n in names)
     return names
 
 

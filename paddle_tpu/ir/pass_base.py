@@ -127,8 +127,9 @@ class PassManager:
         treat the result as theirs to lower)."""
         ctx = ctx or PassContext()
         opt = program.clone()
-        # clone() drops non-IR carry attrs the lowering reads
-        for attr in ('_fsdp_axis',):
+        # clone() drops non-IR carry attrs the lowering (and the passes
+        # themselves — the fleet fuse_all_reduce_ops stamp) read
+        for attr in ('_fsdp_axis', '_dist_fuse_all_reduce_ops'):
             if hasattr(program, attr):
                 setattr(opt, attr, getattr(program, attr))
         stamp_rng_salts(opt)

@@ -5,8 +5,13 @@ from .mesh import (make_mesh, make_hybrid_mesh, set_default_mesh,
                    get_default_mesh, mesh_guard, data_sharding, replicated,
                    topology)
 from . import fsdp
-from .fsdp import fsdp_shardings, fsdp_sharding, fsdp_spec
+from .fsdp import (fsdp_shardings, fsdp_sharding, fsdp_spec,
+                   reduce_scatter_grads)
 from . import collective
+from . import quant_collectives
+from .quant_collectives import (qallreduce_sum, qallreduce_mean,
+                                qreduce_scatter_sum, block_quantize,
+                                block_dequantize, resolve_comm_dtype)
 from .fleet import (fleet, Fleet, DistributedStrategy, DistributedOptimizer,
                     PaddleCloudRoleMaker, UserDefinedRoleMaker)
 from .ring_attention import ring_attention

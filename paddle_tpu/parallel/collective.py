@@ -64,11 +64,61 @@ def barrier(axis='dp'):
     return lax.psum(jnp.zeros((), jnp.float32), axis)
 
 
-# graph-op registrations (c_* parity): usable from static programs that are
-# lowered inside shard_map contexts (parallel/fleet.py wires this).
+def _axis_bound(axis):
+    """Whether `axis` is a live mesh axis of the surrounding trace. Static
+    programs run through the plain (non-shard_map) Executor jit have NO
+    bound axes — the gradient c_allreduce ops fleet inserts then lower to
+    identity (single-replica semantics: XLA already derives the AllReduce
+    from the GSPMD sharded-batch formulation; the explicit ops carry the
+    sync-point STRUCTURE the bucketing pass ir/bucket_allreduce.py and the
+    bytes accounting operate on, and become real collectives the moment
+    the program lowers inside a shard_map)."""
+    try:
+        lax.psum(1, axis)
+        return True
+    except NameError:
+        return False
+
+
+# graph-op registrations (c_* parity): real lax collectives when lowered
+# inside a shard_map context binding their axis; identity/single-replica
+# lowering otherwise (see _axis_bound).
 @register_op('c_allreduce_sum')
-def c_allreduce_sum(x, *, ring_id=0, use_calc_stream=True, axis='dp'):
-    return lax.psum(jnp.asarray(x), axis)
+def c_allreduce_sum(x, *, ring_id=0, use_calc_stream=True, axis='dp',
+                    comm_dtype=None):
+    """AllReduce-sum; `comm_dtype` (f32/bf16/int8, stamped by fleet from
+    DistributedStrategy.comm_dtype) block-quantizes the payload via
+    parallel/quant_collectives.py — exact lax.psum at f32."""
+    if not _axis_bound(axis):
+        return jnp.asarray(x)
+    from . import quant_collectives as qc
+    return qc.qallreduce_sum(jnp.asarray(x), axis, comm_dtype=comm_dtype)
+
+
+@register_op('c_allreduce_sum_bucket', variadic=('xs',))
+def c_allreduce_sum_bucket(xs, *, ring_id=0, use_calc_stream=True,
+                           axis='dp', comm_dtype=None):
+    """One size-capped bucket of gradient AllReduces fused by the
+    ir/bucket_allreduce.py pass: members flatten into one contiguous
+    bundle, ONE collective moves it, and the results split back to the
+    members' shapes. Concat/slice/reshape only around the collective —
+    bucketed vs per-grad reduction is bitwise-identical at f32 (elementwise
+    psum over the same values), which the pass parity suite asserts."""
+    arrs = [jnp.asarray(x) for x in xs]
+    shapes = [a.shape for a in arrs]
+    sizes = [int(a.size) for a in arrs]
+    flat = jnp.concatenate([a if a.ndim == 1 else jnp.ravel(a)
+                            for a in arrs]) if len(arrs) > 1 else \
+        jnp.ravel(arrs[0])
+    if _axis_bound(axis):
+        from . import quant_collectives as qc
+        flat = qc.qallreduce_sum(flat, axis, comm_dtype=comm_dtype)
+    out, off = [], 0
+    for shp, sz in zip(shapes, sizes):
+        seg = flat[off:off + sz]
+        out.append(seg if shp == (sz,) else jnp.reshape(seg, shp))
+        off += sz
+    return out
 
 
 @register_op('c_allreduce_max')

@@ -128,9 +128,27 @@ class DistributedStrategy:
     local_sgd_steps (lowered to the sync-every-k-steps schedule — see
     DistributedOptimizer.minimize for why replicas cannot diverge inside one
     SPMD program; parallel/local_sgd.py provides true divergent-replica
-    LocalSGD for the functional path). Subsumed by XLA and accepted as
-    no-ops: fuse_all_reduce_ops (gradient bucketing), nccl_comm_num,
-    use_hierarchical_allreduce (ICI/DCN mesh axes give this for free)."""
+    LocalSGD for the functional path).
+
+    LIVE comm knobs (ROADMAP item 3):
+
+    - ``fuse_all_reduce_ops`` — drives the ``bucket_allreduce`` IR pass
+      (ir/bucket_allreduce.py): per-gradient ``c_allreduce_sum`` ops that
+      ``minimize`` emits are split into size-capped buckets
+      (``PADDLE_TPU_ALLREDUCE_BUCKET_MB``), each dispatched right after
+      its gradients' producer so XLA overlaps bucket comm with the
+      remaining backward compute instead of one tail-synchronous
+      reduction;
+    - ``comm_dtype`` ∈ {f32, bf16, int8} — block-quantizes every gradient
+      sync payload (parallel/quant_collectives.py, EQuARX two-phase
+      decomposition; ``PADDLE_TPU_COMM_DTYPE`` overrides). Unknown names
+      raise ValueError. ``f32`` (default) is exact/bitwise;
+    - ``use_hierarchical_allreduce`` — lowered through the hybrid device
+      mesh (parallel/mesh.make_hybrid_mesh): dp over DCN × tp/fsdp over
+      ICI makes XLA emit the two-level reduction the reference built from
+      hierarchical NCCL comms.
+
+    Still accepted-for-compat only: nccl_comm_num (one XLA comm world)."""
 
     def __init__(self):
         self.fuse_all_reduce_ops = True
@@ -149,6 +167,24 @@ class DistributedStrategy:
         # 'fsdp' mesh axis via GSPMD (parallel/fsdp.py)
         self.sharding = False
         self.sharding_axis = 'fsdp'
+        self._comm_dtype = 'f32'
+
+    @property
+    def comm_dtype(self):
+        """Gradient-sync wire dtype: 'f32' (exact), 'bf16', or 'int8'
+        (block-quantized, parallel/quant_collectives.py). The
+        ``PADDLE_TPU_COMM_DTYPE`` env var overrides at every sync point."""
+        return self._comm_dtype
+
+    @comm_dtype.setter
+    def comm_dtype(self, value):
+        from .quant_collectives import SUPPORTED_COMM_DTYPES
+        if value not in SUPPORTED_COMM_DTYPES:
+            raise ValueError(
+                f"DistributedStrategy.comm_dtype: unknown comm_dtype "
+                f"{value!r} (supported: "
+                f"{', '.join(SUPPORTED_COMM_DTYPES)})")
+        self._comm_dtype = value
 
 
 class DistributedOptimizer:
@@ -196,7 +232,40 @@ class DistributedOptimizer:
             # Executor.run places persistable state with FSDP shardings
             # before each jitted step (a no-op once placed)
             loss.block.program._fsdp_axis = strat.sharding_axis
+        if merge_k == 1:
+            # per-step DP gradient sync points (ref: the collective
+            # transpiler's per-grad c_allreduce_sum insertion). On the
+            # GSPMD executor these lower to identity — XLA derives the
+            # AllReduce from the sharded-batch formulation — but they make
+            # the sync STRUCTURE explicit: the bucket_allreduce IR pass
+            # groups them into overlap-friendly size-capped buckets, and
+            # comm_dtype rides on them into any shard_map lowering.
+            # Skipped for k-step schedules (gradient merge / local SGD):
+            # those sync once per k steps, not per gradient per step.
+            self._insert_grad_allreduce(loss.block.program, strat)
         return result
+
+    @staticmethod
+    def _insert_grad_allreduce(program, strat):
+        from ..framework import BACKWARD_OP_TYPE, Operator
+        blk = program.global_block()
+        bwd = next((i for i, op in enumerate(blk.ops)
+                    if op.type == BACKWARD_OP_TYPE), None)
+        if bwd is None:
+            return
+        grads = blk.ops[bwd].outputs.get('Grads', [])
+        comm = getattr(strat, 'comm_dtype', 'f32')
+        for j, g in enumerate(grads):
+            blk.ops.insert(bwd + 1 + j, Operator(
+                blk, 'c_allreduce_sum', inputs={'x': g},
+                outputs={'Out': g},
+                attrs={'ring_id': 0, 'use_calc_stream': True, 'axis': 'dp',
+                       'comm_dtype': comm}))
+        program._bump_version()
+        # carry the bucketing decision for programs run WITHOUT a
+        # CompiledProgram BuildStrategy (ir/bucket_allreduce.py reads it)
+        program._dist_fuse_all_reduce_ops = bool(
+            getattr(strat, 'fuse_all_reduce_ops', True))
 
 
 class Role:

@@ -17,7 +17,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ['fsdp_spec', 'fsdp_sharding', 'fsdp_shardings', 'shard_params',
-           'param_shard_bytes']
+           'param_shard_bytes', 'reduce_scatter_grads']
 
 
 def fsdp_spec(shape, mesh: Mesh, axis: str = 'fsdp') -> PartitionSpec:
@@ -72,3 +72,75 @@ def param_shard_bytes(arr) -> int:
     """Bytes of `arr` held on ONE device (diagnostic for the 1/p check)."""
     shards = arr.addressable_shards
     return int(np.prod(shards[0].data.shape)) * arr.dtype.itemsize
+
+
+def reduce_scatter_grads(stacked_grads, mesh: Mesh = None, axis: str = 'fsdp',
+                         comm_dtype=None, block_size=None):
+    """Gradient reduce-scatter: per-device full gradients -> each device's
+    1/p tile of their SUM, laid out exactly like :func:`fsdp_spec` shards
+    the parameter (the ZeRO gradient sync, made explicit).
+
+    ``stacked_grads`` is a dict name -> (p, *shape) array whose leading dim
+    stacks the per-device local gradients over ``axis`` (sharded or host —
+    device_put happens here). The payload quantizes per ``comm_dtype``
+    (quant_collectives; env `PADDLE_TPU_COMM_DTYPE` wins): at int8/bf16 the
+    summed gradient never crosses the wire in full precision — only each
+    device's tile is materialized from exact-f32 partial sums. A shape with
+    no ``axis``-divisible dim falls back to a (quantized) full all-reduce,
+    replicated like its parameter. Exact ``lax.psum_scatter``/``psum`` at
+    f32. Telemetry: one ``collective_*`` record per call (path ``fsdp``)."""
+    from jax.sharding import PartitionSpec as P
+    from .mesh import get_default_mesh
+    from ..core import compat
+    from . import quant_collectives as qc
+    mesh = mesh or get_default_mesh()
+    if mesh is None or axis not in mesh.shape:
+        raise ValueError(f"reduce_scatter_grads: no mesh axis {axis!r}")
+    p = mesh.shape[axis]
+    comm = qc.resolve_comm_dtype(comm_dtype)
+    stacked_grads = {k: jax.device_put(
+        jax.numpy.asarray(v),
+        NamedSharding(mesh, P(axis, *([None] * (np.ndim(v) - 1)))))
+        for k, v in stacked_grads.items()}
+    shapes = {k: tuple(v.shape[1:]) for k, v in stacked_grads.items()}
+    specs = {k: fsdp_spec(s, mesh, axis) for k, s in shapes.items()}
+    scatter_dim = {}
+    for k, spec in specs.items():
+        entries = tuple(spec)
+        scatter_dim[k] = entries.index(axis) if axis in entries else None
+    in_specs = {k: P(axis, *([None] * len(shapes[k])))
+                for k in stacked_grads}
+
+    def body(stacked):
+        out = {}
+        for k, v in stacked.items():
+            g = v[0]                      # this device's local gradient
+            d = scatter_dim[k]
+            if d is None:
+                out[k] = compat.pcast(
+                    qc.qallreduce_sum(g, axis, comm_dtype=comm,
+                                      block_size=block_size),
+                    axis, to='varying')
+            else:
+                out[k] = qc.qreduce_scatter_sum(
+                    g, axis, comm_dtype=comm, block_size=block_size,
+                    scattered_dimension=d)
+        return out
+
+    fn = compat.shard_map(body, mesh=mesh, in_specs=(in_specs,),
+                          out_specs=specs)
+    qc.record_collective(
+        'fsdp',
+        sum(int(np.prod(s, dtype=np.int64)) if s else 1
+            for s in shapes.values()),
+        comm, p, block_size=block_size,
+        phases=1)       # reduce-scatter is phase 1 only (no all-gather)
+    if _qc_err_enabled(comm):
+        for k, v in stacked_grads.items():
+            qc.record_quant_error('fsdp', v, comm, block_size)
+    return fn(stacked_grads)
+
+
+def _qc_err_enabled(comm):
+    from .. import observability as _obs
+    return _obs._ENABLED and comm != 'f32'

@@ -24,6 +24,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import compat
+from . import quant_collectives as qc
 
 
 class GeoSGDStep:
@@ -33,11 +34,18 @@ class GeoSGDStep:
         for batch in data:            # leading dim sharded over `axis`
             loss = step(batch)
         final = step.base_params()    # the synchronized base
+
+    `comm_dtype` quantizes the k-step delta-sum AllReduce — deltas are the
+    natural quantization target (small dynamic range vs the params
+    themselves); `f32` (default) keeps the exact `lax.psum` bitwise.
     """
 
     def __init__(self, loss_fn, params, mesh, need_push_nums, lr=0.1,
-                 axis='dp'):
+                 axis='dp', comm_dtype=None):
         self._k = int(need_push_nums)
+        self._comm = qc.resolve_comm_dtype(comm_dtype)
+        self._sync_elems = sum(
+            int(jnp.size(jnp.asarray(v))) for v in params.values())
         n = self._n = mesh.shape[axis]
         rep_spec = {name: P(axis, *([None] * jnp.ndim(v)))
                     for name, v in params.items()}
@@ -56,6 +64,7 @@ class GeoSGDStep:
                            stacked))
         self._t = 0
         k = self._k
+        comm = self._comm
 
         def body(local_stacked, base_stacked, batch, t):
             local = {m: v[0] for m, v in local_stacked.items()}
@@ -69,7 +78,8 @@ class GeoSGDStep:
                 # adding the varying `base` keeps the result 'varying', so
                 # both cond branches type-match under shard_map
                 new_base = {
-                    m: base[m] + lax.psum(local[m] - base[m], axis)
+                    m: base[m] + qc.qallreduce_sum(local[m] - base[m], axis,
+                                                   comm_dtype=comm)
                     for m in base}
                 return new_base, new_base
 
@@ -88,6 +98,18 @@ class GeoSGDStep:
         self._step = jax.jit(fn, donate_argnums=(0, 1))
 
     def __call__(self, batch):
+        if (self._t % self._k) == (self._k - 1):
+            # bytes + codec-error telemetry for the delta psum this step
+            # runs inside the jitted body; the error samples the current
+            # local-base delta (the quantization target) per call
+            qc.record_collective('geo_sgd', self._sync_elems, self._comm,
+                                 self._n)
+            if self._comm != 'f32':
+                local, base = self._state
+                for m in local:
+                    qc.record_quant_error('geo_sgd',
+                                          local[m][0] - base[m][0],
+                                          self._comm)
         local, base = self._state
         local, base, loss = self._step(local, base, jnp.asarray(batch),
                                        jnp.int32(self._t))

@@ -20,6 +20,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import compat
+from . import quant_collectives as qc
 
 
 class LocalSGDStep:
@@ -33,12 +34,20 @@ class LocalSGDStep:
         for batch in data:           # batch leading dim sharded over `axis`
             loss = step(batch)
         final = step.averaged_params()
+
+    `comm_dtype` quantizes the k-step parameter-averaging AllReduce
+    (quant_collectives; env `PADDLE_TPU_COMM_DTYPE` wins) — `f32` (default)
+    keeps the exact `lax.pmean` bitwise.
     """
 
-    def __init__(self, loss_fn, params, mesh, k_steps, lr=0.1, axis='dp'):
-        # k/lr/axis are baked into the compiled step below — rebuild the
-        # LocalSGDStep to change them
+    def __init__(self, loss_fn, params, mesh, k_steps, lr=0.1, axis='dp',
+                 comm_dtype=None):
+        # k/lr/axis/comm_dtype are baked into the compiled step below —
+        # rebuild the LocalSGDStep to change them
         self._k = int(k_steps)
+        self._comm = qc.resolve_comm_dtype(comm_dtype)
+        self._sync_elems = sum(
+            int(jnp.size(jnp.asarray(v))) for v in params.values())
         n = self._n = mesh.shape[axis]
         rep_sharding = {
             name: NamedSharding(mesh, P(axis, *([None] * jnp.ndim(v))))
@@ -50,6 +59,7 @@ class LocalSGDStep:
             for name, v in params.items()}
         self._t = 0
         k = self._k
+        comm = self._comm
 
         def body(stacked, batch, t):
             local = {m: v[0] for m, v in stacked.items()}
@@ -57,9 +67,11 @@ class LocalSGDStep:
             new = {m: v - lr * grads[m] for m, v in local.items()}
 
             def sync(p):
-                # pmean output is replication-invariant; pcast back to
+                # collective output is replication-invariant; pcast back to
                 # varying so both cond branches type-match under shard_map
-                return {m: compat.pcast(lax.pmean(v, axis), axis, to='varying')
+                return {m: compat.pcast(
+                    qc.qallreduce_mean(v, axis, comm_dtype=comm),
+                    axis, to='varying')
                         for m, v in p.items()}
 
             new = lax.cond((t % k) == (k - 1), sync, lambda p: p, new)
@@ -74,6 +86,16 @@ class LocalSGDStep:
         self._step = jax.jit(fn, donate_argnums=(0,))
 
     def __call__(self, batch):
+        if (self._t % self._k) == (self._k - 1):
+            # host-side bytes-on-wire accounting for the sync this step
+            # performs inside the jitted body (no-op with telemetry off);
+            # the error histogram samples the codec on the values entering
+            # the boundary (pre-step params — a per-call estimate)
+            qc.record_collective('local_sgd', self._sync_elems, self._comm,
+                                 self._n)
+            if self._comm != 'f32':
+                for v in self._params.values():
+                    qc.record_quant_error('local_sgd', v[0], self._comm)
         self._params, loss = self._step(self._params,
                                         jnp.asarray(batch),
                                         jnp.int32(self._t))

@@ -1,0 +1,58 @@
+"""tier-1 guard for the collectives bench: tools/bench_collectives.py must
+run end-to-end under JAX_PLATFORMS=cpu at smoke sizes and demonstrate the
+ISSUE 9 acceptances: int8 block-quantized all-reduce cuts telemetry-counted
+bytes-on-wire >= 3.5x vs f32 with convergence parity on the MNIST recipe,
+and the bucketing pass is bitwise pass-on/off at comm_dtype=f32."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+def test_bench_collectives_smoke_runs_on_cpu():
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PADDLE_TPU_COMM_DTYPE', None)
+    env.pop('PADDLE_TPU_ALLREDUCE_BUCKET_MB', None)
+    env.pop('PADDLE_TPU_PASSES', None)
+    flags = env.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in flags:
+        env['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=8').strip()
+    r = subprocess.run(
+        [sys.executable, os.path.join('tools', 'bench_collectives.py'),
+         '--smoke'],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
+    benches = {d['bench']: d for d in lines if 'bench' in d}
+    assert {'collectives_bytes', 'collectives_steps',
+            'collectives_convergence', 'collectives_bucketing'} <= \
+        set(benches)
+
+    by = benches['collectives_bytes']
+    # THE acceptance: int8 bytes-on-wire reduction >= 3.5x, telemetry-counted
+    assert by['acceptance_ge_3_5x'] is True, by
+    assert by['bytes_reduction_int8'] >= 3.5, by
+    assert by['reduction_bf16'] == 2.0, by
+    # the f32 path is exact (bitwise psum passthrough)
+    assert by['f32_exact'] is True, by
+    assert by['max_rel_err_f32'] == 0.0, by
+    # quantized error is small but nonzero (it really quantized)
+    assert 0 < by['max_rel_err_int8'] < 0.05, by
+
+    st = benches['collectives_steps']
+    # the quantized step is a real train step on every dtype
+    for comm in ('f32', 'bf16', 'int8'):
+        assert st[f'steps_per_s_{comm}'] > 0, st
+
+    cv = benches['collectives_convergence']
+    # EQuARX quality claim at bench scale: int8 final loss tracks f32
+    assert cv['parity'] is True, cv
+    assert cv['both_converged'] is True, cv
+
+    bk = benches['collectives_bucketing']
+    assert bk['bitwise_identical'] is True, bk
+    assert bk['buckets'] >= 2 and bk['bucketed_ops'] == \
+        bk['allreduce_ops'], bk

@@ -577,6 +577,9 @@ NONDIFF = {
     'c_sync_comm_stream': 'no-op stream sync',
     # collectives need a mesh/shard_map context
     'c_allreduce_sum': 'collective (tested in test_parallel.py)',
+    'c_allreduce_sum_bucket': 'collective (bucketed gradient sync — '
+                              'tested in test_bucket_allreduce.py / '
+                              'test_quant_collectives.py)',
     'c_allreduce_max': 'collective (tested in test_parallel.py)',
     'c_allreduce_min': 'collective (tested in test_parallel.py)',
     'c_allreduce_prod': 'collective (tested in test_parallel.py)',

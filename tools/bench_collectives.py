@@ -1,0 +1,428 @@
+"""Quantized + bucketed gradient-collective bench (PERF.md §16).
+
+Four sections, each printed as one JSON line (partial-evidence protocol):
+
+- ``collectives_bytes`` — telemetry-counted bytes-on-wire for one
+  gradient-volume sync at f32 / bf16 / int8 on the 8-device CPU mesh,
+  plus the measured max elementwise error of the quantized all-reduce vs
+  the exact ``lax.psum``. THE acceptance: int8 reduction ≥ 3.5×.
+- ``collectives_steps`` — steps/s of an explicit-gradient-sync DP train
+  step (shard_map, grads reduced with ``qallreduce_mean``) per comm
+  dtype. On CPU the codec is host arithmetic with no real interconnect to
+  save, so int8 is NOT expected to win here — bytes is the column that
+  transfers to TPU; this column proves the quantized step is a working
+  train step and prices the codec.
+- ``collectives_convergence`` — the MNIST-MLP recipe trained twice on
+  identical data/init, grads synced at f32 vs int8; final-loss parity
+  within tolerance is the EQuARX "negligible quality loss" claim.
+- ``collectives_bucketing`` — the fleet static path: per-grad
+  ``c_allreduce_sum`` ops bucketed by ir/bucket_allreduce.py under a
+  small cap; losses must be BITWISE identical pass-on/off and the bucket
+  count must match the cap arithmetic.
+
+Runs on any backend; sized for CPU::
+
+  JAX_PLATFORMS=cpu python tools/bench_collectives.py [--smoke] [--iters N]
+
+Multi-process mode (real cross-process reduce through the dygraph
+DataParallel bundle path)::
+
+  python tools/bench_collectives.py --nproc 2
+
+spawns the workers, initializes ``jax.distributed`` over localhost, and
+verifies the bundled quantized all-reduce sums per-process gradients
+exactly (f32) / within the codec bound (int8). Not part of ``--smoke``
+(tier-1 stays single-process).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+# runnable as `python tools/bench_collectives.py` from the repo root
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+NDEV = 8
+
+
+def _force_devices():
+    flags = os.environ.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in flags:
+        os.environ['XLA_FLAGS'] = (
+            flags + f' --xla_force_host_platform_device_count={NDEV}').strip()
+
+
+# ---------------------------------------------------------------------------
+# explicit-sync DP train step (shard_map + qallreduce over 'dp')
+# ---------------------------------------------------------------------------
+
+def _init_mlp(rng, in_dim, hidden, out_dim):
+    import numpy as np
+    s1 = (2.0 / in_dim) ** 0.5
+    s2 = (2.0 / hidden) ** 0.5
+    return {'w1': (rng.randn(in_dim, hidden) * s1).astype(np.float32),
+            'b1': np.zeros(hidden, np.float32),
+            'w2': (rng.randn(hidden, out_dim) * s2).astype(np.float32),
+            'b2': np.zeros(out_dim, np.float32)}
+
+
+def make_dp_step(mesh, params, lr, comm_dtype, axis='dp'):
+    """Jitted data-parallel step: batch sharded over `axis`, params
+    replicated, per-shard grads explicitly synced with qallreduce_mean at
+    `comm_dtype` (exact pmean at f32). Returns (step_fn, n_elems)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.core import compat
+    from paddle_tpu.parallel import quant_collectives as qc
+
+    def loss_fn(p, x, y):
+        h = jnp.maximum(x @ p['w1'] + p['b1'], 0.0)
+        logits = h @ p['w2'] + p['b2']
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y, axis=1))
+
+    def body(p, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        grads = {k: compat.pcast(
+            qc.qallreduce_mean(g, axis, comm_dtype=comm_dtype),
+            axis, to='varying') for k, g in grads.items()}
+        new_p = {k: v - lr * grads[k] for k, v in p.items()}
+        return new_p, lax.pmean(loss, axis)
+
+    pspec = {k: P() for k in params}
+    fn = compat.shard_map(body, mesh=mesh,
+                          in_specs=(pspec, P(axis), P(axis)),
+                          out_specs=(pspec, P()))
+    n_elems = sum(int(v.size) for v in params.values())
+    return jax.jit(fn, donate_argnums=(0,)), n_elems
+
+
+def _mnist_like(rng, n, in_dim=784, classes=10):
+    """Prototype-digit corpus (the test_mnist_convergence recipe shape):
+    per-class fixed prototypes + pixel noise, learnable by an MLP."""
+    import numpy as np
+    protos = rng.randint(0, 256, (classes, in_dim))
+    labels = rng.randint(0, classes, n)
+    imgs = np.clip(protos[labels] + rng.randint(-80, 80, (n, in_dim)),
+                   0, 255).astype(np.float32) / 255.0
+    return imgs, labels.astype(np.int32)[:, None]
+
+
+# ---------------------------------------------------------------------------
+# sections
+# ---------------------------------------------------------------------------
+
+def measure_bytes(smoke=False):
+    """Telemetry-counted wire bytes per comm dtype + quantized-vs-exact
+    error for one gradient-volume all-reduce on the dp mesh."""
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu import observability as obs
+    from paddle_tpu.core import compat
+    from paddle_tpu.parallel import quant_collectives as qc
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({'dp': NDEV})
+    elems = (1 << 16) if smoke else (1 << 20)
+    rng = np.random.RandomState(0)
+    X = rng.randn(NDEV, elems).astype('float32')
+    want = np.asarray(
+        compat.shard_map(lambda v: lax.psum(v[0], 'dp')[None], mesh=mesh,
+                         in_specs=P('dp'), out_specs=P('dp'))(
+            jnp.asarray(X)))[0]
+
+    out = {'bench': 'collectives_bytes', 'grad_elems': elems,
+           'devices': NDEV}
+    with obs.telemetry_guard(True):
+        for comm in ('f32', 'bf16', 'int8'):
+            obs.reset()
+            got = np.asarray(
+                compat.shard_map(
+                    lambda v: qc.qallreduce_sum(v[0], 'dp',
+                                                comm_dtype=comm)[None],
+                    mesh=mesh, in_specs=P('dp'), out_specs=P('dp'))(
+                    jnp.asarray(X)))[0]
+            qc.record_collective('bench', elems, comm, NDEV)
+            m = obs.registry.to_dict()
+            wire = sum(s['value']
+                       for s in m['collective_bytes_on_wire']['samples'])
+            f32eq = sum(s['value']
+                        for s in m['collective_bytes_f32_equiv']['samples'])
+            err = float(np.abs(got - want).max())
+            rel = err / float(np.abs(want).max())
+            out[f'wire_bytes_{comm}'] = int(wire)
+            out[f'reduction_{comm}'] = round(f32eq / wire, 3)
+            out[f'max_rel_err_{comm}'] = float(f'{rel:.3e}')
+            if comm == 'f32':
+                out['f32_exact'] = bool(np.array_equal(got, want))
+    out['bytes_reduction_int8'] = out['reduction_int8']
+    out['acceptance_ge_3_5x'] = out['reduction_int8'] >= 3.5
+    return out
+
+
+def measure_steps(iters=30, smoke=False):
+    """steps/s of the explicit-sync DP step per comm dtype (CPU prices the
+    codec; the interconnect win needs real ICI — documented)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({'dp': NDEV})
+    hidden = 64 if smoke else 512
+    bs = NDEV * (8 if smoke else 32)
+    iters = max(4, iters // 4) if smoke else iters
+    rng = np.random.RandomState(0)
+    X, Y = _mnist_like(rng, bs)
+    data_sh = NamedSharding(mesh, P('dp'))
+    Xd = jax.device_put(jnp.asarray(X), data_sh)
+    Yd = jax.device_put(jnp.asarray(Y), data_sh)
+
+    out = {'bench': 'collectives_steps', 'devices': NDEV, 'hidden': hidden,
+           'batch': bs, 'iters': iters}
+    for comm in ('f32', 'bf16', 'int8'):
+        params = {k: jnp.asarray(v) for k, v in
+                  _init_mlp(np.random.RandomState(1), 784, hidden,
+                            10).items()}
+        step, _ = make_dp_step(mesh, params, 0.1, comm)
+        params, loss = step(params, Xd, Yd)          # compile
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, loss = step(params, Xd, Yd)
+        float(loss)
+        dt = (time.perf_counter() - t0) / iters
+        out[f'steps_per_s_{comm}'] = round(1.0 / dt, 2)
+        out[f'final_loss_{comm}'] = float(loss)
+    out['int8_vs_f32'] = round(out['steps_per_s_int8']
+                               / out['steps_per_s_f32'], 3)
+    return out
+
+
+def measure_convergence(smoke=False):
+    """MNIST-recipe final-loss parity: identical data/init, grads synced
+    at f32 vs int8 (the EQuARX quality claim, loss-gated)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({'dp': NDEV})
+    n, epochs = (512, 4) if smoke else (2048, 3)
+    bs = 64
+    hidden = 64 if smoke else 128
+    rng = np.random.RandomState(0)
+    X, Y = _mnist_like(rng, n)
+    data_sh = NamedSharding(mesh, P('dp'))
+
+    losses = {}
+    for comm in ('f32', 'int8'):
+        params = {k: jnp.asarray(v) for k, v in
+                  _init_mlp(np.random.RandomState(1), 784, hidden,
+                            10).items()}
+        step, _ = make_dp_step(mesh, params, 0.1, comm)
+        hist = []
+        for _ in range(epochs):
+            for i in range(0, n - bs + 1, bs):
+                xb = jax.device_put(jnp.asarray(X[i:i + bs]), data_sh)
+                yb = jax.device_put(jnp.asarray(Y[i:i + bs]), data_sh)
+                params, loss = step(params, xb, yb)
+                hist.append(float(loss))
+        losses[comm] = hist
+    f32_final = float(np.mean(losses['f32'][-4:]))
+    int8_final = float(np.mean(losses['int8'][-4:]))
+    first = float(losses['f32'][0])
+    # parity: the quantized run lands within 10% of the f32 run's total
+    # loss DECREASE, or within 15% of its final value — the first term
+    # gates a converged run tightly, the second keeps a steep early curve
+    # (smoke sizes) from flagging sub-step timing noise as divergence
+    gap = abs(int8_final - f32_final)
+    tol = max(0.1 * (first - f32_final), 0.15 * f32_final, 1e-6)
+    return {'bench': 'collectives_convergence', 'steps': len(losses['f32']),
+            'first_loss': round(first, 4),
+            'final_loss_f32': round(f32_final, 4),
+            'final_loss_int8': round(int8_final, 4),
+            'final_gap': round(gap, 4), 'tolerance': round(tol, 4),
+            'parity': bool(gap <= tol),
+            'both_converged': bool(f32_final < 0.5 * first
+                                   and int8_final < 0.5 * first)}
+
+
+def measure_bucketing(smoke=False):
+    """Static fleet path: bucket pass on/off bitwise parity + bucket-count
+    arithmetic under a small PADDLE_TPU_ALLREDUCE_BUCKET_MB cap."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import ir, layers
+    from paddle_tpu.compiler import BuildStrategy, CompiledProgram
+    from paddle_tpu.parallel import DistributedStrategy, fleet
+
+    depth = 4 if smoke else 8
+    width = 64
+    fleet.init()
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data('x', shape=[width], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        h = x
+        for _ in range(depth):
+            h = layers.fc(h, size=width, act='relu')
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fleet.distributed_optimizer(
+            fluid.optimizer.SGD(0.05),
+            strategy=DistributedStrategy()).minimize(loss)
+    n_ar = len([o for o in main.global_block().ops
+                if o.type == 'c_allreduce_sum'])
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, width).astype('float32')
+    Yv = rng.randn(16, 1).astype('float32')
+
+    old = os.environ.get('PADDLE_TPU_ALLREDUCE_BUCKET_MB')
+    # cap sized to force >1 bucket: each fc layer grad is width*width*4 B
+    os.environ['PADDLE_TPU_ALLREDUCE_BUCKET_MB'] = str(
+        2 * width * width * 4 / 2 ** 20)
+    try:
+        runs = {}
+        for tag, on in (('off', False), ('on', True)):
+            bs = BuildStrategy()
+            bs.fuse_all_reduce_ops = on
+            exe = fluid.Executor()
+            with fluid.scope_guard(fluid.Scope()):
+                exe.run(start)
+                cp = CompiledProgram(main, build_strategy=bs)
+                runs[tag] = [
+                    np.asarray(exe.run(cp, feed={'x': X, 'y': Yv},
+                                       fetch_list=[loss])[0])
+                    for _ in range(6)]
+        bitwise = all(np.array_equal(a, b)
+                      for a, b in zip(runs['off'], runs['on']))
+        bs = BuildStrategy()
+        bs.fuse_all_reduce_ops = True
+        opt, ctx = ir.apply_pipeline(main, fetch_names=[loss.name],
+                                     build_strategy=bs)
+        stats = ctx.stats.get('bucket_allreduce', {})
+    finally:
+        if old is None:
+            os.environ.pop('PADDLE_TPU_ALLREDUCE_BUCKET_MB', None)
+        else:
+            os.environ['PADDLE_TPU_ALLREDUCE_BUCKET_MB'] = old
+    return {'bench': 'collectives_bucketing', 'allreduce_ops': n_ar,
+            'buckets': stats.get('buckets', 0),
+            'bucketed_ops': stats.get('bucketed_ops', 0),
+            'bitwise_identical': bool(bitwise)}
+
+
+def measure_all(iters=30, smoke=False):
+    return {'collectives_bytes': measure_bytes(smoke=smoke),
+            'collectives_steps': measure_steps(iters=iters, smoke=smoke),
+            'collectives_convergence': measure_convergence(smoke=smoke),
+            'collectives_bucketing': measure_bucketing(smoke=smoke)}
+
+
+# ---------------------------------------------------------------------------
+# multi-process mode (real cross-process bundle reduce)
+# ---------------------------------------------------------------------------
+
+def _worker(rank, nproc, port, comm):
+    import jax
+    try:
+        # cross-process computations on the CPU backend need the gloo
+        # collectives implementation (no-op on jax builds without it)
+        jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+    except Exception:
+        pass
+    jax.distributed.initialize(coordinator_address=f'localhost:{port}',
+                               num_processes=nproc, process_id=rank)
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu import dygraph
+    from paddle_tpu.dygraph.parallel import DataParallel
+    from paddle_tpu.dygraph.nn import Linear
+
+    with dygraph.guard():
+        model = Linear(16, 4)
+        dp = DataParallel(model)
+        rngs = [np.random.RandomState(100 + r) for r in range(nproc)]
+        grads = {}
+        for p in model.parameters():
+            per_rank = [r.randn(*np.shape(p.value)).astype('float32')
+                        for r in rngs]
+            p.grad = jnp.asarray(per_rank[rank])
+            grads[id(p)] = np.sum(per_rank, axis=0)
+        os.environ['PADDLE_TPU_COMM_DTYPE'] = comm
+        t0 = time.perf_counter()
+        dp.apply_collective_grads()
+        dt = time.perf_counter() - t0
+        max_err = max(float(np.abs(np.asarray(p.grad) - grads[id(p)]).max())
+                      for p in model.parameters())
+        tol = 0.0 if comm == 'f32' else 0.5
+        ok = max_err <= tol
+    if rank == 0:
+        print(json.dumps({'bench': 'collectives_multiproc', 'nproc': nproc,
+                          'comm_dtype': comm, 'max_err': max_err,
+                          'reduce_seconds': round(dt, 4), 'ok': ok}),
+              flush=True)
+    sys.exit(0 if ok else 1)
+
+
+def _spawn_multiproc(nproc, comm):
+    with socket.socket() as s:
+        s.bind(('localhost', 0))
+        port = s.getsockname()[1]
+    procs = []
+    for r in range(nproc):
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        env.pop('XLA_FLAGS', None)       # one device per process
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), '--worker-rank',
+             str(r), '--nproc', str(nproc), '--port', str(port),
+             '--comm', comm],
+            env=env, cwd=_REPO))
+    rc = [p.wait(timeout=300) for p in procs]
+    if any(rc):
+        raise SystemExit(f'multiproc workers failed: rc={rc}')
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--iters', type=int, default=30)
+    ap.add_argument('--smoke', action='store_true',
+                    help='tiny shapes / CI smoke sizes')
+    ap.add_argument('--nproc', type=int, default=0,
+                    help='spawn N jax.distributed processes and verify the '
+                         'cross-process bundled reduce instead of the '
+                         'single-process sections')
+    ap.add_argument('--comm', default='int8',
+                    help='comm dtype for --nproc mode')
+    ap.add_argument('--worker-rank', type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument('--port', type=int, default=0, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.worker_rank is not None:
+        _worker(args.worker_rank, args.nproc, args.port, args.comm)
+        return
+    if args.nproc:
+        _spawn_multiproc(args.nproc, args.comm)
+        return
+    _force_devices()
+    for res in measure_all(iters=args.iters, smoke=args.smoke).values():
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -166,6 +166,57 @@ def summarize(metrics, trace, steps, top=10):
                      'ExecutionStrategy.num_inflight_steps>1)')
     lines.append('')
 
+    # ---- collectives (quantized + bucketed gradient sync) ----
+    sync_calls = _counter(metrics, 'collective_sync_calls')
+    buckets = _counter(metrics, 'collective_allreduce_buckets')
+    if sync_calls or buckets:
+        lines.append('## Collectives')
+        if sync_calls:
+            by_key = {}
+            for s in (metrics.get('collective_sync_calls')
+                      or {}).get('samples', []):
+                k = (f"{s['labels'].get('path', '?')}"
+                     f"/{s['labels'].get('dtype', '?')}")
+                by_key[k] = by_key.get(k, 0) + s['value']
+            lines.append(
+                f"sync calls:            {int(sync_calls)} "
+                f"({', '.join(f'{k}: {int(v)}' for k, v in sorted(by_key.items()))})")
+            wire = _counter(metrics, 'collective_bytes_on_wire')
+            f32eq = _counter(metrics, 'collective_bytes_f32_equiv')
+            if wire and f32eq:
+                def fmt(b):
+                    return f"{b / 2**20:.1f} MiB" if b >= 2**20 \
+                        else f"{b / 2**10:.1f} KiB"
+                note = '' if f32eq >= wire else \
+                    ' — EXPANSION: block padding dominates; tensors this ' \
+                    'small should sync at f32'
+                lines.append(
+                    f"bytes on wire:         {fmt(wire)} vs "
+                    f"{fmt(f32eq)} f32-equivalent "
+                    f"({f32eq / wire:.2f}x reduction{note})")
+            qerr = (metrics.get('collective_quant_rel_error')
+                    or {}).get('samples', [])
+            qn = sum(s['count'] for s in qerr)
+            if qn:
+                qs = sum(s['sum'] for s in qerr)
+                qmax = max(s['max'] or 0 for s in qerr)
+                lines.append(
+                    f"quantization error:    mean {qs / qn:.2e} rel/absmax "
+                    f"per codec pass, max {qmax:.2e} ({int(qn)} samples)")
+        if buckets:
+            passes = _gauge_by_label(metrics, 'ir_pass_applied_total',
+                                     'pass').get('bucket_allreduce', 0)
+            per = buckets / max(passes, 1)
+            lines.append(
+                f"bucketed all-reduce:   {per:.0f} bucket(s) per lowering "
+                f"(PADDLE_TPU_ALLREDUCE_BUCKET_MB caps each)")
+            if per > 1:
+                lines.append(
+                    f"comm overlap ceiling:  {1 - 1 / per:.1%} of gradient "
+                    f"comm can overlap backward compute (all but the last "
+                    f"bucket dispatch before the backward tail finishes)")
+        lines.append('')
+
     # ---- resilience / goodput ----
     saves = _counter(metrics, 'checkpoint_saves')
     goodput = (metrics.get('goodput_ratio') or {}).get('samples', [])
