@@ -354,6 +354,18 @@ def bench_ir_passes(on_tpu):
     return measure_all(iters=3 if on_tpu else 2, smoke=not on_tpu)
 
 
+def bench_verify_overhead(on_tpu):
+    """Static-verifier cost (PERF.md §17): paddle_tpu/analysis/ at
+    PADDLE_TPU_VERIFY=passes on the multi-param Adam MLP recipe — the
+    verifier's fraction of the cold lower+compile it rides on (must be
+    ≤2%) and the warm-step ratio (must be ~1.0: build-time only). Valid
+    on CPU: the quantity under test is host-side analysis time."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools'))
+    from bench_verify import measure_all
+    return measure_all(iters=5 if on_tpu else 3, smoke=not on_tpu)
+
+
 def bench_serving_batcher(on_tpu):
     """Serving-path load bench (PERF.md §11): closed-loop clients through
     the dynamic micro-batcher (paddle_tpu/serving/) vs serial single-request
@@ -600,6 +612,17 @@ def main():
             ['parity'],
             collective_bucketing_bitwise=co['collectives_bucketing']
             ['bitwise_identical'])
+
+    vo = run("verify_overhead", lambda: bench_verify_overhead(on_tpu))
+    if vo is not None:
+        emit({"metric": "verify_overhead",
+              "overhead": vo['verify_overhead'],
+              "pipeline_ab": vo['verify_pipeline_ab']})
+        summary.update(
+            verify_frac_of_compile=vo['verify_overhead']
+            ['verify_frac_of_compile'],
+            verify_warm_step_ratio=vo['verify_overhead']
+            ['warm_step_ratio'])
 
     s = run("telemetry_sidecar", lambda: bench_telemetry_sidecar(on_tpu))
     if s is not None:

@@ -54,6 +54,7 @@ from . import contrib
 from . import observability
 from . import serving
 from . import resilience
+from . import analysis
 from . import profiler
 from . import debugger
 from . import log_helper

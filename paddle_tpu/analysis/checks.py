@@ -1,0 +1,401 @@
+"""Dataflow diagnostics over a Program: the verifier's check suite.
+
+One forward walk per block drives everything: availability tracking
+(read-before-write / dangling vars), registry conformance (unknown op
+types, missing required input slots), the infer.py rule engine
+(shape/dtype mismatch at op inputs, declared-vs-inferred drift), and
+the repo-specific consistency lints (collective comm_dtype drift,
+``c_allreduce`` under k-step schedules, bucket dtype uniformity, RNG
+salt stamps after pass rewrites). A reverse pass afterwards finds dead
+writes, dead vars, and donation hazards.
+
+Everything lands as a :class:`~.diagnostics.Diagnostic`; severities
+follow the policy in diagnostics.py (errors = cannot lower, warnings =
+suspicious/slow, info = coverage notes). ``stage`` tweaks two rules:
+
+- ``'post-pass'`` — an INTERMEDIATE IR-pass output: needs_rng ops must
+  carry their ``_rng_salt`` stamp (bitwise pass-on/off RNG contract);
+  dead code stays info, because e.g. constant folding deliberately
+  leaves orphaned producers for the DCE pass to sweep.
+- ``'post-pipeline'`` — the FINAL pipeline output: dead writes/vars
+  become warnings (the pipeline ends with DCE; surviving debris means a
+  pass left a mess DCE could not see).
+- anything else — user-built programs: dead code is info (the DCE pass
+  exists precisely to sweep it).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..framework import BACKWARD_OP_TYPE
+from .diagnostics import Diagnostic
+from . import infer
+from .infer import (InferError, VarInfo, declared_info, has_rule, infer_op,
+                    is_float, seed_env, shapes_agree)
+
+__all__ = ['run_checks']
+
+# executor-interpreted op types that never reach the op registry
+_SPECIAL_OPS = frozenset({
+    BACKWARD_OP_TYPE, '__init__', '__constant__', '__create_array__',
+    '__cond__', '__switch__', '__while__', '__while_legacy__', '__scan__'})
+
+_SUB_BLOCK_ATTRS = ('true_block', 'false_block', 'cond_block', 'body_block',
+                    'block')
+
+_COLLECTIVE_TYPES = ('c_allreduce_sum', 'c_allreduce_max', 'c_allreduce_min',
+                     'c_allreduce_prod', 'c_allreduce_sum_bucket')
+
+_UPDATE_OP_TYPES = frozenset(infer._OPT_MIRROR) | \
+    frozenset(infer._FUSED_OPT_MIRROR)
+
+
+def _site(op):
+    return getattr(op, '_site', None)
+
+
+def _sub_block_indices(op):
+    subs = [op.attrs[a] for a in _SUB_BLOCK_ATTRS if a in op.attrs]
+    subs.extend(op.attrs.get('blocks', []))
+    return subs
+
+
+def _op_external_reads(op, program) -> Set[str]:
+    """Names an op reads from its enclosing scope: declared inputs plus
+    sub-block reads that are not produced earlier inside the sub-block
+    (control-flow branches chain onto the outer env — executor._run_block).
+    Names the control-flow machinery binds itself before the sub-block
+    runs — __scan__'s per-step slices and carried memories (bound from the
+    op's X/Init inputs, executor._run_scan) — are not external. Names the
+    machinery READS from the outer env — cond/switch `writes` passthrough,
+    while-loop carry seeds — are external even when no sub-op reads them
+    (mirrors executor._op_read_names)."""
+    reads = set(op.input_names())
+    for attr in ('writes', 'loop_vars', 'carry'):
+        v = op.attrs.get(attr)
+        if isinstance(v, (list, tuple)):
+            reads.update(x for x in v if isinstance(x, str))
+    bound: Set[str] = set()
+    if op.type == '__scan__':
+        bound |= set(op.attrs.get('slice_names', []))
+        bound |= set(op.attrs.get('pre_names', []))
+    for bi in _sub_block_indices(op):
+        produced: Set[str] = set(bound)
+        for sub in program.block(bi).ops:
+            reads |= _op_external_reads(sub, program) - produced
+            produced |= set(sub.output_names())
+    return reads
+
+
+class _Checker:
+    def __init__(self, program, fetch_names, feed_names, stage):
+        self.program = program
+        self.fetch_names = tuple(fetch_names)
+        self.stage = stage
+        self.diags: List[Diagnostic] = []
+        self.persist = {v.name for v in program.list_vars() if v.persistable}
+        self.declared = {v.name for v in program.list_vars()}
+        self.data_vars = {v.name for v in program.list_vars() if v.is_data}
+        self.roots = self.persist | self.data_vars | set(feed_names)
+        self.amp = getattr(program, '_amp_config', None) is not None
+        self.comm_dtype_seen: Optional[object] = None
+        self.has_kstep_update = self._detect_kstep_update()
+
+    # -- helpers ---------------------------------------------------------
+
+    def emit(self, severity, code, message, op=None, op_index=None,
+             block_idx=0, var=None):
+        self.diags.append(Diagnostic(
+            severity, code, message,
+            op_type=op.type if op is not None else None,
+            op_index=op_index, block_idx=block_idx, var=var,
+            site=_site(op) if op is not None else None, stage=self.stage))
+
+    def _dtype_compatible(self, a, b):
+        """IR-level dtype agreement, absorbing the runtime int64→int32
+        mapping (core/dtypes.to_jax_dtype) and — under AMP — trace-time
+        float casts (executor._amp_cast_args)."""
+        if a is None or b is None or a == b:
+            return True
+        if {a, b} == {'int32', 'int64'}:
+            return True
+        if self.amp and is_float(a) and is_float(b):
+            return True
+        return False
+
+    def _detect_kstep_update(self):
+        """Whether parameter updates live inside a cond sub-block — the
+        gradient-merge / local-SGD k-step schedule shape. Per-step
+        c_allreduce sync points are wrong there: the sync must happen once
+        per k steps (parallel/fleet.py skips insertion for merge_k > 1)."""
+        for op in self.program.global_block().ops:
+            if op.type not in ('__cond__', '__switch__'):
+                continue
+            for bi in _sub_block_indices(op):
+                for sub in self.program.block(bi).ops:
+                    if sub.type in _UPDATE_OP_TYPES:
+                        return True
+        return False
+
+    # -- the walk --------------------------------------------------------
+
+    def run(self):
+        blk = self.program.global_block()
+        env = seed_env(self.program)
+        self._walk(blk, env, set(self.roots))
+        self._check_dead(blk)
+        self._check_donation(blk)
+        return self.diags
+
+    def _walk(self, block, env: Dict[str, VarInfo], available: Set[str]):
+        for idx, op in enumerate(block.ops):
+            self._check_op(op, idx, block, env, available)
+            available |= set(op.output_names())
+
+    def _check_op(self, op, idx, block, env, available):
+        bi = block.idx
+        # 1. op type resolution
+        opdef = None
+        if op.type not in _SPECIAL_OPS:
+            from ..ops.registry import has_op, get_op
+            if not has_op(op.type):
+                self.emit('error', 'unknown-op',
+                          f"op type {op.type!r} is not a registered op",
+                          op, idx, bi)
+                return
+            opdef = get_op(op.type)
+
+        # 2. reads resolve (read-before-write / dangling)
+        for name in sorted(_op_external_reads(op, self.program)):
+            if name in available:
+                continue
+            if name not in self.declared:
+                self.emit('error', 'dangling-var',
+                          f"op reads {name!r}, which is not declared in "
+                          f"any block", op, idx, bi, var=name)
+            else:
+                self.emit('error', 'read-before-write',
+                          f"op reads {name!r} before any op writes it "
+                          f"(not a feed, not persistable)",
+                          op, idx, bi, var=name)
+
+        # 3. special ops
+        if op.type == BACKWARD_OP_TYPE:
+            self._check_backward(op, idx, block, env, available)
+            return
+        if op.type in _SPECIAL_OPS:
+            self._check_control_flow(op, idx, block, env, available)
+            return
+
+        # 4. required input slots
+        for slot in opdef.input_slots:
+            if slot not in opdef.optional and not op.inputs.get(slot):
+                self.emit('error', 'missing-input',
+                          f"required input slot {slot!r} of "
+                          f"{op.type!r} is empty", op, idx, bi)
+
+        # 5. mixed-precision inputs (outside AMP: silently-upcasting math)
+        if not self.amp:
+            fdts = {env[n].dtype if n in env
+                    else (declared_info(block.var(n)).dtype
+                          if block.has_var(n) else None)
+                    for n in op.input_names()}
+            fdts = {d for d in fdts if d is not None and is_float(d)}
+            if len(fdts) > 1:
+                self.emit('warning', 'mixed-float-inputs',
+                          f"op mixes float input dtypes {sorted(fdts)} "
+                          f"without an AMP config (silent upcast)",
+                          op, idx, bi)
+
+        # 6. collective consistency
+        if op.type in _COLLECTIVE_TYPES:
+            self._check_collective(op, idx, block)
+
+        # 7. RNG salt stamps (pass post-condition only)
+        if self.stage in ('post-pass', 'post-pipeline') and opdef.needs_rng \
+                and '_rng_salt' not in op.attrs:
+            self.emit('warning', 'rng-salt-missing',
+                      f"RNG op {op.type!r} lost its _rng_salt stamp in a "
+                      f"pass rewrite; its random stream will shift with "
+                      f"program position", op, idx, bi)
+
+        # 8. shape/dtype inference + declared-info drift
+        self._infer_into(op, idx, block, env)
+
+    def _infer_into(self, op, idx, block, env):
+        bi = block.idx
+        try:
+            result = infer_op(op, env, block)
+        except InferError as e:
+            self.emit('error', e.kind, str(e), op, idx, bi)
+            result = None
+        if result is None:
+            if not has_rule(op.type):
+                self.emit('info', 'no-infer-rule',
+                          f"no shape/dtype inference rule for "
+                          f"{op.type!r}; propagating declared infos",
+                          op, idx, bi)
+            for name in op.output_names():
+                if block.has_var(name):
+                    env[name] = declared_info(block.var(name))
+                else:
+                    env[name] = VarInfo()
+            return
+        from ..ops.registry import get_op
+        opdef = get_op(op.type)
+        for slot in opdef.output_slots:
+            names = op.outputs.get(slot, [])
+            if not names:
+                continue
+            res = result.get(slot)
+            infos = (list(res) if isinstance(res, (list, tuple))
+                     else [res] * len(names))
+            for name, info in zip(names, infos):
+                if info is None:
+                    info = VarInfo()
+                self._bind_output(op, idx, block, env, name, info)
+
+    def _bind_output(self, op, idx, block, env, name, info: VarInfo):
+        bi = block.idx
+        if block.has_var(name):
+            decl = declared_info(block.var(name))
+            if not shapes_agree(info, decl):
+                self.emit('warning', 'shape-decl-mismatch',
+                          f"op writes {name!r} with inferred shape "
+                          f"{info.display_shape()}, but the var is "
+                          f"declared {decl.display_shape()}",
+                          op, idx, bi, var=name)
+            if not self._dtype_compatible(info.dtype, decl.dtype):
+                self.emit('warning', 'dtype-decl-mismatch',
+                          f"op writes {name!r} with inferred dtype "
+                          f"{info.dtype}, but the var is declared "
+                          f"{decl.dtype}", op, idx, bi, var=name)
+            # fill unknowns from the declaration (build-time eval_shape)
+            if info.shape is None:
+                info = VarInfo(decl.shape, info.dtype or decl.dtype,
+                               decl.lod_level)
+            elif info.dtype is None:
+                info = info.with_dtype(decl.dtype)
+        env[name] = info
+
+    def _check_backward(self, op, idx, block, env, available):
+        bi = block.idx
+        loss = op.attrs.get('loss')
+        if loss and loss not in available and loss not in self.declared:
+            self.emit('error', 'dangling-var',
+                      f"backward marker loss {loss!r} is not declared",
+                      op, idx, bi, var=loss)
+        feeds = self.data_vars | set(self.roots)
+        for p in op.attrs.get('params', []):
+            if p in self.persist or p in feeds or p in available:
+                continue
+            self.emit('error', 'read-before-write',
+                      f"gradient target {p!r} is neither a persistable "
+                      f"parameter nor a fed variable", op, idx, bi, var=p)
+        # grads mirror their params
+        for p, g in zip(op.attrs.get('params', []),
+                        op.outputs.get('Grads', [])):
+            if block.has_var(p):
+                pi = declared_info(block.var(p))
+                env[g] = VarInfo(pi.shape, pi.dtype)
+
+    def _check_control_flow(self, op, idx, block, env, available):
+        for bi in _sub_block_indices(op):
+            sub = self.program.block(bi)
+            child_env = dict(env)
+            child_avail = set(available) | set(op.output_names())
+            # loop carries / scan slices are bound by the executor before
+            # the sub-block runs
+            for attr in ('loop_vars', 'carry', 'slice_names', 'pre_names',
+                         'writes'):
+                v = op.attrs.get(attr)
+                if isinstance(v, (list, tuple)):
+                    child_avail |= {x for x in v if isinstance(x, str)}
+            self._walk(sub, child_env, child_avail)
+        for name in op.output_names():
+            env[name] = (declared_info(block.var(name))
+                         if block.has_var(name) else VarInfo())
+
+    def _check_collective(self, op, idx, block):
+        bi = block.idx
+        cd = op.attrs.get('comm_dtype')
+        if cd is not None:
+            if self.comm_dtype_seen is None:
+                self.comm_dtype_seen = cd
+            elif cd != self.comm_dtype_seen:
+                self.emit('warning', 'comm-dtype-drift',
+                          f"collective comm_dtype {cd!r} differs from "
+                          f"{self.comm_dtype_seen!r} seen earlier in this "
+                          f"program; gradient sync would mix wire "
+                          f"precisions", op, idx, bi)
+        if self.has_kstep_update:
+            self.emit('warning', 'allreduce-under-kstep',
+                      f"per-step {op.type!r} in a program whose parameter "
+                      f"updates run under a k-step schedule (gradient "
+                      f"merge / local SGD); the sync belongs at the k-step "
+                      f"boundary", op, idx, bi)
+
+    # -- post-walk checks ------------------------------------------------
+
+    def _check_dead(self, blk):
+        """Reverse liveness: ops none of whose outputs are ever read,
+        fetched, or persisted; and vars no op references at all."""
+        live = set(self.fetch_names) | self.persist
+        dead_sev = 'warning' if self.stage == 'post-pipeline' else 'info'
+        marker_used = set()
+        for op in blk.ops:
+            for attr in ('loss', 'params', 'checkpoints'):
+                v = op.attrs.get(attr)
+                if isinstance(v, str):
+                    marker_used.add(v)
+                elif isinstance(v, (list, tuple)):
+                    marker_used.update(x for x in v if isinstance(x, str))
+        live |= marker_used
+        for idx in range(len(blk.ops) - 1, -1, -1):
+            op = blk.ops[idx]
+            outs = op.output_names()
+            if op.type == BACKWARD_OP_TYPE or not outs \
+                    or any(o in live for o in outs):
+                live |= _op_external_reads(op, self.program)
+                continue
+            self.emit(dead_sev, 'dead-write',
+                      f"no later op reads any output of this op "
+                      f"({', '.join(repr(o) for o in outs[:3])}"
+                      f"{'…' if len(outs) > 3 else ''}); it is dead code",
+                      op, idx, blk.idx)
+        referenced = set(self.fetch_names) | marker_used
+        for op in blk.ops:
+            referenced |= _op_external_reads(op, self.program)
+            referenced |= set(op.output_names())
+        for name, v in blk.vars.items():
+            if name in referenced or name in self.persist or v.is_data:
+                continue
+            self.emit(dead_sev, 'dead-var',
+                      f"var {name!r} is declared in the global block but "
+                      f"no op references it", var=name)
+
+    def _check_donation(self, blk):
+        """A fetched persistable that the step also WRITES cannot be
+        donated — Executor.run keeps it out of the in-place set, so the
+        state runs copy-in/copy-out every step (executor.py donation
+        guards). Static warning so the cost is visible before runtime."""
+        fetch = set(self.fetch_names)
+        if not fetch:
+            return
+        for idx, op in enumerate(blk.ops):
+            if op.type == BACKWARD_OP_TYPE:
+                continue
+            for name in op.output_names():
+                if name in fetch and name in self.persist:
+                    self.emit('warning', 'donated-fetch',
+                              f"persistable {name!r} is both updated by "
+                              f"this op and fetched; it will be excluded "
+                              f"from buffer donation (copy-in/copy-out "
+                              f"every step)", op, idx, blk.idx, var=name)
+                    fetch.discard(name)      # one diagnostic per var
+
+
+def run_checks(program, fetch_names=(), feed_names=(), stage='pre'):
+    """All diagnostics for `program`. `stage` ∈ {'pre', 'pre-lower',
+    'post-pass', 'post-pipeline'} — see module docstring."""
+    return _Checker(program, fetch_names, feed_names, stage).run()

@@ -1,0 +1,123 @@
+"""Structured diagnostics for the static Program verifier.
+
+A :class:`Diagnostic` is one finding about one op (or var) of a Program:
+a severity, a stable machine-readable code (docs/ANALYSIS.md catalogs
+them), a human message, and — when construction-site capture is on
+(``PADDLE_TPU_VERIFY`` ≠ ``off``, see framework.Operator) — the
+``file:line`` of the Python call that appended the op, so a verifier
+finding points back at the model code that built the bad op instead of
+at an opaque trace failure three layers down.
+
+Severity policy (docs/ANALYSIS.md):
+
+- ``error`` — the program cannot lower correctly (dangling reads,
+  impossible shapes, malformed attrs). ``verify_program`` callers raise
+  :class:`ProgramVerificationError` on these.
+- ``warning`` — lowering will work but something is suspicious or
+  costs performance (a fetched persistable blocks donation, drifting
+  comm dtypes). Never raised on; tier-1 recipes must stay free of them.
+- ``info`` — coverage notes (op without an inference rule, dead writes
+  the DCE pass will sweep). Reported by tools/lint_program.py only.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = ['Diagnostic', 'ProgramVerificationError', 'SEVERITIES',
+           'max_severity', 'format_report', 'severity_at_least']
+
+# ascending order; index = rank
+SEVERITIES = ('info', 'warning', 'error')
+
+
+def _rank(severity: str) -> int:
+    try:
+        return SEVERITIES.index(severity)
+    except ValueError:
+        raise ValueError(f'unknown severity {severity!r}; '
+                         f'expected one of {SEVERITIES}')
+
+
+class Diagnostic:
+    """One verifier finding, anchored to an op of the analyzed Program."""
+
+    __slots__ = ('severity', 'code', 'message', 'op_type', 'op_index',
+                 'block_idx', 'var', 'site', 'stage')
+
+    def __init__(self, severity: str, code: str, message: str,
+                 op_type: Optional[str] = None, op_index: Optional[int] = None,
+                 block_idx: int = 0, var: Optional[str] = None,
+                 site: Optional[str] = None, stage: Optional[str] = None):
+        _rank(severity)            # validate eagerly
+        self.severity = severity
+        self.code = code
+        self.message = message
+        self.op_type = op_type
+        self.op_index = op_index
+        self.block_idx = block_idx
+        self.var = var
+        self.site = site
+        self.stage = stage
+
+    def key(self):
+        """Identity used to diff diagnostics across pass rewrites (op
+        indices shift when passes remove ops, so position is excluded)."""
+        return (self.code, self.severity, self.op_type, self.var)
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self.__slots__
+                if getattr(self, k) is not None}
+
+    def format(self) -> str:
+        where = ''
+        if self.op_type is not None:
+            pos = f'#{self.op_index}' if self.op_index is not None else ''
+            blk = f'/b{self.block_idx}' if self.block_idx else ''
+            where = f' [{self.op_type}{pos}{blk}]'
+        var = f' var={self.var!r}' if self.var else ''
+        site = f' (built at {self.site})' if self.site else ''
+        return f'{self.severity}:{self.code}{where}{var}: ' \
+               f'{self.message}{site}'
+
+    def __repr__(self):
+        return f'Diagnostic({self.format()})'
+
+
+def severity_at_least(diags: List[Diagnostic], severity: str):
+    """Subset of `diags` at or above `severity`."""
+    floor = _rank(severity)
+    return [d for d in diags if _rank(d.severity) >= floor]
+
+
+def max_severity(diags: List[Diagnostic]) -> Optional[str]:
+    if not diags:
+        return None
+    return SEVERITIES[max(_rank(d.severity) for d in diags)]
+
+
+def format_report(diags: List[Diagnostic], header: str = '') -> str:
+    lines = [header] if header else []
+    by_sev = {s: [d for d in diags if d.severity == s]
+              for s in reversed(SEVERITIES)}
+    for sev, ds in by_sev.items():
+        for d in ds:
+            lines.append('  ' + d.format())
+    counts = ', '.join(f'{len(ds)} {sev}' for sev, ds in by_sev.items()
+                       if ds)
+    lines.append(f'  -- {counts or "clean"}')
+    return '\n'.join(lines)
+
+
+class ProgramVerificationError(RuntimeError):
+    """A Program failed static verification. Carries the error-severity
+    diagnostics; `pass_name` is set when the failure is an IR pass
+    post-condition (the pass emitted an inconsistent program)."""
+
+    def __init__(self, diagnostics: List[Diagnostic], stage: str = 'verify',
+                 pass_name: Optional[str] = None):
+        self.diagnostics = list(diagnostics)
+        self.stage = stage
+        self.pass_name = pass_name
+        origin = (f"IR pass '{pass_name}' emitted an inconsistent program"
+                  if pass_name else f'program verification failed ({stage})')
+        super().__init__(format_report(self.diagnostics, origin + ':'))

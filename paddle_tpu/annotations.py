@@ -17,7 +17,7 @@ def deprecated(since, instead, extra_message=''):
 
         @functools.wraps(func)
         def wrapper(*args, **kwargs):
-            print(err_msg, file=sys.stderr)
+            print(err_msg, file=sys.stderr)  # lint: allow-print (deprecation banner to stderr)
             return func(*args, **kwargs)
 
         wrapper.__doc__ = (wrapper.__doc__ or '') + '\n    ' + err_msg

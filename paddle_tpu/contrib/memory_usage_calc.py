@@ -71,12 +71,12 @@ def print_memory_report():
     """Human-readable HBM live-arena report (one line per device)."""
     report = device_memory_stats()
     if not report:
-        print("[paddle_tpu.memory] no allocator stats on this backend")
+        print("[paddle_tpu.memory] no allocator stats on this backend")  # lint: allow-print (console report API)
         return report
     for dev, st in report.items():
         in_use = st.get('bytes_in_use', 0) / 2**20
         peak = st.get('peak_bytes_in_use', 0) / 2**20
         limit = st.get('bytes_limit', 0) / 2**20
-        print(f"[paddle_tpu.memory] {dev}: in_use={in_use:.1f}MB "
+        print(f"[paddle_tpu.memory] {dev}: in_use={in_use:.1f}MB "  # lint: allow-print (console report API)
               f"peak={peak:.1f}MB limit={limit:.1f}MB")
     return report

@@ -86,12 +86,12 @@ def summary(main_prog):
     header = f"| {'No.':>4} | {'TYPE':>12} | {'INPUT':>18} | " \
              f"{'OUTPUT':>18} | {'PARAMs':>9} | {'FLOPs':>12} |"
     sep = '+' + '-' * (len(header) - 2) + '+'
-    print(sep); print(header); print(sep)
+    print(sep); print(header); print(sep)  # lint: allow-print (summary-table API)
     for i, r in enumerate(rows):
-        print(f"| {i:>4} | {r['type']:>12} | {str(tuple(r['input_shape'])):>18} | "
+        print(f"| {i:>4} | {r['type']:>12} | {str(tuple(r['input_shape'])):>18} | "  # lint: allow-print
               f"{str(tuple(r['out_shape'])):>18} | {r['PARAMs']:>9} | "
               f"{r['FLOPs']:>12} |")
-    print(sep)
-    print(f'Total PARAMs: {total_params}({total_params / 1e9:.4f}G)')
-    print(f'Total FLOPs: {total_flops}({total_flops / 1e9:.2f}G)')
+    print(sep)  # lint: allow-print (summary-table API)
+    print(f'Total PARAMs: {total_params}({total_params / 1e9:.4f}G)')  # lint: allow-print (summary-table API)
+    print(f'Total FLOPs: {total_flops}({total_flops / 1e9:.2f}G)')  # lint: allow-print (summary-table API)
     return rows, total_params, total_flops

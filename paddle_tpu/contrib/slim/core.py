@@ -19,6 +19,12 @@ from ...framework import Program, program_guard
 from ...executor import Executor
 from .graph import GraphWrapper, SlimGraphExecutor
 
+
+def _logger():
+    import logging
+    from ...log_helper import get_logger
+    return get_logger(__name__, logging.INFO, fmt='%(message)s')
+
 __all__ = ['Strategy', 'Context', 'Compressor', 'ConfigFactory']
 
 
@@ -250,7 +256,7 @@ class Compressor:
         with self._scope_guard(context):
             io.load_persistables(exe, self.init_model,
                                  context.train_graph.program)
-        print(f"[slim] loaded init model from {self.init_model}")
+        _logger().info("[slim] loaded init model from %s", self.init_model)
 
     # ---- checkpoints (ref compressor.py:_load_checkpoint/_save_checkpoint)
     def _checkpoint_dir(self, epoch_id):

@@ -198,7 +198,12 @@ class PostTrainingQuantization:
         for name, info in self._scales.items():
             flat[f'{name}.activation'] = np.asarray([info['activation']])
             flat[f'{name}.weight'] = np.asarray(info['weight'])
-        np.savez(os.path.join(save_model_path, 'quant_scales.npz'), **flat)
+        # torn-write-proof like every other model artifact (PR 7): a crash
+        # mid-save must not leave a half-written scales file beside a
+        # fully-written model checkpoint
+        from ...io import _atomic_savez
+        _atomic_savez(os.path.join(save_model_path, 'quant_scales.npz'),
+                      flat)
         return save_model_path
 
 

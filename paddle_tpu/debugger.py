@@ -138,7 +138,7 @@ def pprint_program_codes(program, show_backward=True):
     assert isinstance(program, Program)
     text = '\n\n'.join(pprint_block_codes(b, show_backward)
                        for b in program.blocks)
-    print(text)
+    print(text)  # lint: allow-print (pprint API contract is console output)
     return text
 
 

@@ -90,6 +90,8 @@ def _cross_process_reducer():
                     * s[:, :, None])
             return jnp.sum(part.reshape(q.shape[0], -1), axis=0)
 
+        from ..core.compile_cache import setup_persistent_cache
+        setup_persistent_cache()
         _REDUCER = (NamedSharding(mesh, P('proc')),
                     per_proc[jax.process_index()],
                     {'f32': jax.jit(lambda g: jnp.sum(g, axis=0),

@@ -402,6 +402,10 @@ def _cached_dispatch(op_type, opdef, arg_spec, attrs, call_with, call, vals,
     if entry is _BLOCKED:
         return _BLOCKED
     if entry is None:
+        # every eager kernel compiles through the persistent cross-process
+        # XLA cache, same as Executor steps (lint_codebase.py invariant)
+        from ..core.compile_cache import setup_persistent_cache
+        setup_persistent_cache()
         if needs_grad:
             # fwd returns (primal outs, vjp residuals as a Partial pytree);
             # bwd re-applies that Partial under jit, so a repeated backward

@@ -336,8 +336,19 @@ def _op_needs_key(op):
 def _op_read_names(op):
     """All var names an op may read, including reads made by its sub-blocks
     (control-flow branches chain onto the outer env, so their reads are not
-    declared in op.inputs)."""
+    declared in op.inputs) AND reads the control-flow machinery itself
+    performs: cond/switch merge their `writes` vars out of every branch,
+    reading the OUTER value for a branch that leaves one untouched
+    (_run_cond/_run_switch), and while loops seed their carry from the
+    outer env (_run_while/_run_while_legacy). Omitting these made DCE drop
+    the producer of a cond `writes` var nothing else read — the program
+    then died at trace time with a bare KeyError (found by the PR 10
+    static verifier; regression: test_program_verifier.py)."""
     names = set(op.input_names())
+    for attr in ('writes', 'loop_vars', 'carry'):
+        v = op.attrs.get(attr)
+        if isinstance(v, (list, tuple)):
+            names.update(x for x in v if isinstance(x, str))
     program = op.block.program
     sub_blocks = []
     for attr in ('true_block', 'false_block', 'cond_block', 'body_block',
@@ -557,7 +568,26 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                         k, offset + i if salt is None else salt)
                 else:
                     kk = None
-                _OpRunner.run(op, read, write, kk)
+                try:
+                    _OpRunner.run(op, read, write, kk)
+                except Exception as e:
+                    _annotate_trace_error(e, op, offset + i)
+                    raise
+
+        def _annotate_trace_error(e, op, pos):
+            # trace-time failures name the op and — with construction-site
+            # capture on (PADDLE_TPU_VERIFY ≠ off) — the model line that
+            # built it, so the error points at user code, not the lowering
+            site = getattr(op, '_site', None)
+            note = (f"[while lowering op '{op.type}' (op #{pos})"
+                    + (f" built at {site}" if site else '') + ']')
+            if hasattr(e, 'add_note'):              # Python ≥3.11
+                e.add_note(note)
+            elif e.args and isinstance(e.args[0], str) \
+                    and note not in e.args[0]:
+                # 3.10 fallback: fold the note into the message (guarded
+                # against double-annotation by nested run_seq frames)
+                e.args = (f'{e.args[0]} {note}',) + e.args[1:]
 
         if bwd_idx is None:
             run_seq(ops, 0, make_read(env, state), env.__setitem__)
@@ -733,6 +763,14 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
         return new_state, fetches
 
     return step
+
+
+def _dataset_logger():
+    """INFO logger for *_from_dataset fetch reporting (repo invariant:
+    framework code never print()s — tools/lint_codebase.py enforces it)."""
+    import logging
+    from .log_helper import get_logger
+    return get_logger(__name__, logging.INFO, fmt='%(message)s')
 
 
 def _default_len_feeds(block, feed_vals):
@@ -930,6 +968,15 @@ class Executor:
         lower_span = _obs.span('executor/lower', program=program._id)
         if fn is None:
             with lower_span:
+                # pre-lowering validation (PADDLE_TPU_VERIFY=full): the
+                # static verifier rejects malformed programs HERE, with the
+                # op and its Python construction site, instead of deep in
+                # the XLA trace. Runs per compile-cache miss, never per step.
+                from . import analysis
+                if analysis.verify_level() == 'full':
+                    analysis.assert_verified(
+                        program, fetch_names=fetch_names,
+                        feed_names=list(feed_vals), stage='pre-lower')
                 # program-level IR passes rewrite a CLONE before the trace
                 # (op fusion / DCE / constant folding — paddle_tpu/ir/);
                 # their runtime lands inside executor/lower and therefore in
@@ -1124,7 +1171,7 @@ class Executor:
                         f'{info}={np.asarray(val).ravel()[:4]}'
                         for info, val in zip(fetch_info, fetches))
                     if msg:
-                        print(f'step {step}: {msg}')
+                        _dataset_logger().info('step %d: %s', step, msg)
         finally:
             if monitor is not None:
                 monitor.stop()

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import os
+import sys
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -24,6 +26,37 @@ from .core.scope import global_scope
 _DYNAMIC_DIM_SENTINEL = 1999
 
 BACKWARD_OP_TYPE = '__backward__'
+
+# ---------------------------------------------------------------------------
+# op construction-site capture (paddle_tpu/analysis/): with PADDLE_TPU_VERIFY
+# ≠ off, every Operator records the first non-framework file:line of the
+# stack that appended it, so verifier diagnostics and trace-time errors can
+# name the model code that built the op instead of an executor internal.
+# ---------------------------------------------------------------------------
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__)) + os.sep
+
+
+def _sites_enabled():
+    # tolerant read on purpose: analysis.verify_level() owns the strict
+    # parse; an unknown value here must not break program construction
+    return os.environ.get('PADDLE_TPU_VERIFY', 'off').strip().lower() \
+        not in ('', 'off')
+
+
+def _capture_site():
+    """file:line of the nearest stack frame outside paddle_tpu/ — the user
+    call that (transitively) appended the op. A plain frame walk, no
+    traceback object, so the cost is a few attribute reads per op at
+    program BUILD time only."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_DIR) and '<frozen' not in fn:
+            return f'{fn}:{f.f_lineno}'
+        f = f.f_back
+    return None
+
 
 _dygraph_tracer_ = None  # set by dygraph.base when in imperative mode
 
@@ -116,6 +149,7 @@ class Operator:
             k: ([v] if isinstance(v, str) else list(v))
             for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
+        self._site = _capture_site() if _sites_enabled() else None
         if _DEVICE_GUARD is not None and 'op_device' not in self.attrs:
             self.attrs['op_device'] = _DEVICE_GUARD
 
@@ -278,6 +312,7 @@ class Program:
                                {k: list(v) for k, v in op.inputs.items()},
                                {k: list(v) for k, v in op.outputs.items()},
                                copy.deepcopy(op.attrs))
+                nop._site = op._site        # clones keep the original site
                 if for_test and 'is_test' in nop.attrs:
                     nop.attrs['is_test'] = True
                 nb.ops.append(nop)
@@ -287,6 +322,28 @@ class Program:
         amp = getattr(self, '_amp_config', None)
         if amp is not None:
             p._amp_config = amp
+        if for_test:
+            # dropping the backward tail orphans its vars (@GRAD buffers,
+            # optimizer temps) — sweep them so eval/inference programs
+            # don't carry dead declarations (paddle_tpu/analysis/ flags
+            # them; found by the verifier's dead-var check)
+            referenced = set()
+            for b in p.blocks:
+                for op in b.ops:
+                    referenced |= set(op.input_names())
+                    referenced |= set(op.output_names())
+                    for a in ('loss', 'params', 'checkpoints', 'loop_vars',
+                              'writes', 'carry', 'slice_names', 'pre_names',
+                              'new_names', 'out_names', 'cond_out'):
+                        v = op.attrs.get(a)
+                        if isinstance(v, str):
+                            referenced.add(v)
+                        elif isinstance(v, (list, tuple)):
+                            referenced.update(
+                                x for x in v if isinstance(x, str))
+            for b in p.blocks:
+                b.vars = {n: v for n, v in b.vars.items()
+                          if n in referenced or v.persistable or v.is_data}
         return p
 
     def _prune(self, targets):

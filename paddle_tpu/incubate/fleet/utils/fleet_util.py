@@ -43,7 +43,7 @@ class FleetUtil:
     def rank0_print(self, s):
         """ref :63 — only worker 0 prints."""
         if self._rank() == 0:
-            print(s, flush=True)
+            print(s, flush=True)  # lint: allow-print (rank0_print contract is stdout)
 
     def rank0_info(self, s):
         if self._rank() == 0:

@@ -166,7 +166,7 @@ def export_stablehlo(fn, example_args, path=None, bf16=False):
             lambda a: jnp.asarray(a, jnp.bfloat16)
             if hasattr(a, 'dtype') and np.asarray(a).dtype == np.float32
             else a, example_args)
-    lowered = jax.jit(fn).lower(*example_args)
+    lowered = jax.jit(fn).lower(*example_args)  # lint: allow-jit (lower-only export, no XLA compile)
     text = lowered.as_text(dialect='stablehlo')
     if path:
         os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
@@ -195,7 +195,7 @@ def export_program_stablehlo(program, feed_shapes, fetch_list, path=None,
     finally:
         if ctx is not None:
             ctx.__exit__(None, None, None)
-    lowered = jax.jit(fn).lower(*arg_vals)
+    lowered = jax.jit(fn).lower(*arg_vals)  # lint: allow-jit (lower-only export, no XLA compile)
     text = lowered.as_text(dialect='stablehlo')
     if path:
         with open(path, 'w') as f:

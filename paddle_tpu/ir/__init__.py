@@ -14,6 +14,14 @@ Environment: ``PADDLE_TPU_PASSES`` — unset/``1`` = default pipeline
 ``0``/empty = pipeline off entirely, or a comma-separated pass list
 (e.g. ``dce,fuse_all_optimizer_ops``) = exactly those passes, flags
 ignored.
+
+Post-condition (``PADDLE_TPU_VERIFY`` ∈ {``passes``, ``full``},
+docs/ANALYSIS.md): after every pass that changes the program, the static
+verifier (``paddle_tpu/analysis/``) re-checks it at the pass boundary —
+a pass emitting an inconsistent program (dangling reads, mixed-dtype
+buckets, lost ``_rng_salt`` stamps, …) raises
+``ProgramVerificationError`` naming the pass, instead of surfacing as an
+opaque trace error three layers later. See ``pass_base.PassManager``.
 """
 from __future__ import annotations
 

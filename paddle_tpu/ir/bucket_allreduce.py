@@ -152,9 +152,11 @@ class BucketAllReducePass(Pass):
             grads = [m.inputs['x'][0] for m in members]
             outs = [m.outputs['Out'][0] for m in members]
             attrs = {k: v for k, v in members[0].attrs.items()}
-            fused[bucket[0]] = Operator(
+            bop = Operator(
                 blk, 'c_allreduce_sum_bucket',
                 inputs={'xs': grads}, outputs={'Out': outs}, attrs=attrs)
+            bop._site = members[0]._site
+            fused[bucket[0]] = bop
             dead.update(bucket[1:])
         if not fused:
             return False

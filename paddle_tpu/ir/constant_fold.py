@@ -81,5 +81,7 @@ class ConstantFoldingPass(Pass):
                  'dtype': out_dtype}
         if RNG_SALT_ATTR in op.attrs:
             attrs[RNG_SALT_ATTR] = op.attrs[RNG_SALT_ATTR]
-        return Operator(op.block, 'fill_constant', inputs={},
-                        outputs={'Out': list(op.outputs['Out'])}, attrs=attrs)
+        new = Operator(op.block, 'fill_constant', inputs={},
+                       outputs={'Out': list(op.outputs['Out'])}, attrs=attrs)
+        new._site = op._site       # diagnostics keep the folded op's origin
+        return new

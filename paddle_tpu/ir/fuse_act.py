@@ -87,10 +87,12 @@ class FuseElewiseAddActPass(Pass):
                      'axis': add.attrs.get('axis', -1)}
             if RNG_SALT_ATTR in act.attrs:
                 attrs[RNG_SALT_ATTR] = act.attrs[RNG_SALT_ATTR]
-            replaced[j] = Operator(
+            fused = Operator(
                 blk, 'fused_elemwise_add_activation',
                 inputs={'x': x, 'y': y},
                 outputs={'Out': list(act.outputs['Out'])}, attrs=attrs)
+            fused._site = add._site    # diagnostics point at the add's origin
+            replaced[j] = fused
             dead.add(i)
         if not replaced:
             return False

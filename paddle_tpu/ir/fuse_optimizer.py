@@ -94,8 +94,10 @@ class FuseAllOptimizerOpsPass(Pass):
             outputs = {s: [m.outputs[s][0] for m in members]
                        for s in out_slots}
             attrs = {k: v for k, v in members[0].attrs.items()}
-            replaced[idxs[0]] = Operator(blk, fused_type, inputs=inputs,
-                                         outputs=outputs, attrs=attrs)
+            fused = Operator(blk, fused_type, inputs=inputs,
+                             outputs=outputs, attrs=attrs)
+            fused._site = members[0]._site
+            replaced[idxs[0]] = fused
             dead.update(idxs[1:])
             fused_groups += 1
             fused_ops += len(idxs)

@@ -115,6 +115,44 @@ def stamp_rng_salts(program: Program):
             op.attrs[RNG_SALT_ATTR] = i
 
 
+def _make_verifier(opt, ctx):
+    """Pass-boundary verification closure, or None when verification is
+    off. Called BEFORE any pass runs, so the pre-pipeline error baseline
+    describes the pipeline's input; each call then re-verifies `opt` and
+    raises on errors the named pass newly introduced."""
+    from .. import analysis
+    if analysis.verify_level() == 'off':
+        return None
+
+    t0 = time.perf_counter()
+    pre = analysis.verify_program(opt, fetch_names=ctx.fetch_names,
+                                  feed_names=ctx.feed_names)
+    state = {'baseline': {
+        d.key() for d in analysis.severity_at_least(pre, 'error')}}
+    if _obs._ENABLED:
+        _obs.observe('program_verify_seconds', time.perf_counter() - t0,
+                     help='wall time per static program verification')
+
+    def verify(pass_name):
+        t1 = time.perf_counter()
+        diags = analysis.assert_verified(
+            opt, fetch_names=ctx.fetch_names, feed_names=ctx.feed_names,
+            stage='post-pass', pass_name=pass_name,
+            baseline=state['baseline'])
+        # later passes are measured against this pass's output
+        state['baseline'] = {
+            d.key() for d in analysis.severity_at_least(diags, 'error')}
+        if _obs._ENABLED:
+            _obs.inc('program_verify_runs', 1,
+                     help='static verifier runs at IR pass boundaries',
+                     stage='post-pass')
+            _obs.observe('program_verify_seconds',
+                         time.perf_counter() - t1,
+                         help='wall time per static program verification')
+
+    return verify
+
+
 class PassManager:
     """Applies a deterministic sequence of passes to a CLONE of a Program."""
 
@@ -124,7 +162,17 @@ class PassManager:
     def apply(self, program: Program, ctx: Optional[PassContext] = None):
         """Returns (optimized_program, ctx). The input Program is untouched;
         when no pass changes anything the clone is still returned (callers
-        treat the result as theirs to lower)."""
+        treat the result as theirs to lower).
+
+        Post-condition (PADDLE_TPU_VERIFY ∈ {passes, full}): after every
+        pass that changed the program, the static verifier
+        (paddle_tpu/analysis/) re-checks it — a pass that emits an
+        inconsistent program raises :class:`ProgramVerificationError`
+        naming the pass AT THE PASS BOUNDARY, instead of surfacing as an
+        opaque trace error three layers later. The contract is "no NEW
+        error-severity diagnostics": a pass is never blamed for problems
+        already present in its input (those belong to the 'full'-level
+        pre-lowering check)."""
         ctx = ctx or PassContext()
         opt = program.clone()
         # clone() drops non-IR carry attrs the lowering (and the passes
@@ -133,9 +181,12 @@ class PassManager:
             if hasattr(program, attr):
                 setattr(opt, attr, getattr(program, attr))
         stamp_rng_salts(opt)
+        verifier = _make_verifier(opt, ctx) if self.passes else None
         ops_before = len(opt.global_block().ops)
         for p in self.passes:
-            p.apply(opt, ctx)
+            changed = p.apply(opt, ctx)
+            if changed and verifier is not None:
+                verifier(p.name)
         if _obs._ENABLED:
             _obs.inc('ir_pass_pipeline_runs', 1,
                      help='pass-pipeline applications (one per program+shape '

@@ -98,7 +98,16 @@ class LayerHelper:
         return op
 
     def _infer_shapes(self, op):
-        """Fill in missing output var shapes via jax.eval_shape on the op fn."""
+        """Fill in missing output var shapes via jax.eval_shape on the op fn.
+
+        When eval_shape cannot run (an input's shape is still unknown —
+        typical inside control-flow sub-blocks — or the abstract eval
+        raises), fall back to the static rule engine
+        (paddle_tpu/analysis/infer.py) so declared output DTYPES stay
+        truthful: before this fallback, an arg_max emitted on an
+        unknown-shape input kept its input's float32 as the declared
+        dtype, which anything reading declarations (the verifier, bucket
+        sizing, donation stability) then mis-trusted."""
         try:
             opdef = get_op(op.type)
         except KeyError:
@@ -120,12 +129,12 @@ class LayerHelper:
             elif slot in opdef.variadic:
                 specs = [spec_of(n) for n in names]
                 if any(s is None for s in specs):
-                    return
+                    return self._static_infer(op)
                 args.append(specs)
             else:
                 s = spec_of(names[0])
                 if s is None:
-                    return
+                    return self._static_infer(op)
                 args.append(s)
         from .ops.registry import NON_KERNEL_ATTRS
         attrs = {k: v for k, v in op.attrs.items()
@@ -138,7 +147,7 @@ class LayerHelper:
             else:
                 out = jax.eval_shape(lambda *a: opdef.fn(*a, **attrs), *args)
         except Exception:
-            return
+            return self._static_infer(op)
         outs = [out] if len(opdef.output_slots) == 1 else list(out)
         flat_out_names = []
         for slot in opdef.output_slots:
@@ -151,6 +160,34 @@ class LayerHelper:
                 if v.shape is None:
                     v.shape = shape_from_concrete(r.shape)
                     v.dtype = convert_dtype(r.dtype)
+
+    def _static_infer(self, op):
+        """Best-effort declared-info refinement from the analysis rules
+        when eval_shape cannot run: dtypes always (they are shape-
+        independent facts the rules know exactly), shapes when the rule
+        derives one (unknown dims map to -1)."""
+        from .analysis.infer import infer_op
+        try:
+            result = infer_op(op, {}, op.block)
+        except Exception:
+            return
+        if not result:
+            return
+        opdef = get_op(op.type)
+        for slot in opdef.output_slots:
+            names = op.outputs.get(slot, [])
+            res = result.get(slot)
+            infos = (list(res) if isinstance(res, (list, tuple))
+                     else [res] * len(names))
+            for n, info in zip(names, infos):
+                if info is None or not op.block.has_var(n):
+                    continue
+                v = op.block.var(n)
+                if v.shape is None:
+                    if info.dtype is not None:
+                        v.dtype = convert_dtype(info.dtype)
+                    if info.shape is not None:
+                        v.shape = info.display_shape()
 
     def append_activation(self, out):
         act = self.kwargs.get('act')

@@ -51,24 +51,35 @@ class OpDef:
 
 def register_op(name: str, outputs: Sequence[str] = ('Out',),
                 variadic: Sequence[str] = (), needs_rng: bool = False,
-                atomic_output: bool = False):
-    """Decorator registering a jax functional as a graph op."""
+                atomic_output: bool = False, optional: Sequence[str] = ()):
+    """Decorator registering a jax functional as a graph op.
+
+    `optional` explicitly marks input slots the kernel tolerates as None
+    when a `=None` default is impossible positionally (e.g. lstm's h0/c0
+    precede required weight slots). The static verifier
+    (paddle_tpu/analysis/) reads this metadata: a non-optional slot left
+    empty at program build is a 'missing-input' diagnostic."""
 
     def deco(fn):
         sig = inspect.signature(fn)
-        input_slots, optional = [], set()
+        input_slots, opt = [], set(optional)
         for pname, p in sig.parameters.items():
             if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
                           inspect.Parameter.POSITIONAL_OR_KEYWORD):
                 input_slots.append(pname)
                 if p.default is None:
-                    optional.add(pname)
+                    opt.add(pname)
             # keyword-only params are attrs (incl. `key` for rng ops)
+        unknown = opt - set(input_slots)
+        if unknown:
+            raise ValueError(
+                f"op {name!r}: optional={sorted(unknown)} are not input "
+                f"slots (slots: {input_slots})")
         if name in _REGISTRY:
             raise ValueError(f"op {name!r} registered twice")
         _REGISTRY[name] = OpDef(name, fn, input_slots, list(outputs),
                                 frozenset(variadic), needs_rng,
-                                frozenset(optional), atomic_output)
+                                frozenset(opt), atomic_output)
         return fn
 
     return deco

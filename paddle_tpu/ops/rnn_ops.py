@@ -37,7 +37,7 @@ def _mask_step(t, seq_len, new, old):
     return jnp.where(keep, new, old)
 
 
-@register_op('lstm', outputs=('Hidden', 'Cell'))
+@register_op('lstm', outputs=('Hidden', 'Cell'), optional=('h0', 'c0'))
 def lstm(x, h0, c0, w_h, bias, peephole=None, seq_len=None, proj_w=None, *,
          use_peepholes=False, is_reverse=False, gate_activation='sigmoid',
          cell_activation='tanh', candidate_activation='tanh'):
@@ -94,7 +94,7 @@ def lstm(x, h0, c0, w_h, bias, peephole=None, seq_len=None, proj_w=None, *,
     return hs, cs
 
 
-@register_op('gru')
+@register_op('gru', optional=('h0',))
 def gru(x, h0, gate_w, cand_w, seq_len=None, *, is_reverse=False,
         gate_activation='sigmoid', candidate_activation='tanh',
         origin_mode=False):

@@ -199,6 +199,8 @@ class Optimizer:
                                 for s, r in zip(self._slot_names, res[1:])}
                 return new_p, new_s
 
+            from .core.compile_cache import setup_persistent_cache
+            setup_persistent_cache()
             self._dy_step_fn = jax.jit(step, donate_argnums=(0, 2))
 
         new_p, new_s = self._dy_step_fn(pvals, gvals, svals,

@@ -95,6 +95,8 @@ class GeoSGDStep:
         fn = compat.shard_map(body, mesh=mesh,
                            in_specs=(rep_spec, rep_spec, P(axis), P()),
                            out_specs=(rep_spec, rep_spec, P()))
+        from ..core.compile_cache import setup_persistent_cache
+        setup_persistent_cache()
         self._step = jax.jit(fn, donate_argnums=(0, 1))
 
     def __call__(self, batch):

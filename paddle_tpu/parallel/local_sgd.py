@@ -83,6 +83,8 @@ class LocalSGDStep:
         fn = compat.shard_map(body, mesh=mesh,
                            in_specs=(pspec, P(axis), P()),
                            out_specs=(pspec, P()))
+        from ..core.compile_cache import setup_persistent_cache
+        setup_persistent_cache()
         self._step = jax.jit(fn, donate_argnums=(0,))
 
     def __call__(self, batch):

@@ -47,10 +47,11 @@ class FetchHandler:
     def handler(self, res_dict):
         for key in res_dict:
             if isinstance(res_dict[key], np.ndarray):
-                print(f'{key}[0]: {res_dict[key].ravel()[:1]}')
+                print(f'{key}[0]: {res_dict[key].ravel()[:1]}')  # lint: allow-print (default debug FetchHandler, fluid parity)
 
     @staticmethod
     def help():
+        # lint: allow-print (interactive help())
         print("""class FetchHandlerExample(FetchHandler):
     def handler(self, res_dict):
         print(res_dict["var_name"])""")

@@ -3,6 +3,12 @@ tests run without TPU hardware (SURVEY §4)."""
 import os
 
 os.environ['JAX_PLATFORMS'] = 'cpu'  # force: the session env exports 'axon'
+
+# tier-1 runs with the static verifier live at every IR pass boundary, so
+# every test doubles as a false-positive check on the analysis layer
+# (paddle_tpu/analysis/; ISSUE 10). setdefault: a test (or CI matrix job)
+# may still pin its own level, including 'off'.
+os.environ.setdefault('PADDLE_TPU_VERIFY', 'passes')
 flags = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (

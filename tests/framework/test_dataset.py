@@ -107,7 +107,9 @@ def test_train_from_dataset(slot_files, capsys):
     exe.train_from_dataset(main, ds, fetch_list=[loss], print_period=1)
     w1 = np.asarray(fluid.global_scope().find(w_name))
     assert not np.allclose(w0, w1)      # training actually stepped
-    assert 'step 0' in capsys.readouterr().out
+    # fetch reporting goes through log_helper (stderr handler), never print
+    cap = capsys.readouterr()
+    assert 'step 0' in cap.err and 'step 0' not in cap.out
 
 
 def test_lod_slot_packs_as_lodtensor(tmp_path):

@@ -1,0 +1,161 @@
+"""Static-verifier overhead bench (PERF.md §17).
+
+The verifier (paddle_tpu/analysis/) runs at program-BUILD time — once
+per compile-cache miss at every IR pass boundary — never per step. This
+bench prices that on the multi-param Adam MLP recipe (the same program
+bench_passes.py uses):
+
+- ``verify_frac_of_compile`` — verifier seconds as a fraction of the
+  cold lower+compile cost it rides on (measured through the telemetry
+  registry's ``program_verify_seconds`` vs ``executor_compile_seconds``,
+  so both numbers come from the same real Executor run);
+- ``pipeline_overhead`` — direct A/B of ``ir.apply_pipeline`` wall time
+  with ``PADDLE_TPU_VERIFY`` off vs ``passes``;
+- ``warm_step_ratio`` — warm step time at passes-level over off-level
+  (must be ~1.0: the verifier never touches the step path).
+
+Acceptance (asserted in tier-1 via test_bench_verify.py at smoke sizes):
+``verify_frac_of_compile`` ≤ 0.02.
+
+  JAX_PLATFORMS=cpu python tools/bench_verify.py [--smoke]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _hist_sum(registry, name):
+    d = registry.to_dict().get(name)
+    if not d or not d.get('samples'):
+        return 0.0
+    return sum(s.get('sum', 0.0) for s in d['samples'])
+
+
+def _build_recipe(smoke):
+    sys.path.insert(0, os.path.join(_REPO, 'tools'))
+    from bench_passes import build_mlp_adam
+    return build_mlp_adam(smoke=smoke)
+
+
+def _fused_bs():
+    from paddle_tpu.compiler import BuildStrategy
+    bs = BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True
+    bs.fuse_all_optimizer_ops = True
+    return bs
+
+
+def measure_pipeline_ab(iters=5, smoke=False):
+    """ir.apply_pipeline wall time, verify off vs passes (median)."""
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu import ir
+    main, _startup, make_feed, fetch = _build_recipe(smoke)
+    feed = make_feed()
+    kw = dict(fetch_names=[fetch.name], feed_names=sorted(feed),
+              build_strategy=_fused_bs())
+    out = {}
+    for level in ('off', 'passes'):
+        os.environ['PADDLE_TPU_VERIFY'] = level
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ir.apply_pipeline(main, **kw)
+            ts.append(time.perf_counter() - t0)
+        out[level] = statistics.median(ts)
+    return {'bench': 'verify_pipeline_ab',
+            'ops': main.num_ops(),
+            'pipeline_off_s': round(out['off'], 5),
+            'pipeline_on_s': round(out['passes'], 5),
+            'verify_added_s': round(out['passes'] - out['off'], 5)}
+
+
+def measure_compile_fraction(smoke=False, steps=10):
+    """One real cold Executor build+run at PADDLE_TPU_VERIFY=passes with
+    telemetry on; the verifier's share of the compile cost and the warm
+    step ratio come from the same run pair."""
+    os.environ['PADDLE_TPU_COMPILE_CACHE'] = '0'   # price the real compile
+    import paddle_tpu as fluid
+    from paddle_tpu import observability as obs
+
+    def one_cold_run(level):
+        os.environ['PADDLE_TPU_VERIFY'] = level
+        main, startup, make_feed, fetch = _build_recipe(smoke)
+        feed = make_feed()
+        exe = fluid.Executor()
+        exe.run(startup)
+        t0 = time.perf_counter()
+        exe.run(main, feed=feed, fetch_list=[fetch])     # cold: compiles
+        cold = time.perf_counter() - t0
+        warm = []
+        for _ in range(steps):
+            t1 = time.perf_counter()
+            exe.run(main, feed=feed, fetch_list=[fetch])
+            warm.append(time.perf_counter() - t1)
+        # min: warm steps are sub-ms host dispatches, so scheduler noise
+        # dominates any central tendency; the best observed pair is the
+        # honest "does the verifier touch the step path" probe
+        return cold, min(warm)
+
+    with obs.telemetry_guard(True):
+        obs.registry.reset()
+        cold_off, warm_off = one_cold_run('off')
+        verify_off = _hist_sum(obs.registry, 'program_verify_seconds')
+
+        obs.registry.reset()
+        cold_on, warm_on = one_cold_run('passes')
+        verify_on = _hist_sum(obs.registry, 'program_verify_seconds')
+        compile_on = _hist_sum(obs.registry, 'executor_compile_seconds')
+
+    assert verify_off == 0.0, 'verifier ran at level=off'
+    assert verify_on > 0.0, 'verifier never ran at level=passes'
+    frac = verify_on / compile_on if compile_on else 0.0
+    return {'bench': 'verify_overhead',
+            'verify_seconds': round(verify_on, 5),
+            'compile_seconds': round(compile_on, 4),
+            'verify_frac_of_compile': round(frac, 5),
+            'cold_off_s': round(cold_off, 4),
+            'cold_on_s': round(cold_on, 4),
+            'warm_step_ratio': round(warm_on / warm_off, 4)
+            if warm_off else None}
+
+
+def measure_all(iters=5, smoke=False):
+    prior = os.environ.get('PADDLE_TPU_VERIFY')
+    try:
+        ab = measure_pipeline_ab(iters=iters, smoke=smoke)
+        frac = measure_compile_fraction(smoke=smoke)
+    finally:
+        if prior is None:
+            os.environ.pop('PADDLE_TPU_VERIFY', None)
+        else:
+            os.environ['PADDLE_TPU_VERIFY'] = prior
+    print(json.dumps(ab))
+    print(json.dumps(frac))
+    return {'verify_pipeline_ab': ab, 'verify_overhead': frac}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--iters', type=int, default=5)
+    ap.add_argument('--smoke', action='store_true')
+    args = ap.parse_args()
+    r = measure_all(iters=args.iters, smoke=args.smoke)
+    frac = r['verify_overhead']['verify_frac_of_compile']
+    ok = frac <= 0.02
+    print(json.dumps({'bench': 'verify_acceptance',
+                      'verify_frac_of_compile': frac,
+                      'threshold': 0.02, 'ok': ok}))
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""Repo-level codebase lint: AST-enforced paddle_tpu/ invariants.
+
+Three rules, each an invariant this repo adopted in an earlier PR and
+until now enforced only by review:
+
+- ``bare-print`` — framework code never ``print()``s (PR 2: everything
+  routes through log_helper so headless runs can capture it). Exempt:
+  ``paddle_tpu/utils/`` (console probe CLIs). Deliberate console APIs
+  carry an inline ``# lint: allow-print (<reason>)`` marker.
+- ``atomic-io`` — model/param payload writes (``np.savez`` /
+  ``np.save``) go through the PR 7 torn-write-proof helpers
+  (io._atomic_savez or the resilience/snapshot.py commit protocol);
+  a bare savez can leave a half-written artifact after ``kill -9``.
+  Exempt: the two atomic-commit homes themselves.
+- ``jit-compile-cache`` — modules calling ``jax.jit`` must ensure the
+  persistent cross-process XLA compile cache is configured
+  (core.compile_cache.setup_persistent_cache); a stray jit in a process
+  that never built an Executor recompiles from scratch on every run.
+  Lower-only jits (no XLA compile) carry ``# lint: allow-jit``.
+
+Suppression: ``# lint: allow-<rule>`` on the violating line or the line
+directly above it. Run:
+
+    python tools/lint_codebase.py [--root REPO] [--json]
+
+Exit 0 = clean, 1 = violations. tier-1 runs this via
+tests/framework/test_lint_codebase.py.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import List, NamedTuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# rule name → dirs/files (relative to paddle_tpu/) exempt from it
+EXEMPT = {
+    'bare-print': ('utils/',),
+    'atomic-io': ('io.py', 'resilience/snapshot.py'),
+    'jit-compile-cache': (),
+}
+
+
+class Violation(NamedTuple):
+    rule: str
+    path: str          # repo-relative
+    line: int
+    message: str
+
+    def format(self):
+        return f'{self.path}:{self.line}: [{self.rule}] {self.message}'
+
+
+def _suppressed(lines, lineno, rule):
+    tag = {'bare-print': 'lint: allow-print',
+           'atomic-io': 'lint: allow-io',
+           'jit-compile-cache': 'lint: allow-jit'}[rule]
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines) and tag in lines[ln - 1]:
+            return True
+    return False
+
+
+def _dotted(node):
+    """'np.savez' / 'jax.jit' style dotted name of a call target."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return '.'.join(reversed(parts))
+
+
+_SAVE_CALLS = {'np.savez', 'np.savez_compressed', 'np.save',
+               'numpy.savez', 'numpy.savez_compressed', 'numpy.save'}
+
+
+def lint_file(path, rel):
+    src = open(path, encoding='utf-8').read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Violation('syntax', rel, e.lineno or 0, str(e))]
+    lines = src.splitlines()
+    has_cache_setup = 'setup_persistent_cache' in src
+    out: List[Violation] = []
+
+    def exempt(rule):
+        sub = rel.split('paddle_tpu/', 1)[1] if 'paddle_tpu/' in rel else rel
+        return any(sub == e or sub.startswith(e) for e in EXEMPT[rule])
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = _dotted(node.func)
+        if target == 'print' and not exempt('bare-print') \
+                and not _suppressed(lines, node.lineno, 'bare-print'):
+            out.append(Violation(
+                'bare-print', rel, node.lineno,
+                'framework code must log via log_helper, not print() '
+                '(mark deliberate console APIs with '
+                '"# lint: allow-print (<reason>)")'))
+        elif target in _SAVE_CALLS and not exempt('atomic-io') \
+                and not _suppressed(lines, node.lineno, 'atomic-io'):
+            out.append(Violation(
+                'atomic-io', rel, node.lineno,
+                f'{target}() writes non-atomically; route payload saves '
+                f'through io._atomic_savez (PR 7 torn-write protocol)'))
+        elif target == 'jax.jit' and not has_cache_setup \
+                and not exempt('jit-compile-cache') \
+                and not _suppressed(lines, node.lineno, 'jit-compile-cache'):
+            out.append(Violation(
+                'jit-compile-cache', rel, node.lineno,
+                'jax.jit without core.compile_cache.setup_persistent_cache '
+                'in this module bypasses the persistent XLA compile cache'))
+    return out
+
+
+def lint_tree(root=_REPO):
+    pkg = os.path.join(root, 'paddle_tpu')
+    violations: List[Violation] = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = sorted(d for d in dirnames if d != '__pycache__')
+        for fn in sorted(filenames):
+            if not fn.endswith('.py'):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            violations.extend(lint_file(path, rel))
+    return violations
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--root', default=_REPO)
+    ap.add_argument('--json', action='store_true')
+    args = ap.parse_args(argv)
+    violations = lint_tree(args.root)
+    if args.json:
+        print(json.dumps([v._asdict() for v in violations], indent=1))
+    else:
+        for v in violations:
+            print(v.format())
+        print(f'{len(violations)} violation(s) in paddle_tpu/')
+    return 1 if violations else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
