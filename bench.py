@@ -366,6 +366,41 @@ def bench_verify_overhead(on_tpu):
     return measure_all(iters=5 if on_tpu else 3, smoke=not on_tpu)
 
 
+def bench_partitioner(on_tpu):
+    """Unified SPMD partitioner bench (docs/PARTITIONER.md): per-Program
+    spec-resolution time (zero tracing — the cost the Executor pays per
+    compile-cache miss on a partitioned program), spec parity vs the
+    retired per-module plumbing, and dp×fsdp / dp×tp SpmdTrainStep
+    composition parity with the quantized-collective sync counters
+    asserted. Runs in a SUBPROCESS: the composed meshes need ≥8 devices
+    (XLA_FLAGS before backend init on CPU). Valid on CPU: the quantities
+    under test are host-side resolution time + scheduling/shape
+    discipline."""
+    import subprocess
+    env = dict(os.environ)
+    if not on_tpu:
+        env['JAX_PLATFORMS'] = 'cpu'
+    flags = env.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in flags:
+        env['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=8').strip()
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)), 'tools',
+                      'bench_partition.py')]
+        + ([] if on_tpu else ['--smoke']),
+        env=env, capture_output=True, text=True, timeout=1200)
+    if r.returncode != 0:
+        raise RuntimeError(f'bench_partition failed: {r.stderr[-2000:]}')
+    out = {}
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith('{'):
+            d = json.loads(line)
+            out[d['bench']] = d
+    return out
+
+
 def bench_serving_batcher(on_tpu):
     """Serving-path load bench (PERF.md §11): closed-loop clients through
     the dynamic micro-batcher (paddle_tpu/serving/) vs serial single-request
@@ -623,6 +658,18 @@ def main():
             ['verify_frac_of_compile'],
             verify_warm_step_ratio=vo['verify_overhead']
             ['warm_step_ratio'])
+
+    pt = run("partitioner", lambda: bench_partitioner(on_tpu))
+    if pt is not None:
+        emit({"metric": "partitioner",
+              "spec_resolution": pt['partition_spec_resolution'],
+              "parity": pt['partition_parity'],
+              "composition": pt['partition_composition']})
+        summary.update(
+            partition_resolve_s=pt['partition_spec_resolution']
+            ['resolve_s'],
+            partition_parity_ok=pt['partition_parity']['ok'],
+            partition_composition_ok=pt['partition_composition']['ok'])
 
     s = run("telemetry_sidecar", lambda: bench_telemetry_sidecar(on_tpu))
     if s is not None:

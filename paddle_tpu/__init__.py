@@ -48,6 +48,7 @@ from .io import (save_params, save_persistables, load_params, load_persistables,
 from . import reader
 from .reader import DataLoader
 from .data_feeder import DataFeeder
+from . import partition
 from . import parallel
 from . import distributed
 from . import contrib

@@ -145,6 +145,7 @@ class _Checker:
         self._walk(blk, env, set(self.roots))
         self._check_dead(blk)
         self._check_donation(blk)
+        self._check_sharding(blk)
         return self.diags
 
     def _walk(self, block, env: Dict[str, VarInfo], available: Set[str]):
@@ -393,6 +394,97 @@ class _Checker:
                               f"from buffer donation (copy-in/copy-out "
                               f"every step)", op, idx, blk.idx, var=name)
                     fetch.discard(name)      # one diagnostic per var
+
+
+    # -- sharding consistency (partitioner-stamped programs) -------------
+
+    def _check_sharding(self, blk):
+        """Sharding-consistency diagnostics for programs the partitioner
+        stamped (`program._partition_specs` — paddle_tpu/partition):
+        every asserted PartitionSpec must fit its var's declared rank
+        ('spec-rank-mismatch'), name only mesh axes that exist
+        ('spec-unknown-axis'), use each mesh axis at most once per tensor
+        ('spec-axis-reuse'), divide every concretely-known sharded dim
+        ('spec-indivisible'), and elementwise producer/consumer pairs
+        must not assert different axes on the same dim ('spec-conflict').
+        Each finding anchors at the var's producer op so the
+        construction site points at the model code."""
+        specs = getattr(self.program, '_partition_specs', None)
+        if not specs:
+            return
+        mesh_axes = dict(
+            getattr(self.program, '_partition_mesh_axes', None) or {})
+        producer = {}
+        for idx, op in enumerate(blk.ops):
+            for n in op.output_names():
+                producer.setdefault(n, (op, idx))
+
+        def flat_axes(entry):
+            if entry is None:
+                return ()
+            return tuple(entry) if isinstance(entry, (tuple, list)) \
+                else (entry,)
+
+        for name in sorted(specs):
+            entries = tuple(specs[name])
+            op, idx = producer.get(name, (None, None))
+            shape = None
+            if blk.has_var(name):
+                shape = declared_info(blk.var(name)).shape
+            if shape is not None and len(entries) > len(shape):
+                self.emit('error', 'spec-rank-mismatch',
+                          f"partition spec {entries!r} for {name!r} has "
+                          f"{len(entries)} entries but the var is rank "
+                          f"{len(shape)}", op, idx, var=name)
+                continue
+            seen: Set[str] = set()
+            for i, entry in enumerate(entries):
+                axes = flat_axes(entry)
+                span = 1
+                for a in axes:
+                    if a not in mesh_axes:
+                        self.emit('error', 'spec-unknown-axis',
+                                  f"partition spec of {name!r} names mesh "
+                                  f"axis {a!r}, not an axis of the mesh "
+                                  f"{sorted(mesh_axes)}", op, idx, var=name)
+                        continue
+                    if a in seen:
+                        self.emit('error', 'spec-axis-reuse',
+                                  f"partition spec of {name!r} uses mesh "
+                                  f"axis {a!r} on more than one dim",
+                                  op, idx, var=name)
+                    seen.add(a)
+                    span *= int(mesh_axes[a])
+                if span > 1 and shape is not None and i < len(shape):
+                    dim = shape[i]
+                    if isinstance(dim, int) and dim % span != 0:
+                        self.emit('error', 'spec-indivisible',
+                                  f"dim {i} of {name!r} is {dim}, not "
+                                  f"divisible by the {span}-way sharding "
+                                  f"{entry!r}", op, idx, var=name)
+
+        # producer/consumer conflicts: an elementwise op whose two
+        # operands positively assert DIFFERENT axes on the same dim
+        # cannot satisfy both without a resharding GSPMD would have to
+        # invent — the composition the partitioner exists to rule out
+        from ..partition.propagation import ELEMENTWISE_BINARY
+        for idx, op in enumerate(blk.ops):
+            if op.type not in ELEMENTWISE_BINARY:
+                continue
+            xn = (op.inputs.get('x') or (None,))[0]
+            yn = (op.inputs.get('y') or (None,))[0]
+            xs, ys = specs.get(xn), specs.get(yn)
+            if not xs or not ys or len(xs) != len(ys):
+                continue
+            for i, (a, b) in enumerate(zip(xs, ys)):
+                if a is not None and b is not None \
+                        and flat_axes(a) != flat_axes(b):
+                    self.emit('error', 'spec-conflict',
+                              f"operands {xn!r} ({tuple(xs)!r}) and "
+                              f"{yn!r} ({tuple(ys)!r}) of {op.type!r} "
+                              f"assert conflicting sharding on dim {i}",
+                              op, idx)
+                    break
 
 
 def run_checks(program, fetch_names=(), feed_names=(), stage='pre'):

@@ -112,17 +112,20 @@ class CompiledProgram:
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
                            places=None):
-        """Shard feeds over all local devices (mesh axis 'dp')."""
-        from .parallel.mesh import get_default_mesh, make_mesh
+        """Shard feeds over the partitioner's data axes (the 'batch'
+        logical axis — 'dp', or dp×fsdp on a composed mesh); without a
+        configured mesh, a flat all-device 'dp' mesh is built."""
+        from .partition import get_partitioner, make_mesh
         if build_strategy is not None:
             self._build_strategy = build_strategy
         if exec_strategy is not None:
             self._exec_strategy = exec_strategy
-        mesh = get_default_mesh()
-        if mesh is None or 'dp' not in mesh.axis_names:
+        sharding = get_partitioner().data_sharding()
+        if sharding is None:
             n = len(jax.devices())
-            mesh = make_mesh({'dp': n})
-        self._data_sharding = NamedSharding(mesh, PartitionSpec('dp'))
+            sharding = NamedSharding(make_mesh({'dp': n}),
+                                     PartitionSpec('dp'))
+        self._data_sharding = sharding
         self._places = places
         return self
 

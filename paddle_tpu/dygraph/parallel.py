@@ -63,25 +63,20 @@ _REDUCER = None
 
 
 def _cross_process_reducer():
-    """(shard_sharding, own_device, reduce fns by comm dtype) over a
-    1-device-per-process mesh, built once: reuse keeps the jit cache warm
-    (one compile per bundle shape for the whole run), and picking each
-    process's FIRST local device — grouped by process_index, never by raw
-    device id order, which JAX does not guarantee to be process-contiguous
-    — means every mesh row is owned by exactly the process whose grad
-    shard it carries. The int8/bf16 reducers take the quantized payload
+    """(shard_sharding, own_device, reduce fns by comm dtype) over the
+    partitioner's 1-device-per-process mesh (partition.process_mesh),
+    built once: reuse keeps the jit cache warm (one compile per bundle
+    shape for the whole run), and the mesh rows are process-owned by
+    construction. The int8/bf16 reducers take the quantized payload
     rows (quant_collectives codec) and dequantize-sum in exact f32, so the
     bytes H2D'd and exchanged across processes are the compressed ones."""
     global _REDUCER
     if _REDUCER is None:
-        import numpy as _np
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from jax.sharding import NamedSharding, PartitionSpec as P
         from ..parallel import quant_collectives as qc
-        per_proc = {}
-        for d in jax.devices():
-            per_proc.setdefault(d.process_index, d)
-        devs = [per_proc[i] for i in sorted(per_proc)]
-        mesh = Mesh(_np.array(devs), ('proc',))
+        from ..partition import process_mesh
+        mesh = process_mesh()
+        own = {d.process_index: d for d in mesh.devices.ravel()}
         rep = NamedSharding(mesh, P())
 
         def dequant_sum(q, s):
@@ -93,7 +88,7 @@ def _cross_process_reducer():
         from ..core.compile_cache import setup_persistent_cache
         setup_persistent_cache()
         _REDUCER = (NamedSharding(mesh, P('proc')),
-                    per_proc[jax.process_index()],
+                    own[jax.process_index()],
                     {'f32': jax.jit(lambda g: jnp.sum(g, axis=0),
                                     out_shardings=rep),
                      'bf16': jax.jit(

@@ -795,7 +795,7 @@ class Executor:
         self.place = _get_paddle_place(place)
         self._cache = {}
         self._step_counter = 0
-        self._fsdp_placed = set()
+        self._partition_placed = set()
         # async pipeline bookkeeping: dispatched steps whose FetchHandles
         # are still pending (K-in-flight window + donation protection)
         self._window = InflightWindow()
@@ -888,18 +888,22 @@ class Executor:
         # persistable vars = training state
         state_names = sorted(v.name for v in program.list_vars()
                              if v.persistable)
-        fsdp_axis = getattr(program, '_fsdp_axis', None)
-        fsdp_mesh = None
-        # place once per (program, scope): step outputs keep the sharding,
-        # so re-placing every run would only add host-side dispatch cost.
+        # partitioned state placement (paddle_tpu/partition): programs a
+        # fleet strategy stamped (`_fsdp_axis` legacy pure-fsdp, or
+        # `_partition_params` full rule-table resolution — tp Megatron
+        # specs + fsdp tiles on one mesh) get their persistables
+        # device_put with the partitioner-resolved NamedShardings, the
+        # pjit-style in_shardings of the jitted step. Place once per
+        # (program, scope): step outputs keep the sharding, so
+        # re-placing every run would only add host-side dispatch cost.
         # program._id is a never-recycled counter (unlike id())
-        fsdp_key = (program._id, id(scope))
-        if fsdp_axis is not None and fsdp_key not in self._fsdp_placed:
-            from .parallel.mesh import get_default_mesh
-            mesh = get_default_mesh()
-            if mesh is not None and fsdp_axis in mesh.shape:
-                fsdp_mesh = mesh
-                self._fsdp_placed.add(fsdp_key)
+        spec_fn = None
+        part_key = (program._id, id(scope))
+        if part_key not in self._partition_placed:
+            from .partition import state_spec_fn
+            spec_fn = state_spec_fn(program)
+            if spec_fn is not None:
+                self._partition_placed.add(part_key)
         state = {}
         for n in state_names:
             val = scope.find(n)
@@ -907,10 +911,8 @@ class Executor:
                 raise RuntimeError(
                     f"persistable var '{n}' is uninitialized; run the startup "
                     f"program first (exe.run(fluid.default_startup_program()))")
-            if fsdp_mesh is not None and hasattr(val, 'shape'):
-                from .parallel.fsdp import fsdp_sharding
-                val = jax.device_put(
-                    val, fsdp_sharding(val.shape, fsdp_mesh, fsdp_axis))
+            if spec_fn is not None and hasattr(val, 'shape'):
+                val = jax.device_put(val, spec_fn(n, val.shape))
             state[n] = val
 
         from .core.lod import LoDTensor

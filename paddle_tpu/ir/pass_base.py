@@ -177,7 +177,9 @@ class PassManager:
         opt = program.clone()
         # clone() drops non-IR carry attrs the lowering (and the passes
         # themselves — the fleet fuse_all_reduce_ops stamp) read
-        for attr in ('_fsdp_axis', '_dist_fuse_all_reduce_ops'):
+        for attr in ('_fsdp_axis', '_dist_fuse_all_reduce_ops',
+                     '_partition_params', '_partition_specs',
+                     '_partition_mesh_axes'):
             if hasattr(program, attr):
                 setattr(opt, attr, getattr(program, attr))
         stamp_rng_salts(opt)

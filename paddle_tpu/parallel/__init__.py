@@ -1,9 +1,13 @@
-"""Distribution: mesh, collectives, fleet, model/pipeline/sequence
-parallelism (SURVEY §2.8)."""
+"""Distribution: the unified SPMD partitioner (paddle_tpu.partition) plus
+collectives, fleet, and model/pipeline/sequence parallelism (SURVEY
+§2.8, docs/PARTITIONER.md). The mesh module is a compatibility shim —
+the partitioner owns the device mesh."""
 from . import mesh
 from .mesh import (make_mesh, make_hybrid_mesh, set_default_mesh,
                    get_default_mesh, mesh_guard, data_sharding, replicated,
                    topology)
+from ..partition import (Partitioner, get_partitioner, configure,
+                         mesh_scope)
 from . import fsdp
 from .fsdp import (fsdp_shardings, fsdp_sharding, fsdp_spec,
                    reduce_scatter_grads)

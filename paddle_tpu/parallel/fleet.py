@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import jax
 
-from .mesh import get_default_mesh, make_mesh, set_default_mesh, topology
+from .mesh import topology
 
 
 class Fleet:
@@ -22,24 +22,32 @@ class Fleet:
         self._mode = mode
 
     # ---- lifecycle ----
-    def init(self, role_maker=None, is_collective=True, mesh_shape=None):
+    def init(self, role_maker=None, is_collective=True, mesh_shape=None,
+             dcn_mesh_shape=None, axis_rules=None):
         """Accepts both collective and parameter-server role makers (ref:
         incubate/fleet/base/fleet_base.py:Fleet.init). PS roles lower to
         collective DP on TPU: there are no parameter servers — every process
         is a worker and parameter state is replicated over the mesh, with XLA
         AllReduce replacing the send/recv to pservers (SURVEY 2.8).
 
-        mesh_shape (TPU extension): dict of mesh axes, e.g.
-        {'dp': 4, 'tp': 2} — installs the hybrid-parallel device mesh that
-        the parallel helpers (tensor_parallel, ring_attention, …) pick up as
-        the default."""
+        mesh_shape (TPU extension): mesh axes for the PARTITIONER's owned
+        device mesh, e.g. {'dp': 4, 'tp': 2} or "dp=4,tp=2" — strict
+        parse, unknown axis names raise. `dcn_mesh_shape` lays those axes
+        over the data-center network (hybrid ICI×DCN mesh —
+        partition.make_hybrid_mesh); `axis_rules` overrides the logical
+        axis rule table (docs/PARTITIONER.md). Every parallel helper
+        (tensor_parallel, fsdp, local/geo SGD, ring_attention, the
+        Executor's Program lowering) resolves through that one
+        partitioner."""
+        from ..partition import configure, get_partitioner
         self._role_maker = role_maker or PaddleCloudRoleMaker(
             is_collective=is_collective)
-        if mesh_shape:
-            set_default_mesh(make_mesh(dict(mesh_shape)))
-        elif get_default_mesh() is None:
+        if mesh_shape or dcn_mesh_shape or axis_rules:
+            configure(mesh_shape=mesh_shape, dcn_mesh_shape=dcn_mesh_shape,
+                      axis_rules=axis_rules)
+        elif get_partitioner().mesh is None:
             n = len(jax.devices())
-            set_default_mesh(make_mesh({'dp': n}))
+            configure(mesh_shape={'dp': n})
         self._inited = True
         return self
 
@@ -168,6 +176,50 @@ class DistributedStrategy:
         self.sharding = False
         self.sharding_axis = 'fsdp'
         self._comm_dtype = 'f32'
+        # partitioner topology (docs/PARTITIONER.md): mesh_shape builds
+        # the owned device mesh at minimize/init time ("dp=2,tp=4" or a
+        # dict; dcn_mesh_shape lays axes over DCN), axis_rules overrides
+        # the logical-axis rule table. All strict-parse: unknown mesh
+        # axis / logical names raise ValueError listing the supported
+        # set (the PR 8/9 knob-hygiene contract).
+        self._mesh_shape = None
+        self._dcn_mesh_shape = None
+        self._axis_rules = None
+
+    @property
+    def mesh_shape(self):
+        """Partitioner mesh topology, e.g. {'dp': 2, 'tp': 4} or
+        "dp=2,tp=4". Unknown axis names raise ValueError."""
+        return self._mesh_shape
+
+    @mesh_shape.setter
+    def mesh_shape(self, value):
+        from ..partition.rules import parse_mesh_shape
+        self._mesh_shape = parse_mesh_shape(
+            value, source='DistributedStrategy.mesh_shape')
+
+    @property
+    def dcn_mesh_shape(self):
+        """Axes spanning the data-center network (hybrid ICI×DCN mesh)."""
+        return self._dcn_mesh_shape
+
+    @dcn_mesh_shape.setter
+    def dcn_mesh_shape(self, value):
+        from ..partition.rules import parse_mesh_shape
+        self._dcn_mesh_shape = parse_mesh_shape(
+            value, source='DistributedStrategy.dcn_mesh_shape')
+
+    @property
+    def axis_rules(self):
+        """Logical-axis rule overrides, e.g. "batch=dp,mlp=tp,kv=" or a
+        sequence of (logical, mesh) pairs. Unknown names raise."""
+        return self._axis_rules
+
+    @axis_rules.setter
+    def axis_rules(self, value):
+        from ..partition.rules import parse_axis_rules
+        self._axis_rules = parse_axis_rules(
+            value, source='DistributedStrategy.axis_rules')
 
     @property
     def comm_dtype(self):
@@ -228,10 +280,32 @@ class DistributedOptimizer:
             inner = GradientMergeOptimizer(inner, k_steps=merge_k, avg=True)
         result = inner.minimize(loss, startup_program, parameter_list,
                                 no_grad_set)
+        program = loss.block.program
+        from ..partition import configure, get_partitioner
+        if strat.mesh_shape or strat.axis_rules:
+            # strategy-declared topology: build the partitioner's owned
+            # mesh here so `minimize` is the single bring-up point
+            configure(mesh_shape=strat.mesh_shape,
+                      dcn_mesh_shape=strat.dcn_mesh_shape,
+                      axis_rules=strat.axis_rules)
+        part = get_partitioner()
         if strat.sharding:
             # Executor.run places persistable state with FSDP shardings
             # before each jitted step (a no-op once placed)
-            loss.block.program._fsdp_axis = strat.sharding_axis
+            program._fsdp_axis = strat.sharding_axis
+        mesh_axes = part.axis_sizes()
+        composed = sum(1 for s in mesh_axes.values() if s > 1) > 1 \
+            or any(mesh_axes.get(a, 1) > 1 for a in ('tp', 'sp', 'pp'))
+        if strat.sharding or composed:
+            # full rule-table resolution when lowering: the Executor
+            # consults the partitioner for every persistable's sharding
+            # (tp Megatron specs + fsdp tiles compose on one mesh), and
+            # the stamped specs feed the analysis/checks.py
+            # sharding-consistency diagnostics
+            program._partition_params = True
+            part.stamp_program(
+                program,
+                fsdp_axis=strat.sharding_axis if strat.sharding else None)
         if merge_k == 1:
             # per-step DP gradient sync points (ref: the collective
             # transpiler's per-grad c_allreduce_sum insertion). On the
@@ -248,6 +322,7 @@ class DistributedOptimizer:
     @staticmethod
     def _insert_grad_allreduce(program, strat):
         from ..framework import BACKWARD_OP_TYPE, Operator
+        from ..partition import get_partitioner
         blk = program.global_block()
         bwd = next((i for i, op in enumerate(blk.ops)
                     if op.type == BACKWARD_OP_TYPE), None)
@@ -255,11 +330,19 @@ class DistributedOptimizer:
             return
         grads = blk.ops[bwd].outputs.get('Grads', [])
         comm = getattr(strat, 'comm_dtype', 'f32')
+        # gradient sync axis comes from the partitioner's rule table —
+        # the axes 'batch' shards over ARE the axes gradients reduce
+        # over (a dp×fsdp mesh stamps the tuple; shard_map lowerings
+        # then psum over both, the GSPMD executor keeps identity)
+        data_axes = get_partitioner().data_axes()
+        axis = ('dp' if not data_axes
+                else data_axes[0] if len(data_axes) == 1
+                else tuple(data_axes))
         for j, g in enumerate(grads):
             blk.ops.insert(bwd + 1 + j, Operator(
                 blk, 'c_allreduce_sum', inputs={'x': g},
                 outputs={'Out': g},
-                attrs={'ring_id': 0, 'use_calc_stream': True, 'axis': 'dp',
+                attrs={'ring_id': 0, 'use_calc_stream': True, 'axis': axis,
                        'comm_dtype': comm}))
         program._bump_version()
         # carry the bucketing decision for programs run WITHOUT a

@@ -23,14 +23,15 @@ __all__ = ['fsdp_spec', 'fsdp_sharding', 'fsdp_shardings', 'shard_params',
 def fsdp_spec(shape, mesh: Mesh, axis: str = 'fsdp') -> PartitionSpec:
     """PartitionSpec sharding the LARGEST dim divisible by the axis size
     (replicated if none divides). Largest-dim wins: it maximizes the bytes
-    saved per device and keeps the all-gather contiguous."""
+    saved per device and keeps the all-gather contiguous. This is the
+    partitioner's 'fsdp' placement rule (partition/rules.py); kept as a
+    module function because the explicit (mesh, axis) form is this
+    module's sharding contract."""
     if axis not in mesh.shape:
         return PartitionSpec()
     p = mesh.shape[axis]
-    best, best_size = None, 0
-    for d, s in enumerate(shape):
-        if s % p == 0 and s >= p and s > best_size:
-            best, best_size = d, s
+    from ..partition.rules import largest_divisible_dim
+    best = largest_divisible_dim(shape, p) if p > 1 else None
     if best is None:
         return PartitionSpec()
     spec = [None] * len(shape)

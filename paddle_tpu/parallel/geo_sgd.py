@@ -21,7 +21,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..core import compat
 from . import quant_collectives as qc
@@ -38,10 +38,22 @@ class GeoSGDStep:
     `comm_dtype` quantizes the k-step delta-sum AllReduce — deltas are the
     natural quantization target (small dynamic range vs the params
     themselves); `f32` (default) keeps the exact `lax.psum` bitwise.
+
+    `mesh` may be omitted when a partitioner owns one — replica layout
+    and sync axis come from it (docs/PARTITIONER.md).
     """
 
-    def __init__(self, loss_fn, params, mesh, need_push_nums, lr=0.1,
-                 axis='dp', comm_dtype=None):
+    def __init__(self, loss_fn, params, mesh=None, need_push_nums=1, lr=0.1,
+                 axis='dp', comm_dtype=None, partitioner=None):
+        from ..partition import Partitioner, get_partitioner
+        p = partitioner or get_partitioner()
+        if mesh is not None and mesh is not p.mesh:
+            p = Partitioner(mesh=mesh, axis_rules=p.rules)
+        mesh = p.mesh
+        if mesh is None or axis not in mesh.shape:
+            raise ValueError(
+                f"GeoSGDStep: no mesh axis {axis!r} (pass mesh= or "
+                f"configure the partitioner)")
         self._k = int(need_push_nums)
         self._comm = qc.resolve_comm_dtype(comm_dtype)
         self._sync_elems = sum(
@@ -49,13 +61,8 @@ class GeoSGDStep:
         n = self._n = mesh.shape[axis]
         rep_spec = {name: P(axis, *([None] * jnp.ndim(v)))
                     for name, v in params.items()}
-        rep_sharding = {name: NamedSharding(mesh, spec)
-                        for name, spec in rep_spec.items()}
-        stacked = {
-            name: jax.device_put(
-                jnp.broadcast_to(jnp.asarray(v), (n,) + jnp.shape(v)),
-                rep_sharding[name])
-            for name, v in params.items()}
+        stacked = {name: p.replica_put(v, axis)
+                   for name, v in params.items()}
         # local replicas and the base start identical — DISTINCT buffers
         # (both arguments are donated; aliasing them would donate twice)
         self._state = (stacked,

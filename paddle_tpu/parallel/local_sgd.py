@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..core import compat
 from . import quant_collectives as qc
@@ -38,25 +38,33 @@ class LocalSGDStep:
     `comm_dtype` quantizes the k-step parameter-averaging AllReduce
     (quant_collectives; env `PADDLE_TPU_COMM_DTYPE` wins) — `f32` (default)
     keeps the exact `lax.pmean` bitwise.
+
+    `mesh` may be omitted when a partitioner owns one
+    (`partition.configure(...)` / `fleet.init`): the replica layout and
+    the sync axis then come from the partitioner instead of hand-rolled
+    per-module plumbing.
     """
 
-    def __init__(self, loss_fn, params, mesh, k_steps, lr=0.1, axis='dp',
-                 comm_dtype=None):
+    def __init__(self, loss_fn, params, mesh=None, k_steps=1, lr=0.1,
+                 axis='dp', comm_dtype=None, partitioner=None):
         # k/lr/axis/comm_dtype are baked into the compiled step below —
         # rebuild the LocalSGDStep to change them
+        from ..partition import Partitioner, get_partitioner
+        p = partitioner or get_partitioner()
+        if mesh is not None and mesh is not p.mesh:
+            p = Partitioner(mesh=mesh, axis_rules=p.rules)
+        mesh = p.mesh
+        if mesh is None or axis not in mesh.shape:
+            raise ValueError(
+                f"LocalSGDStep: no mesh axis {axis!r} (pass mesh= or "
+                f"configure the partitioner)")
         self._k = int(k_steps)
         self._comm = qc.resolve_comm_dtype(comm_dtype)
         self._sync_elems = sum(
             int(jnp.size(jnp.asarray(v))) for v in params.values())
         n = self._n = mesh.shape[axis]
-        rep_sharding = {
-            name: NamedSharding(mesh, P(axis, *([None] * jnp.ndim(v))))
-            for name, v in params.items()}
-        self._params = {
-            name: jax.device_put(
-                jnp.broadcast_to(jnp.asarray(v), (n,) + jnp.shape(v)),
-                rep_sharding[name])
-            for name, v in params.items()}
+        self._params = {name: p.replica_put(v, axis)
+                        for name, v in params.items()}
         self._t = 0
         k = self._k
         comm = self._comm

@@ -1,137 +1,81 @@
-"""Device mesh management — the TPU-native backbone of all distribution.
+"""Device-mesh compatibility shims over the unified SPMD partitioner.
 
-Replaces the reference's NCCL communicator bootstrap
-(/root/reference/paddle/fluid/operators/collective/c_comm_init_op.cc,
-c_gen_nccl_id_op.cc): instead of exchanging NCCL unique ids over RPC, we
-build a jax.sharding.Mesh over the ICI/DCN topology; XLA lowers collectives
-onto it. Axes convention (SURVEY §2.8): dp (data), fsdp (sharded params),
-tp (tensor), pp (pipeline), sp (sequence).
+This module used to own a module-global default mesh that every
+``parallel/`` module mutated and read around each other — exactly the
+hand-rolled plumbing the partitioner retired (ROADMAP item 1,
+docs/PARTITIONER.md). The mesh is now OWNED by
+:mod:`paddle_tpu.partition`: built once from a ``DistributedStrategy`` /
+``PADDLE_TPU_MESH`` topology, resolved through the logical axis rules.
+
+Everything here is a delegating alias kept for API compatibility:
+
+- ``make_mesh`` / ``make_hybrid_mesh`` / ``topology`` re-export
+  partition.device_mesh (the only sanctioned ``Mesh(`` construction
+  site — tools/lint_codebase.py enforces it);
+- ``get_default_mesh`` / ``mesh_guard`` read/scope the partitioner's
+  owned mesh;
+- ``set_default_mesh`` still works but is DEPRECATED (one warning per
+  process through log_helper): configure the partitioner instead
+  (``partition.configure(mesh_shape=...)`` or ``fleet.init``).
 """
 from __future__ import annotations
 
-import contextlib
-from typing import Dict, Optional
+from typing import Optional
 
-import numpy as np
-import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh
 
-_default_mesh: Optional[Mesh] = None
+from ..partition.device_mesh import make_mesh, make_hybrid_mesh, topology
 
-
-def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
-    """Create a Mesh with named axes, e.g. make_mesh({'dp': 4, 'tp': 2}).
-    Uses mesh_utils for ICI-aware device ordering when available."""
-    devices = devices if devices is not None else jax.devices()
-    shape = tuple(axes.values())
-    n = int(np.prod(shape))
-    if n > len(devices):
-        raise ValueError(f"mesh {axes} needs {n} devices, have {len(devices)}")
-    try:
-        from jax.experimental import mesh_utils
-        dev_array = mesh_utils.create_device_mesh(shape, devices[:n])
-    except Exception:
-        dev_array = np.asarray(devices[:n]).reshape(shape)
-    return Mesh(dev_array, tuple(axes.keys()))
-
-
-def make_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
-                     devices=None) -> Mesh:
-    """Multi-slice/pod mesh: `dcn_axes` span the data-center network
-    (slices), `ici_axes` the in-slice interconnect. This is the TPU
-    analogue of the reference's hierarchical allreduce
-    (ref: incubate/fleet DistributedStrategy.use_hierarchical_allreduce +
-    NCCL hierarchical comms): laying dp over DCN and tp/fsdp over ICI makes
-    XLA emit the two-level collective automatically. Uses
-    mesh_utils.create_hybrid_device_mesh when slice topology is available;
-    otherwise (single slice / CPU test mesh) falls back to a flat
-    ICI-ordered mesh with the same named axes."""
-    devices = devices if devices is not None else jax.devices()
-    overlap = set(dcn_axes) & set(ici_axes)
-    if overlap:
-        raise ValueError(
-            f"axis names {sorted(overlap)} appear in both dcn_axes and "
-            f"ici_axes")
-    dcn_shape = tuple(dcn_axes.values())
-    ici_shape = tuple(ici_axes.values())
-    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
-    n_dcn = int(np.prod(dcn_shape))
-    n_ici = int(np.prod(ici_shape))
-    if n_dcn * n_ici > len(devices):
-        raise ValueError(
-            f"hybrid mesh {dcn_axes}x{ici_axes} needs {n_dcn * n_ici} "
-            f"devices, have {len(devices)}")
-    by_slice: Dict[int, list] = {}
-    for d in devices:
-        by_slice.setdefault(getattr(d, 'slice_index', 0), []).append(d)
-    if len(by_slice) > 1:
-        # pick WHOLE slices (n_dcn of them × n_ici devices each) so the
-        # dcn axes really span DCN — a flat device prefix could land
-        # entirely inside one slice
-        usable = [ds[:n_ici] for ds in by_slice.values()
-                  if len(ds) >= n_ici]
-        if len(usable) < n_dcn:
-            raise ValueError(
-                f"hybrid mesh needs {n_dcn} slices with ≥{n_ici} devices "
-                f"each; have {[len(v) for v in by_slice.values()]}")
-        chosen = [d for ds in usable[:n_dcn] for d in ds]
-        # create_hybrid_device_mesh wants same-rank shapes and returns
-        # their ELEMENTWISE product; padding with 1s yields exactly
-        # dcn_shape + ici_shape in (dcn..., ici...) order
-        from jax.experimental import mesh_utils
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            (1,) * len(dcn_shape) + ici_shape,
-            dcn_shape + (1,) * len(ici_shape), chosen)
-        return Mesh(dev_array, names)
-    # single slice / CPU test mesh: flat ICI-ordered mesh, same named axes
-    return make_mesh({**dcn_axes, **ici_axes}, devices[:n_dcn * n_ici])
+__all__ = ['make_mesh', 'make_hybrid_mesh', 'set_default_mesh',
+           'get_default_mesh', 'mesh_guard', 'data_sharding', 'replicated',
+           'topology']
 
 
 def set_default_mesh(mesh: Optional[Mesh]):
-    global _default_mesh
-    _default_mesh = mesh
+    """DEPRECATED: mutate the partitioner's owned mesh. Prefer
+    ``partition.configure(mesh_shape=...)`` (builds it once from a
+    topology) or the scoped ``partition.mesh_scope``."""
+    from ..partition import get_partitioner
+    from ..partition.partitioner import warn_once
+    warn_once(
+        'set_default_mesh',
+        'parallel.mesh.set_default_mesh is deprecated: the device mesh is '
+        'owned by the partitioner (paddle_tpu.partition). Use '
+        'partition.configure(mesh_shape=...) / fleet.init(mesh_shape=...) '
+        'or the scoped partition.mesh_scope(mesh) instead.')
+    get_partitioner().set_mesh(mesh)
 
 
 def get_default_mesh() -> Optional[Mesh]:
-    return _default_mesh
+    from ..partition import get_partitioner
+    return get_partitioner().mesh
 
 
-@contextlib.contextmanager
 def mesh_guard(mesh: Mesh):
-    global _default_mesh
-    old = _default_mesh
-    _default_mesh = mesh
-    try:
-        yield mesh
-    finally:
-        _default_mesh = old
+    """Scoped mesh override (delegates to partition.mesh_scope)."""
+    from ..partition import mesh_scope
+    return mesh_scope(mesh)
 
 
-def data_sharding(mesh=None, axis='dp'):
-    """Sharding for a batch tensor: leading dim over `axis`, rest replicated."""
-    mesh = mesh or get_default_mesh()
+def data_sharding(mesh=None, axis=None):
+    """Sharding for a batch tensor: leading dim over the data axes the
+    rule table resolves (or an explicit ``axis``), rest replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from ..partition import get_partitioner
+    p = get_partitioner()
+    if mesh is None and axis is None:
+        return p.data_sharding()
+    mesh = mesh if mesh is not None else p.mesh
     if mesh is None:
         return None
-    return NamedSharding(mesh, PartitionSpec(axis))
+    return NamedSharding(mesh, PartitionSpec(axis if axis is not None
+                                             else 'dp'))
 
 
 def replicated(mesh=None):
-    mesh = mesh or get_default_mesh()
+    from jax.sharding import NamedSharding, PartitionSpec
+    from ..partition import get_partitioner
+    mesh = mesh if mesh is not None else get_partitioner().mesh
     if mesh is None:
         return None
     return NamedSharding(mesh, PartitionSpec())
-
-
-def topology():
-    """Slice/pod topology report (ref: fleet's role maker endpoints)."""
-    devs = jax.devices()
-    info = {
-        'process_index': jax.process_index(),
-        'process_count': jax.process_count(),
-        'local_device_count': jax.local_device_count(),
-        'device_count': len(devs),
-        'platform': devs[0].platform if devs else 'none',
-    }
-    if hasattr(devs[0], 'coords'):
-        info['coords'] = [tuple(d.coords) for d in devs]
-    return info

@@ -15,6 +15,8 @@ Two complementary surfaces, both riding ICI collectives:
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -24,15 +26,66 @@ from ..core import compat
 from .mesh import get_default_mesh
 
 __all__ = ['megatron_param_spec', 'shard_params', 'column_parallel_matmul',
-           'row_parallel_matmul', 'vocab_parallel_embedding']
+           'row_parallel_matmul', 'vocab_parallel_embedding', 'mp_copy',
+           'mp_allreduce']
 
 
-def megatron_param_spec(name, arr, axis='tp', col_markers=('ffn1', 'q_proj',
-                        'k_proj', 'v_proj', '.q.', '.k.', '.v.'),
-                        row_markers=('ffn2', 'out_proj', '.out.')):
+@functools.lru_cache(maxsize=None)
+def _mp_pair(axis):
+    """Megatron's (f, g) conjugate collectives over ``axis``:
+
+    - ``f`` (mp_copy): identity forward, all-reduce backward — placed at
+      the ENTRY of a tensor-parallel region so upstream (replicated)
+      parameters receive the full gradient, summed over the tp shards'
+      partial contributions;
+    - ``g`` (mp_allreduce): all-reduce forward, identity backward —
+      placed at the EXIT (after a row-parallel matmul). A plain
+      ``lax.psum`` is wrong there under autodiff: its transpose is psum
+      again, so a replicated cotangent comes back multiplied by the axis
+      size (the classic n× gradient bug the custom VJP removes).
+    """
+    @jax.custom_vjp
+    def g(x):
+        return lax.psum(x, axis)
+
+    g.defvjp(lambda x: (lax.psum(x, axis), None),
+             lambda _, ct: (compat.pcast(ct, axis, to='varying'),))
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None),
+             lambda _, ct: (lax.psum(ct, axis),))
+    return f, g
+
+
+def mp_copy(x, axis='tp'):
+    """Identity forward / psum backward (Megatron 'f') — wrap the input
+    of a tensor-parallel region with it."""
+    return _mp_pair(axis)[0](x)
+
+
+def mp_allreduce(x, axis='tp'):
+    """psum forward / identity backward (Megatron 'g') — reduce the
+    partial products of a row-parallel matmul with it."""
+    return _mp_pair(axis)[1](x)
+
+
+def megatron_param_spec(name, arr, axis='tp', col_markers=None,
+                        row_markers=None):
     """PartitionSpec for a parameter by Megatron rules: up-projections /
     QKV shard columns, down-projections shard rows, everything else
-    replicated over `axis`."""
+    replicated over `axis`. The marker tables live on the partitioner
+    (partition/partitioner.py) — the same rules drive
+    ``Partitioner.param_spec`` so the explicit-shard_map surface and the
+    Program-lowering surface can never disagree."""
+    from ..partition.partitioner import (COLUMN_PARALLEL_MARKERS,
+                                         ROW_PARALLEL_MARKERS)
+    col_markers = (COLUMN_PARALLEL_MARKERS if col_markers is None
+                   else col_markers)
+    row_markers = (ROW_PARALLEL_MARKERS if row_markers is None
+                   else row_markers)
     if getattr(arr, 'ndim', len(getattr(arr, 'shape', ()))) == 2:
         if any(m in name for m in col_markers):
             return P(None, axis)
@@ -83,7 +136,9 @@ def row_parallel_matmul(x, w, b=None, mesh=None, axis='tp'):
 
     def body(xs, ws, bs):
         part = xs @ ws
-        y = lax.psum(part, axis)
+        # mp_allreduce, not bare psum: psum's transpose is psum, so a
+        # replicated cotangent would come back ×axis_size (see _mp_pair)
+        y = mp_allreduce(part, axis)
         if bs is not None:
             y = y + bs
         return y

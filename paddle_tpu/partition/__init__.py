@@ -1,0 +1,45 @@
+"""Unified SPMD partitioner: one mesh, logical axis rules, pjit-style
+Program lowering (ROADMAP item 1; docs/PARTITIONER.md).
+
+Public surface:
+
+- :class:`Partitioner` + the process-global instance
+  (:func:`get_partitioner` / :func:`configure` / :func:`mesh_scope`) —
+  owns the device mesh and resolves PartitionSpecs through the ordered
+  logical-axis rule table (rules.py);
+- mesh builders (device_mesh.py) — the only sanctioned home of
+  ``Mesh(`` construction (tools/lint_codebase.py enforces it);
+- :func:`propagate_specs` — zero-tracing activation sharding
+  propagation over a Program, driven by analysis/infer.py shapes;
+- :class:`SpmdTrainStep` — the composed DP×TP×FSDP functional step with
+  quantized + bucketed gradient sync (lazy import: it pulls in the
+  collectives stack).
+"""
+from . import rules
+from .rules import (AxisRules, DEFAULT_AXIS_RULES, LOGICAL_AXES, MESH_AXES,
+                    parse_axis_rules, parse_mesh_shape)
+from . import device_mesh
+from .device_mesh import (make_mesh, make_hybrid_mesh, mesh_from_env,
+                          process_mesh, topology)
+from .partitioner import (Partitioner, configure, get_partitioner,
+                          mesh_scope, reset_partitioner, set_partitioner,
+                          spec_entries, state_spec_fn)
+
+__all__ = ['Partitioner', 'AxisRules', 'DEFAULT_AXIS_RULES', 'LOGICAL_AXES',
+           'MESH_AXES', 'parse_axis_rules', 'parse_mesh_shape', 'make_mesh',
+           'make_hybrid_mesh', 'mesh_from_env', 'process_mesh', 'topology',
+           'configure', 'get_partitioner', 'mesh_scope', 'reset_partitioner',
+           'set_partitioner', 'spec_entries', 'state_spec_fn',
+           'propagate_specs', 'SpmdTrainStep']
+
+
+def __getattr__(name):
+    # lazy: SpmdTrainStep/propagate_specs import the parallel/analysis
+    # stacks, which import this package — deferring breaks the cycle
+    if name == 'SpmdTrainStep':
+        from .spmd_step import SpmdTrainStep
+        return SpmdTrainStep
+    if name == 'propagate_specs':
+        from .propagation import propagate_specs
+        return propagate_specs
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

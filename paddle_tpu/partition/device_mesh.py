@@ -1,0 +1,144 @@
+"""Device-mesh construction — the only module that builds ``Mesh`` objects.
+
+Replaces the reference's NCCL communicator bootstrap
+(/root/reference/paddle/fluid/operators/collective/c_comm_init_op.cc,
+c_gen_nccl_id_op.cc): instead of exchanging NCCL unique ids over RPC, we
+build a jax.sharding.Mesh over the ICI/DCN topology and XLA lowers the
+collectives onto it. Every other module obtains meshes through the
+Partitioner (partition/partitioner.py); direct ``Mesh(`` construction
+outside ``partition/`` is a lint violation (tools/lint_codebase.py,
+``mesh-construction``) — hand-rolled meshes are exactly the per-module
+plumbing this subsystem retired.
+
+Axes convention (SURVEY §2.8, rules.MESH_AXES): dp (data), fsdp
+(sharded params), tp (tensor), pp (pipeline), sp (sequence).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from .rules import parse_mesh_shape
+
+__all__ = ['make_mesh', 'make_hybrid_mesh', 'mesh_from_env',
+           'process_mesh', 'topology', 'ENV_MESH']
+
+ENV_MESH = 'PADDLE_TPU_MESH'
+
+
+def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Create a Mesh with named axes, e.g. make_mesh({'dp': 4, 'tp': 2}).
+    Uses mesh_utils for ICI-aware device ordering when available; plain
+    reshape otherwise (the CPU-mesh fallback tests run on)."""
+    devices = devices if devices is not None else jax.devices()
+    shape = tuple(axes.values())
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh {axes} needs {n} devices, have {len(devices)}")
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(shape, devices[:n])
+    except Exception:
+        dev_array = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def make_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
+                     devices=None) -> Mesh:
+    """Multi-slice/pod mesh: `dcn_axes` span the data-center network
+    (slices), `ici_axes` the in-slice interconnect. This is the TPU
+    analogue of the reference's hierarchical allreduce
+    (ref: incubate/fleet DistributedStrategy.use_hierarchical_allreduce +
+    NCCL hierarchical comms): laying dp over DCN and tp/fsdp over ICI makes
+    XLA emit the two-level collective automatically. Uses
+    mesh_utils.create_hybrid_device_mesh when slice topology is available;
+    otherwise (single slice / CPU test mesh) falls back to a flat
+    ICI-ordered mesh with the same named axes."""
+    devices = devices if devices is not None else jax.devices()
+    overlap = set(dcn_axes) & set(ici_axes)
+    if overlap:
+        raise ValueError(
+            f"axis names {sorted(overlap)} appear in both dcn_axes and "
+            f"ici_axes")
+    dcn_shape = tuple(dcn_axes.values())
+    ici_shape = tuple(ici_axes.values())
+    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    n_dcn = int(np.prod(dcn_shape))
+    n_ici = int(np.prod(ici_shape))
+    if n_dcn * n_ici > len(devices):
+        raise ValueError(
+            f"hybrid mesh {dcn_axes}x{ici_axes} needs {n_dcn * n_ici} "
+            f"devices, have {len(devices)}")
+    by_slice: Dict[int, list] = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, 'slice_index', 0), []).append(d)
+    if len(by_slice) > 1:
+        # pick WHOLE slices (n_dcn of them × n_ici devices each) so the
+        # dcn axes really span DCN — a flat device prefix could land
+        # entirely inside one slice
+        usable = [ds[:n_ici] for ds in by_slice.values()
+                  if len(ds) >= n_ici]
+        if len(usable) < n_dcn:
+            raise ValueError(
+                f"hybrid mesh needs {n_dcn} slices with ≥{n_ici} devices "
+                f"each; have {[len(v) for v in by_slice.values()]}")
+        chosen = [d for ds in usable[:n_dcn] for d in ds]
+        # create_hybrid_device_mesh wants same-rank shapes and returns
+        # their ELEMENTWISE product; padding with 1s yields exactly
+        # dcn_shape + ici_shape in (dcn..., ici...) order
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            (1,) * len(dcn_shape) + ici_shape,
+            dcn_shape + (1,) * len(ici_shape), chosen)
+        return Mesh(dev_array, names)
+    # single slice / CPU test mesh: flat ICI-ordered mesh, same named axes
+    return make_mesh({**dcn_axes, **ici_axes}, devices[:n_dcn * n_ici])
+
+
+def mesh_from_env() -> Optional[Mesh]:
+    """Mesh described by ``PADDLE_TPU_MESH`` (e.g. ``"dp=2,tp=4"``), or
+    None when unset. Strict parse: unknown axis names / bad sizes raise
+    ValueError naming the supported set."""
+    spec = os.environ.get(ENV_MESH)
+    if not spec:
+        return None
+    return make_mesh(parse_mesh_shape(spec, source=ENV_MESH))
+
+
+_PROCESS_MESH: Optional[Mesh] = None
+
+
+def process_mesh() -> Mesh:
+    """One-device-per-process ('proc',) mesh for cross-process host
+    collectives (dygraph DataParallel grad sync), built once: reuse keeps
+    the jit cache warm, and picking each process's FIRST local device —
+    grouped by process_index, never by raw device id order, which JAX
+    does not guarantee to be process-contiguous — means every mesh row is
+    owned by exactly the process whose shard it carries."""
+    global _PROCESS_MESH
+    if _PROCESS_MESH is None:
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        devs = [per_proc[i] for i in sorted(per_proc)]
+        _PROCESS_MESH = Mesh(np.array(devs), ('proc',))
+    return _PROCESS_MESH
+
+
+def topology():
+    """Slice/pod topology report (ref: fleet's role maker endpoints)."""
+    devs = jax.devices()
+    info = {
+        'process_index': jax.process_index(),
+        'process_count': jax.process_count(),
+        'local_device_count': jax.local_device_count(),
+        'device_count': len(devs),
+        'platform': devs[0].platform if devs else 'none',
+    }
+    if hasattr(devs[0], 'coords'):
+        info['coords'] = [tuple(d.coords) for d in devs]
+    return info

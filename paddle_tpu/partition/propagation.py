@@ -1,0 +1,126 @@
+"""Sharding propagation over a Program — zero tracing.
+
+Given the partitioner's seed specs (persistables by the param rules,
+data vars by the batch rule), walk the global block once and derive a
+PartitionSpec entry tuple for every activation, using the PR 10
+``analysis/infer.py`` shape engine for rank/shape facts and a small
+per-op-category rule set mirroring how GSPMD actually propagates:
+
+- elementwise / same-shape unary ops carry their input's spec;
+- ``matmul``/``mul`` keep the row operand's batch/row sharding and take
+  the column sharding from the weight;
+- everything else (reshapes, reductions, concats, control flow)
+  conservatively replicates — a replicated activation is always
+  *correct*, just not maximally sharded, and the diagnostics in
+  analysis/checks.py only ever act on positively-asserted specs.
+
+The result is what gets stamped as ``program._partition_specs`` for the
+sharding-consistency diagnostics and recorded into checkpoint manifests.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..analysis.infer import InferError, infer_op, seed_env
+
+__all__ = ['propagate_specs', 'ELEMENTWISE_BINARY', 'SPEC_PRESERVING_UNARY']
+
+ELEMENTWISE_BINARY = frozenset((
+    'elementwise_add', 'elementwise_sub', 'elementwise_mul',
+    'elementwise_div', 'elementwise_max', 'elementwise_min',
+    'elementwise_pow', 'elementwise_mod', 'elementwise_floordiv',
+    'fused_elemwise_add_activation'))
+
+SPEC_PRESERVING_UNARY = frozenset((
+    'relu', 'sigmoid', 'tanh', 'exp', 'sqrt', 'rsqrt', 'abs', 'ceil',
+    'floor', 'cos', 'sin', 'round', 'reciprocal', 'log', 'square',
+    'softplus', 'softsign', 'sign', 'erf', 'gelu', 'leaky_relu', 'relu6',
+    'elu', 'selu', 'swish', 'scale', 'clip', 'assign', 'cast', 'dropout',
+    'softmax', 'log_softmax', 'prelu', 'pow', 'l2_normalize',
+    'fill_zeros_like'))
+
+_MATMUL = frozenset(('matmul', 'mul'))
+
+
+def _first(op, slot) -> Optional[str]:
+    names = op.inputs.get(slot) or ()
+    return names[0] if names else None
+
+
+def _has_assignment(entries):
+    return entries is not None and any(e is not None for e in entries)
+
+
+def propagate_specs(program, partitioner, seed=None) -> Dict[str, tuple]:
+    """``{var name: spec entries}`` for the program's global block:
+    ``seed`` (typically the partitioner's persistable/data specs) plus
+    propagated activation specs. Never raises on malformed programs —
+    inference failures just stop propagation at that op (the verifier
+    owns reporting them)."""
+    specs: Dict[str, tuple] = dict(seed or {})
+    env = seed_env(program)
+    blk = program.global_block()
+
+    def padded(name):
+        """Spec entries padded with None to the var's known rank —
+        PartitionSpec semantics leave trailing dims implicit, but the
+        positional arithmetic below needs them explicit."""
+        e = specs.get(name)
+        if e is None:
+            return None
+        info = env.get(name)
+        if info is not None and info.shape is not None \
+                and len(e) < len(info.shape):
+            e = tuple(e) + (None,) * (len(info.shape) - len(e))
+        return tuple(e)
+
+    for op in blk.ops:
+        out = None
+        if op.type in ELEMENTWISE_BINARY:
+            xs = padded(_first(op, 'x'))
+            ys = padded(_first(op, 'y'))
+            out = xs if _has_assignment(xs) else ys
+        elif op.type in SPEC_PRESERVING_UNARY:
+            out = padded(_first(op, 'x'))
+        elif op.type in _MATMUL:
+            xs = padded(_first(op, 'x')) or ()
+            ys = padded(_first(op, 'y')) or ()
+            row = tuple(xs[:-1]) if len(xs) else ()
+            col = tuple(ys[-1:]) if len(ys) else (None,)
+            # a mesh axis may not repeat within one tensor: the
+            # contraction result drops the column sharding on collision
+            used = {a for e in row if e is not None
+                    for a in (e if isinstance(e, tuple) else (e,))}
+            col = tuple(None if (e is not None and any(
+                a in used for a in (e if isinstance(e, tuple) else (e,))))
+                else e for e in col)
+            if row or _has_assignment(col):
+                out = row + col
+        if not _has_assignment(out):
+            out = None
+
+        # shape engine keeps env current + guards the propagated rank
+        infos = None
+        try:
+            infos = infer_op(op, env, blk)
+        except InferError:
+            infos = None
+        out_names = op.output_names()
+        ranks = {}
+        if infos:
+            for slot, res in infos.items():
+                names = op.outputs.get(slot, [])
+                vals = (list(res) if isinstance(res, (tuple, list))
+                        else [res] * len(names))
+                for n, info in zip(names, vals):
+                    if info is not None:
+                        env[n] = info
+                        if info.shape is not None:
+                            ranks[n] = len(info.shape)
+        for n in out_names:
+            if out is None:
+                continue
+            if n in ranks and ranks[n] != len(out):
+                continue                      # rank changed: replicate
+            specs[n] = tuple(out)
+    return specs

@@ -1,0 +1,223 @@
+"""Logical axis rules: the T5X-style table mapping LOGICAL tensor axes
+('batch', 'embed', 'mlp', …) onto MESH axes ('dp', 'tp', 'fsdp', …).
+
+Models and recipes talk about what a dimension *means*; the Partitioner
+owns how meaning maps onto hardware. The table is ORDERED — the first
+rule whose mesh axes exist in the mesh, are not already used by another
+dimension of the same tensor, and divide the dimension size wins; no
+rule matching means the dimension replicates. That one lookup is what
+lets `dp`, `dp×tp`, `dp×fsdp`, and `fsdp`-only meshes share every model
+definition (SNIPPETS.md [1]–[3] pattern).
+
+Parsing is strict (the PR 8/9 knob-hygiene contract): unknown logical
+or mesh axis names raise ``ValueError`` listing the supported set, both
+from the env knobs (``PADDLE_TPU_AXIS_RULES`` / ``PADDLE_TPU_MESH``)
+and from ``DistributedStrategy.axis_rules`` / ``mesh_shape``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import PartitionSpec
+
+__all__ = ['LOGICAL_AXES', 'MESH_AXES', 'DEFAULT_AXIS_RULES', 'AxisRules',
+           'parse_axis_rules', 'parse_mesh_shape', 'largest_divisible_dim']
+
+# logical tensor-dimension names models/recipes may use (SURVEY §2.8 +
+# the T5X convention). 'fsdp' doubles as a logical name so a parameter
+# can *ask* for ZeRO-style sharding of a specific dim.
+LOGICAL_AXES = ('batch', 'embed', 'mlp', 'heads', 'kv', 'vocab', 'seq',
+                'stage', 'fsdp')
+
+# mesh axis-name convention: dp (data), fsdp (sharded params), tp
+# (tensor), pp (pipeline), sp (sequence).
+MESH_AXES = ('dp', 'fsdp', 'tp', 'pp', 'sp')
+
+# Ordered rule table. A value may be one mesh axis, a tuple (the dim
+# shards over their product, e.g. batch over dp×fsdp), or None
+# (explicitly replicated). First match wins.
+DEFAULT_AXIS_RULES = (
+    ('batch', ('dp', 'fsdp')),
+    ('fsdp', 'fsdp'),
+    ('mlp', 'tp'),
+    ('heads', 'tp'),
+    ('vocab', 'tp'),
+    ('kv', None),
+    ('embed', None),
+    ('seq', 'sp'),
+    ('stage', 'pp'),
+)
+
+
+def _err(source, what, value, supported):
+    raise ValueError(
+        f"{source}: unknown {what} {value!r} "
+        f"(supported: {', '.join(supported)})")
+
+
+def _norm_value(value, source):
+    """Rule value → tuple of mesh axes, or None (replicated)."""
+    if value is None or value == '':
+        return None
+    if isinstance(value, str):
+        value = tuple(v for v in value.replace('+', ' ').split() if v)
+    axes = tuple(value)
+    for a in axes:
+        if a not in MESH_AXES:
+            _err(source, 'mesh axis', a, MESH_AXES)
+    return axes or None
+
+
+def parse_axis_rules(value, source='axis_rules'):
+    """Strict parse of an axis-rule table.
+
+    Accepts ``None`` (→ None), a string ``"batch=dp+fsdp,mlp=tp,kv="``
+    (``=`` with an empty right side pins a logical axis to replicated),
+    or a sequence of ``(logical, mesh_axis_or_tuple_or_None)`` pairs.
+    Unknown logical/mesh names raise ValueError naming the supported set.
+    """
+    if value is None:
+        return None
+    if isinstance(value, str):
+        pairs = []
+        for item in value.split(','):
+            item = item.strip()
+            if not item:
+                continue
+            if '=' not in item:
+                raise ValueError(
+                    f"{source}: expected 'logical=mesh' entries, got "
+                    f"{item!r} (e.g. 'batch=dp,mlp=tp,kv=')")
+            k, v = item.split('=', 1)
+            pairs.append((k.strip(), v.strip()))
+        value = pairs
+    out = []
+    for entry in value:
+        if not isinstance(entry, (tuple, list)) or len(entry) != 2:
+            raise ValueError(
+                f"{source}: each rule must be a (logical, mesh) pair, "
+                f"got {entry!r}")
+        logical, mesh_axes = entry
+        if logical not in LOGICAL_AXES:
+            _err(source, 'logical axis', logical, LOGICAL_AXES)
+        out.append((logical, _norm_value(mesh_axes, source)))
+    return tuple(out)
+
+
+def parse_mesh_shape(value, source='mesh_shape'):
+    """Strict parse of a mesh shape: dict or ``"dp=2,tp=4"`` string →
+    ordered ``{axis: size}``. Unknown axis names and non-positive sizes
+    raise ValueError."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        pairs = []
+        for item in value.split(','):
+            item = item.strip()
+            if not item:
+                continue
+            if '=' not in item:
+                raise ValueError(
+                    f"{source}: expected 'axis=size' entries, got "
+                    f"{item!r} (e.g. 'dp=2,tp=4')")
+            k, v = item.split('=', 1)
+            pairs.append((k.strip(), v.strip()))
+        value = pairs
+    items = value.items() if isinstance(value, dict) else value
+    out: Dict[str, int] = {}
+    for axis, size in items:
+        if axis not in MESH_AXES:
+            _err(source, 'mesh axis', axis, MESH_AXES)
+        try:
+            size = int(size)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"{source}: size of mesh axis {axis!r} must be an int, "
+                f"got {size!r}")
+        if size < 1:
+            raise ValueError(
+                f"{source}: size of mesh axis {axis!r} must be >= 1, "
+                f"got {size}")
+        if axis in out:
+            raise ValueError(f"{source}: mesh axis {axis!r} given twice")
+        out[axis] = size
+    return out or None
+
+
+def largest_divisible_dim(shape, size) -> Optional[int]:
+    """Index of the LARGEST dim divisible by ``size`` (and >= it), or
+    None. Largest-dim wins: maximizes bytes saved per device and keeps
+    the all-gather contiguous — the ZeRO/fsdp placement rule."""
+    best, best_size = None, 0
+    for d, s in enumerate(shape):
+        if isinstance(s, int) and s % size == 0 and s >= size \
+                and s > best_size:
+            best, best_size = d, s
+    return best
+
+
+class AxisRules:
+    """Ordered, validated logical→mesh rule table."""
+
+    __slots__ = ('_rules',)
+
+    def __init__(self, rules=None):
+        self._rules = parse_axis_rules(
+            DEFAULT_AXIS_RULES if rules is None else rules) or ()
+
+    @property
+    def rules(self) -> Tuple:
+        return self._rules
+
+    def candidates(self, logical) -> Sequence[Optional[Tuple[str, ...]]]:
+        """Rule values for ``logical``, in table order."""
+        return [v for k, v in self._rules if k == logical]
+
+    def resolve(self, logical, axis_sizes: Dict[str, int], taken=(),
+                dim=None):
+        """Mesh axes ``logical`` shards over in a mesh with
+        ``axis_sizes``: the first rule whose (mesh-present, un-``taken``)
+        axes divide ``dim`` (when known). None → replicate."""
+        if logical is None:
+            return None
+        for value in self.candidates(logical):
+            if value is None:
+                return None
+            axes = tuple(a for a in value
+                         if axis_sizes.get(a, 0) > 1 and a not in taken)
+            if not axes:
+                continue
+            span = int(np.prod([axis_sizes[a] for a in axes]))
+            if isinstance(dim, int) and dim % span != 0:
+                continue
+            return axes
+        return None
+
+    def spec(self, logical_axes, axis_sizes: Dict[str, int],
+             shape=None) -> PartitionSpec:
+        """Resolve a whole logical spec (one logical name or None per
+        dim) into a PartitionSpec, never assigning a mesh axis twice."""
+        taken: set = set()
+        entries = []
+        for i, logical in enumerate(logical_axes):
+            dim = None
+            if shape is not None and i < len(shape) \
+                    and isinstance(shape[i], int):
+                dim = shape[i]
+            axes = self.resolve(logical, axis_sizes, taken=taken, dim=dim)
+            if axes is None:
+                entries.append(None)
+            else:
+                taken.update(axes)
+                entries.append(axes[0] if len(axes) == 1 else axes)
+        while entries and entries[-1] is None:
+            entries.pop()
+        return PartitionSpec(*entries)
+
+    def to_json(self):
+        return [[k, list(v) if v is not None else None]
+                for k, v in self._rules]
+
+    def __repr__(self):
+        return f'AxisRules({self._rules!r})'

@@ -1,0 +1,233 @@
+"""Composed DP×TP×FSDP training step — the partitioner's pjit-style
+lowering of a functional train loop onto ONE mesh.
+
+This is the composition `DistributedStrategy` could never express before
+(ROADMAP item 1): batch sharded over the data axes, Megatron-marked
+parameters sharded over ``tp``, ZeRO parameters stored as 1/p tiles over
+``fsdp`` (gathered just-in-time inside the step), and EVERY gradient
+sync routed through the PR 9 quantized collectives
+(``parallel/quant_collectives.py``) keyed by mesh axis — replicated
+parameters' gradients additionally coalesce into
+``PADDLE_TPU_ALLREDUCE_BUCKET_MB``-capped buckets (the PR 9 bucketing
+semantics applied to the functional path).
+
+``loss_fn(params, batch) -> scalar`` runs INSIDE the shard_map: it sees
+the full (gathered) value of fsdp parameters, the LOCAL tile of
+tp-sharded parameters (write the Megatron dataflow with
+``lax.psum(..., tp_axis)``, or use parallel/tensor_parallel.py's
+primitives), and the local batch shard; the loss must be the mean over
+the local shard. Exact `comm_dtype='f32'` passthrough keeps every sync
+a plain lax collective.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import compat
+from ..parallel import quant_collectives as qc
+from .partitioner import get_partitioner, spec_entries
+
+__all__ = ['SpmdTrainStep']
+
+
+def _flat_axes(entries):
+    out = []
+    for e in entries:
+        if e is None:
+            continue
+        out.extend(e if isinstance(e, tuple) else (e,))
+    return out
+
+
+class SpmdTrainStep:
+    """One jitted SGD step over the partitioner's mesh with composed
+    data/tensor/fsdp parallelism and quantized, bucketed gradient sync.
+
+        p = partition.configure(mesh_shape={'dp': 2, 'fsdp': 4})
+        step = SpmdTrainStep(loss_fn, params, partitioner=p, lr=0.1)
+        for batch in data:          # leading dim = GLOBAL batch
+            loss = step(batch)
+        final = step.materialize()
+    """
+
+    def __init__(self, loss_fn, params, partitioner=None, lr=0.1,
+                 comm_dtype=None, bucket_mb=None):
+        p = partitioner or get_partitioner()
+        mesh = p.mesh
+        if mesh is None:
+            raise ValueError(
+                'SpmdTrainStep: partitioner has no mesh (configure() a '
+                'mesh_shape or set PADDLE_TPU_MESH)')
+        self._p = p
+        self._comm = qc.resolve_comm_dtype(comm_dtype)
+        data_axes = tuple(p.data_axes())
+        fsdp_axes = p.mesh_axes_for('fsdp') or ()
+        fsdp_ax = fsdp_axes[0] if fsdp_axes else None
+        self._n_data = max(1, p.axis_size(data_axes))
+        self._data_axes = data_axes
+
+        entries: Dict[str, tuple] = {}
+        fsdp_dim: Dict[str, Optional[int]] = {}
+        kinds: Dict[str, str] = {}
+        arrays = {n: jnp.asarray(v) for n, v in params.items()}
+        for n, v in arrays.items():
+            e = spec_entries(p.param_spec(n, v.shape))
+            e = e + (None,) * (v.ndim - len(e))
+            axes = _flat_axes(e)
+            if fsdp_ax is not None and fsdp_ax in axes:
+                kinds[n] = 'fsdp'
+                fsdp_dim[n] = next(i for i, x in enumerate(e)
+                                   if x is not None
+                                   and fsdp_ax in ((x,) if isinstance(
+                                       x, str) else x))
+            elif axes:
+                kinds[n] = 'tp'                 # device-varying tile
+            else:
+                kinds[n] = 'replicated'
+            entries[n] = e
+        self._kinds = kinds
+
+        # sharded storage: each param placed per its spec ONCE; step
+        # outputs keep the sharding (donated in-place update)
+        self._params = {
+            n: jax.device_put(v, NamedSharding(mesh, P(*entries[n])))
+            for n, v in arrays.items()}
+
+        # replicated-gradient buckets (PR 9 size cap, f32 elements)
+        from ..ir.bucket_allreduce import bucket_cap_bytes
+        cap = (int(float(bucket_mb) * (1 << 20)) if bucket_mb is not None
+               else bucket_cap_bytes())
+        repl = [n for n in sorted(arrays) if kinds[n] == 'replicated']
+        buckets, cur, cur_bytes = [], [], 0
+        for n in repl:
+            nbytes = int(arrays[n].size) * 4
+            if cur and cur_bytes + nbytes > cap:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(n)
+            cur_bytes += nbytes
+        if cur:
+            buckets.append(cur)
+        self._buckets = buckets
+        tp_names = [n for n in sorted(arrays) if kinds[n] == 'tp']
+        fsdp_names = [n for n in sorted(arrays) if kinds[n] == 'fsdp']
+        other_axes = tuple(a for a in mesh.axis_names
+                           if a not in data_axes)
+        all_axes = tuple(mesh.axis_names)
+        n_data = self._n_data
+        comm = self._comm
+        shapes = {n: arrays[n].shape for n in arrays}
+
+        # host-side telemetry plan: one record per collective dispatched
+        # inside the jitted body, per step (docs/OBSERVABILITY.md)
+        recs = []
+        for names in buckets:
+            elems = sum(int(np.prod(shapes[n]) or 1) for n in names)
+            for ax in data_axes:
+                recs.append((elems, p.axis_size(ax), 2))
+        for n in tp_names:
+            elems = int(np.prod(shapes[n]) or 1)
+            for ax in data_axes:
+                recs.append((elems, p.axis_size(ax), 2))
+        for n in fsdp_names:
+            elems = int(np.prod(shapes[n]) or 1)
+            recs.append((elems, p.axis_size(fsdp_ax), 1))  # reduce-scatter
+            for ax in data_axes:
+                if ax != fsdp_ax:
+                    recs.append((elems // p.axis_size(fsdp_ax),
+                                 p.axis_size(ax), 2))
+        self._sync_records = recs
+
+        def sync_data(g, skip=()):
+            for ax in data_axes:
+                if ax not in skip:
+                    g = qc.qallreduce_sum(g, ax, comm_dtype=comm)
+            return g
+
+        def body(ptiles, batch):
+            full = {}
+            for n, v in ptiles.items():
+                if kinds[n] == 'fsdp':
+                    full[n] = lax.all_gather(v, fsdp_ax,
+                                             axis=fsdp_dim[n], tiled=True)
+                else:
+                    full[n] = v
+            loss, grads = jax.value_and_grad(loss_fn)(full, batch)
+            new = {}
+            for n in fsdp_names:
+                d = fsdp_dim[n]
+                g = qc.qreduce_scatter_sum(grads[n], fsdp_ax,
+                                           comm_dtype=comm,
+                                           scattered_dimension=d)
+                g = sync_data(g, skip=(fsdp_ax,)) / n_data
+                new[n] = ptiles[n] - lr * g
+            for n in tp_names:
+                g = sync_data(grads[n]) / n_data
+                new[n] = ptiles[n] - lr * g
+            for names in buckets:
+                flat = jnp.concatenate(
+                    [jnp.ravel(grads[n]).astype(jnp.float32)
+                     for n in names]) if len(names) > 1 else \
+                    jnp.ravel(grads[names[0]]).astype(jnp.float32)
+                flat = sync_data(flat) / n_data
+                for ax in other_axes:
+                    # correct tp formulations produce identical grads for
+                    # replicated params on every tp shard; the pmean is a
+                    # value no-op that establishes replication for the
+                    # out-spec typing
+                    flat = lax.pmean(flat, ax)
+                off = 0
+                for n in names:
+                    sz = int(np.prod(shapes[n]) or 1)
+                    seg = flat[off:off + sz]
+                    g = seg.reshape(shapes[n]).astype(ptiles[n].dtype)
+                    new[n] = ptiles[n] - lr * g
+                    off += sz
+            return new, lax.pmean(loss, all_axes)
+
+        pspec = {n: P(*entries[n]) for n in arrays}
+        bspec = P(data_axes if len(data_axes) != 1 else data_axes[0]) \
+            if data_axes else P()
+        fn = compat.shard_map(body, mesh=mesh, in_specs=(pspec, bspec),
+                              out_specs=(pspec, P()))
+        from ..core.compile_cache import setup_persistent_cache
+        setup_persistent_cache()
+        self._step = jax.jit(fn, donate_argnums=(0,))
+        self._mesh = mesh
+
+    # ------------------------------------------------------------------
+    def __call__(self, batch):
+        batch = jnp.asarray(batch)
+        if self._n_data > 1 and batch.shape[0] % self._n_data:
+            raise ValueError(
+                f'SpmdTrainStep: global batch {batch.shape[0]} is not '
+                f'divisible by the data-axis span {self._n_data} '
+                f'({self._data_axes})')
+        for elems, axis_size, phases in self._sync_records:
+            qc.record_collective('spmd_step', elems, self._comm,
+                                 axis_size, phases=phases)
+        self._params, loss = self._step(self._params, batch)
+        return loss
+
+    @property
+    def sync_calls_per_step(self):
+        """Collectives dispatched per step (buckets + per-tile syncs) —
+        the bucketing win is this being << the parameter count."""
+        return len(self._sync_records)
+
+    def sharded_params(self):
+        """name → the live global (possibly sharded) jax arrays."""
+        return dict(self._params)
+
+    def materialize(self):
+        """name → full host numpy values (gathers fsdp/tp tiles)."""
+        return {n: np.asarray(v) for n, v in self._params.items()}
+
+    def param_kind(self, name):
+        return self._kinds[name]

@@ -126,6 +126,18 @@ def capture_training_state(executor=None, program=None, scope=None,
                 handles[v.name] = FetchHandle(val, name=v.name)
         arrays.update({SCOPE_PREFIX + n: h for n, h in handles.items()})
 
+    if program is not None:
+        # partitioner-keyed spec manifest (docs/PARTITIONER.md): mesh
+        # topology + rule table + per-persistable PartitionSpecs recorded
+        # with every checkpoint, so a restore can re-shard state onto a
+        # DIFFERENT mesh — the prerequisite for sharded per-host
+        # save/load (ROADMAP item 2)
+        from ..partition import get_partitioner
+        part = get_partitioner()
+        if part.mesh is not None:
+            meta['partition'] = part.state_manifest(
+                program, fsdp_axis=getattr(program, '_fsdp_axis', None))
+
     if loader is not None:
         meta['loader'] = loader.state_dict()
     if extra:

@@ -63,6 +63,26 @@ def test_jit_ok_with_cache_setup(tmp_path):
     assert vs == []
 
 
+def test_detects_direct_mesh_construction(tmp_path):
+    vs = _probe(tmp_path, '''
+        import numpy as np
+        from jax.sharding import Mesh
+        m = Mesh(np.array([0]), ('dp',))
+        ''')
+    assert [v.rule for v in vs] == ['mesh-construction']
+
+
+def test_mesh_construction_allowed_in_partition(tmp_path):
+    p = tmp_path / 'paddle_tpu' / 'partition' / 'probe.py'
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent('''
+        import numpy as np
+        from jax.sharding import Mesh
+        m = Mesh(np.array([0]), ('dp',))
+        '''))
+    assert lint_file(str(p), 'paddle_tpu/partition/probe.py') == []
+
+
 def test_suppression_markers(tmp_path):
     vs = _probe(tmp_path, '''
         import numpy as np

@@ -1,0 +1,208 @@
+"""Partitioner bench: spec-resolution throughput + composition parity.
+
+Three sections (one JSON line each, like the sibling bench tools):
+
+- ``partition_spec_resolution`` — wall time for the Partitioner to
+  resolve a PartitionSpec for EVERY persistable + activation of a real
+  recipe Program (the multi-param Adam MLP bench_passes builds), zero
+  tracing: this is the per-compile-cache-miss cost the Executor pays
+  when lowering a partitioned program. Reported per-Program and per-var.
+- ``partition_parity`` — the refactored spec paths agree with the
+  retired per-module plumbing: `fsdp.fsdp_spec` ≡ partitioner fsdp
+  rule over a shape battery, Megatron marker specs ≡
+  `tensor_parallel.megatron_param_spec`, and the data spec composes
+  over dp×fsdp. Assertion failures exit non-zero.
+- ``partition_composition`` — SpmdTrainStep dp×fsdp and dp×tp smoke
+  training vs a single-device reference (allclose), with the
+  quantized-collective sync-call counters asserted (the PR 9 path).
+
+  JAX_PLATFORMS=cpu python tools/bench_partition.py [--smoke]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)          # lint: allow-print (CLI)
+
+
+def _build_recipe(smoke):
+    sys.path.insert(0, os.path.join(_REPO, 'tools'))
+    from bench_passes import build_mlp_adam
+    return build_mlp_adam(smoke=smoke)
+
+
+def measure_spec_resolution(iters=20, smoke=False):
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu import partition
+    main, _startup, _make_feed, _fetch = _build_recipe(smoke)
+    p = partition.Partitioner(mesh_shape={'dp': 2, 'fsdp': 4})
+    ts = []
+    specs = {}
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        specs = p.program_specs(main, include_activations=True)
+        ts.append(time.perf_counter() - t0)
+    med = statistics.median(ts)
+    return {'bench': 'partition_spec_resolution',
+            'ops': main.num_ops(),
+            'vars_resolved': len(specs),
+            'resolve_s': round(med, 6),
+            'vars_per_s': round(len(specs) / med) if med else None}
+
+
+def measure_parity():
+    import numpy as np
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu import partition
+    from paddle_tpu.parallel import fsdp as F
+    from paddle_tpu.parallel.tensor_parallel import megatron_param_spec
+    checked = 0
+    mesh = partition.make_mesh({'fsdp': 8})
+    p = partition.Partitioner(mesh=mesh)
+    rng = np.random.RandomState(0)
+    shapes = [(64, 32), (32, 64), (8,), (3, 5), (1,), (16, 16, 4),
+              (24, 7), (7, 24), (8, 8)]
+    for s in shapes:
+        assert p.fsdp_spec(s) == F.fsdp_spec(s, mesh), s
+        checked += 1
+    tp_mesh = partition.make_mesh({'tp': 8})
+    p = partition.Partitioner(mesh=tp_mesh)
+    for name in ('layer.ffn1.w', 'enc.q_proj.w', 'blk.ffn2.w',
+                 'att.out_proj.w', 'plain.w'):
+        arr = rng.randn(64, 32).astype('float32')
+        assert tuple(p.param_spec(name, arr.shape)) == tuple(
+            megatron_param_spec(name, arr)), name
+        checked += 1
+    p = partition.Partitioner(mesh_shape={'dp': 2, 'fsdp': 4})
+    assert tuple(p.data_spec(16)) == (('dp', 'fsdp'),)
+    assert p.data_axes() == ('dp', 'fsdp')
+    checked += 2
+    return {'bench': 'partition_parity', 'assertions': checked, 'ok': True}
+
+
+def _reference_sgd(loss_fn, params, batch, lr, steps):
+    import jax
+    import jax.numpy as jnp
+    ps = {k: jnp.asarray(v) for k, v in params.items()}
+    losses = []
+    for _ in range(steps):
+        l, g = jax.value_and_grad(loss_fn)(ps, jnp.asarray(batch))
+        ps = {k: v - lr * g[k] for k, v in ps.items()}
+        losses.append(float(l))
+    return losses, ps
+
+
+def measure_composition(smoke=False, steps=4):
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu import observability as obs, partition
+    from paddle_tpu.partition.spmd_step import SpmdTrainStep
+    from paddle_tpu.parallel.tensor_parallel import mp_allreduce, mp_copy
+    d = 16 if smoke else 64
+    h = 32 if smoke else 256
+    b = 16 if smoke else 64
+    lr = 0.1
+    rng = np.random.RandomState(0)
+    W1 = (rng.randn(d, h) * 0.1).astype('float32')
+    W2 = (rng.randn(h, 1) * 0.1).astype('float32')
+    bias = np.zeros((1,), 'float32')
+    X = rng.randn(b, d).astype('float32')
+    batch = np.concatenate([X, X[:, :1]], axis=1)
+
+    def ref_loss(ps, bt):
+        x, y = bt[:, :-1], bt[:, -1:]
+        hh = jnp.maximum(x @ ps['ffn1.w'], 0.0)
+        return jnp.mean(((hh @ ps['ffn2.w'] + ps['b']) - y) ** 2)
+
+    ref_losses, _ = _reference_sgd(
+        ref_loss, {'ffn1.w': W1, 'ffn2.w': W2, 'b': bias}, batch, lr, steps)
+
+    out = {'bench': 'partition_composition', 'steps': steps}
+    with obs.telemetry_guard(True):
+        # dp×fsdp: fc weights tile over fsdp, bias buckets over dp+fsdp
+        obs.reset()
+        p = partition.Partitioner(mesh_shape={'dp': 2, 'fsdp': 4})
+        step = SpmdTrainStep(ref_loss, {'ffn1.w': W1, 'ffn2.w': W2,
+                                        'b': bias}, partitioner=p, lr=lr)
+        fsdp_losses = [float(step(batch)) for _ in range(steps)]
+        m = obs.registry.to_dict()
+        calls = sum(s['value'] for s in
+                    m['collective_sync_calls']['samples']
+                    if s['labels'].get('path') == 'spmd_step')
+        np.testing.assert_allclose(fsdp_losses, ref_losses,
+                                   rtol=5e-4, atol=1e-5)
+        assert calls == step.sync_calls_per_step * steps
+        out['dp_fsdp_max_rel_err'] = float(np.max(np.abs(
+            (np.asarray(fsdp_losses) - np.asarray(ref_losses))
+            / np.asarray(ref_losses))))
+        out['dp_fsdp_sync_calls_per_step'] = step.sync_calls_per_step
+
+        # dp×tp: Megatron col+row MLP via the f/g conjugate collectives
+        def tp_loss(ps, bt):
+            x, y = bt[:, :-1], bt[:, -1:]
+            x = mp_copy(x, 'tp')
+            hh = jnp.maximum(x @ ps['ffn1.w'], 0.0)
+            part = hh @ ps['ffn2.w']
+            return jnp.mean(((mp_allreduce(part, 'tp') + ps['b']) - y) ** 2)
+
+        obs.reset()
+        p = partition.Partitioner(mesh_shape={'dp': 2, 'tp': 4})
+        step = SpmdTrainStep(tp_loss, {'ffn1.w': W1, 'ffn2.w': W2,
+                                       'b': bias}, partitioner=p, lr=lr)
+        tp_losses = [float(step(batch)) for _ in range(steps)]
+        np.testing.assert_allclose(tp_losses, ref_losses,
+                                   rtol=5e-4, atol=1e-5)
+        out['dp_tp_max_rel_err'] = float(np.max(np.abs(
+            (np.asarray(tp_losses) - np.asarray(ref_losses))
+            / np.asarray(ref_losses))))
+        out['dp_tp_sync_calls_per_step'] = step.sync_calls_per_step
+    out['ok'] = True
+    return out
+
+
+def measure_all(smoke=False, iters=None):
+    """All sections as one dict (bench.py's `partitioner` line)."""
+    return {
+        'partition_spec_resolution': measure_spec_resolution(
+            iters=iters or (3 if smoke else 20), smoke=smoke),
+        'partition_parity': measure_parity(),
+        'partition_composition': measure_composition(smoke=smoke),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--smoke', action='store_true',
+                    help='tiny sizes + few iters (tier-1 CI)')
+    args = ap.parse_args(argv)
+    iters = 3 if args.smoke else 20
+    res = measure_spec_resolution(iters=iters, smoke=args.smoke)
+    emit(res)
+    emit(measure_parity())
+    emit(measure_composition(smoke=args.smoke))
+    emit({'bench': 'partition_summary',
+          'resolve_s': res['resolve_s'],
+          'vars_per_s': res['vars_per_s'], 'ok': True})
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

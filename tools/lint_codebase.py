@@ -17,6 +17,11 @@ until now enforced only by review:
   (core.compile_cache.setup_persistent_cache); a stray jit in a process
   that never built an Executor recompiles from scratch on every run.
   Lower-only jits (no XLA compile) carry ``# lint: allow-jit``.
+- ``mesh-construction`` — ``jax.sharding.Mesh`` objects are built ONLY
+  inside ``paddle_tpu/partition/`` (PR 11: the unified SPMD partitioner
+  owns the device mesh; hand-rolled per-module meshes are exactly the
+  plumbing it retired). Everything else resolves meshes through
+  ``partition.get_partitioner()`` / the ``partition.make_mesh`` builders.
 
 Suppression: ``# lint: allow-<rule>`` on the violating line or the line
 directly above it. Run:
@@ -42,6 +47,7 @@ EXEMPT = {
     'bare-print': ('utils/',),
     'atomic-io': ('io.py', 'resilience/snapshot.py'),
     'jit-compile-cache': (),
+    'mesh-construction': ('partition/',),
 }
 
 
@@ -58,7 +64,8 @@ class Violation(NamedTuple):
 def _suppressed(lines, lineno, rule):
     tag = {'bare-print': 'lint: allow-print',
            'atomic-io': 'lint: allow-io',
-           'jit-compile-cache': 'lint: allow-jit'}[rule]
+           'jit-compile-cache': 'lint: allow-jit',
+           'mesh-construction': 'lint: allow-mesh'}[rule]
     for ln in (lineno, lineno - 1):
         if 1 <= ln <= len(lines) and tag in lines[ln - 1]:
             return True
@@ -118,6 +125,15 @@ def lint_file(path, rel):
                 'jit-compile-cache', rel, node.lineno,
                 'jax.jit without core.compile_cache.setup_persistent_cache '
                 'in this module bypasses the persistent XLA compile cache'))
+        elif (target == 'Mesh' or target.endswith('.Mesh')) \
+                and not exempt('mesh-construction') \
+                and not _suppressed(lines, node.lineno, 'mesh-construction'):
+            out.append(Violation(
+                'mesh-construction', rel, node.lineno,
+                'direct Mesh() construction outside paddle_tpu/partition/ '
+                'hand-rolls mesh plumbing the unified partitioner owns; '
+                'use partition.make_mesh / get_partitioner() (mark '
+                'deliberate cases with "# lint: allow-mesh (<reason>)")'))
     return out
 
 
