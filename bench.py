@@ -477,6 +477,37 @@ def bench_collectives_section(on_tpu):
     return out
 
 
+def bench_fleet_section(on_tpu):
+    """Fleet weak scaling (PERF.md §18). Runs in a SUBPROCESS per fleet
+    size: each worker is a REAL jax.distributed process (gloo CPU
+    collectives) through the executor spine. Valid on CPU: the quantity
+    under test is the fleet runtime's overhead against perfect
+    timesharing (samples/s-normalized weak-scaling efficiency), which is
+    the transferable number; acceptance ≥0.8 at nproc=2 for the
+    compute-bound recipe."""
+    import subprocess
+    env = dict(os.environ)
+    if not on_tpu:
+        env['JAX_PLATFORMS'] = 'cpu'
+    env.pop('XLA_FLAGS', None)        # workers own one device each
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)), 'tools',
+                      'bench_fleet.py'), '--nprocs', '1,2,4']
+        + ([] if on_tpu else []),
+        env=env, capture_output=True, text=True, timeout=1500)
+    if r.returncode != 0:
+        raise RuntimeError(f'bench_fleet failed: {r.stderr[-2000:]}')
+    out = {}
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith('{'):
+            d = json.loads(line)
+            if d['bench'] == 'fleet_weak_scaling_summary':
+                out = d
+    return out
+
+
 def bench_telemetry_sidecar(on_tpu):
     """Telemetry sidecar for the bench run: the headline benches above run
     with telemetry off (their numbers stay comparable across PRs), then the
@@ -670,6 +701,16 @@ def main():
             ['resolve_s'],
             partition_parity_ok=pt['partition_parity']['ok'],
             partition_composition_ok=pt['partition_composition']['ok'])
+
+    fw = run("fleet_runtime", lambda: bench_fleet_section(on_tpu))
+    if fw is not None:
+        emit({"metric": "fleet_runtime",
+              "steps_per_s": fw.get('steps_per_s'),
+              "samples_per_s": fw.get('samples_per_s'),
+              "efficiency": fw.get('efficiency')})
+        summary.update(
+            fleet_efficiency_nproc2=fw.get('efficiency_nproc2'),
+            fleet_acceptance_ge_0_8=fw.get('acceptance_ge_0_8'))
 
     s = run("telemetry_sidecar", lambda: bench_telemetry_sidecar(on_tpu))
     if s is not None:

@@ -1210,6 +1210,8 @@ for _t in _OPT_MIRROR:
 _FUSED_OPT_MIRROR = {
     'fused_sgd': {'ParamOut': 'params'},
     'fused_momentum': {'ParamOut': 'params', 'VelocityOut': 'velocities'},
+    'fused_lars_momentum': {'ParamOut': 'params',
+                            'VelocityOut': 'velocities'},
     'fused_adam': {'ParamOut': 'params', 'Moment1Out': 'moment1s',
                    'Moment2Out': 'moment2s'},
 }

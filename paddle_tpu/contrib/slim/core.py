@@ -166,6 +166,15 @@ class Context:
                 [a + v for a, v in zip(accum, vals)]
             batches += 1
         assert batches, "eval_reader yielded no batches"
+        # fleet-global eval (docs/DISTRIBUTED.md): on a multi-host fleet
+        # each host evaluated its own shard of the eval stream; sum the
+        # per-host metric accumulators AND batch counts so the reported
+        # numbers are over the WHOLE eval set, identical on every host
+        import jax as _jax
+        if _jax.process_count() > 1:
+            from ...fleet_runtime import fleet_allreduce_scalars
+            reduced = fleet_allreduce_scalars(accum + [float(batches)])
+            accum, batches = reduced[:-1], reduced[-1]
         result = {n: a / batches for n, a in zip(names, accum)}
         for n, v in result.items():
             self.eval_results.setdefault(n, []).append(v)

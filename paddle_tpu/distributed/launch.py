@@ -14,18 +14,22 @@ import jax
 
 def init_parallel_env(coordinator_address=None, num_processes=None,
                       process_id=None):
-    """Initialize multi-host jax runtime. No-op on single host."""
-    if num_processes is None:
-        num_processes = int(os.environ.get('PADDLE_TRAINERS_NUM', '1'))
-    if num_processes <= 1:
-        return
-    kwargs = {}
-    if coordinator_address:
-        kwargs['coordinator_address'] = coordinator_address
-        kwargs['num_processes'] = num_processes
-        kwargs['process_id'] = process_id or int(
-            os.environ.get('PADDLE_TRAINER_ID', '0'))
-    jax.distributed.initialize(**kwargs)
+    """Initialize the multi-host jax runtime. Delegates to the strict-parse
+    fleet bootstrap (fleet_runtime/bootstrap.py): env discovery +
+    jax.distributed init + partitioner mesh from the global devices +
+    fleet sentinel. No-op on a single host. Explicit arguments override
+    the environment."""
+    from ..fleet_runtime.bootstrap import FleetSpec, bootstrap
+    spec = None
+    if num_processes is not None or coordinator_address is not None:
+        if num_processes is None:
+            num_processes = int(os.environ.get('PADDLE_TRAINERS_NUM', '1'))
+        spec = FleetSpec(
+            num_processes,
+            process_id if process_id is not None
+            else int(os.environ.get('PADDLE_TRAINER_ID', '0')),
+            coordinator_address=coordinator_address)
+    return bootstrap(spec=spec)
 
 
 def get_rank():

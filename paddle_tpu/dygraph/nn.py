@@ -169,7 +169,8 @@ class BatchNorm(Layer):
                  dtype='float32', data_layout='NCHW', in_place=False,
                  moving_mean_name=None, moving_variance_name=None,
                  do_model_average_for_mean_and_var=True,
-                 use_global_stats=False, trainable_statistics=False):
+                 use_global_stats=False, trainable_statistics=False,
+                 sync_stats=False):
         super().__init__()
         self.weight = self.create_parameter(
             [num_channels], param_attr, dtype,
@@ -182,7 +183,8 @@ class BatchNorm(Layer):
             '_variance_buf', self.create_buffer([num_channels], dtype, 1.0))
         self._attrs = dict(momentum=momentum, epsilon=epsilon,
                            data_layout=data_layout,
-                           use_global_stats=use_global_stats)
+                           use_global_stats=use_global_stats,
+                           sync_stats=sync_stats)
 
     def forward(self, x):
         y, new_mean, new_var = dispatch_op(

@@ -36,6 +36,46 @@ from .ops.registry import NON_KERNEL_ATTRS, get_op
 from .resilience import watchdog as _watchdog
 
 
+def _fleet_spmd_mesh():
+    """The partitioner's mesh when this is a REAL multi-host run whose
+    mesh spans every process — the condition under which the executor
+    must lower against GLOBAL arrays (feeds assembled from per-host
+    shards, state placed once fleet-wide) so XLA derives the cross-host
+    collectives. None single-process (the normal path, zero change)."""
+    if jax.process_count() <= 1:
+        return None
+    from .partition import get_partitioner
+    mesh = get_partitioner().mesh
+    if mesh is None or mesh.devices.size != jax.device_count():
+        return None
+    return mesh
+
+
+def _globalize_state(value, mesh, sharding):
+    """Host-local state value (every host holds the identical/full value,
+    by seed determinism or by restore) → global jax.Array under
+    `sharding`. Already-global arrays — anything whose sharding spans
+    the whole mesh, e.g. every warm step's own outputs (which come back
+    as GSPMD shardings, not NamedShardings — attribute equality would
+    re-place 1× state bytes per step) — pass through untouched."""
+    sh = getattr(value, 'sharding', None)
+    if sh is not None and len(sh.device_set) == mesh.devices.size:
+        return value
+    host_val = np.asarray(value)
+    return jax.make_array_from_callback(
+        host_val.shape, sharding, lambda idx: host_val[idx])
+
+
+def _globalize_feed(value, mesh, spec):
+    """Per-host feed rows → ONE global batch array sharded per `spec`
+    (each host contributed its own process_index-strided slice — the
+    DataLoader's fleet sharding). Feeds with no batch spec must be
+    identical on every host and replicate."""
+    from jax.experimental import multihost_utils
+    return multihost_utils.host_local_array_to_global_array(
+        np.asarray(value), mesh, spec)
+
+
 class _OpRunner:
     """Executes one IR op given a name→value resolver. Shared by the jit
     lowering and the eager startup path."""
@@ -904,6 +944,20 @@ class Executor:
             spec_fn = state_spec_fn(program)
             if spec_fn is not None:
                 self._partition_placed.add(part_key)
+        # multi-host fleet (fleet_runtime/): state must live as GLOBAL
+        # arrays on the process-spanning mesh — partitioner-resolved
+        # shardings (fsdp tiles, tp tiles) or replicated — so the jitted
+        # step is one SPMD program over all hosts and XLA emits the
+        # cross-host gradient reduction the c_allreduce sync points
+        # describe. The guard per value is one attribute check; already-
+        # global step outputs pass straight through on warm steps.
+        fleet_mesh = _fleet_spmd_mesh()
+        fleet_spec_fn = None
+        if fleet_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from .partition import state_spec_fn as _state_spec_fn
+            fleet_spec_fn = _state_spec_fn(program) or (
+                lambda n, s: NamedSharding(fleet_mesh, PartitionSpec()))
         state = {}
         for n in state_names:
             val = scope.find(n)
@@ -911,46 +965,83 @@ class Executor:
                 raise RuntimeError(
                     f"persistable var '{n}' is uninitialized; run the startup "
                     f"program first (exe.run(fluid.default_startup_program()))")
-            if spec_fn is not None and hasattr(val, 'shape'):
+            if fleet_mesh is not None and hasattr(val, 'shape'):
+                val = _globalize_state(val, fleet_mesh,
+                                       fleet_spec_fn(n, val.shape))
+            elif spec_fn is not None and hasattr(val, 'shape'):
                 val = jax.device_put(val, spec_fn(n, val.shape))
             state[n] = val
 
         from .core.lod import LoDTensor
         feed_vals = {}
         passthrough_bytes = 0
-        for name, value in feed.items():
-            if isinstance(value, LoDTensor):
-                # ragged feed: bind the padded data plus the companion
-                # length var that data(lod_level>0) declared
-                if block.has_var(name + '@LEN'):
+        if fleet_mesh is not None:
+            # fleet feeds: every host contributes its local rows, the
+            # step consumes ONE global batch (docs/DISTRIBUTED.md). Data
+            # vars shard their leading dim over the partitioner's data
+            # axes; everything else must be host-identical and
+            # replicates. LoD feeds have no row-aligned global form.
+            from .partition import get_partitioner
+            from jax.sharding import PartitionSpec
+            part = get_partitioner()
+            data_spec = part.data_spec()
+            for name, value in feed.items():
+                if isinstance(value, LoDTensor):
+                    raise NotImplementedError(
+                        f'feed {name!r}: LoDTensor feeds are not '
+                        f'supported on a multi-host fleet (shard the '
+                        f'reader and pad to dense)')
+                dtype = block.var(name).dtype if block.has_var(name) \
+                    else None
+                if dtype == 'int64':
                     from .core.dtypes import check_int32_bounds
-                    feed_vals[name + '@LEN'] = jnp.asarray(
-                        check_int32_bounds(value.lengths, name + '@LEN'))
-                value = value.data
-            dtype = block.var(name).dtype if block.has_var(name) else None
-            target = to_jax_dtype(dtype) if dtype else None
-            if (isinstance(value, jax.Array)
-                    and not isinstance(value, jax.core.Tracer)
-                    and (target is None or value.dtype == target)
-                    and (sharding is None or value.sharding == sharding)):
-                # zero-copy staged feed: the DataLoader producer thread
-                # already committed this batch to the device (reader.py
-                # device_put) — and ran the int64 bounds check host-side at
-                # staging — so re-converting here would only put H2D (and,
-                # for int64, a device→host bounds scan = a full sync) back
-                # on the critical path
-                passthrough_bytes += getattr(value, 'nbytes', 0)
-                feed_vals[name] = value
-                continue
-            if dtype == 'int64':
-                # int64 computes as int32 on device (core/dtypes.py); a
-                # feed that would wrap must fail loudly, not silently
-                from .core.dtypes import check_int32_bounds
-                check_int32_bounds(value, name)
-            arr = jnp.asarray(value, target)
-            if sharding is not None:
-                arr = jax.device_put(arr, sharding)
-            feed_vals[name] = arr
+                    check_int32_bounds(np.asarray(value), name)
+                target = to_jax_dtype(dtype) if dtype else None
+                host_val = np.asarray(value)
+                if target is not None:
+                    host_val = host_val.astype(target, copy=False)
+                is_data = block.has_var(name) and \
+                    getattr(block.var(name), 'is_data', False)
+                spec = (data_spec if is_data and host_val.ndim
+                        else PartitionSpec())
+                feed_vals[name] = _globalize_feed(host_val, fleet_mesh,
+                                                  spec)
+        else:
+            for name, value in feed.items():
+                if isinstance(value, LoDTensor):
+                    # ragged feed: bind the padded data plus the companion
+                    # length var that data(lod_level>0) declared
+                    if block.has_var(name + '@LEN'):
+                        from .core.dtypes import check_int32_bounds
+                        feed_vals[name + '@LEN'] = jnp.asarray(
+                            check_int32_bounds(value.lengths, name + '@LEN'))
+                    value = value.data
+                dtype = block.var(name).dtype if block.has_var(name) \
+                    else None
+                target = to_jax_dtype(dtype) if dtype else None
+                if (isinstance(value, jax.Array)
+                        and not isinstance(value, jax.core.Tracer)
+                        and (target is None or value.dtype == target)
+                        and (sharding is None
+                             or value.sharding == sharding)):
+                    # zero-copy staged feed: the DataLoader producer thread
+                    # already committed this batch to the device (reader.py
+                    # device_put) — and ran the int64 bounds check
+                    # host-side at staging — so re-converting here would
+                    # only put H2D (and, for int64, a device→host bounds
+                    # scan = a full sync) back on the critical path
+                    passthrough_bytes += getattr(value, 'nbytes', 0)
+                    feed_vals[name] = value
+                    continue
+                if dtype == 'int64':
+                    # int64 computes as int32 on device (core/dtypes.py); a
+                    # feed that would wrap must fail loudly, not silently
+                    from .core.dtypes import check_int32_bounds
+                    check_int32_bounds(value, name)
+                arr = jnp.asarray(value, target)
+                if sharding is not None:
+                    arr = jax.device_put(arr, sharding)
+                feed_vals[name] = arr
         if _obs._ENABLED and passthrough_bytes:
             _obs.inc('executor_feed_passthrough_bytes', passthrough_bytes,
                      help='feed bytes recognized as already device-committed '

@@ -1,0 +1,45 @@
+"""Pod-scale fleet runtime: multi-host bring-up, cross-host primitives,
+partitioner-sharded checkpoints, and fleet-wide resilience (ROADMAP item 2;
+docs/DISTRIBUTED.md "Multi-host runtime", docs/RESILIENCE.md "Fleet").
+
+Every other subsystem stays single-process-correct; this package is the
+layer that turns one process into one *host* of a fleet:
+
+- :mod:`bootstrap` — strict-parse fleet-env discovery
+  (``PADDLE_TRAINERS_NUM`` / ``PADDLE_TRAINER_ID`` / endpoints),
+  ``jax.distributed`` bring-up wired into the Partitioner's mesh, a
+  ``local_fleet(nproc)`` subprocess spawner for tests/benches, and the
+  cross-host primitive set (``fleet_barrier`` / ``broadcast_from_host0``
+  / ``all_hosts_agree``).
+- :mod:`coordinator` — the coordinator KV store (jax.distributed client,
+  shared-directory fallback) and the :class:`FleetSentinel` poison flag
+  that propagates one host's failure fleet-wide.
+- :mod:`sharded_ckpt` — per-host checkpoint shards keyed by the
+  partitioner's spec manifest: each host persists only the tiles it owns,
+  host 0 commits the fleet manifest last, restore validates every shard
+  and reassembles (resharding when the mesh changed).
+"""
+from .bootstrap import (FleetSpec, discover_fleet_env, bootstrap,
+                        process_index, process_count, is_host0,
+                        local_fleet, LocalFleet, fleet_barrier,
+                        broadcast_from_host0, all_hosts_agree,
+                        fleet_allreduce_scalars)
+from .coordinator import (FleetSentinel, FleetPoisoned, FLEET_EXIT_CODE,
+                          kv_set, kv_get, kv_dir, active_sentinel,
+                          install_sentinel, clear_sentinel, check_poisoned,
+                          exit_for_resume)
+from .sharded_ckpt import (write_host_shard, commit_fleet_manifest,
+                           read_sharded_checkpoint, owned_tiles,
+                           sharded_save_enabled)
+
+__all__ = [
+    'FleetSpec', 'discover_fleet_env', 'bootstrap', 'process_index',
+    'process_count', 'is_host0', 'local_fleet', 'LocalFleet',
+    'fleet_barrier', 'broadcast_from_host0', 'all_hosts_agree',
+    'fleet_allreduce_scalars',
+    'FleetSentinel', 'FleetPoisoned', 'FLEET_EXIT_CODE', 'kv_set',
+    'kv_get', 'kv_dir', 'active_sentinel', 'install_sentinel',
+    'clear_sentinel', 'check_poisoned', 'exit_for_resume',
+    'write_host_shard', 'commit_fleet_manifest', 'read_sharded_checkpoint',
+    'owned_tiles', 'sharded_save_enabled',
+]

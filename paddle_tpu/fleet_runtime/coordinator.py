@@ -1,0 +1,335 @@
+"""Coordinator KV store + the fleet poison flag (FleetSentinel).
+
+The TPU-supercomputer retrospective's availability lesson (PAPERS.md,
+arxiv 2606.15870) is that at pod scale *any*-host failure must translate
+into *fleet* resume, not a half-dead job burning wall clock. The ladder
+here (docs/RESILIENCE.md "Fleet propagation"):
+
+1. a host detects its own failure — watchdog deadline breach (hang),
+   supervisor escalation (divergence), or an unrecoverable exception;
+2. it **posts a poison flag** through the coordinator KV store (plus a
+   shared-directory file when a fleet dir is configured — the file
+   survives whole-fleet death for post-mortem) and exits for resume;
+3. every other host polls the flag at its next step boundary
+   (:meth:`FleetSentinel.check`) and exits with
+   :data:`FLEET_EXIT_CODE` — *exit-for-resume*, the restarter relaunches
+   the whole fleet which resumes from the last committed fleet
+   checkpoint;
+4. a host that never reaches a boundary because it is blocked inside a
+   collective whose peer died is covered by its own watchdog lease (the
+   PR 8 machinery) — the ladder needs no healthy-path synchronization.
+
+The KV store is ``jax.distributed``'s built-in client (living on the
+coordinator process); :func:`kv_set`/:func:`kv_get`/:func:`kv_dir` wrap it
+with the shared-directory fallback so single-process tests and tools can
+exercise the same code paths. Keys are namespaced ``paddle_tpu/...``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+import jax
+
+from ..log_helper import get_logger
+from ..resilience import watchdog as _wdg
+from ..resilience.snapshot import atomic_write_bytes
+
+__all__ = ['FleetSentinel', 'FleetPoisoned', 'FLEET_EXIT_CODE', 'kv_set',
+           'kv_get', 'kv_dir', 'active_sentinel', 'install_sentinel',
+           'clear_sentinel', 'check_poisoned', 'exit_for_resume',
+           'ENV_FLEET_DIR', 'ENV_POISON_GRACE']
+
+_logger = get_logger(
+    __name__, logging.INFO,
+    fmt='%(asctime)s-%(levelname)s: [fleet] %(message)s')
+
+#: exit code for a healthy host leaving because ANOTHER host poisoned the
+#: fleet — distinct from a crash (signal), a watchdog abort (70), and a
+#: clean exit (0), so the restarter can account the three separately.
+FLEET_EXIT_CODE = 75
+
+ENV_FLEET_DIR = 'PADDLE_TPU_FLEET_DIR'
+ENV_POISON_GRACE = 'PADDLE_TPU_FLEET_POISON_GRACE_S'
+
+_POISON_PREFIX = 'paddle_tpu/poison/'
+_POISON_FILE = 'fleet_poison.json'
+
+
+class FleetPoisoned(RuntimeError):
+    """Raised (optionally) when the fleet poison flag is set: some host
+    posted a failure and every host must exit for resume."""
+
+    def __init__(self, record):
+        self.record = record
+        super().__init__(
+            f"fleet poisoned by host {record.get('source')}: "
+            f"{record.get('reason')} (step {record.get('step')})")
+
+
+def _client():
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client
+    except Exception:
+        return None
+
+
+def kv_set(key, value):
+    """Set `key` → `value` (str) in the coordinator KV store; mirrored to
+    the fleet directory when configured. Returns True if at least one
+    backend accepted the write."""
+    ok = False
+    c = _client()
+    if c is not None:
+        try:
+            c.key_value_set(key, value)
+            ok = True
+        except Exception as e:       # noqa: BLE001 — dying host, best effort
+            _logger.warning('kv_set(%s) failed: %s', key, e)
+    d = os.environ.get(ENV_FLEET_DIR)
+    if d:
+        try:
+            os.makedirs(d, exist_ok=True)
+            atomic_write_bytes(
+                os.path.join(d, key.replace('/', '__')), value.encode())
+            ok = True
+        except OSError as e:
+            _logger.warning('kv_set(%s) file mirror failed: %s', key, e)
+    return ok
+
+
+def kv_get(key, timeout_s=5.0):
+    """Blocking get → str, or None on timeout/no-backend."""
+    c = _client()
+    if c is not None:
+        try:
+            return c.blocking_key_value_get(key, int(timeout_s * 1000))
+        except Exception:
+            pass
+    d = os.environ.get(ENV_FLEET_DIR)
+    if d:
+        path = os.path.join(d, key.replace('/', '__'))
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                with open(path) as f:
+                    return f.read()
+            except OSError:
+                if time.monotonic() >= deadline:
+                    return None
+                time.sleep(0.05)
+    return None
+
+
+def kv_dir(prefix):
+    """Non-blocking directory listing → {key: value} for keys under
+    `prefix` (the poison poll uses this: one RPC, no timeout games)."""
+    out = {}
+    c = _client()
+    if c is not None:
+        try:
+            for k, v in c.key_value_dir_get(prefix):
+                out[k] = v
+        except Exception:
+            pass
+    d = os.environ.get(ENV_FLEET_DIR)
+    if d and os.path.isdir(d):
+        want = prefix.replace('/', '__')
+        for name in os.listdir(d):
+            if name.startswith(want):
+                try:
+                    with open(os.path.join(d, name)) as f:
+                        out.setdefault(name.replace('__', '/'), f.read())
+                except OSError:
+                    pass
+    return out
+
+
+class FleetSentinel:
+    """The poison flag. One per process (installed by
+    ``bootstrap()``/``install_sentinel()``); the CheckpointManager polls
+    it at every step boundary, the watchdog posts through it on breach,
+    and the supervisor posts on escalation.
+
+    `grace_s` (``PADDLE_TPU_FLEET_POISON_GRACE_S``, default 0): extra
+    dwell at each boundary poll — poll, sleep, poll again — giving a
+    just-posted flag time to land before this host commits to dispatching
+    the next step into a collective with a dead peer. Zero keeps the
+    healthy path free; tests/restarts that must observe the KV path
+    deterministically set ~1s."""
+
+    def __init__(self, source=None, grace_s=None):
+        self.source = (source if source is not None
+                       else jax.process_index())
+        raw = os.environ.get(ENV_POISON_GRACE, '').strip()
+        if grace_s is None and raw:
+            try:
+                grace_s = float(raw)
+            except ValueError:
+                raise ValueError(
+                    f'{ENV_POISON_GRACE} must be a number, got {raw!r}')
+        self.grace_s = float(grace_s or 0.0)
+        self._posted = None
+
+    # -- posting -------------------------------------------------------
+    def post(self, reason, step=None, kind='error'):
+        """Poison the fleet: record WHO failed, WHY, and WHERE in the
+        step stream. Idempotent per process; best-effort by design (the
+        poster is usually about to die)."""
+        if self._posted is not None:
+            return self._posted
+        record = {'source': int(self.source), 'reason': str(reason),
+                  'kind': kind, 'step': step, 'pid': os.getpid(),
+                  'unix_time': time.time()}
+        self._posted = record
+        kv_set(f'{_POISON_PREFIX}{self.source}', json.dumps(record))
+        d = os.environ.get(ENV_FLEET_DIR)
+        if d:
+            try:
+                atomic_write_bytes(os.path.join(d, _POISON_FILE),
+                                   json.dumps(record).encode())
+            except OSError:
+                pass
+        _logger.error('fleet POISONED by this host: %s (step %s)',
+                      reason, step)
+        from .. import observability as _obs
+        if _obs._ENABLED:
+            _obs.inc('fleet_poison_posted',
+                     help='fleet poison flags posted by this host')
+        return record
+
+    # -- polling -------------------------------------------------------
+    def check(self):
+        """→ the poison record posted by ANOTHER host, or None. One
+        non-blocking KV poll (+ the grace re-poll when configured) —
+        the per-boundary cost on the healthy path is a single local RPC."""
+        rec = self._poll_once()
+        if rec is None and self.grace_s > 0:
+            time.sleep(self.grace_s)
+            rec = self._poll_once()
+        if rec is not None:
+            from .. import observability as _obs
+            if _obs._ENABLED:
+                _obs.inc('fleet_poison_observed',
+                         help='poison flags observed from other hosts')
+        return rec
+
+    def _poll_once(self):
+        for key, val in kv_dir(_POISON_PREFIX).items():
+            try:
+                rec = json.loads(val)
+            except ValueError:
+                continue
+            if int(rec.get('source', -1)) != int(self.source):
+                return rec
+        d = os.environ.get(ENV_FLEET_DIR)
+        if d:
+            try:
+                with open(os.path.join(d, _POISON_FILE)) as f:
+                    rec = json.loads(f.read())
+                if int(rec.get('source', -1)) != int(self.source):
+                    return rec
+            except (OSError, ValueError):
+                pass
+        return None
+
+    def raise_if_poisoned(self):
+        rec = self.check()
+        if rec is not None:
+            raise FleetPoisoned(rec)
+
+    def clear(self):
+        """Remove stale poison flags (host 0, at bring-up, BEFORE the
+        restore barrier — otherwise a restarted fleet would instantly
+        re-observe last incarnation's flag and exit again)."""
+        c = _client()
+        if c is not None:
+            try:
+                for k, _ in c.key_value_dir_get(_POISON_PREFIX):
+                    c.key_value_delete(k)
+            except Exception:
+                pass
+        d = os.environ.get(ENV_FLEET_DIR)
+        if d and os.path.isdir(d):
+            for name in list(os.listdir(d)):
+                if name == _POISON_FILE or \
+                        name.startswith(_POISON_PREFIX.replace('/', '__')):
+                    try:
+                        os.unlink(os.path.join(d, name))
+                    except OSError:
+                        pass
+        self._posted = None
+
+
+# ---------------------------------------------------------------------------
+# process-wide sentinel + watchdog integration
+# ---------------------------------------------------------------------------
+
+_ACTIVE = None
+
+
+def active_sentinel():
+    return _ACTIVE
+
+
+def install_sentinel(**kwargs):
+    """Install the process sentinel and hook the watchdog: a breach on
+    this host now poisons the fleet BEFORE the abort exit, so every other
+    host follows within one step boundary instead of hanging in a
+    collective until its own deadline."""
+    global _ACTIVE
+    if _ACTIVE is None:
+        _ACTIVE = FleetSentinel(**kwargs)
+        _wdg.add_breach_hook(_on_watchdog_breach)
+    return _ACTIVE
+
+
+def clear_sentinel():
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def check_poisoned():
+    """The poison record another host posted, or None. Train loops call
+    this when a STEP FAILS (a collective error is how a dead peer
+    surfaces on the survivors — gloo closes the connection the instant
+    the peer exits): poisoned → the failure is the fleet going down for
+    resume, exit with FLEET_EXIT_CODE instead of crashing."""
+    s = _ACTIVE
+    return s.check() if s is not None else None
+
+
+def exit_for_resume(record=None, code=FLEET_EXIT_CODE):
+    """Leave the process for a fleet restart: flush stdio and hard-exit
+    with `code`. This is ``os._exit`` ON PURPOSE — the normal interpreter
+    teardown runs jax.distributed's atexit shutdown barrier, which can
+    never complete once a peer died hard (the coordination service
+    aborts the survivor with SIGABRT after its heartbeat timeout instead
+    of letting it exit with our code). Callers flush their own state
+    (CheckpointManager.close()) BEFORE calling."""
+    if record is not None:
+        _logger.error('exiting for fleet resume (code %d): poisoned by '
+                      'host %s: %s', code, record.get('source'),
+                      record.get('reason'))
+    try:
+        import sys
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:
+        pass
+    os._exit(code)
+
+
+def _on_watchdog_breach(record):
+    # NOTE: runs on the watchdog monitor thread moments before a hard
+    # exit — must not touch backend initialization (jax.process_count()
+    # can re-enter platform init mid-teardown); the presence of the
+    # distributed client / a fleet dir is the fleet signal
+    s = _ACTIVE
+    if s is not None and (_client() is not None
+                          or os.environ.get(ENV_FLEET_DIR)):
+        s.post(f"watchdog breach: lease {record.get('name')!r} held "
+               f"{record.get('held_seconds')}s", kind='watchdog')

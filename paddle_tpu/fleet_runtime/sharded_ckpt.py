@@ -1,0 +1,334 @@
+"""Partitioner-sharded checkpoints: each host persists only the tiles it
+owns; host 0 commits the fleet manifest last.
+
+Layout for step 42 on a 2-host fleet (inside the shared checkpoint dir)::
+
+    ckpt-00000042.shard00of02.npz    host 0's owned tiles
+    ckpt-00000042.shard00of02.json   shard manifest (bytes+CRC, tile index
+                                     map, host-local meta) — committed
+                                     AFTER its payload
+    ckpt-00000042.shard01of02.npz    host 1's owned tiles
+    ckpt-00000042.shard01of02.json
+    ckpt-00000042.json               FLEET manifest — committed LAST by
+                                     host 0, after the coordinator-KV
+                                     shard-commit barrier
+
+The fleet manifest is the one global commit marker: discovery
+(:func:`~paddle_tpu.resilience.snapshot.list_checkpoints`) validates every
+listed shard (existence, byte size, CRC32) before a fleet checkpoint is
+eligible — a host that died mid-shard-write, or a torn shard file, makes
+the WHOLE checkpoint invisible (skipped with a logged warning), exactly
+like a torn single-host payload. ``kill -9`` at any instant on any host
+leaves either a fully committed fleet checkpoint or an older one.
+
+**Ownership** is derived from the arrays' actual shardings, not re-derived
+from rules (the partitioner's spec manifest is recorded alongside for
+reshard validation): for every tile index of
+``sharding.devices_indices_map``, the owner is the LOWEST process index
+holding a replica. So fsdp/tp tiles land exactly once across the fleet
+(Σ shard bytes ≈ state bytes, not p× state bytes) and replicated
+variables are saved by host 0 only. Host-local numpy (RNG states, step
+counters) is host-0-owned unless passed through ``host_meta``.
+
+**Restore** reassembles every variable to its FULL global value from the
+tiles across all shard files — which makes reshard-on-restore free: the
+restored full array is simply re-placed under whatever mesh the NEW fleet
+configured (the spec manifest travels in the fleet manifest so callers can
+check/compare). The cross-host shard-COMMIT barrier runs through the
+coordinator KV store — never through device collectives — so the
+background writer thread can commit while the main thread keeps
+dispatching steps.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+import zlib
+
+import numpy as np
+import jax
+
+from ..log_helper import get_logger
+from ..resilience import snapshot as _snap
+
+__all__ = ['owned_tiles', 'materialize_owned', 'write_host_shard',
+           'commit_fleet_manifest', 'wait_for_shards',
+           'read_sharded_checkpoint', 'sharded_save_enabled',
+           'shard_name', 'ENV_FORCE_SHARDED', 'ENV_COMMIT_TIMEOUT']
+
+_logger = get_logger(
+    __name__, logging.INFO,
+    fmt='%(asctime)s-%(levelname)s: [fleet] %(message)s')
+
+ENV_FORCE_SHARDED = 'PADDLE_TPU_FLEET_SHARDED'
+ENV_COMMIT_TIMEOUT = 'PADDLE_TPU_FLEET_CKPT_TIMEOUT_S'
+
+_KV_PREFIX = 'paddle_tpu/ckpt/'
+
+
+def sharded_save_enabled():
+    """Sharded per-host saves are on for real multi-process fleets, or
+    when forced via ``PADDLE_TPU_FLEET_SHARDED=1`` (single-process
+    multi-device meshes — how tier-1 exercises the tile layout). Strict
+    parse: values outside {'', '0', '1'} raise."""
+    raw = os.environ.get(ENV_FORCE_SHARDED, '').strip()
+    if raw not in ('', '0', '1'):
+        raise ValueError(
+            f'{ENV_FORCE_SHARDED} must be 0 or 1, got {raw!r}')
+    if raw == '1':
+        return True
+    return jax.process_count() > 1
+
+
+def shard_name(step, rank, world, ext):
+    return f'ckpt-{int(step):08d}.shard{rank:02d}of{world:02d}.{ext}'
+
+
+def _norm_index(index, shape):
+    """Tile index (tuple of slices) → JSON-safe [[start, stop], ...]."""
+    out = []
+    for s, dim in zip(index, shape):
+        start = 0 if s.start is None else int(s.start)
+        stop = int(dim) if s.stop is None else int(s.stop)
+        out.append([start, stop])
+    return out
+
+
+def _device_value(value):
+    """Unwrap FetchHandles → the on-device array (no host copy, and no
+    np.asarray — which would throw on a non-fully-addressable global
+    array)."""
+    if hasattr(value, 'device_array'):        # FetchHandle
+        return value.device_array()
+    return value
+
+
+def owned_tiles(value, rank=None):
+    """→ list of ``(index_norm, np.ndarray)`` tiles of `value` that THIS
+    process owns (owner = lowest process index holding the tile). Host
+    numpy / scalars / fully-replicated arrays are one full tile owned by
+    host 0."""
+    rank = jax.process_index() if rank is None else int(rank)
+    value = _device_value(value)
+    shardingless = not hasattr(value, 'sharding') \
+        or not hasattr(value, 'addressable_shards')
+    if shardingless:
+        if rank == 0:
+            arr = np.asarray(value)
+            return [(_norm_index((slice(None),) * arr.ndim, arr.shape),
+                     arr)]
+        return []
+    index_owner = {}
+    for dev, idx in value.sharding.devices_indices_map(
+            value.shape).items():
+        key = tuple(map(tuple, _norm_index(idx, value.shape)))
+        p = dev.process_index
+        if key not in index_owner or p < index_owner[key]:
+            index_owner[key] = p
+    tiles, seen = [], set()
+    for shard in value.addressable_shards:
+        norm = _norm_index(shard.index, value.shape)
+        key = tuple(map(tuple, norm))
+        if key in seen or index_owner.get(key) != rank:
+            continue
+        seen.add(key)
+        tiles.append((norm, np.asarray(shard.data)))
+    return tiles
+
+
+def materialize_owned(arrays, rank=None):
+    """{key: array|FetchHandle} → ({npz_key: np tile}, tile manifest).
+    The device→host copy happens here, per owned tile — on the writer
+    thread, overlapped with the main thread's next steps."""
+    stored, manifest = {}, {}
+    for key, value in arrays.items():
+        dev = _device_value(value)
+        shape = tuple(int(d) for d in np.shape(dev))
+        dtype = str(np.dtype(getattr(dev, 'dtype', np.float64)))
+        tiles = owned_tiles(dev, rank=rank)
+        if not tiles and not shape:
+            continue
+        recs = []
+        for i, (index, tile) in enumerate(tiles):
+            npz_key = f'{key}::t{i}'
+            stored_dtype = str(tile.dtype)
+            if tile.dtype.kind not in _snap._SAVEZ_KINDS:
+                tile = tile.astype(np.float32)   # exact widening (bf16 &co)
+                stored_dtype = 'float32'
+            stored[npz_key] = tile
+            recs.append({'npz': npz_key, 'index': index,
+                         'stored_dtype': stored_dtype})
+        manifest[key] = {'global_shape': list(shape), 'dtype': dtype,
+                         'tiles': recs}
+    return stored, manifest
+
+
+def write_host_shard(directory, step, arrays, host_meta=None, rank=None,
+                     world=None):
+    """Materialize this host's owned tiles and commit its shard (payload
+    npz, then shard manifest — both atomic). Announces the commit on the
+    coordinator KV store and returns the shard manifest dict."""
+    import io as _io
+    rank = jax.process_index() if rank is None else int(rank)
+    world = jax.process_count() if world is None else int(world)
+    os.makedirs(directory, exist_ok=True)
+    stored, tile_manifest = materialize_owned(arrays, rank=rank)
+    buf = _io.BytesIO()
+    # in-memory serialize; the bytes land via atomic_write_bytes below
+    # (temp+fsync+os.replace — the PR 7 commit protocol)
+    np.savez(buf, **stored)      # lint: allow-io (BytesIO, committed atomically)
+    payload = buf.getvalue()
+    payload_name = shard_name(step, rank, world, 'npz')
+    _snap.atomic_write_bytes(os.path.join(directory, payload_name), payload)
+    manifest = {
+        'format': _snap.FORMAT_VERSION,
+        'step': int(step), 'rank': rank, 'world': world,
+        'payload': payload_name,
+        'payload_bytes': len(payload),
+        'payload_crc32': zlib.crc32(payload) & 0xFFFFFFFF,
+        'arrays': tile_manifest,
+        'host_meta': dict(host_meta or {}),
+    }
+    _snap.atomic_write_bytes(
+        os.path.join(directory, shard_name(step, rank, world, 'json')),
+        json.dumps(manifest, indent=1).encode())
+    from .coordinator import kv_set
+    kv_set(f'{_KV_PREFIX}{int(step)}/{rank}',
+           json.dumps({'rank': rank, 'bytes': len(payload),
+                       'crc32': manifest['payload_crc32']}))
+    return manifest
+
+
+def _commit_timeout():
+    raw = os.environ.get(ENV_COMMIT_TIMEOUT, '').strip()
+    if not raw:
+        return 600.0
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            f'{ENV_COMMIT_TIMEOUT} must be a number, got {raw!r}')
+
+
+def wait_for_shards(directory, step, world, timeout_s=None, poll_s=0.05):
+    """Host 0's shard-commit barrier: poll the coordinator KV (file
+    fallback: the shard manifests themselves) until every rank announced
+    its shard for `step`. Runs on the WRITER thread — KV RPCs and stat
+    calls only, never device collectives."""
+    from .coordinator import kv_dir
+    timeout_s = _commit_timeout() if timeout_s is None else timeout_s
+    deadline = time.monotonic() + timeout_s
+    want = set(range(world))
+    while True:
+        have = set()
+        for key in kv_dir(f'{_KV_PREFIX}{int(step)}/'):
+            try:
+                have.add(int(key.rsplit('/', 1)[-1]))
+            except ValueError:
+                pass
+        for r in want - have:          # file-system fallback / restarts
+            if os.path.isfile(os.path.join(
+                    directory, shard_name(step, r, world, 'json'))):
+                have.add(r)
+        if want <= have:
+            return
+        if time.monotonic() >= deadline:
+            raise OSError(
+                f'fleet checkpoint step {step}: shard-commit barrier '
+                f'timed out after {timeout_s:.0f}s (have ranks '
+                f'{sorted(have)} of {world})')
+        time.sleep(poll_s)
+
+
+def commit_fleet_manifest(directory, step, world, meta=None,
+                          saved_unix_time=None, wait=True):
+    """Host 0 only: after every shard committed (KV barrier), validate
+    the shard manifests and write the FLEET manifest — the atomic global
+    commit marker discovery keys on. Returns a
+    :class:`~paddle_tpu.resilience.snapshot.Checkpoint`."""
+    if wait:
+        wait_for_shards(directory, step, world)
+    shards, keys = [], set()
+    for r in range(world):
+        mname = shard_name(step, r, world, 'json')
+        with open(os.path.join(directory, mname)) as f:
+            sm = json.load(f)
+        shards.append({'manifest': mname, 'payload': sm['payload'],
+                       'payload_bytes': sm['payload_bytes'],
+                       'payload_crc32': sm['payload_crc32'],
+                       'rank': r})
+        keys.update(sm['arrays'])
+    manifest = {
+        'format': _snap.FORMAT_VERSION,
+        'step': int(step),
+        'sharded': True,
+        'world': int(world),
+        'shards': shards,
+        'keys': sorted(keys),
+        'saved_unix_time': saved_unix_time,
+        'meta': dict(meta or {}),
+    }
+    _snap.atomic_write_bytes(
+        os.path.join(directory, f'ckpt-{int(step):08d}.json'),
+        json.dumps(manifest, indent=1).encode())
+    return _snap.Checkpoint(step, directory, manifest)
+
+
+def read_sharded_checkpoint(ckpt):
+    """Fleet checkpoint → ``(arrays, meta)`` with every variable
+    reassembled to its FULL global value from the tiles across all shard
+    files (validated against the fleet manifest by discovery already).
+    ``meta['host_meta']`` maps rank → that host's local meta (RNG,
+    loader cursor); the restoring manager overlays its own rank's entry.
+    Because full values come back, restoring onto a DIFFERENT mesh shape
+    (reshard-on-restore) needs nothing extra: the new placement happens
+    wherever the state is next consumed."""
+    directory = ckpt.directory
+    manifest = ckpt.manifest
+    specs = {}          # key -> (shape, dtype)
+    pieces = {}         # key -> list[(index, np tile)]
+    host_meta = {}
+    for sh in manifest['shards']:
+        with open(os.path.join(directory, sh['manifest'])) as f:
+            sm = json.load(f)
+        host_meta[str(sm.get('rank', 0))] = sm.get('host_meta', {})
+        with np.load(os.path.join(directory, sm['payload'])) as data:
+            for key, rec in sm['arrays'].items():
+                shape = tuple(rec['global_shape'])
+                prev = specs.get(key)
+                if prev is not None and prev != (shape, rec['dtype']):
+                    raise ValueError(
+                        f'fleet checkpoint step {ckpt.step}: {key!r} '
+                        f'declared as {prev} and '
+                        f'{(shape, rec["dtype"])} in different shards')
+                specs[key] = (shape, rec['dtype'])
+                for t in rec['tiles']:
+                    tile = data[t['npz']]
+                    if t['stored_dtype'] != rec['dtype']:
+                        import ml_dtypes  # noqa: F401 — registers bf16
+                        tile = tile.astype(np.dtype(rec['dtype']))
+                    pieces.setdefault(key, []).append((t['index'], tile))
+    arrays = {}
+    for key, (shape, dtype) in specs.items():
+        tiles = pieces.get(key, [])
+        if len(tiles) == 1 and all(
+                (a, b) == (0, d) for (a, b), d in zip(tiles[0][0], shape)):
+            arrays[key] = tiles[0][1]
+            continue
+        full = np.empty(shape, np.dtype(dtype))
+        covered = 0
+        for index, tile in tiles:
+            sl = tuple(slice(a, b) for a, b in index)
+            full[sl] = tile
+            covered += int(tile.size)
+        if covered != int(np.prod(shape, dtype=np.int64)):
+            raise ValueError(
+                f'fleet checkpoint step {ckpt.step}: {key!r} tiles cover '
+                f'{covered} of {int(np.prod(shape, dtype=np.int64))} '
+                f'elements (shard set incomplete?)')
+        arrays[key] = full
+    meta = dict(ckpt.meta)
+    meta['host_meta'] = host_meta
+    return arrays, meta

@@ -1,15 +1,38 @@
 """Role makers (ref: python/paddle/fluid/incubate/fleet/base/role_maker.py).
-Implementations live in parallel/fleet.py; this module provides the
-reference import path so fleet scripts run unmodified."""
+
+The reference's role maker answered three questions per process — am I a
+worker or a pserver, what is my rank, how many of each are there — by
+parsing the ``PADDLE_*`` environment the launcher exported. On TPU the
+same contract holds with the pserver half lowered away (every process is
+a worker; parameter state syncs via XLA collectives — SURVEY 2.8):
+
+- :class:`PaddleCloudRoleMaker` — THE production role maker. Reads
+  ``PADDLE_TRAINERS_NUM`` / ``PADDLE_TRAINER_ID`` /
+  ``PADDLE_TRAINER_ENDPOINTS`` / ``PADDLE_CURRENT_ENDPOINT`` through the
+  strict-parse fleet bootstrap
+  (:mod:`paddle_tpu.fleet_runtime.bootstrap`): a malformed or
+  contradictory environment raises at ``generate_role()`` listing every
+  expected variable. ``fleet.init(role_maker)`` then hands the validated
+  :class:`~paddle_tpu.fleet_runtime.bootstrap.FleetSpec` to
+  ``fleet_runtime.bootstrap`` for the jax.distributed bring-up. With no
+  fleet env, topology falls back to the live jax runtime.
+- :class:`UserDefinedRoleMaker` / :class:`UserDefinedCollectiveRoleMaker`
+  — programmatic topologies (reference validation rules preserved).
+- :data:`MPISymetricRoleMaker` — the MPI-rendezvous role makers map to
+  the symmetric worker-only topology: jax.distributed covers multi-host
+  rendezvous, so the cloud role maker IS the MPI one here.
+
+``GeneralRoleMaker`` (the reference's gloo-based generalization) is an
+alias of :class:`PaddleCloudRoleMaker` too: its extra knobs configured the
+gloo rendezvous path, which the coordinator-based bootstrap replaces.
+"""
 from ....parallel.fleet import (Role, RoleMakerBase, PaddleCloudRoleMaker,
                                 UserDefinedRoleMaker,
                                 UserDefinedCollectiveRoleMaker)
 
-# MPI role makers map to the single-controller jax runtime: symmetric
-# worker-only topology (no MPI in the TPU stack; jax.distributed covers
-# multi-host rendezvous).
 MPISymetricRoleMaker = PaddleCloudRoleMaker
+GeneralRoleMaker = PaddleCloudRoleMaker
 
 __all__ = ['Role', 'RoleMakerBase', 'PaddleCloudRoleMaker',
            'UserDefinedRoleMaker', 'UserDefinedCollectiveRoleMaker',
-           'MPISymetricRoleMaker']
+           'MPISymetricRoleMaker', 'GeneralRoleMaker']

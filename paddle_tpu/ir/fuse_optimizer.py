@@ -34,6 +34,10 @@ FUSE_SPECS = {
                  (('param', 'params'), ('grad', 'grads'),
                   ('velocity', 'velocities')),
                  ('ParamOut', 'VelocityOut')),
+    'lars_momentum': ('fused_lars_momentum',
+                      (('param', 'params'), ('grad', 'grads'),
+                       ('velocity', 'velocities')),
+                      ('ParamOut', 'VelocityOut')),
     'adam': ('fused_adam',
              (('param', 'params'), ('grad', 'grads'),
               ('moment1', 'moment1s'), ('moment2', 'moment2s'),

@@ -198,9 +198,15 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                param_attr=None, bias_attr=None, data_layout='NCHW',
                in_place=False, name=None, moving_mean_name=None,
                moving_variance_name=None, do_model_average_for_mean_and_var=True,
-               use_global_stats=False):
+               use_global_stats=False, sync_stats=False):
     """ref: layers/nn.py:batch_norm. Running stats are persistable vars whose
-    MeanOut/VarianceOut aliases make the jitted step update them functionally."""
+    MeanOut/VarianceOut aliases make the jitted step update them functionally.
+
+    `sync_stats` (ref: layers/nn.py sync_batch_norm / the fleet
+    sync_batch_norm build knob): normalize with batch statistics reduced
+    over the partitioner's data axes, so a data-parallel fleet sees
+    GLOBAL-batch mean/variance — the large-batch BN ingredient
+    (docs/DISTRIBUTED.md "Sync-BN")."""
     helper = LayerHelper('batch_norm', param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
     c = input.shape[1] if data_layout == 'NCHW' else input.shape[-1]
@@ -236,7 +242,7 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                  'VarianceOut': var_name},
         attrs={'momentum': momentum, 'epsilon': epsilon, 'is_test': is_test,
                'use_global_stats': use_global_stats,
-               'data_layout': data_layout})
+               'data_layout': data_layout, 'sync_stats': sync_stats})
     if act:
         out = apply_op_layer(act, {'x': out})
     return out

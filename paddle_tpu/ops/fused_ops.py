@@ -98,6 +98,33 @@ def fused_momentum(params, grads, velocities, lr, *, mu=0.9,
     return _split(p_new, shapes, sizes), _split(v_new, shapes, sizes)
 
 
+@register_op('fused_lars_momentum', outputs=['ParamOut', 'VelocityOut'],
+             variadic=['params', 'grads', 'velocities'])
+def fused_lars_momentum(params, grads, velocities, lr, *, mu=0.9,
+                        lars_coeff=0.001, lars_weight_decay=0.0005,
+                        epsilon=0.0):
+    """Multi-tensor LARS: the per-LAYER trust ratios are reduced at each
+    member's own shape (bitwise-equal to the per-param op's norms), then
+    expanded over the bundle so the momentum/update chain runs once over
+    the flat concatenation — elementwise, hence bit-identical to N
+    separate lars_momentum ops."""
+    P, shapes, sizes = _bundle(params)
+    G, _, _ = _bundle(grads)
+    V, _, _ = _bundle(velocities)
+    lr = jnp.reshape(jnp.asarray(lr), ())
+    pns = jnp.stack([jnp.sqrt(jnp.sum(jnp.square(jnp.asarray(p))))
+                     for p in params])
+    gns = jnp.stack([jnp.sqrt(jnp.sum(jnp.square(jnp.asarray(g))))
+                     for g in grads])
+    local_lr = jnp.where(
+        (pns > 0) & (gns > 0),
+        lr * lars_coeff * pns / (gns + lars_weight_decay * pns + epsilon),
+        lr)
+    L = _per_param(local_lr, sizes)
+    v_new = mu * V + L * (G + lars_weight_decay * P)
+    return _split(P - v_new, shapes, sizes), _split(v_new, shapes, sizes)
+
+
 @register_op('fused_adam', outputs=['ParamOut', 'Moment1Out', 'Moment2Out',
                                     'Beta1PowOut', 'Beta2PowOut'],
              variadic=['params', 'grads', 'moment1s', 'moment2s',

@@ -219,12 +219,33 @@ def log_softmax(x, *, axis=-1):
     return jax.nn.log_softmax(jnp.asarray(x), axis=axis)
 
 
+def _bound_sync_axes():
+    """Mesh axes batch stats reduce over for sync-BN: the partitioner's
+    data axes that are LIVE in the surrounding trace (shard_map). On the
+    GSPMD executor no axis is bound — and none is needed: jnp.mean over
+    the globally-sharded batch already reduces over every shard, so
+    sync_stats is the identity there by construction."""
+    from ..parallel.collective import _axis_bound
+    from ..partition import get_partitioner
+    return tuple(a for a in (get_partitioner().data_axes() or ())
+                 if _axis_bound(a))
+
+
 @register_op('batch_norm', outputs=['Y', 'MeanOut', 'VarianceOut'])
 def batch_norm(x, scale, bias, mean, variance, *, momentum=0.9, epsilon=1e-5,
-               is_test=False, use_global_stats=False, data_layout='NCHW'):
+               is_test=False, use_global_stats=False, data_layout='NCHW',
+               sync_stats=False):
     """ref: paddle/fluid/operators/batch_norm_op.cc. Returns (y, new_running_
     mean, new_running_var); the graph aliases MeanOut/VarianceOut onto the
-    input stat vars so the lowered step updates state functionally."""
+    input stat vars so the lowered step updates state functionally.
+
+    ``sync_stats`` (the reference's sync_batch_norm, arXiv 1909.09756's
+    large-batch ingredient): batch mean/variance are reduced over the
+    partitioner's data axes, so every shard normalizes with GLOBAL-batch
+    statistics — mean via pmean of per-shard means (equal shard sizes),
+    variance via the E[x²]−E[x]² decomposition over the same reductions.
+    Under explicit SPMD (shard_map) this emits real collectives; on the
+    GSPMD executor the plain batch reduction is already global."""
     x = jnp.asarray(x)
     scale = jnp.asarray(scale)
     bias = jnp.asarray(bias)
@@ -241,8 +262,14 @@ def batch_norm(x, scale, bias, mean, variance, *, momentum=0.9, epsilon=1e-5,
         new_mean, new_var = mean, variance
     else:
         xf = x.astype(jnp.float32)
-        m = jnp.mean(xf, axes)
-        v = jnp.var(xf, axes)
+        sync_axes = _bound_sync_axes() if sync_stats else ()
+        if sync_axes:
+            m = lax.pmean(jnp.mean(xf, axes), sync_axes)
+            ex2 = lax.pmean(jnp.mean(jnp.square(xf), axes), sync_axes)
+            v = ex2 - jnp.square(m)
+        else:
+            m = jnp.mean(xf, axes)
+            v = jnp.var(xf, axes)
         new_mean = momentum * mean + (1 - momentum) * m.astype(mean.dtype)
         new_var = momentum * variance + (1 - momentum) * v.astype(variance.dtype)
         new_mean = lax.stop_gradient(new_mean)

@@ -45,6 +45,12 @@ class Optimizer:
     def _hypers(self):
         return {}
 
+    def _hypers_for(self, param):
+        """Per-PARAMETER hypers: the per-layer treatment hook (LARS/Lamb
+        exclude biases & norm params from weight decay). Default: the
+        shared hypers."""
+        return self._hypers()
+
     def _slot_init(self, param_shape, dtype):
         """slot name → (shape, fill value); default zeros_like(param)."""
         return {s: (param_shape, 0.0) for s in self._slot_names}
@@ -134,7 +140,7 @@ class Optimizer:
         # update ops inside a cond sub-block
         helper.main_program.current_block().append_op(
             type=self._op_type, inputs=opdef_inputs, outputs=outputs,
-            attrs=self._hypers())
+            attrs=self._hypers_for(param))
 
     # ==================================================================
     # dygraph path — fused jitted pytree update
@@ -171,7 +177,7 @@ class Optimizer:
         if self._dy_step_fn is None:
             from .ops.registry import get_op
             fn = get_op(self._op_type).fn
-            hypers = self._hypers()
+            hypers = {p.name: self._hypers_for(p) for p in params}
             has_lr = self._has_lr_input
             clip = self._grad_clip
             base_reg = self.regularization
@@ -189,7 +195,7 @@ class Optimizer:
                     args = [p, gvals[n]] + [slots[s] for s in self._slot_names]
                     if has_lr:
                         args.append(lr)
-                    res = fn(*args, **hypers)
+                    res = fn(*args, **hypers.get(n, self._hypers()))
                     res = res if isinstance(res, tuple) else (res,)
                     # pin param/slot dtypes: fp32 hypers meeting bf16 params
                     # would promote the update, and a donated step whose
@@ -251,19 +257,39 @@ class MomentumOptimizer(Optimizer):
 
 
 class LarsMomentumOptimizer(Optimizer):
+    """LARS (You et al., the ResNet large-batch recipe of arXiv
+    1909.09756 §2): per-LAYER trust ratio — each parameter's update is
+    scaled by ‖w‖/(‖∇w‖ + wd·‖w‖ + ε), so early layers with small
+    gradients and late layers with large ones both train stably at 32k
+    batch. `exclude_from_weight_decay_fn` gives it the same per-layer
+    treatment Lamb has: parameters it matches (biases, BN scale/shift —
+    the standard recipe) take lars_weight_decay=0 in THEIR update op
+    (static + dygraph paths; per-param attrs, so the fuse pass groups
+    excluded params separately and numerics are preserved)."""
+
     _op_type = 'lars_momentum'
     _slot_names = ('velocity',)
 
     def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
-                 lars_weight_decay=0.0005, **kw):
+                 lars_weight_decay=0.0005, epsilon=0.0,
+                 exclude_from_weight_decay_fn=None, **kw):
         super().__init__(learning_rate, **kw)
         self._momentum = momentum
         self._lars_coeff = lars_coeff
         self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
 
     def _hypers(self):
         return {'mu': self._momentum, 'lars_coeff': self._lars_coeff,
-                'lars_weight_decay': self._lars_weight_decay}
+                'lars_weight_decay': self._lars_weight_decay,
+                'epsilon': self._epsilon}
+
+    def _hypers_for(self, param):
+        h = self._hypers()
+        if self._exclude_fn is not None and self._exclude_fn(param):
+            h['lars_weight_decay'] = 0.0
+        return h
 
 
 class AdamOptimizer(Optimizer):
@@ -381,10 +407,20 @@ class LambOptimizer(Optimizer):
         super().__init__(learning_rate, **kw)
         self._wd, self._beta1, self._beta2, self._epsilon = \
             lamb_weight_decay, beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
 
     def _hypers(self):
         return {'weight_decay': self._wd, 'beta1': self._beta1,
                 'beta2': self._beta2, 'epsilon': self._epsilon}
+
+    def _hypers_for(self, param):
+        # ref: optimizer.py:LambOptimizer — matched params take
+        # weight_decay=0 in their own update op (the fn was previously
+        # accepted-but-ignored here; now live on both paths)
+        h = self._hypers()
+        if self._exclude_fn is not None and self._exclude_fn(param):
+            h['weight_decay'] = 0.0
+        return h
 
     def _slot_init(self, param_shape, dtype):
         return {'moment1': (param_shape, 0.0), 'moment2': (param_shape, 0.0),

@@ -42,6 +42,15 @@ class Fleet:
         from ..partition import configure, get_partitioner
         self._role_maker = role_maker or PaddleCloudRoleMaker(
             is_collective=is_collective)
+        self._role_maker.generate_role()
+        # multi-host bring-up (fleet_runtime/bootstrap.py): when the role
+        # maker carries a fleet topology (PADDLE_TRAINERS_NUM et al.),
+        # init jax.distributed against the coordinator BEFORE any mesh is
+        # built, so the partitioner's mesh spans the GLOBAL device list
+        # and the fleet sentinel is armed. Single-host: no-op.
+        from ..fleet_runtime import bootstrap as _fleet_bootstrap
+        _fleet_bootstrap(spec=getattr(self._role_maker, 'fleet_spec', None),
+                         configure_mesh=False)
         if mesh_shape or dcn_mesh_shape or axis_rules:
             configure(mesh_shape=mesh_shape, dcn_mesh_shape=dcn_mesh_shape,
                       axis_rules=axis_rules)
@@ -61,7 +70,11 @@ class Fleet:
         return rm.worker_num() if rm is not None else jax.process_count()
 
     def worker_endpoints(self, to_string=False):
-        eps = [f"process:{i}" for i in range(self.worker_num())]
+        rm = self._role_maker
+        if rm is not None and hasattr(rm, 'worker_endpoints'):
+            eps = rm.worker_endpoints()
+        else:
+            eps = [f"process:{i}" for i in range(self.worker_num())]
         return ','.join(eps) if to_string else eps
 
     def is_first_worker(self):
@@ -381,11 +394,62 @@ class RoleMakerBase:
 
 
 class PaddleCloudRoleMaker(RoleMakerBase):
-    """ref: role_maker.py:PaddleCloudRoleMaker — reads PADDLE_* env vars.
-    On TPU, topology comes from the jax runtime. In PS mode
-    (is_collective=False), TRAINING_ROLE=PSERVER processes report as servers
-    so PS launch scripts behave (nothing is served — see Fleet.run_server);
-    collective jobs ignore the env var, like the reference."""
+    """ref: role_maker.py:PaddleCloudRoleMaker — reads the PADDLE_* fleet
+    env vars, for real: topology comes from the STRICT-PARSE bootstrap
+    (fleet_runtime/bootstrap.py) — ``PADDLE_TRAINERS_NUM`` /
+    ``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINER_ENDPOINTS`` /
+    ``PADDLE_CURRENT_ENDPOINT`` — and an unknown or internally
+    contradictory environment raises at :meth:`generate_role`, listing
+    every expected variable, instead of silently running single-host
+    while the rest of the pod waits in a collective. With NO fleet env
+    set, topology falls back to the live jax runtime (the Cloud-TPU
+    path, where the TPU metadata server already initialized it).
+
+    In PS mode (is_collective=False), TRAINING_ROLE=PSERVER processes
+    report as servers so PS launch scripts behave (nothing is served —
+    see Fleet.run_server); collective jobs ignore the env var, like the
+    reference."""
+
+    def __init__(self, is_collective=True):
+        super().__init__(is_collective)
+        self._generated = False
+        self._spec = None
+
+    def generate_role(self):
+        """Parse + validate the fleet environment (idempotent). This is
+        where a malformed env fails loudly — fleet.init() calls it before
+        any distributed bring-up."""
+        if self._generated:
+            return self
+        from ..fleet_runtime.bootstrap import discover_fleet_env
+        self._spec = discover_fleet_env()
+        self._generated = True
+        return self
+
+    @property
+    def fleet_spec(self):
+        """The validated FleetSpec from env, or None (jax-runtime
+        topology). fleet.init() hands this to fleet_runtime.bootstrap."""
+        self.generate_role()
+        return self._spec
+
+    def worker_num(self):
+        self.generate_role()
+        if self._spec is not None:
+            return self._spec.num_trainers
+        return jax.process_count()
+
+    def worker_index(self):
+        self.generate_role()
+        if self._spec is not None:
+            return self._spec.trainer_id
+        return jax.process_index()
+
+    def worker_endpoints(self):
+        self.generate_role()
+        if self._spec is not None and self._spec.endpoints:
+            return list(self._spec.endpoints)
+        return [f'process:{i}' for i in range(self.worker_num())]
 
     def is_server(self):
         if self._is_collective:

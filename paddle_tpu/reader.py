@@ -176,6 +176,10 @@ class _GeneratorLoader:
         self._epoch = 0
         self._consumed = 0
         self._skip = 0
+        # fleet sharding (fleet_runtime/): rows this host keeps of every
+        # batch — None means unsharded
+        self._shard_n = None
+        self._shard_id = None
 
     # -- configuration (ref API) --
     def set_sample_generator(self, reader, batch_size, drop_last=True,
@@ -265,6 +269,54 @@ class _GeneratorLoader:
             staged[k] = jax.device_put(a)
         return staged
 
+    # -- fleet sharding (docs/DISTRIBUTED.md "Multi-host runtime") --
+    def shard_for_fleet(self, num_shards=None, shard_id=None):
+        """Per-host input sharding: every batch the reader produces is
+        row-sliced ``[shard_id::num_shards]`` on its leading dim BEFORE
+        device staging, so each host reads (and stages) only its own
+        ``process_index``-strided slice of the global batch — the
+        per-host input pipeline of arXiv 1909.09756 §3. Defaults come
+        from the bootstrapped fleet (``jax.process_count/index``); a
+        1-host fleet is a no-op. The resume cursor stays in GLOBAL batch
+        indices (all hosts consume batch i in lockstep), so per-host
+        cursors restored from a host's own shard manifest agree across
+        the fleet. Returns self (chainable)."""
+        import jax as _jax
+        n = int(num_shards if num_shards is not None
+                else _jax.process_count())
+        i = int(shard_id if shard_id is not None else _jax.process_index())
+        if n < 1 or not (0 <= i < n):
+            raise ValueError(
+                f'shard_for_fleet: shard_id {i} outside [0, {n})')
+        self._shard_n = None if n == 1 else n
+        self._shard_id = None if n == 1 else i
+        return self
+
+    def _shard_feed(self, feed):
+        """Slice every array row-strided for this host. LoDTensors are
+        rejected: a ragged batch has no row-aligned stride slicing (shard
+        upstream in the reader instead)."""
+        if self._shard_n is None:
+            return feed
+        from .core.lod import LoDTensor
+        out = {}
+        for k, v in feed.items():
+            if isinstance(v, LoDTensor):
+                raise ValueError(
+                    'DataLoader fleet sharding cannot row-slice LoDTensor '
+                    f'feed {k!r}; shard the reader itself for ragged data')
+            a = np.asarray(v)
+            if a.ndim == 0:
+                out[k] = a
+                continue
+            if a.shape[0] < self._shard_n:
+                raise ValueError(
+                    f'DataLoader fleet sharding: batch dim of {k!r} is '
+                    f'{a.shape[0]}, smaller than the {self._shard_n}-host '
+                    f'fleet')
+            out[k] = a[self._shard_id::self._shard_n]
+        return out
+
     # -- resume cursor (docs/RESILIENCE.md) --
     @property
     def epoch(self):
@@ -314,7 +366,7 @@ class _GeneratorLoader:
                             return
                         if i < skip:   # resume fast-forward: no staging cost
                             continue
-                        staged = self._stage(feed)
+                        staged = self._stage(self._shard_feed(feed))
                     finally:
                         _watchdog.disarm(lease)
                     if _obs._ENABLED:

@@ -131,6 +131,34 @@ class CheckpointManager:
         # verdict is readable as `last_verdict`
         self._supervisor = None
         self.last_verdict = None
+        # fleet integration (fleet_runtime/): when another host poisons
+        # the fleet, end_of_step returns True (exit-for-resume) and the
+        # observed record lands here so the loop can exit with
+        # FLEET_EXIT_CODE instead of 0
+        self.fleet_poisoned = None
+        self._rank = None              # resolved lazily (post-bootstrap)
+
+    # ------------------------------------------------------------------
+    # fleet plumbing (fleet_runtime/)
+    # ------------------------------------------------------------------
+    def _rank_index(self):
+        if self._rank is None:
+            import jax
+            self._rank = jax.process_index()
+        return self._rank
+
+    @staticmethod
+    def _fleet_world():
+        import jax
+        return jax.process_count()
+
+    def _sharded(self):
+        from ..fleet_runtime.sharded_ckpt import sharded_save_enabled
+        return sharded_save_enabled()
+
+    def _sentinel(self):
+        from ..fleet_runtime.coordinator import active_sentinel
+        return active_sentinel()
 
     # ------------------------------------------------------------------
     # discovery / restore
@@ -146,11 +174,44 @@ class CheckpointManager:
     def restore(self, ckpt=None):
         """→ (arrays, meta) from `ckpt` (default: latest). Books restart +
         lost-work accounting from the previous incarnation's heartbeat.
-        Returns None when there is nothing to restore."""
+        Returns None when there is nothing to restore.
+
+        Fleet restore contract (docs/RESILIENCE.md "Fleet"): on a
+        multi-host fleet every host must restore the SAME checkpoint —
+        the hosts first agree on the discovered step (a shared-FS race or
+        a half-synced directory raises instead of silently diverging),
+        then barrier so no host starts stepping against peers still
+        loading; sharded checkpoints additionally reassemble full values
+        from every host's validated shard and overlay this host's own
+        local meta (RNG/loader cursor) from its shard manifest."""
+        fleet = self._fleet_world() > 1
         ckpt = ckpt if ckpt is not None else self.latest()
+        if fleet:
+            from ..fleet_runtime.bootstrap import (all_hosts_agree,
+                                                   fleet_barrier)
+            step = -1 if ckpt is None else int(ckpt.step)
+            if not all_hosts_agree({'restore_step': step},
+                                   tag='ckpt_restore'):
+                raise RuntimeError(
+                    f'fleet restore: hosts disagree on the checkpoint to '
+                    f'restore (this host found step {step}); checkpoint '
+                    f'directory {self.directory} is not consistently '
+                    f'visible across the fleet')
+            fleet_barrier(f'ckpt_restore_{step}')
         if ckpt is None:
             return None
         arrays, meta = _snap.read_checkpoint(ckpt)
+        host_meta = meta.get('host_meta')
+        if host_meta:
+            # this host's own RNG / loader cursor (falls back to host 0's
+            # when the fleet SHRANK and this rank is new... which cannot
+            # happen — rank < world — but a GROWN fleet's extra hosts do
+            # take host 0's meta: same lockstep cursor, fresh host RNG)
+            mine = host_meta.get(str(self._rank_index())) \
+                or host_meta.get('0') or {}
+            for key in ('rng', 'python_rng', 'loader'):
+                if key in mine:
+                    meta[key] = mine[key]
         self.goodput.record_restart(meta.get('goodput'),
                                     self._read_progress())
         self.goodput.export_metrics()
@@ -236,6 +297,8 @@ class CheckpointManager:
         # IO lease over the materialize+commit (watchdog.py)
         lease = _wdg.arm_io('checkpoint_writer')
         try:
+            if self._sharded():
+                return self._write_fleet(job, lease, t0)
             # materialize: for FetchHandles this is the device→host wait +
             # copy, overlapped with the main thread's subsequent steps
             arrays = {k: np.asarray(v) for k, v in job.arrays.items()}
@@ -289,16 +352,73 @@ class CheckpointManager:
             _wdg.disarm(lease)
             job.done.set()
 
+    def _write_fleet(self, job, lease, t0):
+        """Sharded fleet save (fleet_runtime/sharded_ckpt.py): this host
+        materializes + commits ONLY the tiles it owns; host 0 then waits
+        on the coordinator-KV shard barrier and commits the fleet
+        manifest — the single global marker — LAST. Runs on the writer
+        thread; any raise is surfaced by _write's error handling."""
+        from ..fleet_runtime import sharded_ckpt as _shard
+        rank, world = self._rank_index(), self._fleet_world()
+        meta = dict(job.meta)
+        host_meta = {k: meta[k] for k in ('rng', 'python_rng', 'loader')
+                     if k in meta}
+        arrays, job.arrays = job.arrays, None
+        for attempt in range(self.retries + 1):
+            try:
+                self._fault.on_io()
+                sm = _shard.write_host_shard(
+                    self.directory, job.step, arrays,
+                    host_meta=host_meta, rank=rank, world=world)
+                break
+            except OSError as e:
+                if attempt >= self.retries:
+                    raise
+                delay = self.backoff_s * (2 ** attempt)
+                _logger.warning(
+                    'fleet shard step %d attempt %d/%d failed (%s); '
+                    'retrying in %.3fs', job.step, attempt + 1,
+                    self.retries + 1, e, delay)
+                if _obs._ENABLED:
+                    _obs.inc('checkpoint_retries',
+                             help='checkpoint IO attempts retried after '
+                                  'a failure')
+                time.sleep(delay)
+        arrays = None                  # drop handles → donation unblocks
+        if rank == 0:
+            _shard.commit_fleet_manifest(
+                self.directory, job.step, world, meta=meta,
+                saved_unix_time=time.time())
+            self._gc()
+        if _obs._ENABLED:
+            _obs.inc('checkpoint_saves',
+                     help='checkpoints committed (manifest written)')
+            _obs.inc('checkpoint_bytes', sm['payload_bytes'],
+                     help='checkpoint payload bytes written')
+            _obs.inc('checkpoint_shard_bytes', sm['payload_bytes'],
+                     help='bytes this host wrote into its own fleet '
+                          'checkpoint shards (owned tiles only)')
+            _obs.observe('checkpoint_save_seconds',
+                         time.perf_counter() - t0,
+                         help='materialize + write + commit time per '
+                              'checkpoint (background thread)')
+            _obs.set_gauge('checkpoint_last_step', job.step,
+                           help='step of the newest committed checkpoint')
+
     def _gc(self):
         """Keep the newest `keep` valid checkpoints; delete manifest FIRST
-        (decommit), then payload — a crash mid-gc can only leave an orphan
-        payload, never a manifest pointing at nothing valid. Stale temp
-        litter from crashed writers is swept too."""
+        (decommit), then payloads — a crash mid-gc can only leave orphan
+        payloads, never a manifest pointing at nothing valid. Fleet
+        checkpoints are GC'd by host 0 only (the manifest committer);
+        stale temp litter from crashed writers is swept too."""
         ckpts = _snap.list_checkpoints(self.directory)
         for ck in ckpts[:-self.keep] if len(ckpts) > self.keep else []:
+            if ck.sharded and self._rank_index() != 0:
+                continue
             try:
                 os.unlink(ck.manifest_path)
-                os.unlink(ck.payload_path)
+                for p in ck.payload_paths:
+                    os.unlink(p)
             except OSError:
                 pass
         now = time.time()
@@ -350,9 +470,37 @@ class CheckpointManager:
         self.goodput.record_step(
             now - self._last_boundary if self._last_boundary is not None
             else 0.0)
+        sentinel = self._sentinel()
+        if sentinel is not None:
+            # fleet poison poll (docs/RESILIENCE.md "Fleet propagation"):
+            # another host failed — exit for resume NOW, before
+            # dispatching a step into a collective with a dead peer. No
+            # save: a partial fleet cannot commit a fleet checkpoint; the
+            # restart resumes from the last committed one.
+            rec = sentinel.check()
+            if rec is not None:
+                self.fleet_poisoned = rec
+                _logger.error(
+                    'fleet poisoned by host %s (%s) — exiting for resume '
+                    'at step %d', rec.get('source'), rec.get('reason'),
+                    step)
+                self._write_progress(step)
+                self.goodput.export_metrics()
+                return True
         self.last_verdict = None
         if self._supervisor is not None and loss is not None:
-            verdict = self._supervisor.end_of_step(step, loss, batch_desc)
+            try:
+                verdict = self._supervisor.end_of_step(step, loss,
+                                                       batch_desc)
+            except BaseException as e:
+                # supervisor escalation (TrainingDiverged) on THIS host
+                # must take the whole fleet down for resume, not leave
+                # p-1 peers blocked in the next collective
+                if sentinel is not None:
+                    sentinel.post(f'supervisor escalation: '
+                                  f'{type(e).__name__}: {e}',
+                                  step=step, kind='supervisor')
+                raise
             self.last_verdict = verdict
             if verdict.action == 'rollback':
                 # state/RNG/step are back at the restored checkpoint: no
@@ -389,6 +537,17 @@ class CheckpointManager:
     # ------------------------------------------------------------------
     # heartbeat
     # ------------------------------------------------------------------
+    def _progress_path(self):
+        """Per-host heartbeat file: on a fleet the hosts share the
+        checkpoint directory, and p writers clobbering ONE progress.json
+        would corrupt the lost-work delta (booked from each host's own
+        heartbeat — once per host, and the fleet-level counters are host
+        0's, whose steps ARE the fleet's steps in lockstep training)."""
+        rank = self._rank_index()
+        if rank == 0:
+            return os.path.join(self.directory, PROGRESS_FILE)
+        return os.path.join(self.directory, f'progress-{rank:04d}.json')
+
     def _write_progress(self, step):
         """Tiny atomic heartbeat: how far THIS incarnation actually got.
         On restart, (heartbeat − restored checkpoint) is the lost work."""
@@ -397,15 +556,14 @@ class CheckpointManager:
                'unix_time': time.time()}
         doc.update(self.goodput.meta())
         try:
-            _snap.atomic_write_bytes(
-                os.path.join(self.directory, PROGRESS_FILE),
-                json.dumps(doc).encode())
+            _snap.atomic_write_bytes(self._progress_path(),
+                                     json.dumps(doc).encode())
         except OSError as e:
             _logger.warning('progress heartbeat failed: %s', e)
 
     def _read_progress(self):
         try:
-            with open(os.path.join(self.directory, PROGRESS_FILE)) as f:
+            with open(self._progress_path()) as f:
                 return json.load(f)
         except (OSError, ValueError):
             return None

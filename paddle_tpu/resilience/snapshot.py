@@ -91,6 +91,25 @@ class Checkpoint:
         return os.path.join(self.directory, self.manifest['payload'])
 
     @property
+    def sharded(self):
+        """Fleet checkpoint: per-host shard files + a fleet manifest
+        (fleet_runtime/sharded_ckpt.py) instead of one payload."""
+        return bool(self.manifest.get('sharded'))
+
+    @property
+    def payload_paths(self):
+        """Every payload file this checkpoint owns (GC deletes these
+        after decommitting the manifest): the single payload, or one
+        payload + one shard manifest per host for fleet checkpoints."""
+        if not self.sharded:
+            return [self.payload_path]
+        out = []
+        for sh in self.manifest.get('shards', []):
+            out.append(os.path.join(self.directory, sh['payload']))
+            out.append(os.path.join(self.directory, sh['manifest']))
+        return out
+
+    @property
     def manifest_path(self):
         return os.path.join(self.directory, _manifest_name(self.step))
 
@@ -145,6 +164,8 @@ def write_checkpoint(directory, step, arrays, meta=None, saved_unix_time=None):
 
 def _validate(directory, manifest):
     """→ error string, or None when the payload matches the manifest."""
+    if manifest.get('sharded'):
+        return _validate_sharded(directory, manifest)
     payload_path = os.path.join(directory, manifest.get('payload', ''))
     if not os.path.isfile(payload_path):
         return 'payload missing'
@@ -156,6 +177,35 @@ def _validate(directory, manifest):
         crc = zlib.crc32(f.read()) & 0xFFFFFFFF
     if crc != manifest.get('payload_crc32'):
         return 'payload CRC mismatch (corrupt write?)'
+    return None
+
+
+def _validate_sharded(directory, manifest):
+    """Fleet-manifest validation: EVERY host shard it lists must exist
+    with the recorded byte size and CRC32 — a missing or torn host shard
+    (one host died mid-write, partial rsync, bit rot) makes the whole
+    fleet checkpoint invisible to discovery, exactly like a torn
+    single-host payload."""
+    shards = manifest.get('shards')
+    if not shards:
+        return 'fleet manifest lists no shards'
+    for sh in shards:
+        spath = os.path.join(directory, sh.get('payload', ''))
+        if not os.path.isfile(spath):
+            return f"host shard {sh.get('payload')!r} missing"
+        size = os.path.getsize(spath)
+        if size != sh.get('payload_bytes'):
+            return (f"host shard {sh.get('payload')!r} is {size} bytes, "
+                    f"fleet manifest recorded {sh.get('payload_bytes')} "
+                    f"(torn shard write?)")
+        with open(spath, 'rb') as f:
+            crc = zlib.crc32(f.read()) & 0xFFFFFFFF
+        if crc != sh.get('payload_crc32'):
+            return (f"host shard {sh.get('payload')!r} CRC mismatch "
+                    f"(corrupt shard?)")
+        if not os.path.isfile(os.path.join(directory,
+                                           sh.get('manifest', ''))):
+            return f"shard manifest {sh.get('manifest')!r} missing"
     return None
 
 
@@ -179,6 +229,10 @@ def list_checkpoints(directory):
             _logger.warning('skipping unreadable checkpoint manifest %s: %s',
                             path, e)
             continue
+        if name != _manifest_name(step):
+            # per-host SHARD manifests (ckpt-N.shardKofP.json) are not
+            # commit markers — only the fleet manifest is
+            continue
         err = _validate(directory, manifest)
         if err:
             _logger.warning('skipping checkpoint step %d at %s: %s',
@@ -198,7 +252,11 @@ def latest_checkpoint(directory):
 def read_checkpoint(ckpt):
     """Checkpoint → ({flat_key: np.ndarray}, meta dict). Widened dtypes are
     cast back to their recorded originals (bitwise — the widening was
-    exact)."""
+    exact). Fleet checkpoints reassemble full values from the per-host
+    shards (fleet_runtime/sharded_ckpt.py)."""
+    if ckpt.sharded:
+        from ..fleet_runtime.sharded_ckpt import read_sharded_checkpoint
+        return read_sharded_checkpoint(ckpt)
     with np.load(ckpt.payload_path) as data:
         arrays = {k: data[k] for k in data.files}
     meta = dict(ckpt.meta)

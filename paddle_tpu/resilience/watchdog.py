@@ -44,7 +44,8 @@ from .. import observability as _obs
 from ..log_helper import get_logger
 
 __all__ = ['Watchdog', 'WatchdogLease', 'WATCHDOG_EXIT_CODE', 'enable',
-           'disable', 'active_watchdog', 'arm_step', 'arm_io', 'disarm']
+           'disable', 'active_watchdog', 'arm_step', 'arm_io', 'disarm',
+           'add_breach_hook', 'remove_breach_hook']
 
 _logger = get_logger(
     __name__, logging.INFO,
@@ -64,6 +65,24 @@ ENV_POLL = 'PADDLE_TPU_WATCHDOG_POLL_S'
 WATCHDOG_EXIT_CODE = 70
 
 _HISTORY = 32          # rolling per-lease-name duration samples
+
+# breach hooks: called with the breach record BEFORE any abort exit. The
+# fleet runtime registers one that posts the cluster-wide poison flag
+# (fleet_runtime/coordinator.py) so one wedged host turns into a
+# whole-fleet exit-for-resume instead of p-1 peers hanging in a
+# collective until their own deadlines. Hooks must be fast and must not
+# raise (the process is already going down).
+_BREACH_HOOKS = []
+
+
+def add_breach_hook(fn):
+    if fn not in _BREACH_HOOKS:
+        _BREACH_HOOKS.append(fn)
+
+
+def remove_breach_hook(fn):
+    if fn in _BREACH_HOOKS:
+        _BREACH_HOOKS.remove(fn)
 
 
 def _env_float(name, default):
@@ -247,6 +266,11 @@ class Watchdog:
         dump_path = self._dump_stacks(lease, record)
         record['stack_dump'] = dump_path
         self.breaches.append(record)
+        for hook in list(_BREACH_HOOKS):
+            try:
+                hook(record)
+            except BaseException as e:   # noqa: BLE001 — abort path
+                _logger.error('watchdog breach hook failed: %s', e)
         if _obs._ENABLED:
             _obs.inc('watchdog_breaches', lease=lease.name,
                      help='watchdog deadline breaches by lease name')

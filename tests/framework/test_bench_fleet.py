@@ -1,0 +1,36 @@
+"""tools/bench_fleet.py --smoke in tier-1: the weak-scaling bench spawns
+REAL 1- and 2-process jax.distributed fleets through the executor spine
+and must produce a well-formed summary with sane numbers. The ≥0.8
+efficiency acceptance is for the FULL (compute-bound) sizes recorded in
+PERF.md §18; smoke shrinks compute ~6×, so the collective-launch latency
+floor shows through and the smoke bar is correspondingly lower."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+def test_bench_fleet_smoke():
+    env = dict(os.environ, JAX_PLATFORMS='cpu', PYTHONPATH=REPO)
+    env.pop('XLA_FLAGS', None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'bench_fleet.py'),
+         '--smoke'],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, r.stderr[-3000:]
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.strip().startswith('{')]
+    runs = [l for l in lines if l['bench'] == 'fleet_weak_scaling']
+    summary = [l for l in lines
+               if l['bench'] == 'fleet_weak_scaling_summary'][-1]
+    assert {r_['nproc'] for r_ in runs} == {1, 2}
+    for r_ in runs:
+        assert r_['steps_per_s'] > 0
+        assert r_['global_batch'] == 2048 * r_['nproc']
+    eff2 = summary['efficiency']['2']
+    # smoke floor: the fleet must deliver a real fraction of perfect
+    # timesharing even at smoke compute (full-size acceptance is 0.8)
+    assert eff2 >= 0.35, summary
+    assert summary['efficiency']['1'] == 1.0

@@ -564,6 +564,9 @@ NONDIFF = {
                  'param sgd in test_ir_passes.py)',
     'fused_momentum': 'multi-tensor optimizer update (bitwise parity vs '
                       'per-param momentum in test_ir_passes.py)',
+    'fused_lars_momentum': 'multi-tensor optimizer update (bitwise parity '
+                           'vs per-param lars_momentum in '
+                           'test_fleet_runtime.py)',
     'fused_adam': 'multi-tensor optimizer update (bitwise parity vs per-'
                   'param adam in test_ir_passes.py)',
     'check_finite_and_unscale': 'AMP bookkeeping (tested in test_amp.py)',
