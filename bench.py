@@ -424,6 +424,18 @@ def bench_decode_engine(on_tpu):
     return measure_all(smoke=not on_tpu)
 
 
+def bench_serving_tier(on_tpu):
+    """Serving-tier bench (PERF.md §19): open-loop Poisson p50/p99 through
+    the multi-replica router (1 vs 2 replicas), prefix-cache hit rate +
+    prefill-compute-saved on a shared-system-prompt workload, disaggregated
+    handoff parity, and a zero-drop failover drill. Valid on CPU: routing,
+    caching, and scheduling are the quantities under test."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools'))
+    from bench_router import measure_all
+    return measure_all(smoke=not on_tpu)
+
+
 def bench_async_pipeline(on_tpu):
     """Async train-loop pipeline A/B (PERF.md §12): host-bound reader +
     compute-bound step, sync (per-step np.asarray) vs the K=2 in-flight
@@ -637,6 +649,21 @@ def main():
             decode_continuous_vs_drain=de['continuous']['speedup_vs_drain'],
             decode_tokens_per_s=de['continuous']['tokens_per_s'],
             decode_bitwise=de['continuous']['bitwise_equal'])
+
+    st = run("serving_tier", lambda: bench_serving_tier(on_tpu))
+    if st is not None:
+        emit({"metric": "serving_tier",
+              "scaling": st['scaling'], "prefix_cache": st['prefix_cache'],
+              "disagg": st['disagg'], "failover": st['failover']})
+        summary.update(
+            serving_tier_hit_rate=st['prefix_cache']['cache_on']['hit_rate'],
+            serving_tier_prefill_tokens_saved=(
+                st['prefix_cache']['cache_on']['prefill_tokens_saved']),
+            serving_tier_cache_speedup=st['prefix_cache']['speedup'],
+            serving_tier_failover_dropped=st['failover']['dropped'],
+            serving_tier_bitwise=(
+                st['prefix_cache']['cache_on']['bitwise_equal']
+                and st['disagg']['bitwise_equal']))
 
     pl = run("async_pipeline", lambda: bench_async_pipeline(on_tpu))
     if pl is not None:

@@ -17,6 +17,11 @@ Three layers, composable or standalone:
   :class:`DecodeEngine` + :class:`DecodeScheduler`, autoregressive
   generation over a paged KV cache with slot-based continuous batching
   and per-request :class:`GenerationStream` token streams.
+- **serving tier** (tier/ — docs/SERVING.md "Serving tier"):
+  :class:`Router` over N replicas (least-loaded, breaker-aware, mid-stream
+  failover, rolling restarts), :class:`PrefixCache` (radix prefix sharing
+  over the paged KV pool), and disaggregated prefill/decode
+  (:class:`LocalPrefillWorker` handoff seam).
 
 Quick start::
 
@@ -33,7 +38,8 @@ or the whole stack: ``python -m paddle_tpu.serving.server --model-dir …``.
 from __future__ import annotations
 
 from .errors import (DeadlineExceeded, EngineClosed, EngineUnhealthy,
-                     InvalidRequest, Overloaded, OutOfBlocks, ServingError)
+                     InvalidRequest, NoReplicaAvailable, Overloaded,
+                     OutOfBlocks, ServingError)
 from .engine import DEFAULT_MAX_BATCH, InferenceEngine, bucket_ladder
 from .batcher import (DEFAULT_BATCH_TIMEOUT_MS, DEFAULT_QUEUE_DEPTH,
                       MicroBatcher, PredictionFuture)
@@ -41,13 +47,18 @@ from .breaker import CircuitBreaker
 from .server import ServingServer, create_server
 from .decode import (DecodeEngine, DecodeScheduler, GenerationStream,
                      KVCachePool)
+from .tier import (KVPayload, LocalPrefillWorker, PrefillReplica,
+                   PrefixCache, Router, RouterServer)
 
 __all__ = ['InferenceEngine', 'MicroBatcher', 'PredictionFuture',
            'ServingServer', 'create_server', 'bucket_ladder',
            'CircuitBreaker',
            'DecodeEngine', 'DecodeScheduler', 'GenerationStream',
            'KVCachePool',
+           'Router', 'RouterServer', 'PrefixCache', 'KVPayload',
+           'LocalPrefillWorker', 'PrefillReplica',
            'ServingError', 'InvalidRequest', 'Overloaded', 'DeadlineExceeded',
            'EngineClosed', 'EngineUnhealthy', 'OutOfBlocks',
+           'NoReplicaAvailable',
            'DEFAULT_MAX_BATCH', 'DEFAULT_BATCH_TIMEOUT_MS',
            'DEFAULT_QUEUE_DEPTH']

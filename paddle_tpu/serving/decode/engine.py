@@ -25,6 +25,7 @@ it holds no queueing or lifecycle logic — that is scheduler.py.
 """
 from __future__ import annotations
 
+import contextlib
 import time
 
 import numpy as np
@@ -36,6 +37,8 @@ from .kv_cache import (CacheContext, KVCachePool, DEFAULT_BLOCK_SIZE,
                        DEFAULT_MAX_BLOCKS, DEFAULT_SLOTS)
 
 __all__ = ['DecodeEngine']
+
+_NULL_LOCK = contextlib.nullcontext()
 
 
 class DecodeEngine:
@@ -54,10 +57,16 @@ class DecodeEngine:
 
     def __init__(self, model, slots=None, block_size=None, max_blocks=None,
                  max_prompt_len=64, max_new_tokens_cap=64,
-                 prompt_buckets=None, eos_id=None):
+                 prompt_buckets=None, eos_id=None, prefix_cache=None,
+                 model_lock=None):
         self.model = model
         if hasattr(model, 'eval'):
             model.eval()           # generation is inference: no dropout
+        # colocated disaggregation (serving/tier/disagg.py) runs a prefill
+        # engine's forwards on a worker thread beside this engine's decode
+        # steps; a shared lock serializes the two MODEL calls (the dygraph
+        # tape's no_grad flag is process-global). None = zero overhead.
+        self._model_lock = model_lock
         self.slots = int(slots or DEFAULT_SLOTS)
         self.max_prompt_len = int(max_prompt_len)
         self.max_new_tokens_cap = int(max_new_tokens_cap)
@@ -80,6 +89,19 @@ class DecodeEngine:
         _m.decode_slots_total.set(self.slots)
         _m.decode_cache_blocks_total.set(self.pool.allocator.capacity)
         self._prefill_compiled = set()
+        self._step_compiled = False
+        # radix prefix cache (serving/tier/prefix_cache.py): arg wins, else
+        # the strict-parsed PADDLE_TPU_PREFIX_CACHE env knob (default off)
+        from ..tier.knobs import ENV_PREFIX_CACHE, parse_flag_env
+        if prefix_cache is None:
+            prefix_cache = parse_flag_env(ENV_PREFIX_CACHE, default=False)
+        if prefix_cache is False:
+            self.prefix_cache = None
+        elif prefix_cache is True:
+            from ..tier.prefix_cache import PrefixCache
+            self.prefix_cache = PrefixCache(self.pool)
+        else:
+            self.prefix_cache = prefix_cache
 
     # -- geometry ----------------------------------------------------------
     @property
@@ -115,10 +137,24 @@ class DecodeEngine:
                 f'{self.max_new_tokens_cap}')
         return prompt, max_new
 
-    def reserve_table(self, prompt_len, max_new_tokens):
+    def reserve_table(self, prompt_len, max_new_tokens, prompt=None):
         """Block reservation for prompt + budget (raises OutOfBlocks — the
-        scheduler treats that as 'wait for a finishing slot')."""
-        return self.pool.new_table(int(prompt_len) + int(max_new_tokens))
+        scheduler treats that as 'wait for a finishing slot'). With the
+        prefix cache enabled and ``prompt`` given, the table's front blocks
+        are shared cached-prefix blocks (``table.cached_len`` > 0) and only
+        the remainder is freshly allocated."""
+        total = int(prompt_len) + int(max_new_tokens)
+        if self.prefix_cache is not None:
+            return self.prefix_cache.acquire_table(prompt or [], total)
+        return self.pool.new_table(total)
+
+    def publish_prefix(self, prompt, table):
+        """Publish ``table``'s whole-prompt blocks into the prefix cache
+        (no-op when the cache is off). The scheduler calls this once the
+        full prompt's K/V is cached — after a cold prefill, a suffix fill,
+        or a disaggregated injection."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(prompt, table)
 
     def release_table(self, table):
         self.pool.free_table(table)
@@ -137,9 +173,11 @@ class DecodeEngine:
         table.context_len = P
         ctx = CacheContext(self.pool, 'prefill', [table])
         t0 = time.perf_counter()
-        with no_grad_guard():
-            logits = self.model(Tensor(ids, stop_gradient=True), cache=ctx)
-            row = np.asarray(logits.numpy())[0, P - 1]
+        with self._model_lock or _NULL_LOCK:
+            with no_grad_guard():
+                logits = self.model(Tensor(ids, stop_gradient=True),
+                                    cache=ctx)
+                row = np.asarray(logits.numpy())[0, P - 1]
         dt = time.perf_counter() - t0
         _m.decode_prefill_seconds.observe(dt)
         if bucket not in self._prefill_compiled:
@@ -175,12 +213,14 @@ class DecodeEngine:
             ctx_lens.append(c + 1)
         ctx = CacheContext(self.pool, 'decode', tables, ctx_lens)
         t0 = time.perf_counter()
-        with no_grad_guard():
-            logits = self.model(Tensor(ids, stop_gradient=True),
-                                pos_ids=Tensor(pos, stop_gradient=True),
-                                cache=ctx)
-            out = np.asarray(logits.numpy())[:, 0].argmax(-1)
+        with self._model_lock or _NULL_LOCK:
+            with no_grad_guard():
+                logits = self.model(Tensor(ids, stop_gradient=True),
+                                    pos_ids=Tensor(pos, stop_gradient=True),
+                                    cache=ctx)
+                out = np.asarray(logits.numpy())[:, 0].argmax(-1)
         dt = time.perf_counter() - t0
+        self._step_compiled = True
         _m.decode_step_seconds.observe(dt)
         _m.decode_steps.inc()
         active = sum(t is not None for t in tables)
@@ -188,7 +228,44 @@ class DecodeEngine:
         _m.decode_slot_occupancy.observe(active / max(S, 1))
         return out
 
+    def inject_prefill(self, table, payload):
+        """Receive a disaggregated prefill (serving/tier/disagg.py): write
+        the payload's whole K/V blocks into ``table``'s first blocks of
+        THIS pool and mark the prompt cached. Returns the payload's first
+        greedy token. ``table.cached_len`` blocks at the front (shared
+        prefix-cache blocks) are already filled and are skipped."""
+        bs = self.pool.block_size
+        if payload.block_size != bs:
+            raise InvalidRequest(
+                f'handoff block_size {payload.block_size} != engine '
+                f'block_size {bs}')
+        skip = table.cached_len // bs          # shared blocks already filled
+        nb = payload.num_blocks
+        if nb > len(table.blocks):
+            raise InvalidRequest(
+                f'handoff carries {nb} blocks but the table reserves only '
+                f'{len(table.blocks)}')
+        for layer, (k, v) in enumerate(payload.layers):
+            if skip:
+                k, v = k[:, skip:], v[:, skip:]
+            if k.shape[1]:
+                self.pool.write_whole_blocks(
+                    layer, table.blocks[skip:nb], k, v)
+        table.context_len = payload.context_len
+        _m.decode_cache_blocks_used.set(self.pool.allocator.used)
+        return int(payload.first_token)
+
     # -- warmup ------------------------------------------------------------
+    @property
+    def warmed(self):
+        """True once the whole prefill bucket ladder AND the lockstep
+        decode-step shape have compiled (via :meth:`warmup` or organic
+        traffic). Surfaced through ``/healthz`` so the serving-tier router
+        never sends traffic into a cold replica's compile cliff."""
+        return (self._step_compiled
+                and all(b in self._prefill_compiled
+                        for b in self.prompt_buckets))
+
     def warmup(self):
         """Precompile the prefill ladder + the decode-step shape before
         traffic arrives (same contract as InferenceEngine.warmup). Returns

@@ -60,7 +60,16 @@ def _scatter_tokens(pages, block_ids, offsets, vals):
 
 
 class BlockAllocator:
-    """Free-list block allocator. Block 0 (scratch) is never handed out."""
+    """Refcounted free-list block allocator. Block 0 (scratch) is never
+    handed out.
+
+    Refcounts are what make prefix sharing (serving/tier/prefix_cache.py)
+    safe: a block holding a shared system-prompt's K/V is referenced by
+    every live request reading it PLUS the cache's own residency reference,
+    and only returns to the free list when the LAST reference releases it.
+    ``allocate`` hands blocks out at refcount 1; ``free``/``release`` are
+    the same operation (decrement, recycle at zero) so pre-sharing callers
+    keep their exact semantics."""
 
     def __init__(self, num_blocks):
         if num_blocks < 2:
@@ -68,6 +77,7 @@ class BlockAllocator:
                              f'{num_blocks}')
         self.num_blocks = int(num_blocks)
         self._free = list(range(self.num_blocks - 1, 0, -1))  # pop() -> 1..
+        self._refs = {}               # live block id -> refcount >= 1
         self._lock = threading.Lock()
 
     @property
@@ -83,35 +93,67 @@ class BlockAllocator:
     def used(self):
         return self.capacity - self.available
 
+    def refcount(self, block_id):
+        """Live references on ``block_id`` (0 = on the free list)."""
+        with self._lock:
+            return self._refs.get(int(block_id), 0)
+
     def allocate(self, n):
-        """n block ids, or raise :class:`OutOfBlocks` (nothing allocated)."""
+        """n block ids at refcount 1, or raise :class:`OutOfBlocks`
+        (nothing allocated)."""
         n = int(n)
         with self._lock:
             if n > len(self._free):
                 raise OutOfBlocks(n, len(self._free))
-            return [self._free.pop() for _ in range(n)]
+            ids = [self._free.pop() for _ in range(n)]
+            for b in ids:
+                self._refs[b] = 1
+            return ids
 
-    def free(self, block_ids):
+    def retain(self, block_ids):
+        """Add one reference per block (sharing an already-live block)."""
+        with self._lock:
+            for b in block_ids:
+                b = int(b)
+                if b not in self._refs:
+                    raise ValueError(f'retain of non-live block {b}')
+                self._refs[b] += 1
+
+    def release(self, block_ids):
+        """Drop one reference per block; blocks reaching zero return to the
+        free list. Releasing a non-live block raises (double-free)."""
         with self._lock:
             for b in block_ids:
                 b = int(b)
                 if b == SCRATCH_BLOCK:
                     raise ValueError('freeing the scratch block')
-                if b in self._free:
+                if b not in self._refs:
                     raise ValueError(f'double free of block {b}')
-                self._free.append(b)
+                self._refs[b] -= 1
+                if self._refs[b] == 0:
+                    del self._refs[b]
+                    self._free.append(b)
+
+    # exclusive ownership (refcount 1) makes free == release; kept as the
+    # name the pre-sharing callers (engine/scheduler/tests) use
+    free = release
 
 
 class BlockTable:
     """One request's cache blocks, in sequence order. ``context_len`` is the
     number of cached tokens (prompt + generated so far)."""
 
-    __slots__ = ('blocks', 'block_size', 'context_len')
+    __slots__ = ('blocks', 'block_size', 'context_len', 'cached_len')
 
-    def __init__(self, blocks, block_size):
+    def __init__(self, blocks, block_size, cached_len=0):
         self.blocks = list(blocks)
         self.block_size = int(block_size)
         self.context_len = 0
+        # tokens at the FRONT of the table already filled by shared
+        # prefix-cache blocks (always a whole-block multiple); this request
+        # must never write positions < cached_len — they belong to every
+        # other request sharing those blocks
+        self.cached_len = int(cached_len)
 
     @property
     def capacity_tokens(self):
@@ -219,6 +261,30 @@ class KVCachePool:
         offs = np.asarray(offsets, np.int32)
         pages[0] = _scatter_tokens(pages[0], ids, offs, k)
         pages[1] = _scatter_tokens(pages[1], ids, offs, v)
+
+    # -- whole-block transfer (serving/tier/disagg.py handoff) -------------
+    def read_blocks(self, layer, block_ids):
+        """Gather whole blocks as host arrays: ``(k, v)`` each
+        (H, nb, block_size, D). The disaggregation payload format — a
+        prefill replica reads its finished blocks out, a decode replica
+        writes them into its own pool ids."""
+        ids = np.asarray(block_ids, np.int32)
+        k_pages, v_pages = self._layers[layer]
+        return (np.asarray(k_pages[:, ids]), np.asarray(v_pages[:, ids]))
+
+    def write_whole_blocks(self, layer, block_ids, k, v):
+        """Scatter whole blocks (the :meth:`read_blocks` shapes) into this
+        pool at ``block_ids`` — the receiving half of a KV handoff."""
+        h, nb, bs, d = k.shape
+        if bs != self.block_size:
+            raise InvalidRequest(
+                f'handoff block_size {bs} != pool block_size '
+                f'{self.block_size}')
+        pages = self.ensure_layer(layer, h, d)
+        ids = np.asarray(block_ids, np.int32)
+        import jax.numpy as jnp
+        pages[0] = _scatter_blocks(pages[0], ids, jnp.asarray(k))
+        pages[1] = _scatter_blocks(pages[1], ids, jnp.asarray(v))
 
     # -- observability -----------------------------------------------------
     def utilization(self):

@@ -35,9 +35,11 @@ work). Backpressure and drain/fail-fast close mirror MicroBatcher.
 from __future__ import annotations
 
 import collections
+import os
 import queue
 import threading
 import time
+import uuid
 
 from .. import metrics as _m
 from ..breaker import CircuitBreaker
@@ -60,16 +62,30 @@ class GenerationStream:
 
     ``finish_reason``: 'stop' (eos) | 'length' (budget) | None while
     running. Failures (engine error, deadline, shutdown) raise from both
-    the iterator and ``result()``."""
+    the iterator and ``result()``.
 
-    def __init__(self, prompt_len, max_new_tokens):
+    Identity (``meta`` / the final HTTP NDJSON line): ``replica_id`` names
+    the serving process, ``request_id`` is restart-safe — a fresh random
+    component per submission, so retries after a replica restart or a
+    router failover never collide and clients can correlate the attempts
+    of one logical request across replicas."""
+
+    def __init__(self, prompt_len, max_new_tokens, replica_id=None):
         self.prompt_len = prompt_len
         self.max_new_tokens = max_new_tokens
+        self.replica_id = replica_id
+        self.request_id = uuid.uuid4().hex[:16]
         self._q = queue.Queue()
         self._tokens = []
         self._done = threading.Event()
         self._exc = None
         self.finish_reason = None
+
+    @property
+    def meta(self):
+        """Result metadata: {'request_id', 'replica_id'} — stable from
+        submission, valid before/after completion."""
+        return {'request_id': self.request_id, 'replica_id': self.replica_id}
 
     # -- consumer side -----------------------------------------------------
     def __iter__(self):
@@ -126,18 +142,29 @@ class GenerationStream:
 
 class _Request:
     __slots__ = ('prompt', 'max_new_tokens', 'eos_id', 'stream', 'deadline',
-                 'enqueued_at', 'table', 'next_token', 'generated')
+                 'enqueued_at', 'table', 'next_token', 'generated',
+                 'pending_prompt', 'prefilling', 'handoff_pending')
 
-    def __init__(self, prompt, max_new_tokens, eos_id, deadline):
+    def __init__(self, prompt, max_new_tokens, eos_id, deadline,
+                 replica_id=None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
-        self.stream = GenerationStream(len(prompt), max_new_tokens)
+        self.stream = GenerationStream(len(prompt), max_new_tokens,
+                                       replica_id=replica_id)
         self.deadline = deadline
         self.enqueued_at = time.monotonic()
         self.table = None
         self.next_token = None        # sampled but not yet cached/emitted?
         self.generated = 0
+        # chunked suffix fill (prefix-cache hit): prompt tokens still to be
+        # fed through the lockstep step; while prefilling, step outputs are
+        # discarded (the next fed token is forced to the prompt)
+        self.pending_prompt = None
+        self.prefilling = False
+        # disaggregation: admitted, slot reserved, waiting for the prefill
+        # replica's KV payload — inactive in the lockstep step until then
+        self.handoff_pending = False
 
     def expired(self, now):
         return self.deadline is not None and now > self.deadline
@@ -154,11 +181,21 @@ class DecodeScheduler:
 
     def __init__(self, engine, queue_depth=DEFAULT_QUEUE_DEPTH,
                  admission='continuous', default_timeout_ms=None,
-                 breaker_failures=None, breaker_reset_s=None, start=True):
+                 breaker_failures=None, breaker_reset_s=None, start=True,
+                 replica_id=None, disagg=None):
         if admission not in ('continuous', 'drain'):
             raise ValueError(f"admission must be 'continuous' or 'drain', "
                              f"got {admission!r}")
         self.engine = engine
+        # identity stamped into every GenerationStream's result metadata
+        # (serving-tier failover correlation); free-form, not a strict knob
+        self.replica_id = (replica_id
+                           or os.environ.get('PADDLE_TPU_REPLICA_ID')
+                           or f'replica-{os.getpid()}')
+        # disaggregated prefill (serving/tier/disagg.py): cache-miss
+        # prompts hand off to prefill-role replicas instead of stalling
+        # the lockstep decode loop on an inline bucket forward
+        self.disagg = disagg
         # circuit breaker (serving/breaker.py): consecutive engine failures
         # (prefill or lockstep step) trip it — waiting requests fail fast
         # with EngineUnhealthy, /healthz reports degraded, a half-open probe
@@ -202,7 +239,7 @@ class DecodeScheduler:
             else time.monotonic() + float(timeout_ms) / 1e3
         req = _Request(prompt, max_new,
                        self.engine.eos_id if eos_id is None else eos_id,
-                       deadline)
+                       deadline, replica_id=self.replica_id)
         with self._cv:
             if self._closing:
                 raise EngineClosed('decode scheduler is shutting down')
@@ -257,7 +294,8 @@ class DecodeScheduler:
             req = self._waiting[0]
             try:
                 req.table = self.engine.reserve_table(len(req.prompt),
-                                                      req.max_new_tokens)
+                                                      req.max_new_tokens,
+                                                      prompt=req.prompt)
             except OutOfBlocks:
                 break                 # FIFO: wait for blocks, don't skip
             self._waiting.popleft()
@@ -266,7 +304,32 @@ class DecodeScheduler:
         _m.decode_queue_depth.set(len(self._waiting))
         return admitted
 
+    def _publish(self, req):
+        """Publish the fully-cached prompt into the engine's prefix cache
+        (no-op for cache-off and duck-typed engines)."""
+        if getattr(self.engine, 'prefix_cache', None) is not None:
+            self.engine.publish_prefix(req.prompt, req.table)
+
     def _prefill(self, req):
+        cached = getattr(req.table, 'cached_len', 0)
+        if cached:
+            # prefix-cache hit: the front of the table is already-filled
+            # shared blocks; the uncached suffix rides the SAME lockstep
+            # decode step as everyone else's generation (chunked prefill —
+            # bitwise-identical rows by the PR 6 parity contract), so a
+            # long shared prompt costs only its suffix
+            req.table.context_len = cached
+            req.next_token = req.prompt[cached]
+            req.pending_prompt = collections.deque(req.prompt[cached + 1:])
+            req.prefilling = True
+            return
+        if self.disagg is not None:
+            # cache miss under disaggregation: ship the prompt to a
+            # prefill-role replica; this slot stays inactive (and the
+            # decode loop keeps stepping) until the KV payload lands
+            req.handoff_pending = True
+            self.disagg.submit(req, req.prompt, req.max_new_tokens)
+            return
         try:
             first = self.engine.prefill(req.prompt, req.table)
         except Exception as e:
@@ -274,7 +337,33 @@ class DecodeScheduler:
             self._record_engine_failure()
             return
         self.breaker.record_success()
+        self._publish(req)
         self._emit_token(req, first)
+
+    def _drain_handoffs(self, timeout=0.0):
+        """Apply finished prefill handoffs: inject the KV payload into the
+        decode pool (worker thread — the engine has ONE owner) and emit the
+        first token. Payloads for requests already failed/closed are
+        dropped (their table is gone)."""
+        if self.disagg is None:
+            return
+        for req, payload, exc in self.disagg.drain_completed(timeout):
+            if req not in self._slots or req.table is None:
+                continue              # failed or closed while in flight
+            req.handoff_pending = False
+            if exc is not None:
+                self._fail_request(req, exc)
+                self._record_engine_failure()
+                continue
+            try:
+                first = self.engine.inject_prefill(req.table, payload)
+            except Exception as e:
+                self._fail_request(req, e)
+                self._record_engine_failure()
+                continue
+            self.breaker.record_success()
+            self._publish(req)
+            self._emit_token(req, first)
 
     def _record_engine_failure(self):
         """Book one engine-failure batch with the breaker; on a trip, fail
@@ -327,24 +416,39 @@ class DecodeScheduler:
                              f'{type(exc).__name__}: {exc}'))
 
     def _step(self):
-        """One lockstep decode step over the current slots."""
+        """One lockstep decode step over the current slots. Handoff-pending
+        slots are inactive lanes (scratch reads); suffix-filling slots feed
+        their next PROMPT token and their sampled output is discarded until
+        the prompt is exhausted — the step after the last prompt token
+        yields the first generated token."""
         live = [r for r in self._slots if r is not None]
-        if not live:
-            return False
-        tokens = [r.next_token if r is not None else None
-                  for r in self._slots]
-        tables = [r.table if r is not None else None for r in self._slots]
+        active = [r for r in live if not r.handoff_pending]
+        if not active:
+            return bool(live)         # only pending handoffs: work remains
+        tokens = [r.next_token if r is not None and not r.handoff_pending
+                  else None for r in self._slots]
+        tables = [r.table if r is not None and not r.handoff_pending
+                  else None for r in self._slots]
         try:
             out = self.engine.decode_step(tokens, tables)
         except Exception as e:
-            for req in live:        # isolate: fail the batch, keep serving
+            for req in active:      # isolate: fail the batch, keep serving
                 self._fail_request(req, e)
             self._record_engine_failure()
             return True
         self.breaker.record_success()
         for i, req in enumerate(self._slots):
-            if req is not None:
-                self._emit_token(req, int(out[i]))
+            if req is None or req.handoff_pending:
+                continue
+            if req.prefilling:
+                if req.pending_prompt:
+                    req.next_token = req.pending_prompt.popleft()
+                    continue          # still feeding the prompt suffix
+                # the step above consumed the LAST prompt token: its whole
+                # K/V is now cached — publish, then emit the first token
+                req.prefilling = False
+                self._publish(req)
+            self._emit_token(req, int(out[i]))
         return True
 
     def _fail_all_locked(self):
@@ -374,6 +478,15 @@ class DecodeScheduler:
                 admitted = self._admit_locked()
             for req in admitted:
                 self._prefill(req)
+            # finished prefill handoffs join before the step; when ONLY
+            # handoffs are in flight, block briefly on the completion
+            # queue instead of spinning the loop hot
+            only_pending = (self.disagg is not None
+                            and any(r is not None and r.handoff_pending
+                                    for r in self._slots)
+                            and all(r is None or r.handoff_pending
+                                    for r in self._slots))
+            self._drain_handoffs(0.01 if only_pending else 0.0)
             stepped = self._step()
             if not stepped and not admitted:
                 with self._cv:

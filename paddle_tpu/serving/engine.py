@@ -244,6 +244,13 @@ class InferenceEngine:
     def compiled_buckets(self):
         return sorted(self._compiled_buckets)
 
+    @property
+    def warmed(self):
+        """True once every ladder rung has compiled (warmup or traffic) —
+        surfaced through /healthz for the serving-tier router's cold-replica
+        gate."""
+        return all(b in self._compiled_buckets for b in self.buckets)
+
     def get_input_names(self):
         return list(self.feed_names)
 

@@ -10,7 +10,8 @@ for a latency we could not meet* (DeadlineExceeded), and *we are going away*
 from __future__ import annotations
 
 __all__ = ['ServingError', 'InvalidRequest', 'Overloaded', 'DeadlineExceeded',
-           'EngineClosed', 'EngineUnhealthy', 'OutOfBlocks']
+           'EngineClosed', 'EngineUnhealthy', 'OutOfBlocks',
+           'NoReplicaAvailable']
 
 
 class ServingError(RuntimeError):
@@ -59,6 +60,23 @@ class EngineUnhealthy(ServingError):
             f'{name} circuit breaker is open{detail}; '
             f'failing fast instead of queueing onto a broken engine')
         self.failures = failures
+
+
+class NoReplicaAvailable(ServingError):
+    """The serving-tier router found no routable replica — every replica is
+    cold, draining, degraded, or dead — and the wait window expired. Maps
+    to HTTP 503; clients back off and retry (tier/router.py)."""
+
+    def __init__(self, replica_states=None):
+        states = ''
+        if replica_states:
+            states = '; replicas: ' + ', '.join(
+                f"{s['url']} (healthy={s['healthy']} warmed={s['warmed']} "
+                f"draining={s['draining']})" for s in replica_states)
+        super().__init__(
+            f'no routable replica (all cold, draining, degraded, or '
+            f'dead){states}')
+        self.replica_states = replica_states
 
 
 class OutOfBlocks(ServingError):

@@ -228,3 +228,86 @@ DECODE_BREAKER_METRICS = {'state': decode_breaker_state,
                           'trips': decode_breaker_trips,
                           'rejected': decode_breaker_rejected,
                           'probes': decode_breaker_probes}
+
+
+# -- serving tier (serving/tier/, docs/SERVING.md "Serving tier") ----------
+# Same always-on discipline: the router/cache/handoff paths run per-request
+# (ms-scale), and an operator scraping a router box must see these without
+# PADDLE_TPU_TELEMETRY.
+
+# radix prefix cache over the paged KV pool (tier/prefix_cache.py)
+prefix_cache_hits = _LazyMetric(
+    'counter', 'prefix_cache_hits',
+    'admissions that matched >= 1 whole cached block of their prompt')
+prefix_cache_misses = _LazyMetric(
+    'counter', 'prefix_cache_misses',
+    'admissions with no cached prefix (cold prompts)')
+prefix_cache_tokens_saved = _LazyMetric(
+    'counter', 'prefix_cache_tokens_saved',
+    'prompt tokens served from cached KV blocks instead of prefill '
+    'compute — the prefill-compute-saved signal')
+prefix_cache_blocks_resident = _LazyMetric(
+    'gauge', 'prefix_cache_blocks_resident',
+    'KV blocks currently held resident by the prefix-cache trie')
+prefix_cache_inserted_blocks = _LazyMetric(
+    'counter', 'prefix_cache_inserted_blocks',
+    'whole prompt blocks published into the trie')
+prefix_cache_evicted_blocks = _LazyMetric(
+    'counter', 'prefix_cache_evicted_blocks',
+    'cached blocks evicted (LRU over refcount-idle leaves) under pool or '
+    'cap pressure')
+
+# multi-replica router (tier/router.py)
+router_requests = _LazyMetric(
+    'counter', 'router_requests', 'generation requests entering the router')
+router_requests_completed = _LazyMetric(
+    'counter', 'router_requests_completed',
+    'routed requests that finished (done line / full reply)')
+router_requests_failed = _LazyMetric(
+    'counter', 'router_requests_failed',
+    'routed requests that failed after streaming began (in-flight on a '
+    'dying replica) or exhausted every replica')
+router_requests_rerouted = _LazyMetric(
+    'counter', 'router_requests_rerouted',
+    'dispatch attempts moved to another replica before first byte '
+    '(connection refused / 503 / replica died pre-stream) — the '
+    'zero-drop failover counter')
+router_no_replica = _LazyMetric(
+    'counter', 'router_no_replica',
+    'pick attempts that found no routable replica (all cold, draining, '
+    'degraded, or dead)')
+router_replicas_routable = _LazyMetric(
+    'gauge', 'router_replicas_routable',
+    'replicas currently healthy + warm + not draining')
+router_replica_inflight = _LazyMetric(
+    'gauge', 'router_replica_inflight',
+    'router-side in-flight requests per replica (label replica)')
+router_dispatch_seconds = _LazyMetric(
+    'histogram', 'router_dispatch_seconds',
+    'submit -> replica response headers per dispatch attempt')
+router_health_polls = _LazyMetric(
+    'counter', 'router_health_polls', 'replica /healthz polls issued')
+router_probes = _LazyMetric(
+    'counter', 'router_probes',
+    'requests routed to a half-open (probing) replica to re-admit it')
+router_rolling_restarts = _LazyMetric(
+    'counter', 'router_rolling_restarts',
+    'replicas restarted behind a drain by rolling_restart()')
+
+# disaggregated prefill/decode (tier/disagg.py)
+disagg_handoffs = _LazyMetric(
+    'counter', 'disagg_handoffs',
+    'prefill->decode KV handoffs completed')
+disagg_handoff_failures = _LazyMetric(
+    'counter', 'disagg_handoff_failures',
+    'handoffs that failed (prefill error); the request fails typed, the '
+    'decode loop keeps stepping')
+disagg_handoff_seconds = _LazyMetric(
+    'histogram', 'disagg_handoff_seconds',
+    'admission -> KV blocks injected into the decode pool, per handoff')
+disagg_kv_bytes = _LazyMetric(
+    'counter', 'disagg_kv_bytes',
+    'KV payload bytes shipped from prefill to decode replicas')
+disagg_pending = _LazyMetric(
+    'gauge', 'disagg_pending',
+    'admitted requests waiting on a prefill handoff right now')

@@ -95,7 +95,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(503, {'status': 'degraded',
                                   'breakers': srv.breaker_states()})
             else:
-                body = {'status': 'ok'}
+                body = {'status': 'ok', 'replica': srv.replica_id,
+                        'warmup': srv.warmup_status()}
                 if srv.engine is not None:
                     body['buckets'] = srv.engine.buckets
                     body['compiled'] = srv.engine.compiled_buckets
@@ -259,7 +260,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._error(500, e)
             return self._reply(200, {
                 'tokens': toks, 'finish_reason': stream.finish_reason,
-                'latency_ms': round((time.perf_counter() - t0) * 1e3, 3)})
+                'latency_ms': round((time.perf_counter() - t0) * 1e3, 3),
+                **stream.meta})
 
         # chunked per-token streaming
         self.send_response(200)
@@ -275,7 +277,8 @@ class _Handler(BaseHTTPRequestHandler):
                     'done': True, 'finish_reason': stream.finish_reason,
                     'tokens': stream.tokens,
                     'latency_ms': round((time.perf_counter() - t0) * 1e3,
-                                        3)})
+                                        3),
+                    **stream.meta})
             except (BrokenPipeError, ConnectionResetError):
                 raise                 # client went away: just stop
             except Exception as e:    # failure mid-stream: error line
@@ -344,6 +347,29 @@ class ServingServer:
     @property
     def port(self):
         return self._httpd.server_address[1]
+
+    @property
+    def replica_id(self):
+        """This serving process's identity (stamped into /healthz and every
+        GenerationStream's metadata)."""
+        if self.generator is not None:
+            return self.generator.replica_id
+        return (os.environ.get('PADDLE_TPU_REPLICA_ID')
+                or f'replica-{os.getpid()}')
+
+    def warmup_status(self):
+        """Per-component compile-warmth for /healthz: the serving-tier
+        router refuses to route to a replica whose ``done`` is false, so a
+        restart never serves its first requests into a compile cliff.
+        ``done`` = every configured component (predict bucket ladder,
+        decode prefill ladder + lockstep step shape) is precompiled."""
+        status = {}
+        if self.engine is not None:
+            status['predict'] = self.engine.warmed
+        if self.generator is not None:
+            status['decode'] = self.generator.engine.warmed
+        status['done'] = all(status.values()) if status else False
+        return status
 
     def breaker_states(self):
         """{component: breaker state} for every NON-closed circuit breaker

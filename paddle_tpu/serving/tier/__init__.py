@@ -1,0 +1,43 @@
+"""Serving tier (docs/SERVING.md "Serving tier"): the planet-scale layer in
+front of N engine replicas — ROADMAP item 3.
+
+Three composable pieces:
+
+- :class:`Router` / :class:`RouterServer` (router.py) — least-loaded
+  dispatch over replicas using their always-on ``/healthz`` + ``decode_*``
+  load, circuit-breaker awareness (degraded drained, half-open probed),
+  cold-replica gating on the ``warmup`` field, mid-stream failover with the
+  zero-drop first-event rule, and rolling restarts behind drain.
+- :class:`PrefixCache` (prefix_cache.py) — radix trie at block granularity
+  over the paged KV pool: shared system prompts resolve to already-filled
+  refcounted blocks, prefill runs only on the uncached suffix (chunked
+  through the lockstep decode step — bitwise parity preserved), LRU
+  eviction over refcount-idle blocks. Enable per engine
+  (``DecodeEngine(prefix_cache=True)`` / ``PADDLE_TPU_PREFIX_CACHE=1``).
+- disaggregated prefill/decode (disagg.py) — :class:`PrefillReplica` runs
+  the bucket ladder on a prefill-role engine and ships
+  :class:`KVPayload` (whole KV blocks + first token) to decode-role
+  replicas through the :class:`LocalPrefillWorker` handoff seam
+  (``DecodeScheduler(disagg=...)`` / ``PADDLE_TPU_DISAGG=1``).
+
+Quick start::
+
+    # replicas (one process each)
+    python -m paddle_tpu.serving.tier.replica --port 8081
+    python -m paddle_tpu.serving.tier.replica --port 8082
+    # router
+    python -m paddle_tpu.serving.tier.router \
+        --replica http://127.0.0.1:8081 --replica http://127.0.0.1:8082
+"""
+from __future__ import annotations
+
+from .knobs import (parse_flag_env, parse_float_env, parse_int_env,
+                    parse_replicas_env)
+from .prefix_cache import PrefixCache
+from .disagg import KVPayload, LocalPrefillWorker, PrefillReplica
+from .router import Replica, RoutedGeneration, Router, RouterServer
+
+__all__ = ['Router', 'RouterServer', 'RoutedGeneration', 'Replica',
+           'PrefixCache', 'KVPayload', 'LocalPrefillWorker',
+           'PrefillReplica', 'parse_flag_env', 'parse_float_env',
+           'parse_int_env', 'parse_replicas_env']

@@ -1,0 +1,207 @@
+"""Disaggregated prefill/decode: prefill-role replicas run the bucket
+ladder and ship finished KV blocks + the first greedy token to decode-role
+replicas, so long prompts stop stalling the lockstep ``(S, 1)`` decode step
+(docs/SERVING.md "Serving tier").
+
+Why split the phases: prefill and decode want opposite shapes. Prefill is
+one big bucket-padded forward (compute-bound, O(P²) attention); decode is a
+tiny fixed-shape step whose latency IS the per-token latency of every
+active stream. Colocated, each admission's prefill runs between decode
+steps and every active stream's next token waits behind it. Disaggregated,
+the scheduler marks the admitted slot handoff-pending and keeps stepping;
+a prefill worker runs the prompt on its OWN engine/pool and hands back a
+:class:`KVPayload`; the decode worker injects the whole blocks (one scatter
+per layer) and the stream starts.
+
+The HANDOFF INTERFACE is the seam: :class:`LocalPrefillWorker` is the
+in-process transport (threads + queues — the form a single-host deployment
+uses, and what the parity tests pin); :meth:`KVPayload.to_bytes` /
+:meth:`KVPayload.from_bytes` define the wire format a cross-host transport
+ships, so a network hop slots in behind the same
+``submit``/``drain_completed`` contract without touching the scheduler.
+
+Bitwise parity: the prefill engine runs the SAME model weights and the same
+bucket-padded matmul formulation, so the shipped K/V bytes equal what a
+colocated prefill would have written — the decode trajectory is
+``array_equal``-identical to colocated and to the uncached whole-sequence
+reference (tests/framework/test_disagg.py).
+"""
+from __future__ import annotations
+
+import io
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .. import metrics as _m
+from ..errors import ServingError
+
+__all__ = ['KVPayload', 'PrefillReplica', 'LocalPrefillWorker']
+
+
+class KVPayload:
+    """One finished prefill: whole KV blocks for every layer + the first
+    greedy token. ``layers[i]`` is ``(k, v)`` with shape
+    (H, num_blocks, block_size, D) — the :meth:`KVCachePool.read_blocks`
+    layout, scatter-ready on the decode side."""
+
+    __slots__ = ('layers', 'context_len', 'first_token', 'block_size')
+
+    def __init__(self, layers, context_len, first_token, block_size):
+        self.layers = layers
+        self.context_len = int(context_len)
+        self.first_token = int(first_token)
+        self.block_size = int(block_size)
+
+    @property
+    def num_blocks(self):
+        return self.layers[0][0].shape[1] if self.layers else 0
+
+    @property
+    def nbytes(self):
+        return sum(k.nbytes + v.nbytes for k, v in self.layers)
+
+    # -- wire format (the cross-host seam) ---------------------------------
+    def to_bytes(self):
+        arrays = {'meta': np.asarray([self.context_len, self.first_token,
+                                      self.block_size], np.int64)}
+        for i, (k, v) in enumerate(self.layers):
+            arrays[f'k{i}'] = k
+            arrays[f'v{i}'] = v
+        buf = io.BytesIO()
+        # wire serialization into memory — no file, torn-write-proof
+        # commit does not apply
+        np.savez(buf, **arrays)  # lint: allow-io (in-memory BytesIO, not a file)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data):
+        with np.load(io.BytesIO(data)) as z:
+            ctx, first, bs = (int(x) for x in z['meta'])
+            layers = []
+            i = 0
+            while f'k{i}' in z:
+                layers.append((z[f'k{i}'], z[f'v{i}']))
+                i += 1
+        return cls(layers, ctx, first, bs)
+
+
+class PrefillReplica:
+    """Prefill-role wrapper around a :class:`DecodeEngine`: its pool is
+    scratch space — blocks live only from prefill to payload extraction,
+    then free. One worker thread owns it (``LocalPrefillWorker``)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def prefill_to_payload(self, prompt, max_new_tokens=0):
+        """Run the bucket-padded prompt on the prefill engine, read the
+        finished blocks out, free them, return the :class:`KVPayload`."""
+        eng = self.engine
+        bs = eng.pool.block_size
+        table = eng.pool.new_table(len(prompt))   # prompt only: scratch use
+        try:
+            first = eng.prefill(prompt, table)
+            nb = -(-len(prompt) // bs)
+            layers = [eng.pool.read_blocks(layer, table.blocks[:nb])
+                      for layer in range(eng.pool.num_layers)]
+        finally:
+            eng.release_table(table)
+        return KVPayload(layers, len(prompt), first, bs)
+
+
+class LocalPrefillWorker:
+    """In-process handoff transport: a worker thread pool running
+    :class:`PrefillReplica` jobs, feeding a completion queue the decode
+    scheduler drains between steps.
+
+    Contract consumed by ``DecodeScheduler(disagg=...)``:
+
+    - ``submit(key, prompt, max_new_tokens)`` — enqueue one prefill; never
+      blocks the caller.
+    - ``drain_completed(timeout)`` — all finished ``(key, payload, exc)``
+      triples; ``exc`` is a typed ServingError when the prefill failed
+      (the request fails, the decode loop keeps serving).
+    """
+
+    def __init__(self, prefill_replicas, start=True):
+        if not isinstance(prefill_replicas, (list, tuple)):
+            prefill_replicas = [prefill_replicas]
+        self.replicas = [r if isinstance(r, PrefillReplica)
+                         else PrefillReplica(r) for r in prefill_replicas]
+        self._jobs = queue.Queue()
+        self._done = queue.Queue()
+        self._closing = False
+        self._pending = 0
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._run, args=(rep,),
+                             name=f'paddle-tpu-prefill-worker-{i}',
+                             daemon=True)
+            for i, rep in enumerate(self.replicas)]
+        if start:
+            for t in self._threads:
+                t.start()
+
+    @property
+    def pending(self):
+        with self._lock:
+            return self._pending
+
+    def submit(self, key, prompt, max_new_tokens=0):
+        with self._lock:
+            self._pending += 1
+            _m.disagg_pending.set(self._pending)
+        self._jobs.put((key, list(prompt), int(max_new_tokens),
+                        time.perf_counter()))
+
+    def drain_completed(self, timeout=0.0):
+        out = []
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                remaining = deadline - time.monotonic()
+                if out or remaining <= 0:
+                    out.append(self._done.get_nowait())
+                else:
+                    out.append(self._done.get(timeout=remaining))
+            except queue.Empty:
+                return out
+
+    def _run(self, replica):
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            key, prompt, max_new, t0 = job
+            payload, exc = None, None
+            try:
+                payload = replica.prefill_to_payload(prompt, max_new)
+            except Exception as e:
+                exc = e if isinstance(e, ServingError) else ServingError(
+                    f'disaggregated prefill failed: '
+                    f'{type(e).__name__}: {e}')
+                _m.disagg_handoff_failures.inc()
+            with self._lock:
+                self._pending -= 1
+                _m.disagg_pending.set(self._pending)
+            if payload is not None:
+                _m.disagg_handoffs.inc()
+                _m.disagg_kv_bytes.inc(payload.nbytes)
+                _m.disagg_handoff_seconds.observe(time.perf_counter() - t0)
+            self._done.put((key, payload, exc))
+
+    def close(self):
+        self._closing = True
+        for _ in self._threads:
+            self._jobs.put(None)
+        for t in self._threads:
+            t.join(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,107 @@
+"""Strict-parse env knobs for the serving tier (house style per
+PRs 9/12: a malformed value raises ValueError naming the knob and listing
+the supported set, instead of silently falling back while the operator
+believes the knob took effect).
+
+Parsed at USE time (constructors / CLI mains), never at import — a bad
+environment must fail the component that reads it, not every
+``import paddle_tpu``.
+
+| knob | form | used by |
+|---|---|---|
+| ``PADDLE_TPU_PREFIX_CACHE``            | ``0`` / ``1``          | DecodeEngine |
+| ``PADDLE_TPU_PREFIX_CACHE_MAX_BLOCKS`` | int >= 0 (0 = uncapped)| PrefixCache |
+| ``PADDLE_TPU_DISAGG``                  | ``0`` / ``1``          | tier/replica.py |
+| ``PADDLE_TPU_ROUTER_REPLICAS``         | comma list of http URLs| tier/router.py CLI |
+| ``PADDLE_TPU_ROUTER_PORT``             | int in [0, 65535]      | tier/router.py CLI |
+| ``PADDLE_TPU_ROUTER_HEALTH_POLL_S``    | float > 0              | Router |
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ['parse_flag_env', 'parse_int_env', 'parse_float_env',
+           'parse_replicas_env', 'ENV_PREFIX_CACHE',
+           'ENV_PREFIX_CACHE_MAX_BLOCKS', 'ENV_DISAGG', 'ENV_ROUTER_REPLICAS',
+           'ENV_ROUTER_PORT', 'ENV_ROUTER_HEALTH_POLL_S']
+
+ENV_PREFIX_CACHE = 'PADDLE_TPU_PREFIX_CACHE'
+ENV_PREFIX_CACHE_MAX_BLOCKS = 'PADDLE_TPU_PREFIX_CACHE_MAX_BLOCKS'
+ENV_DISAGG = 'PADDLE_TPU_DISAGG'
+ENV_ROUTER_REPLICAS = 'PADDLE_TPU_ROUTER_REPLICAS'
+ENV_ROUTER_PORT = 'PADDLE_TPU_ROUTER_PORT'
+ENV_ROUTER_HEALTH_POLL_S = 'PADDLE_TPU_ROUTER_HEALTH_POLL_S'
+
+
+def parse_flag_env(name, default=False, environ=None):
+    """``0``/``1`` boolean knob; anything else raises listing the set."""
+    raw = (environ if environ is not None else os.environ).get(name, '')
+    raw = raw.strip()
+    if not raw:
+        return bool(default)
+    if raw not in ('0', '1'):
+        raise ValueError(
+            f"{name}={raw!r} is not supported; supported values: '0', '1'")
+    return raw == '1'
+
+
+def parse_int_env(name, default, minimum=0, maximum=None, environ=None):
+    raw = (environ if environ is not None else os.environ).get(name, '')
+    raw = raw.strip()
+    if not raw:
+        return int(default)
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f'{name}={raw!r} is not supported; supported values: integers '
+            f'>= {minimum}' + (f' and <= {maximum}' if maximum is not None
+                               else ''))
+    if val < minimum or (maximum is not None and val > maximum):
+        raise ValueError(
+            f'{name}={val} out of range; supported values: integers '
+            f'>= {minimum}' + (f' and <= {maximum}' if maximum is not None
+                               else ''))
+    return val
+
+
+def parse_float_env(name, default, minimum_exclusive=0.0, environ=None):
+    raw = (environ if environ is not None else os.environ).get(name, '')
+    raw = raw.strip()
+    if not raw:
+        return float(default)
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(
+            f'{name}={raw!r} is not supported; supported values: numbers '
+            f'> {minimum_exclusive}')
+    if not val > minimum_exclusive:
+        raise ValueError(
+            f'{name}={val} out of range; supported values: numbers '
+            f'> {minimum_exclusive}')
+    return val
+
+
+def parse_replicas_env(name=ENV_ROUTER_REPLICAS, default=None, environ=None):
+    """Comma list of replica base URLs. Each entry must be ``http://host:port``
+    (or bare ``host:port``, normalized); a malformed entry raises."""
+    raw = (environ if environ is not None else os.environ).get(name, '')
+    raw = raw.strip()
+    if not raw:
+        return list(default) if default else []
+    urls = []
+    for entry in raw.split(','):
+        entry = entry.strip()
+        if not entry:
+            raise ValueError(
+                f'{name} has an empty entry; supported values: comma list '
+                f'of http://host:port replica URLs')
+        if not entry.startswith(('http://', 'https://')):
+            if ':' not in entry:
+                raise ValueError(
+                    f'{name} entry {entry!r} is not supported; supported '
+                    f'values: http://host:port URLs or host:port pairs')
+            entry = 'http://' + entry
+        urls.append(entry.rstrip('/'))
+    return urls

@@ -1,0 +1,225 @@
+"""Radix prefix cache over the paged KV pool: shared prompt prefixes resolve
+to already-filled, refcounted cache blocks, so prefill runs only on the
+uncached suffix (docs/SERVING.md "Serving tier"; kernel-side blueprint:
+"Ragged Paged Attention", PAPERS.md arxiv 2604.15464).
+
+Why a trie keyed at BLOCK granularity: K/V rows for position ``p`` depend on
+the whole token prefix ``[0..p]`` (attention mixes every earlier position
+into layer-1+ activations), so cached K/V is only reusable for a prompt that
+matches the ENTIRE prefix leading to it. A radix trie whose edges are
+``block_size``-token chunks encodes exactly that: the node reached by
+walking a prompt's whole-block chunks holds a block id whose K/V content is
+valid for ANY prompt sharing that prefix — and block granularity means a hit
+plugs straight into the request's :class:`~..decode.kv_cache.BlockTable`
+with zero copying.
+
+Bitwise-parity design (the load-bearing PR 6 contract): the uncached suffix
+is NOT run through a second prefill formulation — the scheduler feeds the
+remaining prompt tokens through the SAME lockstep ``(S, 1)`` decode step
+used for generation (chunked prefill), whose logits rows are already proven
+``array_equal`` to the whole-sequence forward at ``padded_context``. A
+cached-hit generation therefore emits exactly the cold generation's bytes,
+and the parity suite (tests/framework/test_prefix_cache.py) asserts it.
+
+Invariants:
+
+- only WHOLE blocks of prompt tokens are published (a block also holding
+  generated or padded rows is request-private and never enters the trie);
+- the last prompt token is never served from cache (``match`` caps at
+  ``(P - 1) // block_size`` blocks): at least one token must be fed through
+  the model to produce the first generated token's logits;
+- refcounts (``kv_cache.BlockAllocator``): a resident block carries the
+  cache's own reference plus one per live request sharing it. Eviction is
+  LRU over **refcount-idle leaves** (blocks whose only reference is the
+  cache's), leaf-first so interior nodes never orphan reachable children;
+  it triggers on pool pressure (an allocation that would otherwise raise
+  OutOfBlocks) and on the ``PADDLE_TPU_PREFIX_CACHE_MAX_BLOCKS`` cap.
+
+Metrics (always-on, docs/OBSERVABILITY.md): ``prefix_cache_hits/misses``,
+``prefix_cache_tokens_saved`` (prefill-compute-saved),
+``prefix_cache_blocks_resident``, ``prefix_cache_inserted_blocks``,
+``prefix_cache_evicted_blocks``.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from .. import metrics as _m
+from ..errors import InvalidRequest, OutOfBlocks
+from ..decode.kv_cache import BlockTable
+from .knobs import ENV_PREFIX_CACHE_MAX_BLOCKS, parse_int_env
+
+__all__ = ['PrefixCache']
+
+
+class _Node:
+    __slots__ = ('block', 'children', 'parent', 'chunk', 'last_use')
+
+    def __init__(self, block, parent=None, chunk=None):
+        self.block = block            # pool block id (None only at root)
+        self.children = {}            # chunk tuple -> _Node
+        self.parent = parent
+        self.chunk = chunk            # this node's edge key in parent
+        self.last_use = 0
+
+
+class PrefixCache:
+    """Token-trie prefix cache bound to one :class:`KVCachePool`.
+
+    The intended owner is a :class:`~..decode.engine.DecodeEngine` (enable
+    with ``DecodeEngine(prefix_cache=True)`` or ``PADDLE_TPU_PREFIX_CACHE=1``);
+    all calls arrive on the scheduler worker thread, but a lock keeps
+    direct multi-threaded engine use correct.
+
+    ``max_blocks``: resident-block cap (0 = uncapped, bounded only by pool
+    pressure); defaults from ``PADDLE_TPU_PREFIX_CACHE_MAX_BLOCKS``.
+    """
+
+    def __init__(self, pool, max_blocks=None):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self.max_blocks = (parse_int_env(ENV_PREFIX_CACHE_MAX_BLOCKS, 0,
+                                         minimum=0)
+                           if max_blocks is None else int(max_blocks))
+        self._root = _Node(None)
+        self._resident = 0
+        self._clock = itertools.count(1)
+        self._lock = threading.RLock()
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def resident_blocks(self):
+        return self._resident
+
+    def resident_block_ids(self):
+        with self._lock:
+            out = []
+            stack = list(self._root.children.values())
+            while stack:
+                n = stack.pop()
+                out.append(n.block)
+                stack.extend(n.children.values())
+            return out
+
+    # -- lookup ------------------------------------------------------------
+    def match(self, prompt):
+        """Longest cached whole-block prefix of ``prompt``, RETAINED for the
+        caller (one reference per block). Returns the block-id list; at
+        most ``(len(prompt) - 1) // block_size`` blocks so at least one
+        prompt token is always left to feed."""
+        bs = self.block_size
+        usable = max(len(prompt) - 1, 0) // bs
+        with self._lock:
+            node, blocks = self._root, []
+            for i in range(usable):
+                child = node.children.get(tuple(prompt[i * bs:(i + 1) * bs]))
+                if child is None:
+                    break
+                blocks.append(child.block)
+                node = child
+            # stamp the whole hit path as one recency unit (leaf-first LRU
+            # then naturally evicts deepest, least-shared nodes first)
+            tick = next(self._clock)
+            while node is not self._root:
+                node.last_use = tick
+                node = node.parent
+            if blocks:
+                self.pool.allocator.retain(blocks)
+        if blocks:
+            _m.prefix_cache_hits.inc()
+            _m.prefix_cache_tokens_saved.inc(len(blocks) * bs)
+        else:
+            _m.prefix_cache_misses.inc()
+        return blocks
+
+    # -- admission ---------------------------------------------------------
+    def acquire_table(self, prompt, total_tokens):
+        """Build a request's :class:`BlockTable` for ``total_tokens``
+        (prompt + generation budget): shared cached-prefix blocks first,
+        freshly allocated blocks for the rest. Pool pressure evicts idle
+        cached blocks before giving up (the re-raised OutOfBlocks is the
+        scheduler's FIFO-wait signal, unchanged)."""
+        bs = self.block_size
+        nb = -(-int(total_tokens) // bs)
+        if nb > self.pool.max_blocks_per_seq:
+            raise InvalidRequest(
+                f'{total_tokens} tokens need {nb} blocks > '
+                f'max_blocks_per_seq={self.pool.max_blocks_per_seq}')
+        with self._lock:
+            shared = self.match(prompt) if prompt else []
+            try:
+                fresh = self._allocate_evicting(nb - len(shared))
+            except OutOfBlocks:
+                if shared:
+                    self.pool.allocator.release(shared)
+                raise
+        return BlockTable(shared + fresh, bs,
+                          cached_len=len(shared) * bs)
+
+    def _allocate_evicting(self, n):
+        while True:
+            try:
+                return self.pool.allocator.allocate(n)
+            except OutOfBlocks:
+                if not self._evict_one():
+                    raise
+
+    # -- publication -------------------------------------------------------
+    def insert(self, prompt, table):
+        """Publish ``table``'s whole-prompt blocks into the trie. Blocks
+        already cached along the path are skipped (the request keeps its
+        private copy in its table — content is identical by construction);
+        new nodes retain their block so it survives the request."""
+        bs = self.block_size
+        full = len(prompt) // bs
+        tick = next(self._clock)
+        with self._lock:
+            node = self._root
+            for i in range(full):
+                chunk = tuple(int(t) for t in prompt[i * bs:(i + 1) * bs])
+                child = node.children.get(chunk)
+                if child is None:
+                    if self.max_blocks and self._resident >= self.max_blocks:
+                        if not self._evict_one():
+                            break     # cap reached, nothing idle to drop
+                    bid = table.blocks[i]
+                    self.pool.allocator.retain([bid])
+                    child = _Node(bid, parent=node, chunk=chunk)
+                    node.children[chunk] = child
+                    self._resident += 1
+                    _m.prefix_cache_inserted_blocks.inc()
+                child.last_use = tick
+                node = child
+            _m.prefix_cache_blocks_resident.set(self._resident)
+
+    # -- eviction ----------------------------------------------------------
+    def _evict_one(self):
+        """Drop the least-recently-used idle leaf (block refcount == 1, the
+        cache's own). Leaf-only keeps every remaining node reachable; the
+        caller loops. Returns False when nothing is evictable."""
+        victim = None
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif self.pool.allocator.refcount(n.block) == 1:
+                if victim is None or n.last_use < victim.last_use:
+                    victim = n
+        if victim is None:
+            return False
+        del victim.parent.children[victim.chunk]
+        self.pool.allocator.release([victim.block])
+        self._resident -= 1
+        _m.prefix_cache_evicted_blocks.inc()
+        _m.prefix_cache_blocks_resident.set(self._resident)
+        return True
+
+    def evict_idle(self):
+        """Drop every currently-idle cached block (tests / shutdown)."""
+        with self._lock:
+            n = 0
+            while self._evict_one():
+                n += 1
+            return n

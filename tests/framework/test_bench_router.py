@@ -1,0 +1,53 @@
+"""tier-1 guard for the serving-tier bench: tools/bench_router.py --smoke
+must run end-to-end on CPU and hold the tier's hard guarantees — every
+routed / cached / disaggregated generation bitwise-equal to the uncached
+reference, prefix-cache hit rate AND prefill-compute-saved > 0 on the
+shared-system-prompt workload (the acceptance metric pair), and the
+failover drill completing every request with zero drops. Latency ratios
+(p99 vs replica count, cache speedup) are reported but not asserted so a
+loaded CI box cannot flake them; full-size numbers live in PERF.md §19."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+def test_bench_router_smoke_runs_on_cpu():
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    r = subprocess.run(
+        [sys.executable, os.path.join('tools', 'bench_router.py'),
+         '--smoke'],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
+    benches = {d['bench']: d for d in lines if 'bench' in d}
+    assert {'serving_tier_scaling', 'serving_tier_prefix_cache',
+            'serving_tier_disagg', 'serving_tier_failover'} <= set(benches)
+
+    scaling = benches['serving_tier_scaling']
+    for key in ('one_replica', 'two_replicas'):
+        sec = scaling[key]
+        assert sec['completed'] == scaling['requests']
+        assert sec['bitwise_equal'] is True, scaling
+        assert sec['p99_ms'] > 0
+
+    cache = benches['serving_tier_prefix_cache']
+    assert cache['cache_off']['bitwise_equal'] is True
+    assert cache['cache_on']['bitwise_equal'] is True
+    # the acceptance pair: hit rate and prefill-compute-saved demonstrated
+    # > 0 on a shared-system-prompt workload, via the always-on metrics
+    assert cache['cache_on']['hit_rate'] > 0, cache
+    assert cache['cache_on']['prefill_tokens_saved'] > 0, cache
+    assert cache['cache_off']['hit_rate'] == 0
+
+    disagg = benches['serving_tier_disagg']
+    assert disagg['bitwise_equal'] is True
+    assert disagg['handoffs'] == disagg['requests']
+    assert disagg['kv_bytes'] > 0
+
+    failover = benches['serving_tier_failover']
+    assert failover['dropped'] == 0, failover
+    assert failover['completed'] == failover['requests']
+    assert failover['bitwise_equal'] is True

@@ -180,7 +180,7 @@ class _FlakyDecodeEngine:
     def validate(self, prompt_ids, max_new_tokens):
         return [int(t) for t in prompt_ids], int(max_new_tokens)
 
-    def reserve_table(self, prompt_len, max_new_tokens):
+    def reserve_table(self, prompt_len, max_new_tokens, prompt=None):
         self._tables += 1
         return {'id': self._tables}
 

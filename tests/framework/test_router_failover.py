@@ -1,0 +1,137 @@
+"""Router failover against REAL replica processes: kill -9 one replica
+mid-stream and assert the acceptance contract — the dead replica's
+in-flight stream errors, every non-in-flight request (queued or submitted
+right after the kill) completes bitwise through the survivor, zero drops.
+
+Replicas are ``python -m paddle_tpu.serving.tier.replica`` subprocesses
+(seeded tiny LM — every process builds identical weights, so the in-process
+reference model produces the exact bytes any replica must answer with)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from paddle_tpu.dygraph import guard
+from paddle_tpu.models.causal_lm import greedy_generate
+from paddle_tpu.serving import Router
+from paddle_tpu.serving.tier.replica import DEFAULT_SEED, build_tiny_lm
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def _spawn_replica():
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PADDLE_TPU_TELEMETRY', None)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'paddle_tpu.serving.tier.replica',
+         '--port', '0', '--slots', '2', '--seed', str(DEFAULT_SEED)],
+        cwd=_REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    deadline = time.monotonic() + 180
+    line = ''
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.strip():
+            break
+        if proc.poll() is not None:
+            raise RuntimeError(f'replica died at startup rc={proc.returncode}')
+    ready = json.loads(line)
+    assert ready['ready'] and ready['pid'] == proc.pid
+    return proc, f"http://127.0.0.1:{ready['port']}"
+
+
+def _counter(name):
+    from paddle_tpu.observability import registry
+    d = registry.to_dict().get(name)
+    if not d or not d['samples']:
+        return 0.0
+    return sum(s['value'] for s in d['samples'])
+
+
+def test_kill9_midstream_drops_zero_non_inflight_requests():
+    """Two replica processes behind a router; one long stream pinned on
+    each. kill -9 the first replica: its stream dies with an error event,
+    the other long stream and EIGHT concurrently-submitted short requests
+    all complete bitwise — reroutes observed, zero drops."""
+    with guard():
+        model = build_tiny_lm()
+        # engine geometry matches the replica CLI defaults
+        pad_len = -(-(16 + 16) // 4) * 4
+        long_prompt, short_prompt = [3, 5, 7], [9, 2]
+        long_ref = greedy_generate(model, long_prompt, 16, pad_len=pad_len)
+        short_ref = greedy_generate(model, short_prompt, 4, pad_len=pad_len)
+
+    procs, urls = [], []
+    for _ in range(2):
+        p, u = _spawn_replica()
+        procs.append(p)
+        urls.append(u)
+    try:
+        router = Router(urls, health_poll_s=0.5)
+        assert all(r.healthy and r.warmed for r in router.replicas)
+
+        # one long in-flight stream per replica (loads tie at 1, so the
+        # post-kill shorts are guaranteed to try the dead replica too)
+        gens, iters = [], []
+        for _ in range(2):
+            g = router.stream_generate(long_prompt, max_new_tokens=16)
+            it = g.events()
+            next(it)                          # streaming has begun
+            gens.append(g)
+            iters.append(it)
+        assert {g.replica for g in gens} == set(urls)
+        victim_idx = urls.index(gens[0].replica)
+        victim = procs[victim_idx]
+
+        os.kill(victim.pid, signal.SIGKILL)   # the real thing
+
+        # non-in-flight requests submitted right after the kill: the router
+        # still believes both replicas are healthy, so several dispatches
+        # hit the corpse and must reroute — with zero client-visible drops
+        r0 = _counter('router_requests_rerouted')
+        results, errors = [None] * 8, []
+
+        def short(i):
+            try:
+                results[i] = router.generate(short_prompt, max_new_tokens=4,
+                                             timeout=60)
+            except Exception as e:            # a drop — must not happen
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=short, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+
+        # the victim's stream (gens[0] by construction) is the ONLY casualty
+        victim_events = list(iters[0])
+        assert any('error' in e and not e.get('done')
+                   for e in victim_events), victim_events
+        # the survivor's long stream completes bitwise
+        surv_events = list(iters[1])
+        done = [e for e in surv_events if e.get('done')]
+        assert done and done[0]['tokens'] == long_ref
+
+        assert not errors, f'dropped non-in-flight requests: {errors}'
+        assert all(r is not None for r in results)
+        assert all(r['tokens'] == short_ref for r in results)
+        survivor_url = urls[1 - victim_idx]
+        assert all(r['replica'] == survivor_url for r in results)
+        assert _counter('router_requests_rerouted') - r0 >= 1
+
+        # the fleet keeps serving: a fresh request routes normally
+        fin = router.generate(short_prompt, max_new_tokens=4)
+        assert fin['tokens'] == short_ref
+        assert victim.wait(timeout=10) == -signal.SIGKILL
+        router.close()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)

@@ -317,6 +317,50 @@ def summarize(metrics, trace, steps, top=10):
                 f"dump(s) written")
         lines.append('')
 
+    # ---- serving tier (router / prefix cache / disagg, docs/SERVING.md) --
+    tier_hits = _counter(metrics, 'prefix_cache_hits')
+    tier_misses = _counter(metrics, 'prefix_cache_misses')
+    routed = _counter(metrics, 'router_requests')
+    handoffs = _counter(metrics, 'disagg_handoffs')
+    if tier_hits or tier_misses or routed or handoffs:
+        lines.append('## Serving tier')
+        if tier_hits or tier_misses:
+            saved = _counter(metrics, 'prefix_cache_tokens_saved')
+            resident = (metrics.get('prefix_cache_blocks_resident')
+                        or {}).get('samples', [])
+            lines.append(f"prefix-cache hit rate: "
+                         f"{_rate(tier_hits, tier_misses)}")
+            lines.append(f"prefill compute saved: {int(saved)} prompt "
+                         f"token(s) served from cached KV blocks")
+            if resident:
+                lines.append(f"cache residency:       "
+                             f"{int(resident[0]['value'])} block(s), "
+                             f"{int(_counter(metrics, 'prefix_cache_evicted_blocks'))} "
+                             f"evicted")
+        if routed:
+            completed = _counter(metrics, 'router_requests_completed')
+            rerouted = _counter(metrics, 'router_requests_rerouted')
+            failed = _counter(metrics, 'router_requests_failed')
+            lines.append(
+                f"router:                {int(routed)} request(s), "
+                f"{int(completed)} completed, {int(rerouted)} rerouted "
+                f"(failover), {int(failed)} failed in-flight")
+            per_replica = _gauge_by_label(metrics,
+                                          'router_replica_inflight',
+                                          'replica')
+            if per_replica:
+                load = ', '.join(f'{u}: {int(v)}'
+                                 for u, v in sorted(per_replica.items()))
+                lines.append(f"per-replica in-flight: {load}")
+        if handoffs:
+            hb = _counter(metrics, 'disagg_kv_bytes')
+            hf = _counter(metrics, 'disagg_handoff_failures')
+            lines.append(
+                f"disaggregation:        {int(handoffs)} prefill->decode "
+                f"handoff(s), {int(hb)} KV byte(s) shipped, "
+                f"{int(hf)} failed")
+        lines.append('')
+
     # ---- compile-time breakdown ----
     lines.append('## Compile-time breakdown')
     any_compile = False
