@@ -366,6 +366,19 @@ def bench_verify_overhead(on_tpu):
     return measure_all(iters=5 if on_tpu else 3, smoke=not on_tpu)
 
 
+def bench_memory_plan(on_tpu):
+    """Static memory-planner bench (PERF.md §20): plan latency as a
+    fraction of the cold lower+compile it informs (≤1% acceptance) and
+    the auto-remat memory-vs-steps/s tradeoff on an activation-heavy MLP
+    (fits a simulated PADDLE_TPU_HBM_BUDGET_MB the unplanned program
+    exceeds, bitwise losses). Valid on CPU: the quantities under test
+    are host-side planning time and byte arithmetic."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools'))
+    from bench_plan import measure_all
+    return measure_all(smoke=not on_tpu, iters=7 if on_tpu else 5)
+
+
 def bench_partitioner(on_tpu):
     """Unified SPMD partitioner bench (docs/PARTITIONER.md): per-Program
     spec-resolution time (zero tracing — the cost the Executor pays per
@@ -716,6 +729,16 @@ def main():
             ['verify_frac_of_compile'],
             verify_warm_step_ratio=vo['verify_overhead']
             ['warm_step_ratio'])
+
+    mp = run("memory_plan", lambda: bench_memory_plan(on_tpu))
+    if mp is not None:
+        emit({"metric": "memory_plan",
+              "latency": mp['plan_latency'], "remat": mp['plan_remat']})
+        summary.update(
+            plan_frac_of_compile=mp['plan_latency']
+            ['plan_frac_of_compile'],
+            auto_remat_fits_budget=mp['plan_remat']['fits_budget'],
+            auto_remat_bitwise=mp['plan_remat']['bitwise_identical'])
 
     pt = run("partitioner", lambda: bench_partitioner(on_tpu))
     if pt is not None:

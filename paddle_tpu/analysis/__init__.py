@@ -11,6 +11,12 @@ Layers:
 - :mod:`checks` — the diagnostic suite (read-before-write, dead code,
   shape/dtype mismatch, collective consistency, donation hazards, RNG
   salt lint);
+- :mod:`cost` / :mod:`plan` — the per-op FLOP/byte cost model
+  (``cost_rule`` registry, same coverage contract) and the
+  whole-Program peak-HBM planner feeding ``tools/plan_program.py``,
+  the ``auto_remat`` IR pass (``PADDLE_TPU_HBM_BUDGET_MB``), and
+  ``PADDLE_TPU_ALLREDUCE_BUCKET_MB=auto`` (docs/ANALYSIS.md "Cost
+  model & memory planner");
 - :func:`verify_program` — one call returning the diagnostics;
 - :func:`assert_verified` — raise :class:`ProgramVerificationError` on
   error-severity findings.
@@ -40,13 +46,20 @@ from .diagnostics import (Diagnostic, ProgramVerificationError,  # noqa: F401
                           severity_at_least)
 from .infer import (UNKNOWN, VarInfo, InferError, infer_rule,  # noqa: F401
                     has_rule, all_rules)
+from .cost import (OpCost, cost_rule, has_cost_rule,  # noqa: F401
+                   all_cost_rules, op_cost)
+from .plan import (MemoryPlan, plan_program,  # noqa: F401
+                   select_checkpoints, gradient_bytes)
 from .checks import run_checks
 
 __all__ = ['Diagnostic', 'ProgramVerificationError', 'SEVERITIES',
            'VarInfo', 'UNKNOWN', 'InferError', 'infer_rule', 'has_rule',
            'all_rules', 'verify_program', 'assert_verified', 'verify_level',
            'format_report', 'max_severity', 'severity_at_least',
-           'VERIFY_ENV', 'VERIFY_LEVELS']
+           'VERIFY_ENV', 'VERIFY_LEVELS',
+           'OpCost', 'cost_rule', 'has_cost_rule', 'all_cost_rules',
+           'op_cost', 'MemoryPlan', 'plan_program', 'select_checkpoints',
+           'gradient_bytes']
 
 VERIFY_ENV = 'PADDLE_TPU_VERIFY'
 VERIFY_LEVELS = ('off', 'passes', 'full')

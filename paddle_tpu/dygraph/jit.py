@@ -440,7 +440,7 @@ def _tensorize_keep(vals):
 
 def _grad_enabled():
     from . import tape
-    return tape._grad_enabled
+    return tape.grad_enabled()
 
 
 def declarative(fn=None, input_spec=None):

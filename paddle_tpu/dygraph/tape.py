@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 from collections import OrderedDict
 from typing import Optional
@@ -28,8 +29,20 @@ from ..core.dtypes import convert_dtype, to_jax_dtype
 from ..core.random import default_generator
 from ..ops.registry import get_op
 
-_grad_enabled = True
+# THREAD-LOCAL grad switch (default on). A process-global flag let a
+# serving/decode worker thread's no_grad_guard() — every engine step
+# runs under one — disable tape recording for EVERY thread: a training
+# loop on the main thread would intermittently build tensors with no
+# grad history while a scheduler thread was mid-step, and backward()
+# raised. Per-thread state keeps each guard scoped to its own thread
+# (regression: tests/dygraph/test_tape.py).
+_grad_state = threading.local()
 _tensor_watchers = []
+
+
+def grad_enabled():
+    """Whether op dispatch on THIS thread records grad history."""
+    return getattr(_grad_state, 'enabled', True)
 
 
 # ---------------------------------------------------------------------------
@@ -159,13 +172,12 @@ def watch_tensors(collector: list):
 
 @contextlib.contextmanager
 def no_grad_guard():
-    global _grad_enabled
-    old = _grad_enabled
-    _grad_enabled = False
+    old = grad_enabled()
+    _grad_state.enabled = False
     try:
         yield
     finally:
-        _grad_enabled = old
+        _grad_state.enabled = old
 
 
 def no_grad(fn=None):
@@ -360,7 +372,7 @@ def _dispatch_op_impl(op_type, inputs, attrs):
         return call_with(vals, rng)
 
     vals = [t.value for t in flat_tensors]
-    needs_grad = _grad_enabled and any(
+    needs_grad = grad_enabled() and any(
         not t.stop_gradient and jnp.issubdtype(t.value.dtype, jnp.inexact)
         for t in flat_tensors)
 
@@ -732,7 +744,7 @@ def monkey_patch_tensor():
                 w.append(self)
         if isinstance(idx, Tensor):
             idx = idx.value
-        if (self.stop_gradient or not _grad_enabled
+        if (self.stop_gradient or not grad_enabled()
                 or not jnp.issubdtype(self.value.dtype, jnp.inexact)):
             return Tensor(self.value[idx], stop_gradient=True)
         getter = lambda v: v[idx]  # noqa: E731

@@ -1077,7 +1077,15 @@ class Executor:
                 opt_program, _ = ir.apply_pipeline(
                     program, fetch_names=fetch_names,
                     feed_names=list(feed_vals),
-                    build_strategy=build_strategy)
+                    build_strategy=build_strategy,
+                    feed_shapes={n: tuple(v.shape) for n, v in
+                                 feed_vals.items()
+                                 if hasattr(v, 'shape')})
+                # static memory plan (paddle_tpu/analysis/plan.py): peak
+                # HBM predicted from the VarInfos before the trace runs —
+                # milliseconds, zero tracing, once per compile-cache miss
+                self._plan_telemetry(opt_program, fetch_names, feed_vals,
+                                     donate)
                 step = _lower(opt_program, list(feed_vals), fetch_names,
                               state_names)
                 fn = jax.jit(step, donate_argnums=(0,))
@@ -1173,6 +1181,15 @@ class Executor:
                      help='bytes fed into Executor.run')
             _obs.inc('executor_fetch_bytes', fetch_bytes,
                      help='bytes fetched out of Executor.run')
+            # measured counterpart of program_plan_accounted_bytes: the
+            # same state+feed+fetch accounting from the LIVE buffers
+            state_bytes = sum(getattr(v, 'nbytes', 0)
+                              for v in new_state.values())
+            _obs.set_gauge('program_measured_hbm_bytes',
+                           state_bytes + feed_bytes + fetch_bytes,
+                           help='measured state+feed+fetch bytes of the '
+                                'last step (predicted-vs-measured delta '
+                                'in tools/telemetry_report.py)')
             if compiled_now:
                 _obs.observe(
                     'executor_compile_seconds',
@@ -1189,6 +1206,41 @@ class Executor:
                 execute_s=round(exec_span.duration, 6),
                 fetch_s=round(fetch_span.duration, 6))
         return result
+
+    @staticmethod
+    def _plan_telemetry(program, fetch_names, feed_vals, donate):
+        """Record the static memory plan for a freshly-lowered program:
+        ``program_plan_seconds`` + predicted peak/accounted gauges
+        (docs/OBSERVABILITY.md "Memory plan"). Telemetry-gated and
+        failure-isolated — a planning bug must never break lowering."""
+        if not _obs._ENABLED:
+            return
+        import time
+        from .analysis.plan import plan_program
+        t0 = time.perf_counter()
+        try:
+            plan = plan_program(
+                program, fetch_names=fetch_names,
+                feed_shapes={n: tuple(v.shape)
+                             for n, v in feed_vals.items()
+                             if hasattr(v, 'shape')},
+                donate=donate)
+        except Exception:
+            _obs.inc('program_plan_failures', 1,
+                     help='memory-plan attempts that raised (planning is '
+                          'best-effort; lowering proceeds)')
+            return
+        _obs.observe('program_plan_seconds',
+                     time.perf_counter() - t0,
+                     help='wall time per static memory-plan computation '
+                          '(once per program+shape compile-cache miss)')
+        _obs.set_gauge('program_peak_hbm_bytes', plan.peak_bytes,
+                       help='predicted peak HBM of the last lowered '
+                            'program (analysis/plan.py)')
+        _obs.set_gauge('program_plan_accounted_bytes',
+                       plan.accounted_bytes,
+                       help='predicted state+feed+fetch bytes — the '
+                            'subset program_measured_hbm_bytes measures')
 
     @staticmethod
     def _check_fetches_finite(fetch_names, fetches):

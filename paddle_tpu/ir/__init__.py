@@ -30,7 +30,7 @@ import os
 from .pass_base import (Pass, PassContext, PassManager, all_passes,  # noqa: F401
                         get_pass, register_pass, stamp_rng_salts)
 from . import (constant_fold, dce, fuse_act,  # noqa: F401  (registration)
-               fuse_optimizer, bucket_allreduce)
+               fuse_optimizer, bucket_allreduce, auto_remat)
 
 __all__ = ['Pass', 'PassContext', 'PassManager', 'register_pass',
            'get_pass', 'all_passes', 'apply_pipeline', 'build_pipeline',
@@ -38,9 +38,11 @@ __all__ = ['Pass', 'PassContext', 'PassManager', 'register_pass',
 
 # always-safe passes, on by default; the fuse passes additionally gate on
 # their BuildStrategy flag (or, for bucket_allreduce, the fleet
-# DistributedStrategy stamp) inside apply_impl
+# DistributedStrategy stamp), and auto_remat on PADDLE_TPU_HBM_BUDGET_MB,
+# inside apply_impl
 _DEFAULT_PASSES = ('constant_fold', 'fuse_elewise_add_act',
-                   'bucket_allreduce', 'fuse_all_optimizer_ops', 'dce')
+                   'bucket_allreduce', 'fuse_all_optimizer_ops',
+                   'auto_remat', 'dce')
 
 
 def passes_env():
@@ -82,20 +84,34 @@ def pipeline_signature(build_strategy=None):
             if n not in _FLAG_GATED
             or (bs is not None and getattr(bs, _FLAG_GATED[n], False)))
     if 'bucket_allreduce' in names:
-        # the cap changes the rewrite, so it must re-lower on change
-        names = tuple(
-            f'bucket_allreduce@{bucket_allreduce.bucket_cap_bytes()}'
-            if n == 'bucket_allreduce' else n for n in names)
+        # the cap changes the rewrite, so it must re-lower on change;
+        # '=auto' resolves per program (whose id/version is already in
+        # the executor's cache key), so the tag alone suffices
+        cap = ('auto' if bucket_allreduce.bucket_cap_is_auto()
+               else bucket_allreduce.bucket_cap_bytes())
+        names = tuple(f'bucket_allreduce@{cap}'
+                      if n == 'bucket_allreduce' else n for n in names)
+    if 'auto_remat' in names:
+        # env-gated like the flag-gated fuses: absent budget → the pass
+        # cannot change anything; present budget is part of the rewrite
+        budget = auto_remat.hbm_budget_bytes()
+        names = tuple(f'auto_remat@{budget}' if n == 'auto_remat' else n
+                      for n in names) if budget is not None else \
+            tuple(n for n in names if n != 'auto_remat')
     return names
 
 
 def apply_pipeline(program, fetch_names=(), feed_names=(),
-                   build_strategy=None):
+                   build_strategy=None, feed_shapes=None):
     """Optimized CLONE of `program` (or `program` itself when the pipeline
-    is disabled), plus the PassContext carrying per-pass stats."""
+    is disabled), plus the PassContext carrying per-pass stats.
+    `feed_shapes` (name → concrete shape) lets shape-sensitive passes —
+    auto_remat's memory plan — price dynamic batch dims exactly; the
+    executor passes the run's real feed signature."""
     mgr = build_pipeline()
     ctx = PassContext(fetch_names=fetch_names, feed_names=feed_names,
-                      build_strategy=build_strategy)
+                      build_strategy=build_strategy,
+                      feed_shapes=feed_shapes)
     if not mgr.passes:
         return program, ctx
     opt, ctx = mgr.apply(program, ctx)

@@ -1,0 +1,105 @@
+"""``auto_remat``: budget-driven automatic rematerialization.
+
+``RecomputeOptimizer`` has always had the *mechanism* — checkpoint names
+on the backward marker lower to ``jax.checkpoint`` segments
+(``executor._remat_segments``) — but the checkpoints were hand-picked.
+This pass makes the choice automatic: when ``PADDLE_TPU_HBM_BUDGET_MB``
+is set and the memory planner (``analysis/plan.py``) predicts the
+program's peak HBM exceeds it, the plan's greedy selector picks
+activation-segment boundaries (narrow live-set waists — low
+FLOPs-per-byte-saved, since recompute costs one extra forward pass no
+matter how many boundaries are chosen) and writes them into the marker's
+``checkpoints`` attr. The lowering then remats exactly as if the user
+had called ``RecomputeOptimizer._set_checkpoints`` with the same names —
+bitwise-identical numerics by construction (asserted in
+tests/framework/test_memory_plan.py).
+
+Manual checkpoints always win: a marker that already carries a
+checkpoint list is never overridden. Programs without a backward marker,
+already under budget, or with no helpful boundary are left untouched
+(the shortfall is reported once through log_helper, not raised — an
+optimistic budget must not kill training that might still fit).
+
+The budget is part of ``ir.pipeline_signature`` so changing it re-lowers
+instead of reusing a stale step. Zero per-step cost: the pass (and the
+plan it runs) executes once per program+shape compile-cache miss.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+from .. import observability as _obs
+from ..framework import BACKWARD_OP_TYPE
+from ..log_helper import get_logger
+from .pass_base import Pass, register_pass
+
+ENV_HBM_BUDGET = 'PADDLE_TPU_HBM_BUDGET_MB'
+
+_logger = get_logger(__name__, logging.WARNING)
+_warned_shortfall = set()
+
+
+def hbm_budget_bytes():
+    """The simulated-HBM budget in bytes, or None when unset. Strict
+    parse: non-numeric / non-positive values raise listing the contract
+    (same knob discipline as every other PADDLE_TPU_* env)."""
+    raw = os.environ.get(ENV_HBM_BUDGET)
+    if raw is None or raw == '':
+        return None
+    try:
+        mb = float(raw)
+    except ValueError:
+        raise ValueError(
+            f'{ENV_HBM_BUDGET}: expected a number of MiB (e.g. 2048), '
+            f'got {raw!r}')
+    if mb <= 0:
+        raise ValueError(f'{ENV_HBM_BUDGET}: must be > 0, got {raw!r}')
+    return int(mb * (1 << 20))
+
+
+@register_pass
+class AutoRematPass(Pass):
+    name = 'auto_remat'
+    # after the fuse passes (the plan must price the ops that will
+    # actually lower), before DCE's final sweep
+    order = 350
+
+    def apply_impl(self, program, ctx):
+        budget = hbm_budget_bytes()
+        if budget is None:
+            return False
+        blk = program.global_block()
+        marker = next((op for op in blk.ops
+                       if op.type == BACKWARD_OP_TYPE), None)
+        if marker is None:
+            return False
+        if marker.attrs.get('checkpoints'):
+            return False          # manual RecomputeOptimizer wins
+        from ..analysis.plan import select_checkpoints
+        feed_shapes = getattr(ctx, 'feed_shapes', None)
+        names, new_peak = select_checkpoints(
+            program, budget, fetch_names=ctx.fetch_names,
+            feed_names=ctx.feed_names, feed_shapes=feed_shapes)
+        if not names:
+            if new_peak > budget and program._id not in _warned_shortfall:
+                _warned_shortfall.add(program._id)
+                _logger.warning(
+                    'auto_remat: no checkpoint boundary brings predicted '
+                    'peak %.1f MiB under %s=%.1f MiB; leaving the program '
+                    'unrematerialized', new_peak / 2**20,
+                    ENV_HBM_BUDGET, budget / 2**20)
+            return False
+        marker.attrs['checkpoints'] = list(names)
+        ctx.record(self.name, checkpoints=len(names))
+        if _obs._ENABLED:
+            _obs.inc('auto_remat_programs', 1,
+                     help='programs the auto_remat pass rewrote to fit '
+                          'PADDLE_TPU_HBM_BUDGET_MB')
+            _obs.set_gauge('auto_remat_checkpoints', len(names),
+                           help='checkpoint boundaries chosen by the last '
+                                'auto_remat application')
+            _obs.set_gauge('auto_remat_planned_peak_bytes', new_peak,
+                           help='predicted peak HBM after the auto_remat '
+                                'rewrite')
+        return True

@@ -48,14 +48,43 @@ from .pass_base import Pass, register_pass
 ENV_BUCKET_MB = 'PADDLE_TPU_ALLREDUCE_BUCKET_MB'
 DEFAULT_BUCKET_MB = 32.0
 
+# PADDLE_TPU_ALLREDUCE_BUCKET_MB=auto: size the cap from the program's
+# predicted gradient bytes (the memory plan's numbers) instead of the
+# hand-set 32 MiB — aim at AUTO_TARGET_BUCKETS buckets so 1−1/target of
+# the gradient comm can overlap backward compute, floored at 1 MiB so
+# tiny models never shatter into latency-dominated messages.
+AUTO = 'auto'
+AUTO_TARGET_BUCKETS = 4
+AUTO_MIN_CAP_BYTES = 1 << 20
+
 BUCKETABLE = ('c_allreduce_sum',)
 
 _DTYPE_BYTES = {'float32': 4, 'float64': 8, 'float16': 2, 'bfloat16': 2,
                 'int64': 8, 'int32': 4, 'int8': 1}
 
 
-def bucket_cap_bytes():
+def bucket_cap_is_auto():
     raw = os.environ.get(ENV_BUCKET_MB)
+    return raw is not None and raw.strip().lower() == AUTO
+
+
+def auto_cap_bytes(grad_bytes):
+    """Cap for `grad_bytes` of gradients under the auto policy."""
+    return max(AUTO_MIN_CAP_BYTES,
+               -(-int(grad_bytes) // AUTO_TARGET_BUCKETS))
+
+
+def bucket_cap_bytes(grad_bytes=None):
+    """The live bucket cap in bytes. Under ``=auto`` the caller must
+    supply the gradients' total predicted bytes (the pass computes them
+    from the allreduce operands; ``SpmdTrainStep`` from its replicated
+    params); with no `grad_bytes` under auto this returns None — the
+    pipeline signature renders that as the ``@auto`` tag."""
+    raw = os.environ.get(ENV_BUCKET_MB)
+    if raw is not None and raw.strip().lower() == AUTO:
+        if grad_bytes is None:
+            return None
+        return auto_cap_bytes(grad_bytes)
     if raw is None or raw == '':
         mb = DEFAULT_BUCKET_MB
     else:
@@ -63,7 +92,8 @@ def bucket_cap_bytes():
             mb = float(raw)
         except ValueError:
             raise ValueError(
-                f"{ENV_BUCKET_MB}: expected a number of MiB, got {raw!r}")
+                f"{ENV_BUCKET_MB}: expected a number of MiB or 'auto', "
+                f"got {raw!r}")
         if mb <= 0:
             raise ValueError(f"{ENV_BUCKET_MB}: must be > 0, got {raw!r}")
     return int(mb * 2 ** 20)
@@ -111,7 +141,6 @@ class BucketAllReducePass(Pass):
                     if op.type == BACKWARD_OP_TYPE), None)
         if bwd is None:
             return False
-        cap = bucket_cap_bytes()
 
         # contiguous runs of compatible gradient allreduces after the
         # marker; contiguity makes the rewrite trivially safe (nothing is
@@ -130,6 +159,13 @@ class BucketAllReducePass(Pass):
                     if key is not None else ([], None)
         if cur:
             runs.append(cur)
+
+        # =auto sizes the cap from the gradients actually being synced —
+        # the same byte figures analysis/plan.gradient_bytes predicts
+        total_grad_bytes = sum(nb for run in runs for _, nb in run)
+        cap = bucket_cap_bytes(grad_bytes=total_grad_bytes)
+        if cap is None:        # auto with nothing bucketable
+            return False
 
         buckets = []           # list of [op index]
         for run in runs:

@@ -42,10 +42,14 @@ _PASS_REGISTRY: Dict[str, 'Pass'] = {}
 class PassContext:
     """Immutable-ish facts a pass may consult, plus the stats it fills in."""
 
-    def __init__(self, fetch_names=(), feed_names=(), build_strategy=None):
+    def __init__(self, fetch_names=(), feed_names=(), build_strategy=None,
+                 feed_shapes=None):
         self.fetch_names = tuple(fetch_names)
         self.feed_names = tuple(feed_names)
         self.build_strategy = build_strategy
+        # name → concrete shape of the run's feeds (executor-supplied);
+        # lets shape-sensitive passes (auto_remat) price dynamic dims
+        self.feed_shapes = dict(feed_shapes) if feed_shapes else None
         # pass name → {'removed': n, 'fused': n, 'folded': n, ...}
         self.stats: Dict[str, Dict[str, int]] = {}
 

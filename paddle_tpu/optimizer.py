@@ -451,20 +451,63 @@ class DpsgdOptimizer(Optimizer):
 class RecomputeOptimizer(Optimizer):
     """ref: optimizer.py:RecomputeOptimizer → jax.checkpoint over segments.
     The checkpoint list is recorded on the backward marker; lowering remats
-    the forward between checkpoints (memory ↔ FLOPs trade, SURVEY §6)."""
+    the forward between checkpoints (memory ↔ FLOPs trade, SURVEY §6).
+
+    For AUTOMATIC checkpoint selection set ``PADDLE_TPU_HBM_BUDGET_MB``
+    instead: the ``auto_remat`` IR pass picks the segments from the
+    memory plan (docs/ANALYSIS.md) — same marker mechanism, bitwise-
+    identical numerics vs a manual list of the same names."""
 
     def __init__(self, optimizer):
         self._inner = optimizer
         self._checkpoints = None
 
     def _set_checkpoints(self, checkpoints):
-        self._checkpoints = checkpoints
+        """Strict: entries must be Variables or names, and names must be
+        unique — a duplicate or mistyped checkpoint used to silently
+        no-op into the backward marker (the lowering splits at producer
+        ops, so an unmatched name changed nothing without a word)."""
+        if checkpoints is None:
+            self._checkpoints = None
+            return
+        if not isinstance(checkpoints, (list, tuple)):
+            raise ValueError(
+                f'RecomputeOptimizer checkpoints must be a list/tuple of '
+                f'Variables or var names, got '
+                f'{type(checkpoints).__name__}')
+        names = []
+        for c in checkpoints:
+            n = c.name if hasattr(c, 'name') else c
+            if not isinstance(n, str):
+                raise ValueError(
+                    f'RecomputeOptimizer checkpoint entries must be '
+                    f'Variables or var names, got {type(c).__name__}: '
+                    f'{c!r}')
+            names.append(n)
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        if dupes:
+            raise ValueError(
+                f'RecomputeOptimizer checkpoints contain duplicate '
+                f'name(s): {dupes}')
+        self._checkpoints = list(checkpoints)
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        if self._checkpoints:
+            names = [c.name if hasattr(c, 'name') else c
+                     for c in self._checkpoints]
+            program = loss.block.program
+            unknown = sorted(
+                n for n in names
+                if not any(n in b.vars for b in program.blocks))
+            if unknown:
+                raise ValueError(
+                    f'RecomputeOptimizer checkpoints name var(s) the '
+                    f'program does not declare: {unknown} (typo, or a '
+                    f'var from a different Program?)')
         params_grads = append_backward(
             loss, parameter_list or self._inner._parameter_names(),
             no_grad_set, checkpoints=self._checkpoints)

@@ -99,11 +99,14 @@ class SpmdTrainStep:
             n: jax.device_put(v, NamedSharding(mesh, P(*entries[n])))
             for n, v in arrays.items()}
 
-        # replicated-gradient buckets (PR 9 size cap, f32 elements)
+        # replicated-gradient buckets (PR 9 size cap, f32 elements);
+        # PADDLE_TPU_ALLREDUCE_BUCKET_MB=auto sizes the cap from THESE
+        # grads' predicted bytes instead of the hand-set 32 MiB default
         from ..ir.bucket_allreduce import bucket_cap_bytes
-        cap = (int(float(bucket_mb) * (1 << 20)) if bucket_mb is not None
-               else bucket_cap_bytes())
         repl = [n for n in sorted(arrays) if kinds[n] == 'replicated']
+        repl_grad_bytes = sum(int(arrays[n].size) * 4 for n in repl)
+        cap = (int(float(bucket_mb) * (1 << 20)) if bucket_mb is not None
+               else bucket_cap_bytes(grad_bytes=repl_grad_bytes))
         buckets, cur, cur_bytes = [], [], 0
         for n in repl:
             nbytes = int(arrays[n].size) * 4

@@ -143,3 +143,41 @@ def test_finite_difference_matmul_grad():
                 lm = float(np.sum(x.numpy() @ wm))
                 fd = (lp - lm) / (2 * eps)
                 np.testing.assert_allclose(g[i, j], fd, rtol=1e-2, atol=1e-2)
+
+
+def test_no_grad_guard_is_thread_local():
+    """A worker thread inside no_grad_guard (the serving/decode engines
+    run EVERY step under one) must not disable tape recording on other
+    threads: the flag was process-global, so a scheduler thread mid-step
+    made concurrent main-thread training build tensors with no grad
+    history and backward() raised (latent race surfaced by tier-1
+    ordering — fixed by per-thread grad state)."""
+    import threading
+    from paddle_tpu.dygraph.tape import grad_enabled, no_grad_guard
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def worker():
+        with no_grad_guard():
+            assert not grad_enabled()
+            entered.set()
+            release.wait(timeout=10)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    assert entered.wait(timeout=10)
+    try:
+        # main thread still records while the worker holds its guard
+        assert grad_enabled()
+        with dygraph.guard():
+            x = to_variable(np.ones((2, 3), np.float32))
+            w = dygraph.Parameter(np.ones((3, 2), np.float32))
+            out = dygraph.dispatch_op('matmul', {'x': x, 'y': w}, {})
+            loss = dygraph.dispatch_op('reduce_sum', {'x': out}, {})
+            loss.backward()
+            assert w.gradient() is not None
+    finally:
+        release.set()
+        t.join(timeout=10)
+    assert grad_enabled()

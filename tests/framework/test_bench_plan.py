@@ -1,0 +1,45 @@
+"""tools/bench_plan.py smoke in tier-1: the memory planner runs in ≤1%
+of the cold lower+compile it informs, and auto-remat fits a simulated
+HBM budget the unplanned program exceeds with bitwise losses.
+
+Runs in a SUBPROCESS: the latency acceptance divides plan time by a COLD
+lower+compile, and an in-suite process has every cache warm — the
+denominator would be a warmed-up fraction of the real cost."""
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+def test_bench_plan_smoke():
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env.pop('PADDLE_TPU_HBM_BUDGET_MB', None)
+    env.pop('PADDLE_TPU_ALLREDUCE_BUCKET_MB', None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, 'tools', 'bench_plan.py'),
+         '--smoke', '--iters', '3'],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=_REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = {}
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith('{'):
+            d = json.loads(line)
+            rows[d['bench']] = d
+    lat = rows['plan_latency']
+    # acceptance: planning ≤1% of cold lower+compile (ISSUE 14); smoke
+    # sizes have the LEAST compile to amortize against, so full size
+    # only gets better
+    assert lat['plan_frac_of_compile'] <= 0.01, lat
+    assert lat['predicted_peak_mib'] > 0
+    remat = rows['plan_remat']
+    assert remat['exceeds_without_remat'], remat
+    assert remat['fits_budget'], remat
+    assert remat['checkpoints'] >= 1
+    assert remat['bitwise_identical'], remat
+    acc = rows['plan_acceptance']
+    assert acc['ok'], acc

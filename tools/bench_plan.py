@@ -1,0 +1,189 @@
+"""Memory-planner bench (PERF.md §20).
+
+Two sections, one JSON line each:
+
+- ``plan_latency`` — ``analysis.plan_program`` wall time on the
+  multi-param Adam MLP recipe vs the cold Executor lower+compile it
+  informs. Acceptance (asserted in tier-1 via test_bench_plan.py at
+  smoke sizes): plan ≤ 1% of the cold lower+compile — the planner is
+  zero-tracing by construction, this prices the claim.
+- ``plan_remat`` — the memory-vs-throughput tradeoff on an
+  activation-heavy MLP: predicted peak without remat, the simulated
+  ``PADDLE_TPU_HBM_BUDGET_MB`` the unplanned program exceeds, the
+  post-``auto_remat`` predicted peak (must fit), steps/s with and
+  without remat (recompute costs one extra forward pass), and bitwise
+  loss parity remat-on vs remat-off.
+
+  JAX_PLATFORMS=cpu python tools/bench_plan.py [--smoke]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _fresh_names():
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    unique_name.generator = unique_name.UniqueNameGenerator()
+    fluid.framework.manual_seed(0)
+
+
+def measure_plan_latency(smoke=False, iters=7):
+    """plan_program wall time vs one real cold Executor compile."""
+    os.environ['PADDLE_TPU_COMPILE_CACHE'] = '0'   # price the real compile
+    sys.path.insert(0, os.path.join(_REPO, 'tools'))
+    from bench_passes import build_mlp_adam
+    import paddle_tpu as fluid
+    from paddle_tpu.analysis.plan import plan_program
+
+    _fresh_names()
+    main, startup, make_feed, fetch = build_mlp_adam(smoke=smoke)
+    feed = make_feed()
+    shapes = {k: v.shape for k, v in feed.items()}
+
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        plan = plan_program(main, fetch_names=[fetch.name],
+                            feed_names=sorted(feed), feed_shapes=shapes)
+        ts.append(time.perf_counter() - t0)
+    plan_s = statistics.median(ts)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    t0 = time.perf_counter()
+    exe.run(main, feed=feed, fetch_list=[fetch])       # cold: compiles
+    cold_s = time.perf_counter() - t0
+    return {'bench': 'plan_latency',
+            'ops': main.num_ops(),
+            'plan_ms': round(plan_s * 1e3, 3),
+            'cold_compile_s': round(cold_s, 4),
+            'plan_frac_of_compile': round(plan_s / cold_s, 5),
+            'predicted_peak_mib': round(plan.peak_bytes / 2**20, 3)}
+
+
+def _build_remat_model(smoke):
+    """Activation-heavy MLP under SGD: wide batch × depth so the
+    residuals-into-backward term dominates state — the workload shape
+    remat exists for."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers as L
+    width, depth, bs = (32, 6, 64) if smoke else (256, 8, 512)
+    _fresh_names()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data('x', [width], dtype='float32')
+        y = L.data('y', [1], dtype='float32')
+        h = x
+        for _ in range(depth):
+            h = L.fc(h, size=width, act='relu')
+        pred = L.fc(h, size=1)
+        loss = L.reduce_mean(L.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(bs, width).astype(np.float32),
+            'y': rng.randn(bs, 1).astype(np.float32)}
+    return main, startup, feed, loss
+
+
+def measure_remat_tradeoff(smoke=False, steps=6):
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.analysis.plan import plan_program, select_checkpoints
+
+    def run(budget_mb):
+        if budget_mb is None:
+            os.environ.pop('PADDLE_TPU_HBM_BUDGET_MB', None)
+        else:
+            os.environ['PADDLE_TPU_HBM_BUDGET_MB'] = repr(budget_mb)
+        try:
+            main, startup, feed, loss = _build_remat_model(smoke)
+            exe = fluid.Executor()
+            exe.run(startup)
+            losses = [exe.run(main, feed=feed, fetch_list=[loss])[0]]
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                losses.append(exe.run(main, feed=feed,
+                                      fetch_list=[loss])[0])
+            dt = time.perf_counter() - t0
+            return losses, steps / dt
+        finally:
+            os.environ.pop('PADDLE_TPU_HBM_BUDGET_MB', None)
+
+    main, _startup, feed, loss = _build_remat_model(smoke)
+    shapes = {k: v.shape for k, v in feed.items()}
+    kw = dict(fetch_names=[loss.name], feed_names=sorted(feed),
+              feed_shapes=shapes)
+    no_remat = plan_program(main, **kw)
+    # best-achievable peak under an impossible budget → the remat floor;
+    # the simulated budget sits halfway between floor and no-remat peak,
+    # so the unplanned program EXCEEDS it and auto_remat can FIT it
+    names, floor_peak = select_checkpoints(main, 0, **kw)
+    budget = (floor_peak + no_remat.peak_bytes) // 2
+    budget_mb = budget / float(1 << 20)
+    chosen, remat_peak = select_checkpoints(main, budget, **kw)
+
+    base_losses, base_sps = run(None)
+    remat_losses, remat_sps = run(budget_mb)
+    bitwise = all(np.array_equal(a, b)
+                  for a, b in zip(base_losses, remat_losses))
+    return {'bench': 'plan_remat',
+            'no_remat_peak_mib': round(no_remat.peak_bytes / 2**20, 3),
+            'budget_mib': round(budget_mb, 3),
+            'remat_peak_mib': round(remat_peak / 2**20, 3),
+            'checkpoints': len(chosen),
+            'fits_budget': remat_peak <= budget,
+            'exceeds_without_remat': no_remat.peak_bytes > budget,
+            'steps_per_s_base': round(base_sps, 2),
+            'steps_per_s_remat': round(remat_sps, 2),
+            'remat_steps_ratio': round(remat_sps / base_sps, 3)
+            if base_sps else None,
+            'bitwise_identical': bool(bitwise)}
+
+
+def measure_all(smoke=False, iters=7):
+    prior = os.environ.get('PADDLE_TPU_HBM_BUDGET_MB')
+    try:
+        lat = measure_plan_latency(smoke=smoke, iters=iters)
+        remat = measure_remat_tradeoff(smoke=smoke)
+    finally:
+        if prior is None:
+            os.environ.pop('PADDLE_TPU_HBM_BUDGET_MB', None)
+        else:
+            os.environ['PADDLE_TPU_HBM_BUDGET_MB'] = prior
+    print(json.dumps(lat))
+    print(json.dumps(remat))
+    return {'plan_latency': lat, 'plan_remat': remat}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--smoke', action='store_true')
+    ap.add_argument('--iters', type=int, default=7)
+    args = ap.parse_args()
+    r = measure_all(smoke=args.smoke, iters=args.iters)
+    frac = r['plan_latency']['plan_frac_of_compile']
+    ok = (frac <= 0.01 and r['plan_remat']['fits_budget']
+          and r['plan_remat']['exceeds_without_remat']
+          and r['plan_remat']['bitwise_identical'])
+    print(json.dumps({'bench': 'plan_acceptance',
+                      'plan_frac_of_compile': frac,
+                      'threshold': 0.01,
+                      'remat_fits': r['plan_remat']['fits_budget'],
+                      'bitwise': r['plan_remat']['bitwise_identical'],
+                      'ok': ok}))
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

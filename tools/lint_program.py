@@ -115,6 +115,13 @@ def main(argv=None):
     ap.add_argument('--passes', action='store_true',
                     help='also run the IR pass pipeline (fuse knobs on) '
                          'and re-verify the rewritten program')
+    ap.add_argument('--plan', action='store_true',
+                    help='append the static memory plan (peak HBM, top '
+                         'residents, op cost ranking — '
+                         'tools/plan_program.py report)')
+    ap.add_argument('--batch-size', type=int, default=16,
+                    help='dynamic-dim substitution for --plan '
+                         '(default 16)')
     ap.add_argument('--json', action='store_true',
                     help='emit machine-readable diagnostics')
     ap.add_argument('--fail-on', choices=('info', 'warning', 'error'),
@@ -149,18 +156,30 @@ def main(argv=None):
             opt, fetch_names=fetches, feed_names=feeds,
             stage='post-pipeline')))
 
+    plan = None
+    if args.plan:
+        from paddle_tpu.analysis.plan import plan_program
+        plan = plan_program(program, fetch_names=fetches,
+                            feed_names=feeds,
+                            assume_dim=args.batch_size)
+
     all_diags = [d for _, ds in reports for d in ds]
     if args.json:
-        print(json.dumps({
+        doc = {
             'target': label,
             'stages': {stage: [d.to_dict() for d in ds]
                        for stage, ds in reports},
             'max_severity': analysis.max_severity(all_diags),
-        }, indent=1))
+        }
+        if plan is not None:
+            doc['plan'] = plan.to_dict()
+        print(json.dumps(doc, indent=1))
     else:
         for stage, ds in reports:
             print(analysis.format_report(
                 ds, f'{label} [{stage}]: {len(ds)} finding(s)'))
+        if plan is not None:
+            print('\n'.join(plan.format_report()))
     return 1 if analysis.severity_at_least(all_diags, args.fail_on) else 0
 
 

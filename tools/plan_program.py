@@ -1,0 +1,113 @@
+"""Static memory/cost planner CLI (paddle_tpu/analysis/plan.py).
+
+Loads a saved inference model — or builds one of the tier-1 recipe
+programs — and prints the memory plan: predicted peak HBM, the residency
+breakdown (state/donation, feeds, activations-into-backward, gradients),
+the top residents at the peak, and the per-op FLOP/byte cost ranking.
+Milliseconds, zero tracing — nothing is compiled or executed.
+
+    JAX_PLATFORMS=cpu python tools/plan_program.py --recipe mnist_mlp
+    JAX_PLATFORMS=cpu python tools/plan_program.py --recipe bert_layer \
+        --batch-size 64 --passes
+    JAX_PLATFORMS=cpu python tools/plan_program.py --model-dir /m \
+        --budget 2048
+
+``--budget MB`` gates the exit code: 1 when the predicted peak exceeds
+it (CI memory regression guard), 0 otherwise. ``--passes`` plans the
+post-IR-pipeline program (all fuse knobs on — what the executor actually
+lowers); with ``PADDLE_TPU_HBM_BUDGET_MB`` set that includes the
+``auto_remat`` rewrite, so the report shows the post-remat plan.
+Exit code: 0 = within budget (or no budget), 1 = budget exceeded,
+2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+_TOOLS = os.path.join(_REPO, 'tools')
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+
+def main(argv=None):
+    from lint_program import RECIPES, _build_recipe, _load_model
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument('--model-dir',
+                     help='saved inference model '
+                          '(fluid.io.save_inference_model layout)')
+    src.add_argument('--recipe', choices=RECIPES,
+                     help='build one of the tier-1 recipe programs')
+    ap.add_argument('--batch-size', type=int, default=16,
+                    help='value substituted for dynamic (-1) batch dims '
+                         '(default 16)')
+    ap.add_argument('--budget', type=float, default=None,
+                    help='HBM budget in MiB; exit 1 when the predicted '
+                         'peak exceeds it')
+    ap.add_argument('--passes', action='store_true',
+                    help='plan the post-IR-pipeline program (fuse knobs '
+                         'on; includes auto_remat when '
+                         'PADDLE_TPU_HBM_BUDGET_MB is set)')
+    ap.add_argument('--no-donate', action='store_true',
+                    help='plan with buffer donation off '
+                         '(PADDLE_TPU_DONATE=0 semantics)')
+    ap.add_argument('--top', type=int, default=10,
+                    help='rows in the residents / op-cost tables')
+    ap.add_argument('--json', action='store_true',
+                    help='emit the machine-readable plan')
+    args = ap.parse_args(argv)
+    if args.batch_size <= 0:
+        ap.error('--batch-size must be > 0')
+
+    os.environ.setdefault('PADDLE_TPU_VERIFY', 'full')
+    from paddle_tpu.analysis.plan import plan_program
+
+    if args.model_dir:
+        program, fetches, feeds = _load_model(args.model_dir)
+        label = args.model_dir
+    else:
+        program, fetches, feeds = _build_recipe(args.recipe)
+        label = args.recipe
+
+    if args.passes:
+        from paddle_tpu import ir
+        from paddle_tpu.compiler import BuildStrategy
+        bs = BuildStrategy()
+        bs.fuse_elewise_add_act_ops = True
+        bs.fuse_all_optimizer_ops = True
+        bs.fuse_all_reduce_ops = True
+        program, _ctx = ir.apply_pipeline(program, fetch_names=fetches,
+                                          feed_names=feeds,
+                                          build_strategy=bs)
+
+    plan = plan_program(program, fetch_names=fetches, feed_names=feeds,
+                        donate=not args.no_donate,
+                        assume_dim=args.batch_size)
+    budget_bytes = int(args.budget * (1 << 20)) if args.budget else None
+
+    if args.json:
+        doc = plan.to_dict(top=args.top)
+        doc['target'] = label
+        doc['batch_size'] = args.batch_size
+        if budget_bytes:
+            doc['budget_bytes'] = budget_bytes
+            doc['fits_budget'] = plan.peak_bytes <= budget_bytes
+        print(json.dumps(doc, indent=1))
+    else:
+        print(f'target: {label}  (batch dims assumed {args.batch_size}, '
+              f'{plan.n_ops} ops, planned in '
+              f'{plan.plan_seconds * 1e3:.1f}ms)')
+        print('\n'.join(plan.format_report(top=args.top,
+                                           budget_bytes=budget_bytes)))
+    return 1 if budget_bytes and plan.peak_bytes > budget_bytes else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -361,6 +361,49 @@ def summarize(metrics, trace, steps, top=10):
                 f"{int(hf)} failed")
         lines.append('')
 
+    # ---- memory plan (analysis/plan.py, docs/ANALYSIS.md) ----
+    def _gauge(name):
+        s = (metrics.get(name) or {}).get('samples', [])
+        return s[0]['value'] if s else None
+
+    peak = _gauge('program_peak_hbm_bytes')
+    predicted = _gauge('program_plan_accounted_bytes')
+    measured = _gauge('program_measured_hbm_bytes')
+    if peak is not None or measured is not None:
+        lines.append('## Memory plan')
+        if peak is not None:
+            lines.append(f"predicted peak HBM:    {peak / 2**20:.3f} MiB "
+                         f"(analysis/plan.py, last lowered program)")
+        if predicted is not None and measured is not None:
+            delta = ((measured - predicted) / predicted
+                     if predicted else float('nan'))
+            lines.append(
+                f"state+feed+fetch:      predicted "
+                f"{predicted / 2**20:.3f} MiB vs measured "
+                f"{measured / 2**20:.3f} MiB ({delta:+.1%} delta)")
+        remat = _gauge('auto_remat_checkpoints')
+        if remat:
+            planned = _gauge('auto_remat_planned_peak_bytes') or 0
+            lines.append(
+                f"auto-remat:            {int(remat)} checkpoint(s) "
+                f"chosen; post-remat predicted peak "
+                f"{planned / 2**20:.3f} MiB "
+                f"(PADDLE_TPU_HBM_BUDGET_MB)")
+        plan_s = (metrics.get('program_plan_seconds')
+                  or {}).get('samples', [])
+        if plan_s and plan_s[0]['count']:
+            s = plan_s[0]
+            lines.append(f"plan time:             "
+                         f"{s['count']} plan(s), mean "
+                         f"{_ms(s['sum'] / s['count'])}, "
+                         f"max {_ms(s['max'] or 0)} (zero tracing)")
+        fails = _counter(metrics, 'program_plan_failures')
+        if fails:
+            lines.append(f"PLAN FAILURES:         {int(fails)} plan "
+                         f"attempt(s) raised (best-effort; lowering "
+                         f"proceeded)")
+        lines.append('')
+
     # ---- compile-time breakdown ----
     lines.append('## Compile-time breakdown')
     any_compile = False
