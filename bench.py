@@ -414,6 +414,36 @@ def bench_partitioner(on_tpu):
     return out
 
 
+def bench_sparse_section(on_tpu):
+    """Sparse embedding fast path (PERF.md §21, docs/SPARSE.md): rows-only
+    grad+update step vs the dense-scatter legacy at V=1e6 / nnz≈4k,
+    lookups/sec, DP bytes-on-wire (dense all-reduce vs quantized COO
+    push), and executor-spine sparse-vs-dense parity. Valid on CPU: the
+    quantities are HBM-traffic asymmetry (O(V·D) vs O(nnz·D)) and byte
+    accounting, not device-specific kernels."""
+    import subprocess
+    env = dict(os.environ)
+    if not on_tpu:
+        env['JAX_PLATFORMS'] = 'cpu'
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)), 'tools',
+                      'bench_sparse.py')],
+        env=env, capture_output=True, text=True, timeout=1200)
+    if r.returncode != 0:
+        raise RuntimeError(f'bench_sparse failed: {r.stderr[-2000:]}')
+    out = {}
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith('{'):
+            d = json.loads(line)
+            key = d['bench']
+            if key == 'sparse_step_time':
+                key = f"sparse_step_time_v{d['vocab']}"
+            out[key] = d
+    return out
+
+
 def bench_serving_batcher(on_tpu):
     """Serving-path load bench (PERF.md §11): closed-loop clients through
     the dynamic micro-batcher (paddle_tpu/serving/) vs serial single-request
@@ -761,6 +791,22 @@ def main():
         summary.update(
             fleet_efficiency_nproc2=fw.get('efficiency_nproc2'),
             fleet_acceptance_ge_0_8=fw.get('acceptance_ge_0_8'))
+
+    se = run("sparse_embedding", lambda: bench_sparse_section(on_tpu))
+    if se is not None:
+        big = se.get('sparse_step_time_v1000000', {})
+        wire = se.get('sparse_bytes_on_wire', {})
+        emit({"metric": "sparse_embedding",
+              "step_time": big,
+              "lookup": se.get('sparse_lookup_throughput'),
+              "bytes_on_wire": wire,
+              "executor_parity": se.get('sparse_executor_parity')})
+        summary.update(
+            sparse_over_dense_v1e6=big.get('sparse_over_dense'),
+            sparse_dense_over_int8_bytes=wire.get('dense_over_sparse_int8'),
+            sparse_f32_over_int8_bytes=wire.get('sparse_f32_over_int8'),
+            sparse_parity_ok=se.get('sparse_executor_parity',
+                                    {}).get('ok'))
 
     s = run("telemetry_sidecar", lambda: bench_telemetry_sidecar(on_tpu))
     if s is not None:

@@ -1,0 +1,111 @@
+"""DeepFM CTR training over sparse id features — the recsys workload the
+Fluid parameter-server half served (ROADMAP item 5, docs/SPARSE.md).
+
+Both embedding tables run ``is_sparse=True``: every step backprops a
+rows-only padded-COO gradient (O(nnz·D), bucket-ladder compile-stable)
+and the optimizer scatter-applies only the touched rows. Under a fleet,
+gradient sync pushes the COO pairs through the quantized sparse
+all-gather (int8 rows + per-row f32 scales at ``PADDLE_TPU_COMM_DTYPE=
+int8``) instead of all-reducing the dense tables.
+
+Single host::
+
+    python examples/train_deepfm.py [--steps N] [--batch B] [--vocab V]
+
+As a local test fleet (2 real jax.distributed CPU workers, per-host
+batch shards + sparse grad push)::
+
+    python examples/train_deepfm.py --nproc 2
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=30)
+    ap.add_argument('--batch', type=int, default=64,
+                    help='GLOBAL batch (split across hosts)')
+    ap.add_argument('--vocab', type=int, default=100_000)
+    ap.add_argument('--fields', type=int, default=16)
+    ap.add_argument('--dim', type=int, default=16)
+    ap.add_argument('--dense', action='store_true',
+                    help='legacy dense-gradient tables (A/B baseline)')
+    ap.add_argument('--nproc', type=int, default=0,
+                    help='spawn N local jax.distributed CPU workers')
+    args = ap.parse_args()
+
+    if args.nproc:
+        from paddle_tpu.fleet_runtime import local_fleet
+        fl = local_fleet(args.nproc, os.path.abspath(__file__),
+                         args=['--steps', args.steps, '--batch', args.batch,
+                               '--vocab', args.vocab,
+                               '--fields', args.fields, '--dim', args.dim]
+                         + (['--dense'] if args.dense else []))
+        rcs = fl.wait()
+        sys.exit(max(rc if rc is not None else 1 for rc in rcs))
+
+    import jax
+    from paddle_tpu.fleet_runtime import bootstrap
+    bootstrap()                      # no-op single-host; fleet env wires up
+    import paddle_tpu as fluid
+    import paddle_tpu.dygraph as dygraph
+    from paddle_tpu.dygraph.tape import dispatch_op, Tensor
+    from paddle_tpu.models.nlp_rec import DeepFM
+
+    hosts = jax.process_count()
+    rank = jax.process_index()
+    local_batch = args.batch // hosts
+
+    with dygraph.guard():
+        from paddle_tpu.core.random import default_generator
+        default_generator.seed(2024)    # every host builds the same weights
+        model = DeepFM(args.fields, args.vocab, embedding_size=args.dim,
+                       is_sparse=not args.dense)
+        if hosts > 1:
+            from paddle_tpu.dygraph.parallel import DataParallel
+            model = DataParallel(model)
+        opt = fluid.optimizer.Adagrad(
+            0.05, parameter_list=model.parameters())
+
+        rng = np.random.RandomState(7)   # same stream on every host
+        t0, last = time.perf_counter(), None
+        for step in range(args.steps):
+            ids = rng.randint(0, args.vocab,
+                              (args.batch, args.fields)).astype(np.int64)
+            vals = rng.rand(args.batch, args.fields).astype(np.float32)
+            label = (rng.rand(args.batch, 1) < 0.5).astype(np.float32)
+            sl = slice(rank * local_batch, (rank + 1) * local_batch)
+            logits = model(dygraph.to_variable(ids[sl]),
+                           dygraph.to_variable(vals[sl]))
+            loss = dispatch_op('reduce_mean', {'x': dispatch_op(
+                'sigmoid_cross_entropy_with_logits',
+                {'x': logits,
+                 'label': Tensor(label[sl], stop_gradient=True)}, {})}, {})
+            if hosts > 1:
+                loss = model.scale_loss(loss)
+            loss.backward()
+            if hosts > 1:
+                model.apply_collective_grads()   # sparse COO push + bundles
+            opt.minimize(loss)
+            opt.clear_gradients()
+            last = float(loss.numpy()) * (hosts if hosts > 1 else 1)
+        dt = time.perf_counter() - t0
+
+    if rank == 0:
+        mode = 'dense' if args.dense else 'sparse'
+        print(f'host 0/{hosts}: {args.steps} steps ({mode} tables, '
+              f'V={args.vocab}), final loss {last:.4f}, '
+              f'{args.steps / dt:.2f} steps/s '
+              f'(global batch {args.batch})')
+
+
+if __name__ == '__main__':
+    main()

@@ -30,8 +30,8 @@ from typing import Dict, List, Optional, Set
 from ..framework import BACKWARD_OP_TYPE
 from .diagnostics import Diagnostic
 from . import infer
-from .infer import (InferError, VarInfo, declared_info, has_rule, infer_op,
-                    is_float, seed_env, shapes_agree)
+from .infer import (UNKNOWN, InferError, VarInfo, declared_info, has_rule,
+                    infer_op, is_float, seed_env, shapes_agree)
 
 __all__ = ['run_checks']
 
@@ -47,7 +47,7 @@ _COLLECTIVE_TYPES = ('c_allreduce_sum', 'c_allreduce_max', 'c_allreduce_min',
                      'c_allreduce_prod', 'c_allreduce_sum_bucket')
 
 _UPDATE_OP_TYPES = frozenset(infer._OPT_MIRROR) | \
-    frozenset(infer._FUSED_OPT_MIRROR)
+    frozenset(infer._FUSED_OPT_MIRROR) | frozenset(infer._SPARSE_OPT_MIRROR)
 
 
 def _site(op):
@@ -287,7 +287,8 @@ class _Checker:
                       f"backward marker loss {loss!r} is not declared",
                       op, idx, bi, var=loss)
         feeds = self.data_vars | set(self.roots)
-        for p in op.attrs.get('params', []):
+        for p in (list(op.attrs.get('params', []))
+                  + list(op.attrs.get('sparse_params', []))):
             if p in self.persist or p in feeds or p in available:
                 continue
             self.emit('error', 'read-before-write',
@@ -299,6 +300,19 @@ class _Checker:
             if block.has_var(p):
                 pi = declared_info(block.var(p))
                 env[g] = VarInfo(pi.shape, pi.dtype)
+        # sparse tables emit a padded-COO pair instead (docs/SPARSE.md):
+        # rows (K,) int32 + vals (K, D); K is runtime (bucket ladder)
+        for p, r, v in zip(op.attrs.get('sparse_params', []),
+                           op.outputs.get('SparseRows', []),
+                           op.outputs.get('SparseVals', [])):
+            dim, dtype = UNKNOWN, None
+            if block.has_var(p):
+                pi = declared_info(block.var(p))
+                dtype = pi.dtype
+                if pi.shape is not None and len(pi.shape) == 2:
+                    dim = pi.shape[1]
+            env[r] = VarInfo((UNKNOWN,), 'int32')
+            env[v] = VarInfo((UNKNOWN, dim), dtype)
 
     def _check_control_flow(self, op, idx, block, env, available):
         for bi in _sub_block_indices(op):

@@ -434,6 +434,19 @@ for _t in infer._FUSED_OPT_MIRROR:
     cost_rule(_t)(_c_fused_opt)
 
 
+def _c_sparse_opt(ctx):
+    # rows-only scatter-apply: the update formula runs over the padded
+    # COO vals (K × D), NOT the V × D table — that asymmetry vs the
+    # dense family is the whole fast path (docs/SPARSE.md)
+    base = ctx.op.type[len('sparse_'):]
+    factor = _OPT_FLOP_FACTORS.get(base, 8)
+    return factor * ctx.in_elems('vals')
+
+
+for _t in infer._SPARSE_OPT_MIRROR:
+    cost_rule(_t)(_c_sparse_opt)
+
+
 # ---------------------------------------------------------------------------
 # rules: collectives — local reduce math only; wire bytes are what the
 # collective_* telemetry (PR 9) prices, not this model

@@ -1207,6 +1207,64 @@ for _t in _OPT_MIRROR:
     infer_rule(_t)(_opt_rule)
 
 
+# rows-only (padded-COO) update ops — docs/SPARSE.md. rows is rank-1
+# int, vals rank-2 with the param's embedding width; outputs mirror the
+# param/slot inputs exactly like the dense family above.
+_SPARSE_OPT_MIRROR = {
+    'sparse_sgd': {'ParamOut': 'param'},
+    'sparse_momentum': {'ParamOut': 'param', 'VelocityOut': 'velocity'},
+    'sparse_adagrad': {'ParamOut': 'param', 'MomentOut': 'moment'},
+    'sparse_adam': {'ParamOut': 'param', 'Moment1Out': 'moment1',
+                    'Moment2Out': 'moment2', 'Beta1PowOut': 'beta1_pow',
+                    'Beta2PowOut': 'beta2_pow'},
+}
+
+
+def _sparse_opt_rule(ctx):
+    mirror = _SPARSE_OPT_MIRROR[ctx.op.type]
+    param = ctx.input('param')
+    rows, vals = ctx.input('rows'), ctx.input('vals')
+    if rows is not None and rows.shape is not None and len(rows.shape) != 1:
+        raise InferError(
+            f'{ctx.op.type} rows must be rank 1 (padded COO row ids), got '
+            f'{rows.display_shape()}')
+    if vals is not None and vals.shape is not None and len(vals.shape) != 2:
+        raise InferError(
+            f'{ctx.op.type} vals must be rank 2 (rows × embedding dim), '
+            f'got {vals.display_shape()}')
+    if rows is not None and vals is not None \
+            and rows.shape is not None and vals.shape is not None \
+            and known(rows.shape[0]) and known(vals.shape[0]) \
+            and rows.shape[0] != vals.shape[0]:
+        raise InferError(
+            f'{ctx.op.type} rows/vals leading dims differ: '
+            f'{rows.display_shape()} vs {vals.display_shape()}')
+    if param is not None and vals is not None \
+            and param.shape is not None and vals.shape is not None \
+            and len(param.shape) == 2 \
+            and known(param.shape[1]) and known(vals.shape[1]) \
+            and param.shape[1] != vals.shape[1]:
+        raise InferError(
+            f'{ctx.op.type} vals width {vals.shape[1]} does not match '
+            f'table width {param.shape[1]}')
+    if param is not None and vals is not None \
+            and param.dtype is not None and vals.dtype is not None \
+            and param.dtype != vals.dtype:
+        raise InferError(
+            f'{ctx.op.type} param dtype {param.dtype} vs vals dtype '
+            f'{vals.dtype}', kind='dtype-mismatch')
+    out = {}
+    for out_slot, in_slot in mirror.items():
+        src = ctx.input(in_slot)
+        if src is not None:
+            out[out_slot] = VarInfo(src.shape, src.dtype)
+    return out
+
+
+for _t in _SPARSE_OPT_MIRROR:
+    infer_rule(_t)(_sparse_opt_rule)
+
+
 _FUSED_OPT_MIRROR = {
     'fused_sgd': {'ParamOut': 'params'},
     'fused_momentum': {'ParamOut': 'params', 'VelocityOut': 'velocities'},

@@ -47,7 +47,7 @@ from . import infer
 from .checks import _op_external_reads
 from .cost import (OpCost, dtype_nbytes, has_cost_rule, info_nbytes,
                    op_flops)
-from .infer import VarInfo, declared_info, infer_op, seed_env
+from .infer import UNKNOWN, VarInfo, declared_info, infer_op, seed_env
 
 __all__ = ['MemoryPlan', 'plan_program', 'select_checkpoints',
            'gradient_bytes']
@@ -261,6 +261,17 @@ def plan_program(program, fetch_names=(), feed_names=(), feed_shapes=None,
                 if blk.has_var(p):
                     pi = env.get(p) or declared_info(blk.var(p))
                     env[g] = VarInfo(pi.shape, pi.dtype)
+            # sparse tables emit padded-COO pairs (docs/SPARSE.md); K is
+            # the runtime bucket rung — UNKNOWN prices at assume_dim
+            for p, r, v in zip(op.attrs.get('sparse_params', []),
+                               op.outputs.get('SparseRows', []),
+                               op.outputs.get('SparseVals', [])):
+                pi = (env.get(p) or declared_info(blk.var(p))
+                      if blk.has_var(p) else VarInfo())
+                dim = (pi.shape[1] if pi.shape is not None
+                       and len(pi.shape) == 2 else UNKNOWN)
+                env[r] = VarInfo((UNKNOWN,), 'int32')
+                env[v] = VarInfo((UNKNOWN, dim), pi.dtype)
             plan.op_costs.append((idx, op.type, OpCost(), None))
             continue
         try:

@@ -5,6 +5,13 @@ explicit grad ops per-op via GradOpMaker; the TPU design instead inserts ONE
 backward marker op — the Executor's lowering wraps the forward segment in
 `jax.value_and_grad` over the parameter subtree, which is both simpler and
 lets XLA fuse/schedule the whole backward pass.
+
+Sparse embedding tables (``lookup_table(is_sparse=True)``, docs/SPARSE.md)
+leave the dense parameter list: the marker records them as *sparse params*
+with one `_sparse_site`-stamped lookup per read, and declares a padded-COO
+gradient pair (``@GRAD@ROWS`` int32 + ``@GRAD@VALS``) per table that the
+lowering fills by coalescing the per-occurrence surrogate cotangents —
+O(nnz·D) instead of the dense V×D scatter-add.
 """
 from __future__ import annotations
 
@@ -15,9 +22,52 @@ def _grad_name(name):
     return name + '@GRAD'
 
 
+def _sparse_table_sites(program, param_names):
+    """Tables eligible for rows-only gradients: trainable params whose
+    EVERY read (across all blocks) is a global-block
+    ``lookup_table(is_sparse=True)`` op with a fed (``is_data``) ids var.
+    A table also read densely (weight tying, a projection reuse) stays on
+    the dense path — sparsifying it would silently drop the dense
+    contribution. Returns {param: [(site_key, ids_name, op)]}."""
+    from .ops.sparse_ops import sparse_grad_enabled
+    if not sparse_grad_enabled():
+        return {}
+    wanted = set(param_names)
+    blk = program.global_block()
+    sites = {}
+    readers = {}     # param -> list of (block_idx, op) reading it
+    for b in program.blocks:
+        for op in b.ops:
+            if op.type == BACKWARD_OP_TYPE:
+                continue
+            for n in op.input_names():
+                if n in wanted:
+                    readers.setdefault(n, []).append((b.idx, op))
+    for p, reads in readers.items():
+        ok = []
+        for bi, op in reads:
+            ids_names = op.inputs.get('ids') or []
+            if (bi == 0 and op.type == 'lookup_table'
+                    and op.attrs.get('is_sparse')
+                    and (op.inputs.get('w') or [None])[0] == p
+                    and ids_names and blk.has_var(ids_names[0])
+                    and getattr(blk.var(ids_names[0]), 'is_data', False)):
+                ok.append((op, ids_names[0]))
+            else:
+                ok = None
+                break
+        if ok:
+            sites[p] = [(f'{p}@SPARSE@{i}', ids_name, op)
+                        for i, (op, ids_name) in enumerate(ok)]
+    return sites
+
+
 def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None, checkpoints=None):
-    """Returns list of (param_var, grad_var) like the reference."""
+    """Returns list of (param_var, grad_var) like the reference. Sparse
+    tables come back as (param, vals_var) where ``vals_var`` carries
+    ``is_sparse_rows=True`` and ``sparse_rows_var`` (the optimizer routes
+    those through the ``sparse_*`` scatter-apply update ops)."""
     program = loss.block.program
     block = program.global_block()
     params = [p for p in program.all_parameters() if p.trainable]
@@ -30,21 +80,50 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     if not params:
         raise ValueError("no trainable parameters to differentiate")
 
+    sparse_sites = _sparse_table_sites(program, [p.name for p in params])
+    dense_params = [p for p in params if p.name not in sparse_sites]
+    sparse_params = [p for p in params if p.name in sparse_sites]
+
     param_grads = []
-    for p in params:
+    for p in dense_params:
         g = block.create_var(name=_grad_name(p.name), shape=list(p.shape),
                              dtype=p.dtype, stop_gradient=True)
         param_grads.append((p, g))
 
-    block.append_op(
-        BACKWARD_OP_TYPE,
-        inputs={'Loss': loss.name},
-        outputs={'Grads': [g.name for _, g in param_grads]},
-        attrs={'loss': loss.name,
-               'params': [p.name for p, _ in param_grads],
-               'checkpoints': [c.name if hasattr(c, 'name') else c
-                               for c in (checkpoints or [])]})
-    return param_grads
+    sparse_rows_names, sparse_vals_names, site_records = [], [], []
+    sparse_grads = []
+    for p in sparse_params:
+        dim = int(p.shape[1])
+        rows = block.create_var(name=p.name + '@GRAD@ROWS', shape=[-1],
+                                dtype='int32', stop_gradient=True)
+        vals = block.create_var(name=p.name + '@GRAD@VALS',
+                                shape=[-1, dim], dtype=p.dtype,
+                                stop_gradient=True)
+        vals.is_sparse_rows = True
+        vals.sparse_rows_var = rows
+        sparse_rows_names.append(rows.name)
+        sparse_vals_names.append(vals.name)
+        for site_key, ids_name, op in sparse_sites[p.name]:
+            op._set_attr('_sparse_site', site_key)
+            site_records.append([site_key, p.name, ids_name])
+        sparse_grads.append((p, vals))
+
+    marker_attrs = {'loss': loss.name,
+                    'params': [p.name for p, _ in param_grads],
+                    'checkpoints': [c.name if hasattr(c, 'name') else c
+                                    for c in (checkpoints or [])]}
+    marker_outputs = {'Grads': [g.name for _, g in param_grads]}
+    if sparse_params:
+        marker_attrs['sparse_params'] = [p.name for p in sparse_params]
+        marker_attrs['sparse_sites'] = site_records
+        marker_outputs['SparseRows'] = sparse_rows_names
+        marker_outputs['SparseVals'] = sparse_vals_names
+
+    block.append_op(BACKWARD_OP_TYPE,
+                    inputs={'Loss': loss.name},
+                    outputs=marker_outputs,
+                    attrs=marker_attrs)
+    return param_grads + sparse_grads
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

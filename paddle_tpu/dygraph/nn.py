@@ -207,7 +207,10 @@ class Embedding(Layer):
             default_initializer=XavierInitializer())
         pad = -1 if padding_idx is None else (
             padding_idx if padding_idx >= 0 else size[0] + padding_idx)
-        self._attrs = dict(padding_idx=pad)
+        # is_sparse is LIVE (was: accepted-and-dropped): the tape emits
+        # rows-only COO gradients for this table (docs/SPARSE.md)
+        self._attrs = dict(padding_idx=pad, is_sparse=is_sparse,
+                           is_distributed=is_distributed)
 
     def forward(self, ids):
         return dispatch_op('lookup_table', {'w': self.weight, 'ids': ids},

@@ -105,6 +105,49 @@ def _global_rows(local_row, shard_s, own_dev, n):
         [jax.device_put(local_row, own_dev)])
 
 
+_GATHER_FN = None
+
+
+def _cross_process_gather(arr, n):
+    """(R, …) local value -> (n·R, …) concatenation over the process
+    mesh (replicated out-sharding over a process-sharded input = one
+    XLA all-gather). Reuses the reducer's mesh/jit-cache discipline."""
+    global _GATHER_FN
+    shard_s, own_dev, _ = _cross_process_reducer()
+    if _GATHER_FN is None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = NamedSharding(shard_s.mesh, P())
+        _GATHER_FN = jax.jit(lambda g: g, out_shardings=rep)
+    out = _GATHER_FN(_global_rows(arr[None], shard_s, own_dev, n))
+    full = out.addressable_data(0)
+    return full.reshape((-1,) + tuple(full.shape[2:]))
+
+
+def _sparse_cross_process_push(grad, n, comm):
+    """DP sync of one rows-only embedding gradient: all processes gather
+    every peer's padded COO — int8 payloads cross with per-row f32
+    scales — and the re-coalesce sums duplicate rows, which IS the
+    gradient reduction. O(n·K·D) wire bytes vs the O(V·D) dense
+    all-reduce the same table would otherwise pay (docs/SPARSE.md)."""
+    from ..ops.sparse_ops import SparseRowsGrad
+    from ..parallel import quant_collectives as qc
+    rows = _cross_process_gather(jnp.asarray(grad.rows, jnp.int32), n)
+    vals = jnp.asarray(grad.vals, jnp.float32)
+    if comm == 'int8':
+        q, s = qc.rowwise_quantize(vals)
+        vals_all = qc.rowwise_dequantize(_cross_process_gather(q, n),
+                                         _cross_process_gather(s, n))
+    elif comm == 'bf16':
+        vals_all = _cross_process_gather(
+            vals.astype(jnp.bfloat16), n).astype(jnp.float32)
+    else:
+        vals_all = _cross_process_gather(vals, n)
+    qc.record_sparse_collective('dygraph_dp_sparse', grad.nnz, grad.dim,
+                                comm, n, grad.vocab * grad.dim)
+    return SparseRowsGrad(rows, vals_all, grad.vocab,
+                          grad.dim).coalesced()
+
+
 def _cross_process_allreduce(flat, n, comm):
     """Sum one flat f32 bundle across `n` host processes; payload crosses
     the wire at `comm` dtype (quant_collectives codec), partials sum in
@@ -134,11 +177,12 @@ def _allreduce_bundles(params, reduce_flat, comm='f32', nranks=1,
     optimizer bundling trick applied to comms). Returns the number of
     reduce calls — one per dtype group, not one per parameter."""
     from ..ops.fused_ops import _bundle, _split
+    from ..ops.sparse_ops import SparseRowsGrad
     from ..parallel import quant_collectives as qc
     groups = {}
     for p in params:
-        if p.grad is None:
-            continue
+        if p.grad is None or isinstance(p.grad, SparseRowsGrad):
+            continue    # sparse COO grads take the rows push, not a bundle
         groups.setdefault(jnp.asarray(p.grad).dtype, []).append(p)
     calls = 0
     for dtype, ps in sorted(groups.items(), key=lambda kv: str(kv[0])):
@@ -201,12 +245,17 @@ class DataParallel(Layer):
         if n <= 1:
             return
         from ..parallel import quant_collectives as qc
+        from ..ops.sparse_ops import SparseRowsGrad
         comm = qc.resolve_comm_dtype(
             getattr(self._strategy, 'comm_dtype', None))
+        params = list(self._layers.parameters())
         _allreduce_bundles(
-            list(self._layers.parameters()),
+            params,
             lambda flat: _cross_process_allreduce(flat, n, comm),
             comm=comm, nranks=n)
+        for p in params:
+            if isinstance(p.grad, SparseRowsGrad):
+                p.grad = _sparse_cross_process_push(p.grad, n, comm)
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)

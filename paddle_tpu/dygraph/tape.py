@@ -272,7 +272,14 @@ class Tensor:
         run_backward(self, retain_graph=retain_graph)
 
     def gradient(self):
-        return None if self.grad is None else np.asarray(self.grad)
+        if self.grad is None:
+            return None
+        from ..ops.sparse_ops import SparseRowsGrad
+        if isinstance(self.grad, SparseRowsGrad):
+            # API parity: user code reads a dense (V, D) gradient even
+            # when the tape carried rows-only COO
+            return np.asarray(self.grad.densify())
+        return np.asarray(self.grad)
 
     def clear_gradient(self):
         self.grad = None
@@ -326,6 +333,10 @@ def dispatch_op(op_type, inputs, attrs):
 
 
 def _dispatch_op_impl(op_type, inputs, attrs):
+    if op_type == 'lookup_table' and attrs.get('is_sparse'):
+        out = _try_sparse_lookup(inputs, attrs)
+        if out is not None:
+            return out
     opdef = get_op(op_type)
     flat_tensors = []   # tensors participating in vjp
     arg_spec = []       # per-slot: ('single', idx) | ('list', [idx]) | ('const', v)
@@ -392,6 +403,59 @@ def _dispatch_op_impl(op_type, inputs, attrs):
                 [(r.shape, r.dtype) for r in flat_res], op_type,
                 call_fn=call)
     return _wrap_outputs(opdef, result, node)
+
+
+def _try_sparse_lookup(inputs, attrs):
+    """Rows-only gradient path of ``lookup_table(is_sparse=True)``
+    (docs/SPARSE.md): the eager forward is the plain dense gather; the
+    tape node's hand-written vjp emits a padded-COO
+    :class:`~paddle_tpu.ops.sparse_ops.SparseRowsGrad` — coalesced at a
+    bucket-ladder rung — instead of letting jax.vjp scatter-add a dense
+    V×D table gradient. Returns None (→ the generic dense dispatch) when
+    the path does not apply: knob off, no-grad mode, frozen table,
+    or under a to_static trace (the static path owns sparse there)."""
+    from ..ops import sparse_ops
+    w, ids = inputs.get('w'), inputs.get('ids')
+    if not (isinstance(w, Tensor) and not w.stop_gradient
+            and grad_enabled() and not _tensor_watchers
+            and jnp.issubdtype(w.value.dtype, jnp.inexact)
+            and sparse_ops.sparse_grad_enabled()):
+        return None
+    ids_val = ids.value if isinstance(ids, Tensor) else jnp.asarray(ids)
+    if isinstance(w.value, jax.core.Tracer) \
+            or isinstance(ids_val, jax.core.Tracer):
+        return None
+    opdef = get_op('lookup_table')
+    padding_idx = attrs.get('padding_idx', -1)
+    kernel_attrs = {k: v for k, v in attrs.items()
+                    if k in ('padding_idx', 'is_sparse', 'is_distributed')}
+    out_val = opdef.fn(w.value, ids_val, **kernel_attrs)
+    vocab, dim = int(w.value.shape[0]), int(w.value.shape[1])
+    flat_ids = sparse_ops.flatten_ids(ids_val)
+    nnz = int(flat_ids.shape[0])
+    bucket = sparse_ops.nnz_bucket(nnz)
+
+    def vjp_fn(ct):
+        ct = jnp.asarray(ct).reshape(nnz, dim)
+        vals = ct
+        if padding_idx is not None and padding_idx >= 0:
+            # padded positions were zeroed independent of w: no gradient
+            vals = jnp.where((flat_ids == padding_idx)[:, None], 0.0, vals)
+        rows, coalesced = sparse_ops.coalesce_rows(flat_ids, vals, vocab,
+                                                   bucket=bucket)
+        dedup = None
+        try:
+            dedup = int(np.unique(np.asarray(flat_ids)).shape[0])
+        except Exception:
+            pass
+        sparse_ops.record_sparse_lookup(nnz, bucket, dedup_rows=dedup,
+                                        table=w.name)
+        return (sparse_ops.SparseRowsGrad(rows, coalesced, vocab, dim),)
+
+    node = Node(vjp_fn, [w], 1, [(out_val.shape, out_val.dtype)],
+                'lookup_table',
+                call_fn=lambda wv: opdef.fn(wv, ids_val, **kernel_attrs))
+    return _wrap_outputs(opdef, out_val, node)
 
 
 def _cached_dispatch(op_type, opdef, arg_spec, attrs, call_with, call, vals,

@@ -551,8 +551,24 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
         param_names = marker.attrs['params']
         checkpoints = list(marker.attrs.get('checkpoints') or [])
         fwd_ops = ops[:bwd_idx]
+        # rows-only embedding gradients (docs/SPARSE.md): per-site
+        # surrogate params expose the per-occurrence cotangents, the
+        # post-backward coalesce writes the padded-COO pair the
+        # sparse_* update ops consume
+        sparse_params = list(marker.attrs.get('sparse_params') or [])
+        sparse_sites = [tuple(s) for s in
+                        (marker.attrs.get('sparse_sites') or [])]
+        sparse_rows_names = dict(zip(sparse_params,
+                                     marker.outputs.get('SparseRows', [])))
+        sparse_vals_names = dict(zip(sparse_params,
+                                     marker.outputs.get('SparseVals', [])))
         pplan = _pipeline_plan(program, fwd_ops, marker, feed_names,
                                state_names, fetch_names)
+        if pplan is not None and sparse_params:
+            raise NotImplementedError(
+                'sparse embedding gradients + pipeline microbatching are '
+                'not composable; set PADDLE_TPU_SPARSE_GRAD=0 or drop the '
+                'pipeline cut_list')
         loss_var_shape = None
         blk0 = program.global_block()
         if blk0.has_var(loss_name):
@@ -572,6 +588,8 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                                     for o in ops[bwd_idx + 1:]))
                       if bwd_idx + 1 < len(ops) else set())
         downstream |= {loss_name, *fetch_names, *state_set, *checkpoints}
+        # the coalesce after the backward reads every sparse site's ids
+        downstream |= {ids_name for _, _, ids_name in sparse_sites}
         for _, hi in segs:
             live = set(downstream)
             for o in fwd_ops[hi:]:
@@ -644,6 +662,24 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                     raise KeyError(
                         f"gradient target '{n}' is neither a persistable "
                         f"parameter nor a fed variable")
+            # one zero (nnz, D) surrogate per sparse lookup site: its
+            # gradient is the per-occurrence row cotangent (the table
+            # itself stays a constant — no dense V×D scatter ever exists)
+            site_vals = {}
+            site_keys = [s[0] for s in sparse_sites]
+            for site_key, pname, ids_name in sparse_sites:
+                if ids_name not in feeds:
+                    raise KeyError(
+                        f"sparse lookup site {site_key!r}: ids var "
+                        f"{ids_name!r} is not fed this run; feed it or set "
+                        f"PADDLE_TPU_SPARSE_GRAD=0")
+                shp = tuple(feeds[ids_name].shape)
+                if len(shp) >= 2 and shp[-1] == 1:
+                    shp = shp[:-1]
+                nnz = int(np.prod(shp)) if shp else 1
+                table = state[pname]
+                params[site_key] = jnp.zeros((nnz, int(table.shape[1])),
+                                             table.dtype)
 
             def make_segment(lo, hi):
                 def seg(e_in, pvals):
@@ -654,6 +690,12 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                 return seg
 
             def plain_fwd(pvals):
+                if site_keys:
+                    # publish this trace's surrogate tracers for the
+                    # lookup kernels (ops/sparse_ops.site_value); the
+                    # dict stays bound through the whole value_and_grad
+                    # call so checkpointed-segment replays re-read it
+                    site_vals.update({k: pvals[k] for k in site_keys})
                 e = {k: pvals.get(k, v) for k, v in feeds.items()}
                 for (lo, hi), live in zip(segs, live_after):
                     seg = make_segment(lo, hi)
@@ -789,9 +831,30 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                 fwd = gpipe_fwd
             else:
                 fwd = scan_fwd
-            (_, env), grads = jax.value_and_grad(fwd, has_aux=True)(params)
+            from .ops import sparse_ops as _sp
+            with _sp.site_context(site_vals):
+                (_, env), grads = jax.value_and_grad(fwd, has_aux=True)(
+                    params)
             for n, gname in zip(param_names, marker.outputs['Grads']):
                 env[gname] = grads[n]
+            if sparse_sites:
+                # coalesce per-occurrence cotangents into the padded-COO
+                # pair (@GRAD@ROWS/@GRAD@VALS) the sparse_* updates read
+                per_param = {}
+                for site_key, pname, ids_name in sparse_sites:
+                    per_param.setdefault(pname, []).append(
+                        (site_key, ids_name))
+                for pname, psites in per_param.items():
+                    table = state[pname]
+                    dim = int(table.shape[1])
+                    ids_cat = jnp.concatenate(
+                        [_sp.flatten_ids(feeds[i]) for _, i in psites])
+                    vals_cat = jnp.concatenate(
+                        [grads[k].reshape(-1, dim) for k, _ in psites])
+                    rows, vals = _sp.coalesce_rows(ids_cat, vals_cat,
+                                                   int(table.shape[0]))
+                    env[sparse_rows_names[pname]] = rows
+                    env[sparse_vals_names[pname]] = vals
             run_seq(ops[bwd_idx + 1:], bwd_idx + 1,
                     make_read(env, state), env.__setitem__)
 
@@ -836,6 +899,7 @@ class Executor:
         self._cache = {}
         self._step_counter = 0
         self._partition_placed = set()
+        self._lookup_meta_cache = {}
         # async pipeline bookkeeping: dispatched steps whose FetchHandles
         # are still pending (K-in-flight window + donation protection)
         self._window = InflightWindow()
@@ -881,6 +945,79 @@ class Executor:
                                       return_numpy)
         finally:
             _watchdog.disarm(lease)
+
+    def _lookup_feed_meta(self, program):
+        """Per-program map of embedding lookups fed directly from data
+        vars: [(ids_name, vocab, table_name, is_sparse_site)]. Cached per
+        (program id, version) — one op scan, not one per run."""
+        key = (program._id, program._version)
+        meta = self._lookup_meta_cache.get(key)
+        if meta is None:
+            meta = []
+            blk = program.global_block()
+            for op in blk.ops:
+                if op.type != 'lookup_table':
+                    continue
+                ids = (op.inputs.get('ids') or [None])[0]
+                w = (op.inputs.get('w') or [None])[0]
+                if not (ids and w and blk.has_var(ids) and blk.has_var(w)
+                        and getattr(blk.var(ids), 'is_data', False)):
+                    continue
+                shape = blk.var(w).shape or ()
+                if not shape or not isinstance(shape[0], int) \
+                        or shape[0] <= 0:
+                    continue
+                meta.append((ids, int(shape[0]), w,
+                             op.attrs.get('_sparse_site') is not None))
+            self._lookup_meta_cache[key] = meta
+        return meta
+
+    def _embedding_feed_checks(self, program, block, feed):
+        """Two per-run hooks over embedding-id feeds (docs/SPARSE.md):
+
+        - ``PADDLE_TPU_VERIFY=full`` + ``PADDLE_TPU_EMBED_OOB=error``:
+          host-side dtype/range validation — an out-of-range id would
+          silently clip to row V-1 on device and train the wrong row.
+          ``PADDLE_TPU_EMBED_OOB=clip`` is the legacy escape hatch.
+        - always-on ``sparse_*`` metrics for rows-only-gradient tables
+          (host-resident feeds only; staged device arrays are counted at
+          coalesce by their bucket instead of forcing a D2H sync).
+        """
+        meta = self._lookup_feed_meta(program)
+        if not meta:
+            return
+        from .core.lod import LoDTensor
+        from . import analysis
+        from .ops import sparse_ops as _sp
+        check_range = analysis.verify_level() == 'full' \
+            and _sp.oob_policy() == 'error'
+        for ids_name, vocab, table, is_sparse_site in meta:
+            value = feed.get(ids_name)
+            if value is None:
+                continue
+            if isinstance(value, LoDTensor):
+                value = value.data
+            if isinstance(value, jax.Array):
+                continue      # staged feed: no host copy without a sync
+            arr = np.asarray(value)
+            if check_range:
+                if not np.issubdtype(arr.dtype, np.integer):
+                    raise ValueError(
+                        f"feed {ids_name!r} indexes embedding table "
+                        f"{table!r} but has dtype {arr.dtype} (expected "
+                        f"an integer id dtype)")
+                if arr.size and (arr.min() < 0 or arr.max() >= vocab):
+                    raise ValueError(
+                        f"feed {ids_name!r} holds ids outside [0, {vocab}) "
+                        f"for embedding table {table!r} (min {arr.min()}, "
+                        f"max {arr.max()}); on device they would silently "
+                        f"clip to row {vocab - 1} and train the wrong row. "
+                        f"Set PADDLE_TPU_EMBED_OOB=clip for the legacy "
+                        f"clipping behavior.")
+            if is_sparse_site and arr.size:
+                _sp.record_sparse_lookup(
+                    arr.size, _sp.nnz_bucket(arr.size),
+                    dedup_rows=int(np.unique(arr).size), table=table)
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy):
         from .compiler import CompiledProgram
@@ -1047,6 +1184,7 @@ class Executor:
                      help='feed bytes recognized as already device-committed '
                           'and passed through without a second device_put')
         _default_len_feeds(block, feed_vals)
+        self._embedding_feed_checks(program, block, feed)
         prep_span.__exit__(None, None, None)
 
         from . import ir

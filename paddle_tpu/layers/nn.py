@@ -49,9 +49,18 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
                                 default_initializer=XavierInitializer())
     pad = -1 if padding_idx is None else (
         padding_idx if padding_idx >= 0 else size[0] + padding_idx)
-    return apply_op_layer('lookup_table', {'w': w, 'ids': input},
-                          {'padding_idx': pad, 'is_sparse': is_sparse,
-                           'is_distributed': is_distributed})
+    out = apply_op_layer('lookup_table', {'w': w, 'ids': input},
+                         {'padding_idx': pad, 'is_sparse': is_sparse,
+                          'is_distributed': is_distributed})
+    # LoD travels through the lookup (ref: lookup_table_op InferShape
+    # shares the ids LoD): ragged id batches keep their length var so a
+    # downstream sequence_pool masks the padding steps — without this the
+    # embedding+sequence_pool pair silently pooled pad rows that
+    # fused_embedding_seq_pool (correctly) masked
+    lv = getattr(input, '_length_var', None)
+    if lv is not None:
+        out._length_var = lv
+    return out
 
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,

@@ -139,11 +139,16 @@ class Seq2SeqAttn(Layer):
 
 class DeepFM(Layer):
     def __init__(self, field_num, feature_size, embedding_size=8,
-                 deep_layers=(64, 32)):
+                 deep_layers=(64, 32), is_sparse=False):
         super().__init__()
         init = ParamAttr(initializer=XavierInitializer())
-        self.fm_w = Embedding([feature_size, 1], param_attr=init)
-        self.emb = Embedding([feature_size, embedding_size], param_attr=init)
+        # is_sparse=True: both tables train through the rows-only
+        # gradient fast path (docs/SPARSE.md) — the recsys-scale setting
+        # where feature_size is millions and a batch touches thousands
+        self.fm_w = Embedding([feature_size, 1], param_attr=init,
+                              is_sparse=is_sparse)
+        self.emb = Embedding([feature_size, embedding_size], param_attr=init,
+                             is_sparse=is_sparse)
         dims = [field_num * embedding_size] + list(deep_layers)
         self.deep = []
         for i in range(len(deep_layers)):

@@ -121,8 +121,14 @@ def fused_embedding_seq_pool(ids, w, length=None, *, combiner='sum',
     if combiner == 'sum':
         return s
     if combiner == 'mean':
-        n = jnp.maximum(jnp.sum(valid, axis=1, keepdims=True), 1.0)
-        return s / n
+        # denominator = the LENGTH-masked step count, padding_idx rows
+        # INCLUDED (they contribute zero rows but still count) — exactly
+        # embedding + sequence_pool('average'); excluding them here made
+        # the fused op drift from the unfused pair on batches with pad
+        # ids (tests/layers/test_fused_embedding_seq_pool.py)
+        count = (jnp.sum(m, axis=1, keepdims=True) if m is not None
+                 else jnp.full((ids.shape[0], 1), ids.shape[1], emb.dtype))
+        return s / jnp.maximum(count, 1.0)
     raise ValueError(f'unknown combiner {combiner!r}')
 
 

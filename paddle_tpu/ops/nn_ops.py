@@ -372,15 +372,32 @@ def dropout(x, *, dropout_prob=0.5, is_test=False,
 
 
 @register_op('lookup_table')
-def lookup_table(w, ids, *, padding_idx=-1, is_sparse=False, is_distributed=False):
+def lookup_table(w, ids, *, padding_idx=-1, is_sparse=False,
+                 is_distributed=False, _sparse_site=None):
     """Embedding lookup (ref: paddle/fluid/operators/lookup_table_op.cc).
-    is_sparse accepted for API parity; on TPU dense gather + XLA handles it."""
+
+    ``is_sparse=True`` + a bound ``_sparse_site`` (the static sparse-grad
+    path, docs/SPARSE.md): the gathered rows add a zero-valued surrogate
+    from the trace context (exact: +0.0), so the backward produces the
+    per-occurrence row cotangent O(nnz·D) instead of the dense V×D
+    scatter — the table itself is a non-differentiated constant in that
+    mode. Outside a sparse trace (eval clones, inference programs,
+    PADDLE_TPU_SPARSE_GRAD=0) the surrogate resolves to None and this is
+    the plain dense gather."""
     w = jnp.asarray(w)
     ids = jnp.asarray(ids)
     squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
     if squeeze_last:
         ids = ids[..., 0]
+    surrogate = None
+    if _sparse_site is not None:
+        from .sparse_ops import site_value
+        surrogate = site_value(_sparse_site)
+    if surrogate is not None:
+        w = lax.stop_gradient(w)
     out = jnp.take(w, jnp.clip(ids, 0, w.shape[0] - 1), axis=0)
+    if surrogate is not None:
+        out = out + surrogate.reshape(out.shape)
     if padding_idx is not None and padding_idx >= 0:
         out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
     return out

@@ -1,0 +1,370 @@
+"""Sparse embedding fast path: rows-only gradients + scatter-apply updates.
+
+The Fluid reference's parameter-server half (SelectedRows gradients,
+``lookup_table(is_sparse=True)``, sparse SGD/Adagrad/Adam) exchanged and
+applied embedding gradients as (rows, values) pairs — O(nnz·D) for a V×D
+table instead of the O(V·D) dense scatter-add jax.vjp produces. This
+module is the TPU-native reconstruction (ROADMAP item 5):
+
+- **Padded COO**: a gradient is ``(rows int32 (K,), vals f32 (K, D))``
+  where ``K`` is a compile-stable rung of the nnz **bucket ladder**
+  (powers of two, floor ``PADDLE_TPU_SPARSE_NNZ_BUCKET``). Pad entries
+  carry ``rows == vocab`` (an out-of-range sentinel) and zero vals; XLA
+  scatter drops out-of-bounds updates, so padding is free at apply time.
+- **Coalescing**: ``coalesce_rows`` dedups occurrences with
+  ``jnp.unique(size=K)`` + ``segment_sum`` — fixed output shapes, so the
+  number of compiled variants is bounded by the ladder, not the data.
+- **Updates**: ``sparse_sgd`` / ``sparse_momentum`` / ``sparse_adagrad``
+  / ``sparse_adam`` gather the touched slot rows, apply the dense
+  formula on K rows, and scatter the results back (``mode='drop'``).
+  ``sparse_adam`` is the reference's lazy mode: moments advance only on
+  touched rows; the beta-power schedule advances globally per step.
+- **Dygraph**: :class:`SparseRowsGrad` is the tape's gradient carrier —
+  a registered pytree with the accumulation algebra ``backward()`` needs
+  (sparse+sparse re-coalesces, sparse+dense densifies).
+
+Knobs (strict parse, README table): ``PADDLE_TPU_SPARSE_GRAD`` (``1``
+default; ``0`` restores the dense-scatter legacy path everywhere),
+``PADDLE_TPU_SPARSE_NNZ_BUCKET`` (ladder floor, default 64),
+``PADDLE_TPU_EMBED_OOB`` ∈ {error, clip} (out-of-range-id policy of the
+validation layers; the kernels always clip — docs/SPARSE.md).
+
+Always-on ``sparse_*`` metrics (docs/OBSERVABILITY.md): like serving,
+the interesting consumers (bench, fleet dashboards) must see rows/step
+and dedup without PADDLE_TPU_TELEMETRY, and the increments are host-side
+noise next to a device step.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..observability import registry as _registry
+
+__all__ = ['sparse_grad_enabled', 'nnz_bucket', 'bucket_floor',
+           'oob_policy', 'coalesce_rows', 'flatten_ids', 'SparseRowsGrad',
+           'site_value', 'site_context', 'SPARSE_UPDATE_OPS',
+           'record_sparse_lookup', 'sparse_metrics_snapshot']
+
+ENV_SPARSE_GRAD = 'PADDLE_TPU_SPARSE_GRAD'
+ENV_NNZ_BUCKET = 'PADDLE_TPU_SPARSE_NNZ_BUCKET'
+ENV_EMBED_OOB = 'PADDLE_TPU_EMBED_OOB'
+
+# dense optimizer op type → its rows-only counterpart (optimizer.py
+# consults this to emit/apply sparse updates; unsupported types raise
+# naming this set)
+SPARSE_UPDATE_OPS = {
+    'sgd': 'sparse_sgd',
+    'momentum': 'sparse_momentum',
+    'adagrad': 'sparse_adagrad',
+    'adam': 'sparse_adam',
+}
+
+
+def sparse_grad_enabled():
+    """Whether ``lookup_table(is_sparse=True)`` takes the rows-only
+    gradient path. Strict parse: only '0'/'1' are accepted."""
+    v = os.environ.get(ENV_SPARSE_GRAD, '1')
+    if v not in ('0', '1'):
+        raise ValueError(
+            f"{ENV_SPARSE_GRAD}={v!r} invalid (supported: 0, 1)")
+    return v == '1'
+
+
+def bucket_floor():
+    """Smallest nnz-bucket rung (strict-parse positive int env knob)."""
+    v = os.environ.get(ENV_NNZ_BUCKET, '64')
+    try:
+        n = int(v)
+    except ValueError:
+        n = -1
+    if n < 1:
+        raise ValueError(
+            f"{ENV_NNZ_BUCKET}={v!r} invalid (expected a positive int)")
+    return n
+
+
+def oob_policy():
+    """Out-of-range embedding-id policy of the VALIDATION layers (serving
+    validate(), PADDLE_TPU_VERIFY=full feed checks): 'error' rejects the
+    request/feed, 'clip' is the legacy escape hatch (ids silently clip to
+    row V-1 on device, exactly the pre-PR behavior)."""
+    v = os.environ.get(ENV_EMBED_OOB, 'error')
+    if v not in ('error', 'clip'):
+        raise ValueError(
+            f"{ENV_EMBED_OOB}={v!r} invalid (supported: error, clip)")
+    return v
+
+
+def nnz_bucket(nnz):
+    """Ladder rung for ``nnz`` id occurrences: smallest power-of-two
+    multiple of the floor that is >= nnz. Compile count per (table,
+    feed-signature family) is bounded by the ladder's log2 span."""
+    b = bucket_floor()
+    n = max(int(nnz), 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+def flatten_ids(ids):
+    """The kernel's id normalization (lookup_table squeezes a trailing
+    (…, 1) LoD column), flattened to 1-D int32."""
+    ids = jnp.asarray(ids)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    return ids.reshape(-1).astype(jnp.int32)
+
+
+def coalesce_rows(ids, vals, vocab, bucket=None):
+    """Dedup per-occurrence gradients into padded COO.
+
+    ``ids`` (N,) int, ``vals`` (N, D) → ``(rows (K,) int32, out (K, D))``
+    with K a ladder rung (or the explicit ``bucket``). Occurrence ids are
+    clipped to [0, vocab-1] first — the exact rows the legacy dense
+    gather trained — so sparse-vs-dense parity holds even for bad ids;
+    pad entries get ``rows == vocab`` and zero vals (dropped by the
+    scatter at apply time)."""
+    ids = jnp.asarray(ids).reshape(-1).astype(jnp.int32)
+    vals = jnp.asarray(vals)
+    vals = vals.reshape(ids.shape[0], -1)
+    k = int(bucket) if bucket is not None else nnz_bucket(ids.shape[0])
+    clipped = jnp.clip(ids, 0, vocab - 1)
+    rows, inv = jnp.unique(clipped, size=k, fill_value=vocab,
+                           return_inverse=True)
+    out = jax.ops.segment_sum(vals, inv.reshape(-1), num_segments=k)
+    # fill rows (== vocab) may alias a real segment only when unique
+    # overflows k, which cannot happen: k >= nnz >= unique count
+    return rows, out
+
+
+def _occupied(rows, vocab):
+    """Number of non-pad COO entries (traced-safe)."""
+    return jnp.sum((jnp.asarray(rows) < vocab).astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# dygraph gradient carrier
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+class SparseRowsGrad:
+    """Rows-only gradient of an embedding table: padded COO plus the
+    table geometry. Supports the tape's accumulation algebra (``+``) and
+    densification (the correctness escape hatch)."""
+
+    def __init__(self, rows, vals, vocab, dim):
+        self.rows = rows
+        self.vals = vals
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+
+    # pytree protocol: rows/vals are leaves, geometry is static
+    def tree_flatten(self):
+        return (self.rows, self.vals), (self.vocab, self.dim)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+    @property
+    def shape(self):
+        return (self.vocab, self.dim)
+
+    @property
+    def dtype(self):
+        return jnp.asarray(self.vals).dtype
+
+    @property
+    def nnz(self):
+        return int(self.rows.shape[0])
+
+    def densify(self):
+        """(vocab, dim) dense gradient — the legacy representation."""
+        dense = jnp.zeros((self.vocab, self.dim), self.vals.dtype)
+        return dense.at[self.rows].add(self.vals, mode='drop')
+
+    def coalesced(self, bucket=None):
+        rows, vals = coalesce_rows(self.rows, self.vals, self.vocab,
+                                   bucket=bucket)
+        return SparseRowsGrad(rows, vals, self.vocab, self.dim)
+
+    def __add__(self, other):
+        if isinstance(other, SparseRowsGrad):
+            if (other.vocab, other.dim) != (self.vocab, self.dim):
+                raise ValueError(
+                    f'cannot accumulate sparse grads of tables '
+                    f'{(self.vocab, self.dim)} vs {(other.vocab, other.dim)}')
+            rows = jnp.concatenate([self.rows, other.rows])
+            vals = jnp.concatenate([jnp.asarray(self.vals),
+                                    jnp.asarray(other.vals)])
+            r, v = coalesce_rows(rows, vals, self.vocab)
+            return SparseRowsGrad(r, v, self.vocab, self.dim)
+        if other is None:
+            return self
+        # mixed sparse + dense (e.g. the same table also read densely):
+        # correctness first — densify
+        return self.densify() + jnp.asarray(other)
+
+    __radd__ = __add__
+
+    def __repr__(self):
+        return (f'SparseRowsGrad(rows={self.rows.shape[0]}, '
+                f'table=({self.vocab}, {self.dim}))')
+
+
+# ---------------------------------------------------------------------------
+# static-path surrogate plumbing (executor._lower <-> lookup_table kernel)
+# ---------------------------------------------------------------------------
+#
+# The backward marker lowers to ONE jax.value_and_grad over the parameter
+# dict; a dense table in that dict backprops a V×D scatter. Instead,
+# append_backward moves sparse tables OUT of the dense param list and
+# _lower adds one zero-valued (nnz, D) SURROGATE per lookup site. The
+# lookup kernel adds the surrogate to its gathered rows (exact: +0.0), so
+# d loss/d surrogate is the per-occurrence row cotangent — O(nnz·D) —
+# and the table itself is a non-differentiated constant. The surrogate
+# tracers only exist inside the traced forward, so they reach the kernel
+# through this thread-local context, keyed by the op's `_sparse_site`
+# attr (set while the whole value_and_grad call runs; remat replays of a
+# checkpointed segment re-read it).
+
+_SITE_CTX = threading.local()
+
+
+class site_context:
+    """Bind ``{site_key: surrogate tracer}`` for the current trace."""
+
+    def __init__(self, values):
+        self._values = values
+
+    def __enter__(self):
+        stack = getattr(_SITE_CTX, 'stack', None)
+        if stack is None:
+            stack = _SITE_CTX.stack = []
+        stack.append(self._values)
+        return self
+
+    def __exit__(self, *exc):
+        _SITE_CTX.stack.pop()
+
+
+def site_value(key):
+    """The bound surrogate for ``key``, or None outside a sparse trace
+    (eval clones, inference programs, PADDLE_TPU_SPARSE_GRAD=0 runs)."""
+    stack = getattr(_SITE_CTX, 'stack', None)
+    if not stack:
+        return None
+    for values in reversed(stack):
+        if key in values:
+            return values[key]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# rows-only update ops (static graph; the dygraph step calls the same fns)
+# ---------------------------------------------------------------------------
+
+def _prep(param, rows, vals):
+    p = jnp.asarray(param)
+    r = jnp.asarray(rows).astype(jnp.int32)
+    v = jnp.asarray(vals).astype(p.dtype)
+    return p, r, v
+
+
+@register_op('sparse_sgd', outputs=['ParamOut'])
+def sparse_sgd(param, rows, vals, lr):
+    """SGD over touched rows only (ref: sgd_op.h SelectedRows branch)."""
+    p, r, v = _prep(param, rows, vals)
+    return p.at[r].add(-jnp.asarray(lr) * v, mode='drop')
+
+
+@register_op('sparse_momentum', outputs=['ParamOut', 'VelocityOut'])
+def sparse_momentum(param, rows, vals, velocity, lr, *, mu=0.9,
+                    use_nesterov=False):
+    """Lazy momentum: velocity rows decay+accumulate only when touched."""
+    p, r, v = _prep(param, rows, vals)
+    vel = jnp.asarray(velocity)
+    vel_rows = vel[jnp.clip(r, 0, p.shape[0] - 1)]
+    vel_new = mu * vel_rows + v
+    lr = jnp.asarray(lr)
+    if use_nesterov:
+        step = (v + mu * vel_new) * lr
+    else:
+        step = lr * vel_new
+    return (p.at[r].add(-step, mode='drop'),
+            vel.at[r].set(vel_new, mode='drop'))
+
+
+@register_op('sparse_adagrad', outputs=['ParamOut', 'MomentOut'])
+def sparse_adagrad(param, rows, vals, moment, lr, *, epsilon=1e-6):
+    """Adagrad over touched rows (ref: adagrad_op.h SelectedRows branch)."""
+    p, r, v = _prep(param, rows, vals)
+    m = jnp.asarray(moment)
+    m_rows = m[jnp.clip(r, 0, p.shape[0] - 1)]
+    m_new = m_rows + jnp.square(v)
+    step = jnp.asarray(lr) * v / (jnp.sqrt(m_new) + epsilon)
+    return (p.at[r].add(-step, mode='drop'),
+            m.at[r].set(m_new, mode='drop'))
+
+
+@register_op('sparse_adam', outputs=['ParamOut', 'Moment1Out', 'Moment2Out',
+                                     'Beta1PowOut', 'Beta2PowOut'])
+def sparse_adam(param, rows, vals, moment1, moment2, beta1_pow, beta2_pow,
+                lr, *, beta1=0.9, beta2=0.999, epsilon=1e-8):
+    """Lazy Adam (ref: adam_op.h SelectedRows branch, lazy_mode=True):
+    touched rows update their moments and step; untouched rows keep stale
+    moments; the bias-correction powers advance globally every step."""
+    p, r, v = _prep(param, rows, vals)
+    m1, m2 = jnp.asarray(moment1), jnp.asarray(moment2)
+    b1p, b2p = jnp.asarray(beta1_pow), jnp.asarray(beta2_pow)
+    safe = jnp.clip(r, 0, p.shape[0] - 1)
+    m1_new = beta1 * m1[safe] + (1 - beta1) * v
+    m2_new = beta2 * m2[safe] + (1 - beta2) * jnp.square(v)
+    lr_t = jnp.asarray(lr) * jnp.sqrt(1 - b2p) / (1 - b1p)
+    step = lr_t * m1_new / (jnp.sqrt(m2_new) + epsilon)
+    return (p.at[r].add(-step, mode='drop'),
+            m1.at[r].set(m1_new, mode='drop'),
+            m2.at[r].set(m2_new, mode='drop'),
+            b1p * beta1, b2p * beta2)
+
+
+# ---------------------------------------------------------------------------
+# always-on sparse_* metrics (serving/metrics.py convention: resolve
+# through the registry per use so registry.reset() cannot orphan them)
+# ---------------------------------------------------------------------------
+
+def record_sparse_lookup(nnz, bucket, dedup_rows=None, table=''):
+    """One sparse-gradient emission: raw id occurrences, the padded
+    bucket they coalesced into, and (when the caller knows it host-side)
+    the deduped row count — dedup ratio = ids / rows."""
+    _registry.counter(
+        'sparse_lookup_ids_total',
+        'raw id occurrences feeding rows-only embedding gradients').inc(
+            float(nnz))
+    _registry.counter(
+        'sparse_grad_rows_total',
+        'padded COO rows emitted per step (the bucket-ladder rung)').inc(
+            float(bucket))
+    _registry.gauge(
+        'sparse_nnz_bucket',
+        'current nnz bucket rung by table').labels(table=table).set(
+            float(bucket))
+    if dedup_rows is not None and dedup_rows > 0:
+        _registry.gauge(
+            'sparse_dedup_ratio',
+            'id occurrences per unique row in the last coalesce '
+            '(higher = more duplicate-id traffic saved)').labels(
+                table=table).set(float(nnz) / float(dedup_rows))
+
+
+def sparse_metrics_snapshot():
+    """Test/report helper: current sparse_* counter values."""
+    return {name: _registry.counter(name, '').value
+            for name in ('sparse_lookup_ids_total',
+                         'sparse_grad_rows_total')}

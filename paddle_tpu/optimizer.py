@@ -81,15 +81,24 @@ class Optimizer:
                 for p in self._parameter_list]
 
     def apply_gradients(self, params_grads):
-        params_grads = append_regularization_ops(params_grads,
-                                                 self.regularization)
+        # rows-only embedding gradients (docs/SPARSE.md) skip
+        # regularization and clipping — both are dense whole-tensor
+        # transforms; the reference PS path applied neither to
+        # SelectedRows gradients
+        sparse_pg = [(p, g) for p, g in params_grads
+                     if getattr(g, 'is_sparse_rows', False)]
+        dense_pg = [(p, g) for p, g in params_grads
+                    if not getattr(g, 'is_sparse_rows', False)]
+        dense_pg = append_regularization_ops(dense_pg, self.regularization)
         if self._grad_clip is not None:
-            params_grads = self._grad_clip.process(params_grads)
+            dense_pg = self._grad_clip.process(dense_pg)
         else:
-            params_grads = append_gradient_clip_ops(params_grads)
+            dense_pg = append_gradient_clip_ops(dense_pg)
         lr = self.get_lr_var()
-        for p, g in params_grads:
+        for p, g in dense_pg:
             self._append_optimize_op(p, g, lr)
+        for p, g in sparse_pg:
+            self._append_sparse_optimize_op(p, g, lr)
         return []
 
     def apply_optimize(self, loss, startup_program, params_grads):
@@ -142,6 +151,44 @@ class Optimizer:
             type=self._op_type, inputs=opdef_inputs, outputs=outputs,
             attrs=self._hypers_for(param))
 
+    def _sparse_op_type(self):
+        """The rows-only counterpart of this optimizer's update op, or a
+        ValueError naming the supported set (docs/SPARSE.md)."""
+        from .ops.sparse_ops import SPARSE_UPDATE_OPS
+        st = SPARSE_UPDATE_OPS.get(self._op_type)
+        if st is None:
+            raise ValueError(
+                f"optimizer op {self._op_type!r} has no sparse (rows-only) "
+                f"update; tables trained with lookup_table(is_sparse=True) "
+                f"need one of {sorted(SPARSE_UPDATE_OPS)} — or set "
+                f"PADDLE_TPU_SPARSE_GRAD=0 for the dense legacy path")
+        return st
+
+    def _append_sparse_optimize_op(self, param, grad, lr):
+        """Emit ``sparse_<op>`` consuming the marker's padded-COO grad
+        pair (``grad`` is the @GRAD@VALS var; its ``sparse_rows_var``
+        attribute names the companion @GRAD@ROWS var)."""
+        sparse_type = self._sparse_op_type()
+        slots = self._slot_init(list(param.shape), param.dtype)
+        slot_vars = [self._make_slot_var(param, s, shp, fill)
+                     for s, (shp, fill) in slots.items()]
+        inputs = {'param': param.name,
+                  'rows': grad.sparse_rows_var.name,
+                  'vals': grad.name}
+        for s, v in zip(slots, slot_vars):
+            inputs[s] = v.name
+        if self._has_lr_input:
+            inputs['lr'] = lr.name
+        from .ops.registry import get_op
+        out_slots = get_op(sparse_type).output_slots
+        outputs = {'ParamOut': param.name}
+        for oslot, v in zip(out_slots[1:], slot_vars):
+            outputs[oslot] = v.name
+        helper = LayerHelper('optimizer')
+        helper.main_program.current_block().append_op(
+            type=sparse_type, inputs=inputs, outputs=outputs,
+            attrs=self._hypers_for(param))
+
     # ==================================================================
     # dygraph path — fused jitted pytree update
     # ==================================================================
@@ -174,6 +221,10 @@ class Optimizer:
         svals = {p.name: self._dy_slots[p.name] for p in params}
         regs = {p.name: getattr(p, 'regularizer', None) for p in params}
 
+        from .ops.sparse_ops import SparseRowsGrad
+        if any(isinstance(g, SparseRowsGrad) for g in gvals.values()):
+            self._sparse_op_type()   # raises early for unsupported types
+
         if self._dy_step_fn is None:
             from .ops.registry import get_op
             fn = get_op(self._op_type).fn
@@ -181,21 +232,40 @@ class Optimizer:
             has_lr = self._has_lr_input
             clip = self._grad_clip
             base_reg = self.regularization
+            opt = self
 
             def step(pvals, gvals, svals, lr):
+                # rows-only grads (docs/SPARSE.md) skip regularization and
+                # clip — dense whole-tensor transforms — and scatter-apply
+                # through the sparse_* update kernels; the isinstance
+                # branches are static per jit signature, so a mixed
+                # dense/sparse parameter set compiles one fused step
                 for n in gvals:
+                    if isinstance(gvals[n], SparseRowsGrad):
+                        continue
                     reg = regs.get(n) or base_reg
                     if reg is not None:
                         gvals[n] = reg.apply(pvals[n], gvals[n])
                 if clip is not None:
-                    gvals = clip.apply_tree(gvals)
+                    dense = {n: g for n, g in gvals.items()
+                             if not isinstance(g, SparseRowsGrad)}
+                    gvals = {**gvals, **clip.apply_tree(dense)}
                 new_p, new_s = {}, {}
                 for n, p in pvals.items():
                     slots = svals[n]
-                    args = [p, gvals[n]] + [slots[s] for s in self._slot_names]
-                    if has_lr:
-                        args.append(lr)
-                    res = fn(*args, **hypers.get(n, self._hypers()))
+                    g = gvals[n]
+                    if isinstance(g, SparseRowsGrad):
+                        sfn = get_op(opt._sparse_op_type()).fn
+                        args = [p, g.rows, g.vals] \
+                            + [slots[s] for s in self._slot_names]
+                        if has_lr:
+                            args.append(lr)
+                        res = sfn(*args, **hypers.get(n, self._hypers()))
+                    else:
+                        args = [p, g] + [slots[s] for s in self._slot_names]
+                        if has_lr:
+                            args.append(lr)
+                        res = fn(*args, **hypers.get(n, self._hypers()))
                     res = res if isinstance(res, tuple) else (res,)
                     # pin param/slot dtypes: fp32 hypers meeting bf16 params
                     # would promote the update, and a donated step whose
@@ -676,6 +746,12 @@ class GradientMergeOptimizer(Optimizer):
         from .layers import control_flow as cf
         from .layers.common import apply_op_layer
         from .core import unique_name as un
+        if any(getattr(g, 'is_sparse_rows', False) for _, g in params_grads):
+            raise RuntimeError(
+                'GradientMergeOptimizer cannot accumulate rows-only sparse '
+                'embedding gradients (rows differ per step); set '
+                'PADDLE_TPU_SPARSE_GRAD=0 or use is_sparse=False under '
+                'gradient merge')
         k = self.k_steps
         counter = T.create_global_var([1], -1, 'int64', persistable=True,
                                       name=un.generate('grad_merge_counter'))

@@ -45,7 +45,9 @@ from .. import observability as _obs
 __all__ = ['SUPPORTED_COMM_DTYPES', 'resolve_comm_dtype', 'block_quantize',
            'block_dequantize', 'qallreduce_sum', 'qallreduce_mean',
            'qreduce_scatter_sum', 'wire_bytes', 'record_collective',
-           'quant_error_stats', 'DEFAULT_BLOCK_SIZE']
+           'quant_error_stats', 'DEFAULT_BLOCK_SIZE', 'rowwise_quantize',
+           'rowwise_dequantize', 'sparse_allgather', 'sparse_wire_bytes',
+           'record_sparse_collective']
 
 SUPPORTED_COMM_DTYPES = ('f32', 'bf16', 'int8')
 DEFAULT_BLOCK_SIZE = 256
@@ -127,6 +129,90 @@ def _decode(payload, scales, comm_dtype, block_size):
     if comm_dtype == 'int8':
         return block_dequantize(payload, scales, block_size=block_size)
     return payload.astype(jnp.float32)
+
+
+def rowwise_quantize(vals):
+    """Symmetric int8 with ONE f32 scale per embedding row — the sparse
+    push codec (docs/SPARSE.md). Unlike :func:`block_quantize`, scales
+    align with COO rows so a gathered (rows, vals, scales) triple stays
+    row-addressable; an all-zero row (COO padding) gets scale 0 and
+    decodes to exact zeros."""
+    v = jnp.asarray(vals, jnp.float32)
+    absmax = jnp.max(jnp.abs(v), axis=-1)
+    scale = absmax / 127.0
+    inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0), 0.0)
+    q = jnp.clip(jnp.round(v * inv[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def rowwise_dequantize(q, scales):
+    return q.astype(jnp.float32) * jnp.asarray(scales, jnp.float32)[..., None]
+
+
+def sparse_allgather(rows, vals, axis='dp', comm_dtype=None):
+    """The DP sparse gradient push: every device contributes its padded
+    COO (rows, vals); each gets the CONCATENATION of all peers' entries
+    back — O(n·K·D) bytes at the comm dtype instead of the O(V·D) dense
+    all-reduce it replaces. Call inside shard_map/pjit with ``axis``
+    bound; the caller coalesces (duplicate rows across peers sum there,
+    which IS the gradient reduction). int8 payloads cross the wire with
+    per-row f32 scales (exact-zero padding rows survive)."""
+    comm = resolve_comm_dtype(comm_dtype)
+    n = _axis_size(axis)
+    rows = jnp.asarray(rows).astype(jnp.int32)
+    vals = jnp.asarray(vals)
+    if n == 1:
+        return rows, vals.astype(jnp.float32)
+    rows_all = lax.all_gather(rows, axis).reshape(-1)
+    if comm == 'int8':
+        q, s = rowwise_quantize(vals)
+        qg = lax.all_gather(q, axis).reshape(-1, vals.shape[-1])
+        sg = lax.all_gather(s, axis).reshape(-1)
+        return rows_all, rowwise_dequantize(qg, sg)
+    if comm == 'bf16':
+        vg = lax.all_gather(vals.astype(jnp.bfloat16), axis)
+        return rows_all, vg.reshape(-1, vals.shape[-1]).astype(jnp.float32)
+    vg = lax.all_gather(vals.astype(jnp.float32), axis)
+    return rows_all, vg.reshape(-1, vals.shape[-1])
+
+
+def sparse_wire_bytes(num_rows, dim, comm_dtype, axis_size):
+    """Logical payload bytes one device's COO contribution puts on the
+    wire in a :func:`sparse_allgather`: int32 row ids + vals at the codec
+    width (+ per-row f32 scales for int8). Axis size 1 moves nothing."""
+    comm = resolve_comm_dtype(comm_dtype)
+    if axis_size <= 1:
+        return 0
+    r, d = int(num_rows), int(dim)
+    ids = r * 4
+    if comm == 'int8':
+        return ids + r * d + r * 4
+    if comm == 'bf16':
+        return ids + r * d * 2
+    return ids + r * d * 4
+
+
+def record_sparse_collective(path, num_rows, dim, comm_dtype, axis_size,
+                             dense_elems):
+    """Count one sparse push: bytes on wire at the COO+codec size, f32
+    equivalent = the dense all-reduce of the ``dense_elems``-element
+    table this push replaced — their ratio is the headline sparse win
+    (tools/bench_sparse.py measures it). No-op with telemetry off."""
+    if not _obs._ENABLED:
+        return
+    comm = resolve_comm_dtype(comm_dtype)
+    _obs.inc('collective_sync_calls', 1,
+             help='gradient/param sync collectives by path and comm dtype',
+             path=path, dtype=comm)
+    _obs.inc('collective_bytes_on_wire',
+             sparse_wire_bytes(num_rows, dim, comm, axis_size),
+             help='logical collective payload bytes at the wire dtype',
+             path=path, dtype=comm)
+    _obs.inc('collective_bytes_f32_equiv',
+             wire_bytes(dense_elems, 'f32', axis_size, phases=2),
+             help='f32-equivalent bytes for the same syncs (ratio = '
+                  'compression)',
+             path=path)
 
 
 # ---------------------------------------------------------------------------

@@ -259,9 +259,18 @@ class _GeneratorLoader:
         staged = {}
         for k, v in feed.items():
             if isinstance(v, LoDTensor):
-                # LoDTensors pass through intact (the Executor unpacks
-                # data + lengths)
-                staged[k] = v
+                # ragged id batches stage like dense feeds: the padded
+                # payload commits H2D here (producer thread) with the
+                # int64 bounds check host-side, and the Executor's
+                # zero-copy passthrough consumes the device array as-is;
+                # the lengths stay host-resident (they bind the @LEN var)
+                data = np.ascontiguousarray(v.data)
+                if data.dtype == np.int64:
+                    check_int32_bounds(data, k)
+                st = LoDTensor.__new__(LoDTensor)
+                st._data = jax.device_put(data)
+                st._recursive_seq_lens = v.recursive_sequence_lengths()
+                staged[k] = st
                 continue
             a = np.ascontiguousarray(v)
             if a.dtype == np.int64:

@@ -96,6 +96,20 @@ class InferenceEngine:
             v = block.var(name)
             tail = tuple(None if d == -1 else int(d) for d in v.shape[1:])
             self.input_spec[name] = (tail, np.dtype(v.dtype))
+        # {feed name: (vocab, table name)} for feeds that index an
+        # embedding table directly: an out-of-range id silently clips to
+        # row vocab-1 on device (lookup_table kernel) — validate() rejects
+        # it at the door unless PADDLE_TPU_EMBED_OOB=clip (docs/SPARSE.md)
+        self.id_bounds = {}
+        for op in block.ops:
+            if op.type not in ('lookup_table', 'fused_embedding_seq_pool'):
+                continue
+            ids = (op.inputs.get('ids') or [None])[0]
+            w = (op.inputs.get('w') or [None])[0]
+            if ids in self.input_spec and w and block.has_var(w):
+                shape = block.var(w).shape or ()
+                if shape and isinstance(shape[0], int) and shape[0] > 0:
+                    self.id_bounds[ids] = (int(shape[0]), w)
 
     # -- request validation (BEFORE enqueue — batcher.py calls this) -------
     def validate(self, inputs):
@@ -151,6 +165,18 @@ class InferenceEngine:
                     check_int32_bounds(arr, name)
                 except Exception as e:
                     raise InvalidRequest(str(e))
+            if name in self.id_bounds and arr.size:
+                from ..ops.sparse_ops import oob_policy
+                vocab, table = self.id_bounds[name]
+                if oob_policy() == 'error' \
+                        and (arr.min() < 0 or arr.max() >= vocab):
+                    raise InvalidRequest(
+                        f"input '{name}' holds ids outside [0, {vocab}) "
+                        f"for embedding table '{table}' (min {arr.min()}, "
+                        f"max {arr.max()}); on device they would silently "
+                        f"clip to row {vocab - 1}. Set "
+                        f"PADDLE_TPU_EMBED_OOB=clip for the legacy "
+                        f"clipping behavior.")
             if nrows is None:
                 nrows = arr.shape[0]
             elif arr.shape[0] != nrows:

@@ -1,0 +1,26 @@
+"""tools/bench_sparse.py smoke in tier-1: the rows-only grad+update step
+beats the dense scatter at a CI-sized table, the bytes-on-wire
+accounting holds the acceptance ratios (dense/int8 ≥ 100×, f32-rows/int8
+≥ 3.5×), and the executor-spine sparse path tracks dense losses."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(__file__), '..', '..', 'tools'))
+
+
+def test_bench_sparse_smoke():
+    from bench_sparse import (measure_bytes_on_wire,
+                              measure_executor_parity,
+                              measure_lookup_throughput,
+                              measure_step_time)
+    lk = measure_lookup_throughput(10_000, 32, 512, iters=5)
+    assert lk['lookups_per_sec'] > 0
+    st = measure_step_time(100_000, 32, 512, iters=5, accept_ratio=2.0)
+    assert st['ok'] and st['parity']
+    wire = measure_bytes_on_wire(1_000_000, 64, 4096)
+    assert wire['ok']
+    assert wire['dense_over_sparse_int8'] >= 100.0
+    assert wire['sparse_f32_over_int8'] >= 3.5
+    par = measure_executor_parity(2_000, 16, 8, steps=5, batch=16)
+    assert par['ok'] and par['loss_allclose']

@@ -385,6 +385,32 @@ def _decode_engine_prog():
     return main, [nxt.name], ['ids']
 
 
+def _deepfm_sparse():
+    """Static DeepFM over sparse id features: both embedding tables take
+    the rows-only gradient path (is_sparse=True → padded-COO marker
+    outputs + sparse_* update ops, docs/SPARSE.md) — the 7th recipe, so
+    the sweep covers the sparse op family end to end."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = L.data('feat_ids', [8], dtype='int64')
+        vals = L.data('feat_vals', [8], dtype='float32')
+        label = L.data('ctr', [1], dtype='float32')
+        w1 = L.embedding(ids, size=[500, 1], is_sparse=True)
+        emb = L.embedding(ids, size=[500, 8], is_sparse=True)
+        v3 = L.unsqueeze(vals, axes=[2])
+        first = L.reduce_sum(w1 * v3, dim=1)
+        e = emb * v3
+        sum_sq = L.square(L.reduce_sum(e, dim=1))
+        sq_sum = L.reduce_sum(L.square(e), dim=1)
+        second = 0.5 * L.reduce_sum(sum_sq - sq_sum, dim=1, keep_dim=True)
+        deep = L.fc(e, size=16, act='relu')
+        logit = L.fc(L.concat([first, second, deep], axis=1), size=1)
+        loss = L.reduce_mean(
+            L.sigmoid_cross_entropy_with_logits(logit, label))
+        fluid.optimizer.Adagrad(0.05).minimize(loss)
+    return main, [loss.name], ['feat_ids', 'feat_vals', 'ctr']
+
+
 _RECIPES = {
     'mnist_mlp': _mnist_mlp,
     'mlp_adam': lambda: _from_builder(build_mlp_adam),
@@ -392,6 +418,7 @@ _RECIPES = {
     'bert_layer': lambda: _from_builder(build_bert_layer),
     'fleet_dp': _fleet_dp,
     'decode_engine': _decode_engine_prog,
+    'deepfm_sparse': _deepfm_sparse,
 }
 
 

@@ -1,0 +1,97 @@
+"""fused_embedding_seq_pool ≡ embedding + sequence_pool (PR satellite):
+the fused op must match the unfused pair bit-for-bit across combiners,
+padding_idx placements (incl. negative-index normalization), and ragged
+LoD batches. Two real defects are pinned here: the fused 'mean' used to
+exclude padding_idx rows from its denominator, and `embedding` dropped
+the LoD length var so the downstream pool ignored raggedness."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers as L
+from paddle_tpu.core.lod import LoDTensor
+from paddle_tpu.core.random import default_generator
+import paddle_tpu.core.scope as sm
+from paddle_tpu.core.scope import Scope
+
+
+def _run_pair(combiner, padding_idx, feed_ids):
+    default_generator.seed(3)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = L.data('ids', [4], dtype='int64', lod_level=1)
+        fused = fluid.contrib.layers.fused_embedding_seq_pool(
+            ids, [20, 6], padding_idx=padding_idx, combiner=combiner)
+        emb = L.embedding(ids, size=[20, 6], padding_idx=padding_idx)
+        pool = L.sequence_pool(
+            emb, pool_type='sum' if combiner == 'sum' else 'average')
+    exe = fluid.Executor()
+    old = sm._global_scope
+    sm._global_scope = Scope()
+    try:
+        exe.run(startup)
+        # tie the two tables so only the op formulations differ
+        params = [v.name for v in main.all_parameters()]
+        sm._global_scope.set(
+            params[1], np.asarray(sm._global_scope.find(params[0])))
+        return exe.run(main, feed={'ids': feed_ids},
+                       fetch_list=[fused, pool])
+    finally:
+        sm._global_scope = old
+
+
+_IDS = np.array([[1, 2, 3, 19], [2, 2, 0, 5]], np.int64)
+
+
+@pytest.mark.parametrize('combiner', ['sum', 'mean'])
+@pytest.mark.parametrize('padding_idx', [None, 2, -1])
+def test_dense_batch_parity(combiner, padding_idx):
+    f, p = _run_pair(combiner, padding_idx, _IDS)
+    assert np.array_equal(f, p), (combiner, padding_idx, f, p)
+
+
+@pytest.mark.parametrize('combiner', ['sum', 'mean'])
+@pytest.mark.parametrize('padding_idx', [None, 2, -1])
+def test_ragged_lod_parity(combiner, padding_idx):
+    """Ragged rows: lengths [3, 4] — step 3 of row 0 must be masked by
+    BOTH paths (the embedding layer now carries the LoD length var)."""
+    f, p = _run_pair(combiner, padding_idx, LoDTensor(_IDS, [[3, 4]]))
+    assert np.array_equal(f, p), (combiner, padding_idx, f, p)
+
+
+def test_mean_denominator_counts_padding_rows():
+    """padding_idx rows contribute zero to the numerator but COUNT in
+    the mean denominator (sequence_pool 'average' semantics — the fused
+    op used to divide by the non-pad count only)."""
+    f, _ = _run_pair('mean', 2, np.array([[2, 2, 1, 1]], np.int64))
+    _, full = _run_pair('mean', None, np.array([[1, 1, 1, 1]], np.int64))
+    # two pad rows of four → mean is half the all-ones-row mean
+    assert np.allclose(f, full / 2, atol=1e-6)
+
+
+def test_negative_padding_idx_normalizes():
+    """padding_idx=-1 on a 20-row table masks id 19 in both layers."""
+    fa, pa = _run_pair('sum', -1, np.array([[19, 19, 1, 1]], np.int64))
+    fb, pb = _run_pair('sum', 19, np.array([[19, 19, 1, 1]], np.int64))
+    assert np.array_equal(fa, fb) and np.array_equal(pa, pb)
+    assert np.array_equal(fa, pa)
+
+
+def test_fused_grad_flows_rows():
+    """The fused op trains: its table gradient exists and only touched
+    rows are non-zero."""
+    import paddle_tpu.dygraph as dygraph
+    from paddle_tpu.dygraph.tape import dispatch_op, Tensor
+    with dygraph.guard():
+        default_generator.seed(1)
+        w = Tensor(np.random.RandomState(0).randn(20, 6).astype(np.float32),
+                   stop_gradient=False)
+        ids = Tensor(np.array([[1, 2, 3, 3]], np.int64),
+                     stop_gradient=True)
+        out = dispatch_op('fused_embedding_seq_pool',
+                          {'ids': ids, 'w': w, 'length': None},
+                          {'combiner': 'sum', 'padding_idx': -1})
+        dispatch_op('reduce_sum', {'x': out}, {}).backward()
+        g = np.asarray(w.grad)
+        assert np.count_nonzero(g.sum(axis=1)) == 3
+        assert np.allclose(g[3], 2.0)

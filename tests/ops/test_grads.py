@@ -569,6 +569,14 @@ NONDIFF = {
                            'test_fleet_runtime.py)',
     'fused_adam': 'multi-tensor optimizer update (bitwise parity vs per-'
                   'param adam in test_ir_passes.py)',
+    'sparse_sgd': 'rows-only optimizer update (parity vs dense sgd in '
+                  'tests/ops/test_sparse_ops.py)',
+    'sparse_momentum': 'rows-only optimizer update (parity vs dense '
+                       'momentum in tests/ops/test_sparse_ops.py)',
+    'sparse_adagrad': 'rows-only optimizer update (parity vs dense '
+                      'adagrad in tests/ops/test_sparse_ops.py)',
+    'sparse_adam': 'rows-only lazy optimizer update (parity vs dense '
+                   'adam in tests/ops/test_sparse_ops.py)',
     'check_finite_and_unscale': 'AMP bookkeeping (tested in test_amp.py)',
     'update_loss_scaling': 'AMP bookkeeping (tested in test_amp.py)',
     # control-flow / array plumbing
