@@ -459,8 +459,11 @@ def bench_decode_engine(on_tpu):
     """Stateful decode engine bench (PERF.md §13): uncached whole-sequence
     greedy vs the paged-KV continuous-batching engine vs drain-then-refill
     wave batching, on a heavy-tailed mixed-length workload — tokens/s,
-    slot occupancy, prefill/decode split, bitwise token parity. Valid on
-    CPU: the quantity under test is scheduling + shape discipline."""
+    slot occupancy, prefill/decode split, bitwise token parity — plus the
+    sampled-replay section (pinned request_ids run twice, bitwise) and
+    speculative decoding vs lockstep (n-gram drafts, batched (S, k)
+    verify). Valid on CPU: the quantity under test is scheduling + shape
+    discipline."""
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), 'tools'))
     from bench_decode import measure_all
@@ -687,11 +690,17 @@ def main():
     if de is not None:
         emit({"metric": "decode_engine",
               "uncached": de['uncached'], "continuous": de['continuous'],
-              "drain": de['drain']})
+              "drain": de['drain'], "sampled": de['sampled'],
+              "speculative": de['speculative']})
         summary.update(
             decode_continuous_vs_drain=de['continuous']['speedup_vs_drain'],
             decode_tokens_per_s=de['continuous']['tokens_per_s'],
             decode_bitwise=de['continuous']['bitwise_equal'])
+        summary.update(
+            spec_decode_vs_lockstep=de['speculative']['speedup_vs_lockstep'],
+            spec_decode_acceptance=de['speculative']['acceptance'],
+            spec_decode_bitwise=de['speculative']['bitwise_equal'],
+            decode_sampled_replayable=de['sampled']['replayable'])
 
     st = run("serving_tier", lambda: bench_serving_tier(on_tpu))
     if st is not None:

@@ -1351,6 +1351,32 @@ def _allreduce_bucket(ctx):
 
 
 # ---------------------------------------------------------------------------
+# rules: paged KV-cache attention (serving/decode)
+# ---------------------------------------------------------------------------
+
+@infer_rule('paged_attention')
+def _paged_attention(ctx):
+    # decode read: q (S, H, D) -> (S, H, D); multi-query speculative
+    # verify: q (S, H, K, D) -> (S, H, K, D). Out always mirrors q.
+    q = ctx.require('q')
+    if q.shape is not None and len(q.shape) not in (3, 4):
+        raise InferError(
+            f'paged_attention expects q of rank 3 (decode) or 4 '
+            f'(multi-query verify), got rank {len(q.shape)}')
+    return {'Out': VarInfo(q.shape, q.dtype)}
+
+
+@infer_rule('paged_prefill_attention')
+def _paged_prefill_attention(ctx):
+    q = ctx.require('q')
+    if q.shape is not None and len(q.shape) != 4:
+        raise InferError(
+            f'paged_prefill_attention expects q of rank 4 (1, H, L, D), '
+            f'got rank {len(q.shape)}')
+    return {'Out': VarInfo(q.shape, q.dtype)}
+
+
+# ---------------------------------------------------------------------------
 # rules: framework-internal ops
 # ---------------------------------------------------------------------------
 

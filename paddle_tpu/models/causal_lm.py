@@ -160,3 +160,42 @@ def greedy_generate(model, prompt_ids, max_new_tokens, eos_id=None,
             if eos_id is not None and nxt == int(eos_id):
                 break
     return out
+
+
+def sampled_generate(model, prompt_ids, max_new_tokens, sampler, eos_id=None,
+                     pad_len=None):
+    """Uncached whole-sequence SAMPLED decode — :func:`greedy_generate`'s
+    loop with the argmax replaced by ``sampler(row, index)``, where ``row``
+    is the float logits row of the last real position and ``index`` the
+    0-based generated-token index. Pair it with a
+    ``serving.decode.TokenSampler`` bound to the same request_id/params and
+    ``pad_len == engine.padded_context`` to get the bitwise replay
+    reference for the engine's sampled path (the per-token fold_in key
+    depends only on (seed, index), so cached and uncached loops draw the
+    same stream).
+
+    Returns the generated token ids (list, ≤ max_new_tokens; stops at
+    ``eos_id``).
+    """
+    prompt = [int(t) for t in prompt_ids]
+    P = len(prompt)
+    if P < 1:
+        raise ValueError('empty prompt')
+    L = int(pad_len) if pad_len else P + int(max_new_tokens)
+    if L < P + int(max_new_tokens):
+        raise ValueError(
+            f'pad_len={L} cannot hold prompt({P}) + {max_new_tokens} new '
+            f'tokens')
+    buf = np.zeros((1, L), np.int64)
+    buf[0, :P] = prompt
+    out = []
+    with no_grad_guard():
+        for i in range(int(max_new_tokens)):
+            c = P + i
+            logits = model(Tensor(buf, stop_gradient=True))
+            nxt = int(sampler(np.asarray(logits.numpy())[0, c - 1], i))
+            out.append(nxt)
+            buf[0, c] = nxt
+            if eos_id is not None and nxt == int(eos_id):
+                break
+    return out

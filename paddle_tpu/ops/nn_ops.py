@@ -733,14 +733,19 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
     of the serving decode engine — docs/SERVING.md "Stateful decode";
     kernel blueprint: Ragged Paged Attention, PAPERS.md arxiv 2604.15464).
 
-    - ``q``: (S, H, D) — one query token per decode slot.
+    - ``q``: (S, H, D) — one query token per decode slot — or (S, H, K, D)
+      for the MULTI-QUERY decode read speculative decoding verifies with
+      (K fed tokens per slot in one step; see below).
     - ``k_pages`` / ``v_pages``: (H, num_blocks, block_size, D) — the cache
       pool. Block 0 is the scratch block (inactive slots point at it).
     - ``block_tables``: (S, max_blocks_per_seq) int32 — each slot's cache
       blocks in sequence order; tail entries beyond the context are
       arbitrary valid block ids (masked by ``context_lens``).
     - ``context_lens``: (S,) int32 — tokens to attend per slot, INCLUDING
-      the token written at position context_len-1 this step.
+      the token written at position context_len-1 this step. In the
+      multi-query form this is the extent of fed-token ROW 0; row j
+      attends ``context_lens + j`` keys (a causal staircase over the K
+      fed positions — row j sees the prior context plus fed tokens 0..j).
 
     On TPU this dispatches the pallas paged-attention kernel
     (jax.experimental.pallas.ops.tpu.paged_attention — ragged block walk,
@@ -760,7 +765,10 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
     v_pages = jnp.asarray(v_pages)
     block_tables = jnp.asarray(block_tables, jnp.int32)
     context_lens = jnp.asarray(context_lens, jnp.int32)
-    if _jax.default_backend() == 'tpu':
+    if _jax.default_backend() == 'tpu' and q.ndim == 3:
+        # the stock pallas kernel is single-query; the multi-query (S,H,K,D)
+        # verify read uses the XLA formulation on every backend until a
+        # ragged kernel lands (Ragged Paged Attention is the blueprint)
         try:
             from jax.experimental.pallas.ops.tpu.paged_attention import (
                 paged_attention as _tpu_paged_attention)
@@ -771,6 +779,25 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
                 pages_per_compute_block=max(ppcb, 1))
         except Exception as e:   # kernel shape rejection → XLA fallback
             _pallas_fallback('paged_attention', e, q.shape)
+    if q.ndim == 4:
+        # multi-query decode (speculative verify): K fed tokens per slot.
+        # Same matmul → mask → softmax → matmul sequence as the
+        # single-query path, so each row j is bitwise-identical to the
+        # (S, 1) step that would have read the same K/V at extent
+        # context_lens + j (the tests prove it across ragged extents).
+        s, h, kq, d = q.shape
+        k = _gather_pages(k_pages, block_tables, s, h, d)
+        v = _gather_pages(v_pages, block_tables, s, h, d)
+        t_pad = k.shape[2]
+        scores = jnp.matmul(q, jnp.swapaxes(k, -1, -2))    # (S, H, K, T)
+        if sm_scale != 1.0:
+            scores = scores * jnp.asarray(sm_scale, scores.dtype)
+        valid = jnp.arange(t_pad, dtype=jnp.int32)[None, None, None, :] \
+            < (context_lens[:, None, None, None]
+               + jnp.arange(kq, dtype=jnp.int32)[None, None, :, None])
+        scores = jnp.where(valid, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.matmul(probs, v)                        # (S, H, K, D)
     s, h, d = q.shape
     k = _gather_pages(k_pages, block_tables, s, h, d)
     v = _gather_pages(v_pages, block_tables, s, h, d)

@@ -46,7 +46,7 @@ from .batcher import (DEFAULT_BATCH_TIMEOUT_MS, DEFAULT_QUEUE_DEPTH,
 from .breaker import CircuitBreaker
 from .server import ServingServer, create_server
 from .decode import (DecodeEngine, DecodeScheduler, GenerationStream,
-                     KVCachePool)
+                     KVCachePool, NGramDrafter, SamplingParams)
 from .tier import (KVPayload, LocalPrefillWorker, PrefillReplica,
                    PrefixCache, Router, RouterServer)
 
@@ -54,7 +54,7 @@ __all__ = ['InferenceEngine', 'MicroBatcher', 'PredictionFuture',
            'ServingServer', 'create_server', 'bucket_ladder',
            'CircuitBreaker',
            'DecodeEngine', 'DecodeScheduler', 'GenerationStream',
-           'KVCachePool',
+           'KVCachePool', 'SamplingParams', 'NGramDrafter',
            'Router', 'RouterServer', 'PrefixCache', 'KVPayload',
            'LocalPrefillWorker', 'PrefillReplica',
            'ServingError', 'InvalidRequest', 'Overloaded', 'DeadlineExceeded',

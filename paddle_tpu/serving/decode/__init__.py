@@ -33,8 +33,12 @@ from __future__ import annotations
 from .kv_cache import (BlockAllocator, BlockTable, CacheContext, KVCachePool,
                        DEFAULT_BLOCK_SIZE, DEFAULT_MAX_BLOCKS, DEFAULT_SLOTS)
 from .engine import DecodeEngine
+from .sampling import SamplingParams, TokenSampler, derive_stream_seed
+from .drafter import NGramDrafter, DraftModelDrafter, build_drafter
 from .scheduler import DecodeScheduler, GenerationStream
 
 __all__ = ['BlockAllocator', 'BlockTable', 'CacheContext', 'KVCachePool',
            'DecodeEngine', 'DecodeScheduler', 'GenerationStream',
+           'SamplingParams', 'TokenSampler', 'derive_stream_seed',
+           'NGramDrafter', 'DraftModelDrafter', 'build_drafter',
            'DEFAULT_SLOTS', 'DEFAULT_BLOCK_SIZE', 'DEFAULT_MAX_BLOCKS']

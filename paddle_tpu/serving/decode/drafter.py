@@ -1,0 +1,108 @@
+"""Draft-token proposers for speculative decoding.
+
+A drafter guesses the next n tokens of a greedy stream CHEAPLY; the target
+model verifies all of them in ONE batched (S, k) step (engine.spec_step)
+and keeps the longest matching prefix. Wrong guesses cost nothing but the
+lane they rode in — correctness never depends on the drafter, so the
+interface is deliberately tiny::
+
+    drafter.propose(history, n) -> list of <= n draft token ids
+
+``history`` is the request's prompt + every token emitted so far — its
+LAST element is the pending (emitted-but-uncached) token the drafts must
+continue from.
+
+Two implementations (``PADDLE_TPU_SPEC_DRAFTER`` picks one; docs/SERVING.md
+"Sampling & speculative decode"):
+
+- :class:`NGramDrafter` — zero extra weights: find the most recent earlier
+  occurrence of the history's longest-matching suffix n-gram and propose
+  the tokens that followed it (prompt-copy / repetition capture). This is
+  the default, and on repetitive or prompt-grounded traffic it is hard to
+  beat per dollar.
+- :class:`DraftModelDrafter` — a small TransformerLM greedy-decoded at ONE
+  fixed padded shape (models/causal_lm.greedy_generate's single-compile
+  discipline, sharing the engine's ``padded_context``), for workloads with
+  no surface repetition.
+"""
+from __future__ import annotations
+
+from ..errors import InvalidRequest
+
+__all__ = ['NGramDrafter', 'DraftModelDrafter', 'build_drafter',
+           'DRAFTER_CHOICES']
+
+DRAFTER_CHOICES = ('ngram', 'draft_model', 'off')
+
+
+class NGramDrafter:
+    """Suffix-match drafter: longest n-gram first (``max_ngram`` down to
+    ``min_ngram``), most recent earlier occurrence wins. O(L·g) per probe
+    over the request's own short history — microseconds next to a model
+    step."""
+
+    def __init__(self, max_ngram=3, min_ngram=1):
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, history, n):
+        history = list(history)
+        n = int(n)
+        if n <= 0 or len(history) < self.min_ngram + 1:
+            return []
+        top = min(self.max_ngram, len(history) - 1)
+        for g in range(top, self.min_ngram - 1, -1):
+            suffix = history[-g:]
+            # scan right-to-left: the MOST RECENT earlier occurrence is the
+            # best predictor of what follows now
+            for i in range(len(history) - g - 1, -1, -1):
+                if history[i:i + g] == suffix:
+                    cont = history[i + g:i + g + n]
+                    if cont:
+                        return cont
+        return []
+
+
+class DraftModelDrafter:
+    """Greedy continuation from a small draft LM at one fixed padded shape.
+
+    ``pad_len`` should be the target engine's ``padded_context`` so the
+    draft model compiles exactly once and its positions line up with the
+    stream it drafts for. Proposals are clamped so prompt + drafts never
+    exceed the pad (the verify step re-checks budgets anyway)."""
+
+    def __init__(self, model, pad_len):
+        if hasattr(model, 'eval'):
+            model.eval()
+        self.model = model
+        self.pad_len = int(pad_len)
+
+    def propose(self, history, n):
+        from ...models.causal_lm import greedy_generate
+        history = [int(t) for t in history]
+        n = min(int(n), self.pad_len - len(history))
+        if n <= 0 or not history:
+            return []
+        return greedy_generate(self.model, history, n,
+                               pad_len=self.pad_len)
+
+
+def build_drafter(choice, pad_len, draft_model=None):
+    """Resolve a drafter name (the ``PADDLE_TPU_SPEC_DRAFTER`` knob /
+    scheduler arg) into an instance. 'off' → None (speculative rounds run
+    with zero drafts — the k-window still batches suffix prefill)."""
+    if choice is None or isinstance(choice, str):
+        name = (choice or 'ngram').strip()
+        if name not in DRAFTER_CHOICES:
+            raise InvalidRequest(
+                f'drafter {name!r} is not supported; supported values: '
+                f'{", ".join(DRAFTER_CHOICES)}')
+        if name == 'off':
+            return None
+        if name == 'ngram':
+            return NGramDrafter()
+        if draft_model is None:
+            from ...models.causal_lm import CausalLMConfig, TransformerLM
+            draft_model = TransformerLM(CausalLMConfig.tiny())
+        return DraftModelDrafter(draft_model, pad_len)
+    return choice                     # duck-typed: anything with .propose

@@ -53,12 +53,18 @@ class DecodeEngine:
     - ``max_new_tokens_cap``: per-request generation budget cap (block
       reservations are taken against prompt + budget at admission, so the
       cap bounds what one request can strand).
+    - ``spec_decode`` / ``spec_k``: speculative decoding (the batched
+      (S, k) verify step — :meth:`spec_step`). Arg wins, else the
+      ``PADDLE_TPU_SPEC_DECODE`` knob, default OFF; an explicit env ``0``
+      is the hard escape hatch and wins even over ``spec_decode=True``
+      (an operator must be able to disable speculation on a deployed
+      binary without a code change).
     """
 
     def __init__(self, model, slots=None, block_size=None, max_blocks=None,
                  max_prompt_len=64, max_new_tokens_cap=64,
                  prompt_buckets=None, eos_id=None, prefix_cache=None,
-                 model_lock=None):
+                 model_lock=None, spec_decode=None, spec_k=None):
         self.model = model
         if hasattr(model, 'eval'):
             model.eval()           # generation is inference: no dropout
@@ -90,6 +96,24 @@ class DecodeEngine:
         _m.decode_cache_blocks_total.set(self.pool.allocator.capacity)
         self._prefill_compiled = set()
         self._step_compiled = False
+        self._spec_compiled = False
+        # speculative decoding: env '0' is the hard escape hatch (wins over
+        # the arg); otherwise arg wins, else env, default off
+        from ..tier.knobs import (ENV_SPEC_DECODE, ENV_SPEC_K,
+                                  parse_flag_env, parse_int_env)
+        import os as _os
+        env_raw = _os.environ.get(ENV_SPEC_DECODE, '').strip()
+        if env_raw == '0':
+            self.spec_enabled = False
+        elif spec_decode is not None:
+            self.spec_enabled = bool(spec_decode)
+        else:
+            self.spec_enabled = parse_flag_env(ENV_SPEC_DECODE,
+                                               default=False)
+        self.spec_k = int(spec_k if spec_k is not None
+                          else parse_int_env(ENV_SPEC_K, 4, minimum=2))
+        if self.spec_k < 2:
+            raise ValueError(f'spec_k must be >= 2, got {self.spec_k}')
         # radix prefix cache (serving/tier/prefix_cache.py): arg wins, else
         # the strict-parsed PADDLE_TPU_PREFIX_CACHE env knob (default off)
         from ..tier.knobs import ENV_PREFIX_CACHE, parse_flag_env
@@ -161,9 +185,10 @@ class DecodeEngine:
         _m.decode_cache_blocks_used.set(self.pool.allocator.used)
 
     # -- phases ------------------------------------------------------------
-    def prefill(self, prompt, table):
+    def prefill(self, prompt, table, sampler=None):
         """Run the bucket-padded prompt once, writing K/V into ``table``'s
-        blocks, and return the FIRST generated token (greedy). Sets
+        blocks, and return the FIRST generated token — greedy, or drawn by
+        ``sampler(logits_row)`` for sampled requests. Sets
         ``table.context_len = len(prompt)``."""
         from ...dygraph.tape import Tensor, no_grad_guard
         P = len(prompt)
@@ -184,9 +209,11 @@ class DecodeEngine:
             self._prefill_compiled.add(bucket)
             _m.decode_prefill_compiles.inc()
         _m.decode_cache_blocks_used.set(self.pool.allocator.used)
+        if sampler is not None:
+            return int(sampler(row))
         return int(row.argmax())
 
-    def decode_step(self, tokens, tables):
+    def decode_step(self, tokens, tables, return_rows=False):
         """One lockstep step over all S slots at fixed shape.
 
         ``tokens``: length-S list, the token to feed per slot (None =
@@ -195,7 +222,11 @@ class DecodeEngine:
         one at position c (it was sampled from the previous step/prefill
         but not yet cached); its K/V are written and attended this step.
         Returns (S,) next-token ids (greedy; garbage on inactive slots) and
-        advances each active table's context_len by 1."""
+        advances each active table's context_len by 1. With
+        ``return_rows=True`` the raw (S, V) logits rows come back too
+        (``(ids, rows)``) so the scheduler can sample non-greedy slots —
+        the greedy ids are the argmax of those same rows, so requesting
+        rows changes no bits."""
         from ...dygraph.tape import Tensor, no_grad_guard
         S = self.slots
         assert len(tokens) == S and len(tables) == S
@@ -218,7 +249,8 @@ class DecodeEngine:
                 logits = self.model(Tensor(ids, stop_gradient=True),
                                     pos_ids=Tensor(pos, stop_gradient=True),
                                     cache=ctx)
-                out = np.asarray(logits.numpy())[:, 0].argmax(-1)
+                rows = np.asarray(logits.numpy())[:, 0]
+                out = rows.argmax(-1)
         dt = time.perf_counter() - t0
         self._step_compiled = True
         _m.decode_step_seconds.observe(dt)
@@ -226,7 +258,68 @@ class DecodeEngine:
         active = sum(t is not None for t in tables)
         _m.decode_slots_active.set(active)
         _m.decode_slot_occupancy.observe(active / max(S, 1))
+        if return_rows:
+            return out, rows
         return out
+
+    def spec_step(self, token_lists, tables):
+        """One batched (S, k) speculative/multi-token step.
+
+        ``token_lists``: length-S list; None or [] for an inactive slot,
+        else UP TO ``spec_k`` tokens to feed — the slot's pending token
+        first, then its draft guesses (or further prompt tokens during a
+        chunked suffix fill). All fed tokens' K/V are written at positions
+        context_len .. context_len+f-1 and each table's ``context_len``
+        advances by f; the CALLER rolls rejected tails back by assigning
+        ``table.context_len = base + accepted`` (block ids don't move —
+        rollback is one integer store, and the overwritten tail positions
+        are masked until rewritten, per the kv_cache scratch contract).
+
+        Returns (S, k, V) logits rows: row j of a slot is the target
+        model's distribution AFTER fed tokens 0..j — bitwise-identical to
+        the (S, 1) lockstep row at the same context (the multi-query
+        `paged_attention` staircase; tests/framework/test_spec_decode.py
+        asserts it across ragged accept lengths). Padded lanes (j >= f)
+        are garbage on scratch reads and must be ignored."""
+        from ...dygraph.tape import Tensor, no_grad_guard
+        S, K = self.slots, self.spec_k
+        assert len(token_lists) == S and len(tables) == S
+        ids = np.zeros((S, K), np.int64)
+        pos = np.zeros((S, K), np.int64)
+        ctx_lens, fed_counts = [], []
+        for s in range(S):
+            toks = token_lists[s]
+            if tables[s] is None or not toks:
+                ctx_lens.append(1)      # scratch read, masked + ignored
+                fed_counts.append(0)
+                continue
+            f = min(len(toks), K)
+            c = tables[s].context_len
+            ids[s, :f] = toks[:f]
+            pos[s, :f] = np.arange(c, c + f)
+            pos[s, f:] = c + max(f - 1, 0)   # padded lanes: in-range dummy
+            tables[s].context_len = c + f
+            ctx_lens.append(c + 1)
+            fed_counts.append(f)
+        ctx = CacheContext(self.pool, 'decode', tables, ctx_lens,
+                           fed_counts=fed_counts, window=K)
+        t0 = time.perf_counter()
+        with self._model_lock or _NULL_LOCK:
+            with no_grad_guard():
+                logits = self.model(Tensor(ids, stop_gradient=True),
+                                    pos_ids=Tensor(pos, stop_gradient=True),
+                                    cache=ctx)
+                rows = np.asarray(logits.numpy())
+        dt = time.perf_counter() - t0
+        self._spec_compiled = True
+        _m.decode_step_seconds.observe(dt)      # it IS the decode step
+        _m.decode_spec_verify_seconds.observe(dt)
+        _m.decode_steps.inc()
+        _m.decode_spec_rounds.inc()
+        active = sum(t is not None for t in tables)
+        _m.decode_slots_active.set(active)
+        _m.decode_slot_occupancy.observe(active / max(S, 1))
+        return rows
 
     def inject_prefill(self, table, payload):
         """Receive a disaggregated prefill (serving/tier/disagg.py): write
@@ -263,16 +356,21 @@ class DecodeEngine:
         traffic). Surfaced through ``/healthz`` so the serving-tier router
         never sends traffic into a cold replica's compile cliff."""
         return (self._step_compiled
+                and (self._spec_compiled or not self.spec_enabled)
                 and all(b in self._prefill_compiled
                         for b in self.prompt_buckets))
 
     def warmup(self):
-        """Precompile the prefill ladder + the decode-step shape before
-        traffic arrives (same contract as InferenceEngine.warmup). Returns
+        """Precompile the prefill ladder + the decode-step shape (+ the
+        (S, k) speculative verify shape when enabled) before traffic
+        arrives (same contract as InferenceEngine.warmup). Returns
         {phase: seconds}. Uses temporary blocks; the pool ends unchanged."""
         timings = {}
         for bucket in self.prompt_buckets:
-            table = self.reserve_table(bucket, 1)
+            # reserve spec_k headroom so the warmup spec_step below can
+            # write its window without outgrowing the throwaway table
+            table = self.reserve_table(bucket, self.spec_k
+                                       if self.spec_enabled else 1)
             t0 = time.perf_counter()
             tok = self.prefill([1] * bucket, table)
             timings[f'prefill_{bucket}'] = time.perf_counter() - t0
@@ -283,5 +381,13 @@ class DecodeEngine:
             self.decode_step(tokens, tables)
             timings.setdefault('decode_step',
                                time.perf_counter() - t0)
+            if self.spec_enabled and not self._spec_compiled:
+                base = table.context_len
+                feed = [[tok] * (self.spec_k - 1)] \
+                    + [None] * (self.slots - 1)
+                t0 = time.perf_counter()
+                self.spec_step(feed, tables)
+                timings['spec_step'] = time.perf_counter() - t0
+                table.context_len = base      # roll the warmup feed back
             self.release_table(table)
         return timings

@@ -303,27 +303,44 @@ class CacheContext:
     mode='decode': q/k/v are (S, H, 1, D), one token per slot — K/V land at
     each slot's next position, attention reads through the batched block
     tables (`paged_attention`) at fixed shape.
+
+    mode='decode' with ``window`` K > 1 (speculative verify — the (S, K)
+    step): q/k/v are (S, H, K, D); each slot feeds ``fed_counts[s]`` ≤ K
+    real tokens at positions context_len-1 .. context_len-1+f-1 and the
+    remaining K-f padded lanes write to the scratch block (harmless by the
+    masking contract above). ``context_lens[s]`` is still the extent of
+    fed ROW 0; `paged_attention`'s multi-query form gives row j the causal
+    staircase extent context_lens + j.
     """
 
-    def __init__(self, pool, mode, tables, context_lens=None):
+    def __init__(self, pool, mode, tables, context_lens=None,
+                 fed_counts=None, window=1):
         self.pool = pool
         self.mode = mode
         self.tables = tables          # prefill: [BlockTable]; decode: list
         self.context_lens = context_lens
+        self.window = int(window)
         self._layer = 0
         if mode == 'decode':
+            if fed_counts is None:
+                fed_counts = [1 if t is not None else 0 for t in tables]
             ids, offs, padded = [], [], []
-            for t, c in zip(tables, context_lens):
+            for t, c, f in zip(tables, context_lens, fed_counts):
                 if t is None:                       # inactive slot
-                    ids.append(SCRATCH_BLOCK)
-                    offs.append(0)
+                    ids.extend([SCRATCH_BLOCK] * self.window)
+                    offs.extend([0] * self.window)
                     padded.append([SCRATCH_BLOCK]
                                   * pool.max_blocks_per_seq)
-                else:
-                    b, o = t.slot_for(int(c) - 1)   # token written this step
+                    continue
+                base = int(c) - 1          # first token written this step
+                for j in range(self.window):
+                    if j < int(f):
+                        b, o = t.slot_for(base + j)
+                    else:                  # padded lane: scratch write
+                        b, o = SCRATCH_BLOCK, 0
                     ids.append(b)
                     offs.append(o)
-                    padded.append(t.padded(pool.max_blocks_per_seq))
+                padded.append(t.padded(pool.max_blocks_per_seq))
             self._write_ids = np.asarray(ids, np.int32)
             self._write_offs = np.asarray(offs, np.int32)
             self._batched_tables = np.asarray(padded, np.int32)
@@ -347,6 +364,23 @@ class CacheContext:
                 'paged_prefill_attention',
                 {'q': q, 'k': k, 'v': v, 'k_pages': k_pages,
                  'v_pages': v_pages, 'block_tables': bt},
+                {'sm_scale': float(sm_scale)})
+        if self.window > 1:
+            # multi-token decode (speculative verify): (S, H, K, D) ->
+            # (H, S·K, D) rows, slot-major, matching the flattened write
+            # coordinates built above; q stays rank-4 for the multi-query
+            # paged_attention read
+            s, h, k_w, d = kv.shape
+            self.pool.write_tokens(
+                layer, self._write_ids, self._write_offs,
+                kv.transpose(1, 0, 2, 3).reshape(h, s * k_w, d),
+                vv.transpose(1, 0, 2, 3).reshape(h, s * k_w, d))
+            k_pages, v_pages = self.pool.pages(layer)
+            return dispatch_op(
+                'paged_attention',
+                {'q': q, 'k_pages': k_pages, 'v_pages': v_pages,
+                 'block_tables': self._batched_tables,
+                 'context_lens': self._ctx},
                 {'sm_scale': float(sm_scale)})
         # decode: (S, H, 1, D) -> (H, S, D) token rows
         self.pool.write_tokens(layer, self._write_ids, self._write_offs,

@@ -1,0 +1,180 @@
+"""Per-request sampling params + deterministic, replayable token sampling.
+
+Two contracts (docs/SERVING.md "Sampling & speculative decode"):
+
+**Typed validation.** :meth:`SamplingParams.validate` is the single parser
+every entry point (scheduler ``submit``, HTTP handler, router) funnels
+through — a bad value raises :class:`InvalidRequest` NAMING the field, so
+the HTTP layer maps it to a 400 that tells the client what to fix instead
+of silently dropping the key.
+
+**Bitwise replay.** A sampled stream is a pure function of
+``(request_id-or-seed, params, prompt, model weights)``:
+
+- the stream seed is ``params.seed`` when pinned, else derived from the
+  restart-safe ``request_id`` (sha256 → 63 bits — request ids are
+  free-form client strings, not guaranteed hex);
+- the seed feeds the same :class:`~paddle_tpu.core.random.KeyGenerator`
+  machinery the rest of the framework uses (base ``jax.random.PRNGKey``),
+  and token ``i`` of the stream draws from ``fold_in(base, i)`` — the
+  draw depends on the token INDEX, never on wall clock, slot id, batch
+  composition, or how many requests ran before;
+- filtering (temperature → top-k → top-p) and the inverse-CDF draw run in
+  float64 numpy with a stable sort, so the picked token is exactly
+  reproducible across processes (the replay drill in
+  tests/framework/test_spec_decode.py restarts a subprocess to prove it).
+
+``temperature == 0`` (the default) is GREEDY: a plain argmax with no key
+material touched — the pre-existing bitwise decode contract is unchanged.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from ..errors import InvalidRequest
+
+__all__ = ['SamplingParams', 'TokenSampler', 'derive_stream_seed']
+
+_FIELDS = ('temperature', 'top_k', 'top_p', 'seed')
+
+
+class SamplingParams:
+    """Validated per-request sampling knobs.
+
+    - ``temperature``: 0 = greedy (exact argmax, bitwise-identical to the
+      pre-sampling engine); > 0 scales logits before the draw.
+    - ``top_k``: 0 = off; k > 0 keeps only the k highest-logit tokens.
+    - ``top_p``: 1.0 = off; p ∈ (0, 1] keeps the smallest prefix of the
+      descending-probability ordering whose mass reaches p (always ≥ 1
+      token).
+    - ``seed``: optional explicit stream seed; when None the stream seeds
+      from the request_id (see :func:`derive_stream_seed`).
+    """
+
+    __slots__ = _FIELDS
+
+    def __init__(self, temperature=0.0, top_k=0, top_p=1.0, seed=None):
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = seed if seed is None else int(seed)
+
+    @property
+    def greedy(self):
+        return self.temperature == 0.0
+
+    @classmethod
+    def validate(cls, obj):
+        """Parse ``obj`` (None | dict | SamplingParams) into a validated
+        instance, or raise :class:`InvalidRequest` naming the offending
+        field. Unknown dict keys raise too — a typo'd knob must not be
+        silently ignored."""
+        if obj is None:
+            return cls()
+        if isinstance(obj, cls):
+            d = {f: getattr(obj, f) for f in _FIELDS}
+        elif isinstance(obj, dict):
+            unknown = sorted(set(obj) - set(_FIELDS))
+            if unknown:
+                raise InvalidRequest(
+                    f'unknown sampling field(s): {", ".join(unknown)}; '
+                    f'supported: {", ".join(_FIELDS)}')
+            d = dict(obj)
+        else:
+            raise InvalidRequest(
+                f'sampling must be a dict or SamplingParams, got '
+                f'{type(obj).__name__}')
+
+        def _num(name, default, kind=float):
+            val = d.get(name, default)
+            if val is None:
+                val = default
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                raise InvalidRequest(f'{name} must be a number, got '
+                                     f'{type(val).__name__}')
+            if kind is int and float(val) != int(val):
+                raise InvalidRequest(f'{name} must be an integer, got '
+                                     f'{val!r}')
+            return kind(val)
+
+        temperature = _num('temperature', 0.0)
+        if not np.isfinite(temperature) or temperature < 0:
+            raise InvalidRequest(
+                f'temperature must be >= 0 and finite, got {temperature}')
+        top_k = _num('top_k', 0, int)
+        if top_k < 0:
+            raise InvalidRequest(f'top_k must be >= 0, got {top_k}')
+        top_p = _num('top_p', 1.0)
+        if not 0.0 < top_p <= 1.0:
+            raise InvalidRequest(f'top_p must be in (0, 1], got {top_p}')
+        seed = d.get('seed')
+        if seed is not None:
+            if isinstance(seed, bool) or not isinstance(seed, int):
+                raise InvalidRequest(
+                    f'seed must be an integer, got {type(seed).__name__}')
+            seed = int(seed) & ((1 << 63) - 1)
+        return cls(temperature, top_k, top_p, seed)
+
+    def to_dict(self):
+        return {f: getattr(self, f) for f in _FIELDS}
+
+    def __repr__(self):
+        return (f'SamplingParams(temperature={self.temperature}, '
+                f'top_k={self.top_k}, top_p={self.top_p}, '
+                f'seed={self.seed})')
+
+
+def derive_stream_seed(request_id, seed=None):
+    """The stream seed: an explicit ``seed`` wins; otherwise hash the
+    restart-safe ``request_id`` down to 63 bits (PRNGKey-safe). sha256 is
+    stable across processes and Python versions — ``hash()`` is not."""
+    if seed is not None:
+        return int(seed) & ((1 << 63) - 1)
+    digest = hashlib.sha256(str(request_id).encode('utf-8')).digest()
+    return int.from_bytes(digest[:8], 'big') & ((1 << 63) - 1)
+
+
+class TokenSampler:
+    """Deterministic per-request sampler over raw logits rows.
+
+    One instance per request; ``sample(row, index)`` is a pure function of
+    (stream seed, params, row bits, index) — the replay contract above."""
+
+    def __init__(self, params, request_id):
+        from ...core.random import KeyGenerator
+        self.params = params
+        self.stream_seed = derive_stream_seed(request_id, params.seed)
+        # the framework's own key machinery: base = PRNGKey(seed), built
+        # lazily (KeyGenerator's import-time discipline)
+        self._keygen = KeyGenerator(self.stream_seed)
+
+    def sample(self, row, index):
+        """Draw generated-token ``index`` (0-based) of this stream from the
+        logits ``row`` (V,). Greedy params short-circuit to argmax."""
+        import jax
+        p = self.params
+        row = np.asarray(row)
+        if p.greedy:
+            return int(row.argmax())
+        logits = row.astype(np.float64) / p.temperature
+        # stable descending order: ties broken by token id, ascending —
+        # deterministic regardless of the backend's argsort implementation
+        order = np.argsort(-logits, kind='stable')
+        if p.top_k > 0:
+            order = order[:p.top_k]
+        shifted = logits[order] - logits[order[0]]
+        probs = np.exp(shifted)
+        probs /= probs.sum()
+        if p.top_p < 1.0:
+            # keep the minimal prefix reaching mass top_p (≥ 1 token):
+            # token j survives iff the mass BEFORE it is still < top_p
+            before = np.cumsum(probs) - probs
+            keep = before < p.top_p
+            order, probs = order[keep], probs[keep]
+            probs = probs / probs.sum()
+        key = jax.random.fold_in(self._keygen.base_key(), int(index))
+        u = float(jax.random.uniform(key, (), dtype=np.float32))
+        idx = int(np.searchsorted(np.cumsum(probs), u, side='right'))
+        return int(order[min(idx, len(order) - 1)])

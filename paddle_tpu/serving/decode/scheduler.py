@@ -35,6 +35,7 @@ work). Backpressure and drain/fail-fast close mirror MicroBatcher.
 from __future__ import annotations
 
 import collections
+import contextlib
 import os
 import queue
 import threading
@@ -44,8 +45,9 @@ import uuid
 from .. import metrics as _m
 from ..breaker import CircuitBreaker
 from ..errors import (DeadlineExceeded, EngineClosed, EngineUnhealthy,
-                      Overloaded, OutOfBlocks, ServingError)
+                      InvalidRequest, Overloaded, OutOfBlocks, ServingError)
 from ..batcher import DEFAULT_QUEUE_DEPTH
+from .sampling import SamplingParams, TokenSampler
 
 __all__ = ['DecodeScheduler', 'GenerationStream']
 
@@ -66,15 +68,18 @@ class GenerationStream:
 
     Identity (``meta`` / the final HTTP NDJSON line): ``replica_id`` names
     the serving process, ``request_id`` is restart-safe — a fresh random
-    component per submission, so retries after a replica restart or a
-    router failover never collide and clients can correlate the attempts
-    of one logical request across replicas."""
+    component per submission (or the client's pinned id), so retries after
+    a replica restart or a router failover never collide and clients can
+    correlate the attempts of one logical request across replicas. For
+    SAMPLED requests the request_id is also the stream seed (sampling.py):
+    replaying the same id + params reproduces the token stream bitwise."""
 
-    def __init__(self, prompt_len, max_new_tokens, replica_id=None):
+    def __init__(self, prompt_len, max_new_tokens, replica_id=None,
+                 request_id=None):
         self.prompt_len = prompt_len
         self.max_new_tokens = max_new_tokens
         self.replica_id = replica_id
-        self.request_id = uuid.uuid4().hex[:16]
+        self.request_id = request_id or uuid.uuid4().hex[:16]
         self._q = queue.Queue()
         self._tokens = []
         self._done = threading.Event()
@@ -143,20 +148,31 @@ class GenerationStream:
 class _Request:
     __slots__ = ('prompt', 'max_new_tokens', 'eos_id', 'stream', 'deadline',
                  'enqueued_at', 'table', 'next_token', 'generated',
-                 'pending_prompt', 'prefilling', 'handoff_pending')
+                 'pending_prompt', 'prefilling', 'handoff_pending',
+                 'sampling', 'sampler', 'history')
 
     def __init__(self, prompt, max_new_tokens, eos_id, deadline,
-                 replica_id=None):
+                 replica_id=None, sampling=None, request_id=None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
         self.stream = GenerationStream(len(prompt), max_new_tokens,
-                                       replica_id=replica_id)
+                                       replica_id=replica_id,
+                                       request_id=request_id)
         self.deadline = deadline
         self.enqueued_at = time.monotonic()
         self.table = None
         self.next_token = None        # sampled but not yet cached/emitted?
         self.generated = 0
+        # per-request sampling: sampler is None on the greedy path (exact
+        # argmax, bitwise-unchanged); sampled draws are keyed off the
+        # stream's restart-safe request_id → replayable (sampling.py)
+        self.sampling = sampling or SamplingParams()
+        self.sampler = (None if self.sampling.greedy else
+                        TokenSampler(self.sampling, self.stream.request_id))
+        # prompt + emitted tokens — what the speculative drafter continues
+        # from (its last element is the pending uncached token)
+        self.history = list(prompt)
         # chunked suffix fill (prefix-cache hit): prompt tokens still to be
         # fed through the lockstep step; while prefilling, step outputs are
         # discarded (the next fed token is forced to the prompt)
@@ -182,11 +198,27 @@ class DecodeScheduler:
     def __init__(self, engine, queue_depth=DEFAULT_QUEUE_DEPTH,
                  admission='continuous', default_timeout_ms=None,
                  breaker_failures=None, breaker_reset_s=None, start=True,
-                 replica_id=None, disagg=None):
+                 replica_id=None, disagg=None, drafter=None):
         if admission not in ('continuous', 'drain'):
             raise ValueError(f"admission must be 'continuous' or 'drain', "
                              f"got {admission!r}")
         self.engine = engine
+        # speculative decoding (engine.spec_enabled): the engine owns the
+        # batched (S, k) verify step; the scheduler owns the DRAFTER —
+        # proposals are host-side policy. ``drafter`` may be a name
+        # ('ngram' / 'draft_model' / 'off'), a duck-typed .propose object,
+        # or None → the PADDLE_TPU_SPEC_DRAFTER knob (default 'ngram').
+        self.drafter = None
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        if getattr(engine, 'spec_enabled', False):
+            from ..tier.knobs import ENV_SPEC_DRAFTER, parse_choice_env
+            from .drafter import DRAFTER_CHOICES, build_drafter
+            if drafter is None:
+                drafter = parse_choice_env(ENV_SPEC_DRAFTER,
+                                           DRAFTER_CHOICES, 'ngram')
+            self.drafter = build_drafter(
+                drafter, getattr(engine, 'padded_context', 0))
         # identity stamped into every GenerationStream's result metadata
         # (serving-tier failover correlation); free-form, not a strict knob
         self.replica_id = (replica_id
@@ -220,16 +252,30 @@ class DecodeScheduler:
 
     # -- client side -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=16, eos_id=None,
-               timeout_ms=None):
+               timeout_ms=None, sampling=None, request_id=None):
         """Validate and enqueue one generation; returns its
         :class:`GenerationStream`. Raises InvalidRequest / Overloaded /
-        EngineUnhealthy (breaker open) / EngineClosed (all pre-enqueue)."""
+        EngineUnhealthy (breaker open) / EngineClosed (all pre-enqueue).
+
+        ``sampling``: None (greedy) | dict | SamplingParams — typed
+        validation happens HERE, pre-enqueue, naming the bad field.
+        ``request_id``: optional client-pinned id; for sampled requests it
+        seeds the stream, so resubmitting the same id + params replays the
+        exact token sequence (after a restart, on another replica, ...)."""
         if not self.breaker.allow():
             raise EngineUnhealthy('decode engine',
                                   self.breaker.consecutive_failures)
         try:
             prompt, max_new = self.engine.validate(prompt_ids,
                                                    max_new_tokens)
+            params = SamplingParams.validate(sampling)
+            if request_id is not None:
+                request_id = str(request_id)
+                if not 0 < len(request_id) <= 128 or any(
+                        c in request_id for c in '\r\n'):
+                    raise InvalidRequest(
+                        'request_id must be 1-128 characters with no '
+                        'newlines')
         except Exception:
             _m.decode_requests_rejected_invalid.inc()
             raise
@@ -239,7 +285,8 @@ class DecodeScheduler:
             else time.monotonic() + float(timeout_ms) / 1e3
         req = _Request(prompt, max_new,
                        self.engine.eos_id if eos_id is None else eos_id,
-                       deadline, replica_id=self.replica_id)
+                       deadline, replica_id=self.replica_id,
+                       sampling=params, request_id=request_id)
         with self._cv:
             if self._closing:
                 raise EngineClosed('decode scheduler is shutting down')
@@ -323,15 +370,23 @@ class DecodeScheduler:
             req.pending_prompt = collections.deque(req.prompt[cached + 1:])
             req.prefilling = True
             return
-        if self.disagg is not None:
+        if self.disagg is not None and req.sampler is None:
             # cache miss under disaggregation: ship the prompt to a
             # prefill-role replica; this slot stays inactive (and the
-            # decode loop keeps stepping) until the KV payload lands
+            # decode loop keeps stepping) until the KV payload lands.
+            # Sampled requests prefill INLINE — the handoff payload carries
+            # a greedy first token, not logits, so the draw must happen
+            # here where the row is
             req.handoff_pending = True
             self.disagg.submit(req, req.prompt, req.max_new_tokens)
             return
         try:
-            first = self.engine.prefill(req.prompt, req.table)
+            if req.sampler is None:     # kwarg-free call: duck-typed
+                first = self.engine.prefill(req.prompt, req.table)
+            else:
+                first = self.engine.prefill(
+                    req.prompt, req.table,
+                    sampler=lambda row: self._pick_token(req, row))
         except Exception as e:
             self._fail_request(req, e)
             self._record_engine_failure()
@@ -382,11 +437,22 @@ class DecodeScheduler:
         if failed:
             _m.decode_requests_failed.inc(failed)
 
+    def _pick_token(self, req, row):
+        """Next token from a logits row: the request's deterministic
+        sampler (indexed by tokens generated so far — the replay contract)
+        or exact greedy argmax."""
+        if req.sampler is not None:
+            tok = req.sampler.sample(row, req.generated)
+            _m.decode_tokens_sampled.inc()
+            return int(tok)
+        return int(row.argmax())
+
     def _emit_token(self, req, token):
         """Account one sampled token; marks the request finished when it
         hits eos or its budget. The token still needs to be FED to the next
         decode step (its K/V are uncached) unless the request finished."""
         req.generated += 1
+        req.history.append(int(token))
         req.stream._emit(token)
         _m.decode_tokens_generated.inc()
         if req.eos_id is not None and int(token) == int(req.eos_id):
@@ -429,8 +495,17 @@ class DecodeScheduler:
                   else None for r in self._slots]
         tables = [r.table if r is not None and not r.handoff_pending
                   else None for r in self._slots]
+        # greedy-only batches take the original call (byte-identical path);
+        # a sampled slot that will EMIT this step needs its logits row
+        rows = None
+        need_rows = any(r.sampler is not None and not r.prefilling
+                        for r in active)
         try:
-            out = self.engine.decode_step(tokens, tables)
+            if need_rows:
+                out, rows = self.engine.decode_step(tokens, tables,
+                                                    return_rows=True)
+            else:
+                out = self.engine.decode_step(tokens, tables)
         except Exception as e:
             for req in active:      # isolate: fail the batch, keep serving
                 self._fail_request(req, e)
@@ -448,7 +523,106 @@ class DecodeScheduler:
                 # K/V is now cached — publish, then emit the first token
                 req.prefilling = False
                 self._publish(req)
-            self._emit_token(req, int(out[i]))
+            if req.sampler is not None and rows is not None:
+                self._emit_token(req, self._pick_token(req, rows[i]))
+            else:
+                self._emit_token(req, int(out[i]))
+        return True
+
+    def _spec_step(self):
+        """One speculative (S, k) verify round (engine.spec_enabled).
+
+        Greedy slots feed their pending token plus up to k-1 drafter
+        guesses; the target model's (S, k, V) rows verify them all in ONE
+        step and the longest prefix the target agrees with is emitted
+        (rows are bitwise-identical to the lockstep rows, so the emitted
+        stream equals non-speculative greedy exactly). Rejected tails roll
+        the block table back — one integer store; the stale K/V positions
+        are masked until overwritten (kv_cache scratch contract). Sampled
+        slots ride the same batched step with a single fed token (their
+        draw stays exact + replayable); suffix-filling slots feed up to k
+        prompt tokens per round (chunked prefill, k× fewer steps)."""
+        live = [r for r in self._slots if r is not None]
+        active = [r for r in live if not r.handoff_pending]
+        if not active:
+            return bool(live)
+        K = self.engine.spec_k
+        fed = [None] * len(self._slots)
+        tables = [None] * len(self._slots)
+        bases = [0] * len(self._slots)
+        for i, req in enumerate(self._slots):
+            if req is None or req.handoff_pending:
+                continue
+            tables[i] = req.table
+            bases[i] = req.table.context_len
+            if req.prefilling:
+                toks = [req.next_token]
+                while len(toks) < K and req.pending_prompt:
+                    toks.append(req.pending_prompt.popleft())
+            elif req.sampler is not None:
+                toks = [req.next_token]
+            else:
+                # never draft past the budget: the last verify round feeds
+                # exactly the remaining token allowance
+                budget = req.max_new_tokens - req.generated
+                n = min(K, max(budget, 1)) - 1
+                drafts = []
+                if n > 0 and self.drafter is not None:
+                    # the draft model shares the process-global no_grad
+                    # flag with the engine models — serialize under the
+                    # same lock disaggregation uses (None → no-op)
+                    with (getattr(self.engine, '_model_lock', None)
+                          or contextlib.nullcontext()):
+                        drafts = [int(t) for t in self.drafter.propose(
+                            req.history, n)][:n]
+                toks = [req.next_token] + drafts
+            fed[i] = toks
+        try:
+            rows = self.engine.spec_step(fed, tables)
+        except Exception as e:
+            for req in active:      # isolate: fail the batch, keep serving
+                self._fail_request(req, e)
+            self._record_engine_failure()
+            return True
+        self.breaker.record_success()
+        for i, req in enumerate(self._slots):
+            if req is None or req.handoff_pending:
+                continue
+            toks = fed[i]
+            f = len(toks)
+            if req.prefilling:
+                if req.pending_prompt:
+                    req.next_token = req.pending_prompt.popleft()
+                    continue          # all fed prompt tokens stay cached
+                req.prefilling = False
+                self._publish(req)
+                self._emit_token(req, self._pick_token(req, rows[i, f - 1]))
+                continue
+            drafted = f - 1
+            emitted = 0
+            j = 0
+            while j < f:
+                tok = self._pick_token(req, rows[i, j])
+                self._emit_token(req, tok)
+                emitted += 1
+                if req.table is None:
+                    break             # retired (eos / budget) mid-round
+                if j + 1 < f and int(toks[j + 1]) == tok:
+                    j += 1            # draft confirmed; keep verifying
+                    continue
+                break                 # first rejection (or window end)
+            if req.table is not None:
+                # commit the accepted prefix, roll back the rejected tail
+                req.table.context_len = bases[i] + emitted
+            _m.decode_spec_accept_len.observe(emitted)
+            if drafted:
+                self._spec_drafted += drafted
+                self._spec_accepted += emitted - 1
+                _m.decode_spec_draft_tokens.inc(drafted)
+                if emitted > 1:
+                    _m.decode_spec_accepted_tokens.inc(emitted - 1)
+                _m.decode_spec_acceptance.set(
+                    self._spec_accepted / max(self._spec_drafted, 1))
         return True
 
     def _fail_all_locked(self):
@@ -487,7 +661,10 @@ class DecodeScheduler:
                             and all(r is None or r.handoff_pending
                                     for r in self._slots))
             self._drain_handoffs(0.01 if only_pending else 0.0)
-            stepped = self._step()
+            if getattr(self.engine, 'spec_enabled', False):
+                stepped = self._spec_step()
+            else:
+                stepped = self._step()
             if not stepped and not admitted:
                 with self._cv:
                     if self._closing:

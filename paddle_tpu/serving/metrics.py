@@ -209,6 +209,37 @@ decode_prefill_compiles = _LazyMetric(
     'counter', 'decode_prefill_compiles',
     'prefill bucket shapes compiled (bounded by the prompt ladder length)')
 
+# speculative decoding (engine.spec_step + scheduler verify loop); accept
+# length per round is a small integer — linear buckets up to the window
+_ACCEPT_BOUNDS = tuple(float(i) for i in range(9))
+
+decode_spec_rounds = _LazyMetric(
+    'counter', 'decode_spec_rounds',
+    'speculative (S, k) verify steps executed (each replaces up to k '
+    'lockstep steps)')
+decode_spec_draft_tokens = _LazyMetric(
+    'counter', 'decode_spec_draft_tokens',
+    'draft tokens proposed to verify rounds across all slots')
+decode_spec_accepted_tokens = _LazyMetric(
+    'counter', 'decode_spec_accepted_tokens',
+    'draft tokens accepted by the target model (longest matching prefix); '
+    'accepted/draft is the acceptance rate')
+decode_spec_acceptance = _LazyMetric(
+    'gauge', 'decode_spec_acceptance',
+    'cumulative draft-token acceptance rate (accepted / proposed)')
+decode_spec_verify_seconds = _LazyMetric(
+    'histogram', 'decode_spec_verify_seconds',
+    'wall seconds per batched (S, k) verify step — the verify-step split '
+    'of decode time')
+decode_spec_accept_len = _LazyMetric(
+    'histogram', 'decode_spec_accept_len',
+    'tokens emitted per slot per verify round (1 = all drafts rejected)',
+    bounds=_ACCEPT_BOUNDS)
+decode_tokens_sampled = _LazyMetric(
+    'counter', 'decode_tokens_sampled',
+    'tokens drawn through per-request sampling (temperature > 0) rather '
+    'than greedy argmax')
+
 decode_breaker_state = _LazyMetric(
     'gauge', 'decode_breaker_state',
     'decode-path circuit breaker state (0 closed / 1 half-open / 2 open)')

@@ -57,6 +57,13 @@ _STATUS_BY_ERROR = ((InvalidRequest, 400), (Overloaded, 429),
                     (DeadlineExceeded, 504), (EngineUnhealthy, 503),
                     (EngineClosed, 503))
 
+# /generate request schema: unknown keys are a 400 naming the field (a
+# typo'd sampling knob silently dropped would serve greedy while the
+# client believes it set temperature)
+_SAMPLING_KEYS = frozenset(('temperature', 'top_k', 'top_p', 'seed'))
+_GENERATE_KEYS = frozenset(('prompt', 'max_new_tokens', 'eos_id', 'stream',
+                            'timeout_ms', 'request_id')) | _SAMPLING_KEYS
+
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = 'HTTP/1.1'
@@ -205,7 +212,15 @@ class _Handler(BaseHTTPRequestHandler):
         "Stateful decode"). Body::
 
             {"prompt": [token ids], "max_new_tokens": 16,
-             "eos_id": optional, "stream": true, "timeout_ms": optional}
+             "eos_id": optional, "stream": true, "timeout_ms": optional,
+             "temperature": 0.0, "top_k": 0, "top_p": 1.0,
+             "seed": optional, "request_id": optional}
+
+        Sampling keys are validated typed (serving/decode/sampling.py):
+        a bad value OR an unknown body key is a 400 naming the field —
+        a typo'd knob must never be silently dropped. Sampled streams
+        replay bitwise from ``request_id`` (or ``seed``); greedy
+        (temperature 0, the default) is exact argmax.
 
         ``stream=true`` (default) replies 200 with chunked NDJSON: one
         ``{"token": id, "index": i}`` line per decoded token, then a final
@@ -230,13 +245,21 @@ class _Handler(BaseHTTPRequestHandler):
         if not isinstance(prompt, list):
             return self._error(400, InvalidRequest(
                 'body must include "prompt": [token ids]'))
+        unknown = sorted(set(payload) - _GENERATE_KEYS)
+        if unknown:
+            return self._error(400, InvalidRequest(
+                f'unknown request field(s): {", ".join(unknown)}; '
+                f'supported: {", ".join(sorted(_GENERATE_KEYS))}'))
+        sampling = {k: payload[k] for k in _SAMPLING_KEYS if k in payload}
         t0 = time.perf_counter()
         try:
             stream = srv.generator.submit(
                 prompt,
                 max_new_tokens=payload.get('max_new_tokens', 16),
                 eos_id=payload.get('eos_id'),
-                timeout_ms=payload.get('timeout_ms'))
+                timeout_ms=payload.get('timeout_ms'),
+                sampling=sampling or None,
+                request_id=payload.get('request_id'))
         except tuple(e for e, _ in _STATUS_BY_ERROR) as e:
             for etype, code in _STATUS_BY_ERROR:
                 if isinstance(e, etype):

@@ -15,15 +15,19 @@ environment must fail the component that reads it, not every
 | ``PADDLE_TPU_ROUTER_REPLICAS``         | comma list of http URLs| tier/router.py CLI |
 | ``PADDLE_TPU_ROUTER_PORT``             | int in [0, 65535]      | tier/router.py CLI |
 | ``PADDLE_TPU_ROUTER_HEALTH_POLL_S``    | float > 0              | Router |
+| ``PADDLE_TPU_SPEC_DECODE``             | ``0`` / ``1``          | DecodeEngine (``0`` is the hard escape hatch — wins over the constructor arg) |
+| ``PADDLE_TPU_SPEC_K``                  | int >= 2               | DecodeEngine (verify-window width) |
+| ``PADDLE_TPU_SPEC_DRAFTER``            | ``ngram`` / ``draft_model`` / ``off`` | DecodeScheduler |
 """
 from __future__ import annotations
 
 import os
 
 __all__ = ['parse_flag_env', 'parse_int_env', 'parse_float_env',
-           'parse_replicas_env', 'ENV_PREFIX_CACHE',
+           'parse_replicas_env', 'parse_choice_env', 'ENV_PREFIX_CACHE',
            'ENV_PREFIX_CACHE_MAX_BLOCKS', 'ENV_DISAGG', 'ENV_ROUTER_REPLICAS',
-           'ENV_ROUTER_PORT', 'ENV_ROUTER_HEALTH_POLL_S']
+           'ENV_ROUTER_PORT', 'ENV_ROUTER_HEALTH_POLL_S', 'ENV_SPEC_DECODE',
+           'ENV_SPEC_K', 'ENV_SPEC_DRAFTER']
 
 ENV_PREFIX_CACHE = 'PADDLE_TPU_PREFIX_CACHE'
 ENV_PREFIX_CACHE_MAX_BLOCKS = 'PADDLE_TPU_PREFIX_CACHE_MAX_BLOCKS'
@@ -31,6 +35,9 @@ ENV_DISAGG = 'PADDLE_TPU_DISAGG'
 ENV_ROUTER_REPLICAS = 'PADDLE_TPU_ROUTER_REPLICAS'
 ENV_ROUTER_PORT = 'PADDLE_TPU_ROUTER_PORT'
 ENV_ROUTER_HEALTH_POLL_S = 'PADDLE_TPU_ROUTER_HEALTH_POLL_S'
+ENV_SPEC_DECODE = 'PADDLE_TPU_SPEC_DECODE'
+ENV_SPEC_K = 'PADDLE_TPU_SPEC_K'
+ENV_SPEC_DRAFTER = 'PADDLE_TPU_SPEC_DRAFTER'
 
 
 def parse_flag_env(name, default=False, environ=None):
@@ -81,6 +88,20 @@ def parse_float_env(name, default, minimum_exclusive=0.0, environ=None):
             f'{name}={val} out of range; supported values: numbers '
             f'> {minimum_exclusive}')
     return val
+
+
+def parse_choice_env(name, choices, default, environ=None):
+    """Enumerated string knob; a value outside ``choices`` raises listing
+    the supported set."""
+    raw = (environ if environ is not None else os.environ).get(name, '')
+    raw = raw.strip()
+    if not raw:
+        return default
+    if raw not in choices:
+        raise ValueError(
+            f'{name}={raw!r} is not supported; supported values: '
+            + ', '.join(repr(c) for c in choices))
+    return raw
 
 
 def parse_replicas_env(name=ENV_ROUTER_REPLICAS, default=None, environ=None):

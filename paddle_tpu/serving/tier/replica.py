@@ -19,8 +19,11 @@ background thread (how the warmup-gating test produces a cold-but-alive
 replica).
 
 Knobs consumed here (strict parse, tier/knobs.py): ``PADDLE_TPU_PREFIX_CACHE``
-(via DecodeEngine) and ``PADDLE_TPU_DISAGG`` (build a prefill-role engine +
-LocalPrefillWorker beside the decode engine).
+(via DecodeEngine), ``PADDLE_TPU_DISAGG`` (build a prefill-role engine +
+LocalPrefillWorker beside the decode engine), and the speculative-decoding
+set ``PADDLE_TPU_SPEC_DECODE`` / ``PADDLE_TPU_SPEC_K`` (via DecodeEngine) +
+``PADDLE_TPU_SPEC_DRAFTER`` (via DecodeScheduler) — also exposed as
+``--spec-decode`` / ``--spec-k`` / ``--drafter`` CLI flags.
 """
 from __future__ import annotations
 
@@ -50,7 +53,8 @@ def build_replica_stack(model=None, seed=DEFAULT_SEED, slots=2, block_size=4,
                         max_blocks=128, max_prompt_len=16,
                         max_new_tokens_cap=16, prompt_buckets=None,
                         prefix_cache=None, disagg=None, queue_depth=64,
-                        replica_id=None, model_lock=None):
+                        replica_id=None, model_lock=None, spec_decode=None,
+                        spec_k=None, drafter=None):
     """(engine, scheduler, prefill_worker|None) — the replica's serving
     stack minus the HTTP listener. ``prefix_cache``/``disagg`` default to
     their env knobs. Used by the CLI below and, in-process, by
@@ -70,7 +74,8 @@ def build_replica_stack(model=None, seed=DEFAULT_SEED, slots=2, block_size=4,
                           max_prompt_len=max_prompt_len,
                           max_new_tokens_cap=max_new_tokens_cap,
                           prompt_buckets=prompt_buckets,
-                          prefix_cache=prefix_cache, model_lock=model_lock)
+                          prefix_cache=prefix_cache, model_lock=model_lock,
+                          spec_decode=spec_decode, spec_k=spec_k)
     worker = None
     if disagg:
         from .disagg import LocalPrefillWorker, PrefillReplica
@@ -85,7 +90,8 @@ def build_replica_stack(model=None, seed=DEFAULT_SEED, slots=2, block_size=4,
             model_lock=model_lock)
         worker = LocalPrefillWorker([PrefillReplica(prefill_engine)])
     scheduler = DecodeScheduler(engine, queue_depth=queue_depth,
-                                replica_id=replica_id, disagg=worker)
+                                replica_id=replica_id, disagg=worker,
+                                drafter=drafter)
     return engine, scheduler, worker
 
 
@@ -102,6 +108,17 @@ def main(argv=None):
     ap.add_argument('--max-prompt-len', type=int, default=16)
     ap.add_argument('--max-new-tokens-cap', type=int, default=16)
     ap.add_argument('--replica-id', default=None)
+    ap.add_argument('--spec-decode', type=int, choices=(0, 1), default=None,
+                    help='speculative decoding on/off (default: the '
+                         'PADDLE_TPU_SPEC_DECODE knob, off; env 0 always '
+                         'wins — the escape hatch)')
+    ap.add_argument('--spec-k', type=int, default=None,
+                    help='speculative verify window (default: '
+                         'PADDLE_TPU_SPEC_K, 4)')
+    ap.add_argument('--drafter', default=None,
+                    choices=('ngram', 'draft_model', 'off'),
+                    help='draft proposer (default: PADDLE_TPU_SPEC_DRAFTER, '
+                         'ngram)')
     ap.add_argument('--lazy-warmup', action='store_true',
                     help='serve immediately and warm in the background '
                          '(replica starts COLD: the router must not route '
@@ -115,7 +132,10 @@ def main(argv=None):
             seed=args.seed, slots=args.slots, block_size=args.block_size,
             max_blocks=args.max_blocks, max_prompt_len=args.max_prompt_len,
             max_new_tokens_cap=args.max_new_tokens_cap,
-            replica_id=args.replica_id)
+            replica_id=args.replica_id,
+            spec_decode=(None if args.spec_decode is None
+                         else bool(args.spec_decode)),
+            spec_k=args.spec_k, drafter=args.drafter)
         srv = ServingServer(None, host=args.host, port=args.port,
                             generator=scheduler)
         if args.lazy_warmup:

@@ -42,6 +42,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import metrics as _m
@@ -52,6 +53,35 @@ from .knobs import (ENV_ROUTER_HEALTH_POLL_S, ENV_ROUTER_PORT,
                     parse_replicas_env)
 
 __all__ = ['Router', 'RouterServer', 'RoutedGeneration', 'Replica']
+
+# /generate schema mirrored from serving/server.py: the router rejects
+# unknown keys with the same 400 so a typo fails at the FRONT door instead
+# of after a replica round-trip
+_SAMPLING_KEYS = ('temperature', 'top_k', 'top_p', 'seed')
+_GENERATE_KEYS = frozenset(('prompt', 'max_new_tokens', 'eos_id', 'stream',
+                            'timeout_ms', 'request_id', *_SAMPLING_KEYS))
+
+
+def _attach_sampling(payload, temperature, top_k, top_p, seed, request_id):
+    """Add per-request sampling keys to a /generate payload. A SAMPLED
+    request with no pinned identity gets a router-stamped ``request_id``:
+    the id seeds the stream (serving/decode/sampling.py), so a pre-stream
+    failover retry on another replica REPLAYS the same tokens — the
+    determinism that makes zero-drop rerouting idempotent extends from
+    greedy to sampled traffic."""
+    if temperature is not None:
+        payload['temperature'] = float(temperature)
+    if top_k is not None:
+        payload['top_k'] = int(top_k)
+    if top_p is not None:
+        payload['top_p'] = float(top_p)
+    if seed is not None:
+        payload['seed'] = int(seed)
+    if request_id is not None:
+        payload['request_id'] = str(request_id)
+    elif payload.get('temperature') and seed is None:
+        payload['request_id'] = uuid.uuid4().hex[:16]
+    return payload
 
 _logger = get_logger(
     __name__, logging.INFO,
@@ -285,9 +315,12 @@ class Router:
 
     # -- client API --------------------------------------------------------
     def stream_generate(self, prompt, max_new_tokens=16, eos_id=None,
-                        timeout_ms=None, timeout=None):
+                        timeout_ms=None, timeout=None, temperature=None,
+                        top_k=None, top_p=None, seed=None, request_id=None):
         """Route one streaming generation; returns a
-        :class:`RoutedGeneration` (consume ``.events()``)."""
+        :class:`RoutedGeneration` (consume ``.events()``). Sampling knobs
+        forward to the replica's /generate schema; see
+        :func:`_attach_sampling` for the sampled-failover identity rule."""
         _m.router_requests.inc()
         payload = {'prompt': list(prompt),
                    'max_new_tokens': int(max_new_tokens), 'stream': True}
@@ -295,15 +328,18 @@ class Router:
             payload['eos_id'] = int(eos_id)
         if timeout_ms is not None:
             payload['timeout_ms'] = timeout_ms
+        _attach_sampling(payload, temperature, top_k, top_p, seed,
+                         request_id)
         return RoutedGeneration(self, payload,
                                 timeout or self.request_timeout)
 
     def generate(self, prompt, max_new_tokens=16, eos_id=None,
-                 timeout_ms=None, timeout=None):
+                 timeout_ms=None, timeout=None, **sampling):
         """Blocking convenience: route, stream to completion, return the
-        final done dict (raises on an error event)."""
+        final done dict (raises on an error event). ``**sampling`` passes
+        temperature/top_k/top_p/seed/request_id through."""
         gen = self.stream_generate(prompt, max_new_tokens, eos_id,
-                                   timeout_ms, timeout)
+                                   timeout_ms, timeout, **sampling)
         from ..errors import ServingError
         final = None
         for event in gen.events():
@@ -317,13 +353,16 @@ class Router:
         return final
 
     def generate_nonstream(self, prompt, max_new_tokens=16, eos_id=None,
-                           timeout_ms=None, timeout=None):
+                           timeout_ms=None, timeout=None, temperature=None,
+                           top_k=None, top_p=None, seed=None,
+                           request_id=None):
         """Non-streamed routed generation: the replica replies with ONE
         JSON body, so a failure at ANY point before the reply — connection
         refused, replica killed mid-generation, 5xx — is safely retried on
-        another replica (greedy generation is deterministic, retries are
-        idempotent). Non-streamed requests therefore survive a replica
-        death with zero drops even while in flight."""
+        another replica (generation is deterministic: greedy exactly, and
+        sampled streams replay from the request_id the router stamps —
+        so retries are idempotent). Non-streamed requests therefore
+        survive a replica death with zero drops even while in flight."""
         _m.router_requests.inc()
         timeout = timeout or self.request_timeout
         payload = {'prompt': list(prompt),
@@ -332,6 +371,8 @@ class Router:
             payload['eos_id'] = int(eos_id)
         if timeout_ms is not None:
             payload['timeout_ms'] = timeout_ms
+        _attach_sampling(payload, temperature, top_k, top_p, seed,
+                         request_id)
         deadline = time.monotonic() + timeout
         tried = set()
         retries = 0
@@ -477,13 +518,22 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return self._reply(400, {
                 'error': 'InvalidRequest',
                 'message': 'body must include "prompt": [token ids]'})
+        unknown = sorted(set(payload) - _GENERATE_KEYS)
+        if unknown:
+            return self._reply(400, {
+                'error': 'InvalidRequest',
+                'message': f'unknown request field(s): {", ".join(unknown)}'
+                           f'; supported: '
+                           f'{", ".join(sorted(_GENERATE_KEYS))}'})
         stream = payload.get('stream', True) is not False
         try:
             gen = router.stream_generate(
                 payload['prompt'],
                 max_new_tokens=payload.get('max_new_tokens', 16),
                 eos_id=payload.get('eos_id'),
-                timeout_ms=payload.get('timeout_ms'))
+                timeout_ms=payload.get('timeout_ms'),
+                **{k: payload[k] for k in (*_SAMPLING_KEYS, 'request_id')
+                   if k in payload})
             if not stream:
                 events = list(gen.events())
                 final = events[-1] if events else {}

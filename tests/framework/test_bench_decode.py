@@ -1,10 +1,12 @@
 """tier-1 guard for the decode-engine bench: tools/bench_decode.py --smoke
 must run end-to-end on CPU, keep per-request BITWISE token parity between
 the paged continuous-batching engine and the uncached whole-sequence
-baseline, and show continuous batching beating drain-then-refill. The
-full-size acceptance margin (≥1.5× tokens/s, measured 1.78×) is recorded in
-PERF.md §13; the smoke bound here is soft so CI noise cannot flake it
-(smoke measures ~1.4×)."""
+baseline, show continuous batching beating drain-then-refill, replay the
+sampled section bitwise, and show speculative verify rounds beating
+lockstep steps. The full-size acceptance margins (≥1.5× tokens/s for
+continuous-vs-drain AND speculative-vs-lockstep) are recorded in PERF.md
+§13; the smoke bounds here are structural (step counts, deterministic for
+the seeded workload) so CI noise cannot flake them."""
 import json
 import os
 import subprocess
@@ -27,7 +29,8 @@ def test_bench_decode_smoke_runs_on_cpu():
     lines = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
     benches = {d['bench']: d for d in lines if 'bench' in d}
     assert {'decode_uncached_baseline', 'decode_engine_continuous',
-            'decode_engine_drain'} <= set(benches)
+            'decode_engine_drain', 'decode_sampled',
+            'decode_engine_speculative'} <= set(benches)
 
     base = benches['decode_uncached_baseline']
     assert base['tokens'] > 0 and base['tokens_per_s'] > 0
@@ -48,3 +51,20 @@ def test_bench_decode_smoke_runs_on_cpu():
     assert cont['steps'] * 1.3 <= drain['steps'], (cont, drain)
     assert cont['mean_slot_occupancy'] > drain['mean_slot_occupancy']
     assert 'speedup_vs_drain' in cont and 'speedup_vs_uncached' in cont
+
+    # sampled: pinned request_ids make the two passes bitwise-identical
+    sampled = benches['decode_sampled']
+    assert sampled['replayable'] is True, sampled
+    assert sampled['tokens'] == base['tokens']
+
+    # speculative: still bitwise greedy, and the (S, k) verify rounds beat
+    # lockstep structurally (smoke measures 21 vs 37 steps, deterministic;
+    # the wall-clock ratio — 1.64x full size — stays out of the gate)
+    spec = benches['decode_engine_speculative']
+    assert ENGINE_FIELDS <= set(spec), spec
+    assert spec['bitwise_equal'] is True, spec
+    assert spec['tokens'] == base['tokens']
+    assert spec['steps'] * 1.5 <= cont['steps'], (spec, cont)
+    assert spec['spec_rounds'] == spec['steps']
+    assert 0.0 <= spec['acceptance'] <= 1.0
+    assert 'speedup_vs_lockstep' in spec

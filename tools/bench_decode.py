@@ -17,6 +17,16 @@ drain policy's idle-slot waste), one JSON line each:
    (refill only when ALL slots finish — the wave-batching strawman).
    Acceptance (PERF.md §13): continuous ≥ 1.5× drain tokens/s on this
    workload, parity again bitwise.
+4. ``decode_sampled`` — the same workload with sampling params and PINNED
+   request_ids, run TWICE through the warm engine: reports sampled
+   tokens/s and ``replayable`` (the two passes bitwise-identical — the
+   request_id-is-the-seed contract).
+5. ``decode_engine_speculative`` — a fresh engine with
+   ``spec_decode=True`` (n-gram drafter) over the same greedy workload:
+   parity against section 1 stays bitwise, and the batched (S, k) verify
+   rounds take ≥ 1.5× fewer decode steps than lockstep (greedy tiny-LM
+   streams are repetition-heavy — the n-gram drafter's cache-friendly
+   case). ``speedup_vs_lockstep`` reports the wall-clock ratio.
 
 Runs on any backend; CPU is the honest configuration (the quantity under
 test is scheduling + shape discipline, not FLOPs):
@@ -67,6 +77,14 @@ def _hist_sum(name):
             sum(s['count'] for s in d['samples']))
 
 
+def _counter(name):
+    from paddle_tpu.observability import registry
+    d = registry.to_dict().get(name)
+    if not d or not d['samples']:
+        return 0.0
+    return sum(s['value'] for s in d['samples'])
+
+
 def measure_uncached(model, work, padded_context):
     from paddle_tpu.models.causal_lm import greedy_generate
     # warm the single fixed shape so the baseline wall is steady-state
@@ -86,7 +104,7 @@ def measure_uncached(model, work, padded_context):
     }, refs
 
 
-def measure_engine(engine, work, refs, admission):
+def measure_engine(engine, work, refs, admission, bench_name=None):
     from paddle_tpu.serving.decode import DecodeScheduler
     pre0, _ = _hist_sum('decode_prefill_seconds')
     step0, nstep0 = _hist_sum('decode_step_seconds')
@@ -103,7 +121,7 @@ def measure_engine(engine, work, refs, admission):
     step1, nstep1 = _hist_sum('decode_step_seconds')
     occ1, nocc1 = _hist_sum('decode_slot_occupancy')
     return {
-        'bench': f'decode_engine_{admission}',
+        'bench': bench_name or f'decode_engine_{admission}',
         'requests': len(work), 'tokens': tokens,
         'slots': engine.slots,
         'tokens_per_s': round(tokens / wall, 1),
@@ -115,6 +133,55 @@ def measure_engine(engine, work, refs, admission):
         'decode_s': round(step1 - step0, 3),
         'bitwise_equal': mismatches == 0,
     }
+
+
+def measure_sampled(engine, work):
+    """Sampled decode through the warm lockstep engine: pinned request_ids,
+    the workload run TWICE — the second pass must replay the first bitwise
+    (the request_id-is-the-seed contract of serving/decode/sampling.py)."""
+    from paddle_tpu.serving.decode import DecodeScheduler
+    params = {'temperature': 0.8, 'top_k': 32, 'top_p': 0.95}
+
+    def run_once():
+        with DecodeScheduler(engine, queue_depth=len(work) + 1) as sched:
+            t0 = time.perf_counter()
+            streams = [sched.submit(p, max_new_tokens=m, sampling=params,
+                                    request_id=f'bench-sampled-{i}')
+                       for i, (p, m) in enumerate(work)]
+            outs = [s.result(600) for s in streams]
+            return outs, time.perf_counter() - t0
+
+    outs1, wall = run_once()
+    outs2, _ = run_once()
+    tokens = sum(len(o) for o in outs1)
+    return {
+        'bench': 'decode_sampled',
+        'requests': len(work), 'tokens': tokens,
+        'tokens_per_s': round(tokens / wall, 1),
+        'wall_s': round(wall, 3),
+        'sampling': params,
+        'replayable': outs1 == outs2,
+    }
+
+
+def measure_spec(engine, work, refs):
+    """Speculative decoding (n-gram drafter) over the greedy workload:
+    measure_engine's numbers plus the verify-round/acceptance counters.
+    Parity against the uncached refs must stay bitwise — the drafter only
+    proposes; the target model's (S, k) rows decide every token."""
+    rounds0 = _counter('decode_spec_rounds')
+    drafted0 = _counter('decode_spec_draft_tokens')
+    accepted0 = _counter('decode_spec_accepted_tokens')
+    res = measure_engine(engine, work, refs, 'continuous',
+                         bench_name='decode_engine_speculative')
+    drafted = _counter('decode_spec_draft_tokens') - drafted0
+    res['spec_k'] = engine.spec_k
+    res['spec_rounds'] = int(_counter('decode_spec_rounds') - rounds0)
+    res['draft_tokens'] = int(drafted)
+    res['accepted_tokens'] = int(
+        _counter('decode_spec_accepted_tokens') - accepted0)
+    res['acceptance'] = round(res['accepted_tokens'] / max(drafted, 1), 3)
+    return res
 
 
 def measure_all(smoke=False, seed=0):
@@ -137,11 +204,20 @@ def measure_all(smoke=False, seed=0):
         engine.warmup()
         cont = measure_engine(engine, work, refs, 'continuous')
         drain = measure_engine(engine, work, refs, 'drain')
+        sampled = measure_sampled(engine, work)
+        spec_engine = DecodeEngine(model, slots=slots, block_size=8,
+                                   max_blocks=256, max_prompt_len=16,
+                                   max_new_tokens_cap=64, spec_decode=True)
+        spec_engine.warmup()
+        spec = measure_spec(spec_engine, work, refs)
     cont['speedup_vs_uncached'] = round(
         cont['tokens_per_s'] / baseline['tokens_per_s'], 2)
     cont['speedup_vs_drain'] = round(
         cont['tokens_per_s'] / drain['tokens_per_s'], 2)
-    return {'uncached': baseline, 'continuous': cont, 'drain': drain}
+    spec['speedup_vs_lockstep'] = round(
+        spec['tokens_per_s'] / cont['tokens_per_s'], 2)
+    return {'uncached': baseline, 'continuous': cont, 'drain': drain,
+            'sampled': sampled, 'speculative': spec}
 
 
 def main():
@@ -157,7 +233,11 @@ def main():
     # out of the exit code so a loaded CI box cannot flake the bench
     ok = (results['continuous']['bitwise_equal']
           and results['drain']['bitwise_equal']
-          and results['continuous']['steps'] < results['drain']['steps'])
+          and results['continuous']['steps'] < results['drain']['steps']
+          and results['sampled']['replayable']
+          and results['speculative']['bitwise_equal']
+          and results['speculative']['steps'] * 1.5
+          <= results['continuous']['steps'])
     sys.exit(0 if ok else 1)
 
 
