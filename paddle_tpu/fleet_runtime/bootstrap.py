@@ -221,6 +221,9 @@ def bootstrap(spec=None, configure_mesh=True, install_sentinel_flag=True):
             sentinel.clear()
         fleet_barrier('fleet_bootstrap')
     from .. import observability as _obs
+    # name this process in distributed span records (trace_merge.py shows
+    # 'host<rank>' lanes) — a no-op unless PADDLE_TPU_TRACE_DIR is set
+    _obs.distributed.set_process_label('host%d' % process_index())
     if _obs._ENABLED:
         _obs.set_gauge('fleet_world_size', process_count(),
                        help='number of trainer processes in the fleet')

@@ -34,11 +34,13 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                       registry)
 from .tracer import NULL_SPAN, Span, StepTracer, tracer  # noqa: F401
 from .steplog import StepLogger, step_logger  # noqa: F401
+from .trace_context import TraceContext, TRACE_HEADER  # noqa: F401
+from . import distributed  # noqa: F401
 
 __all__ = ['enabled', 'enable', 'disable', 'telemetry_guard', 'metrics_dir',
            'span', 'instant', 'inc', 'set_gauge', 'observe', 'log_step',
            'record_op_dispatch', 'dump_artifacts', 'registry', 'tracer',
-           'step_logger']
+           'step_logger', 'TraceContext', 'TRACE_HEADER', 'distributed']
 
 # THE hot-path flag. Instrumentation sites read this attribute directly
 # (``if _obs._ENABLED:``); everything else in this module is off-path.
@@ -185,6 +187,7 @@ def reset():
     registry.reset()
     tracer.reset()
     _dispatch_children.clear()
+    distributed.reset_distributed()
 
 
 def dump_artifacts(directory=None):

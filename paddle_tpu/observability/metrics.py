@@ -118,9 +118,15 @@ class Gauge(_Metric):
         return self.labels().value
 
 
+#: retained raw observations per histogram child for exact percentiles —
+#: a ring, so a long-running process keeps the RECENT distribution, which
+#: is what p99 questions are about.
+RECENT_SAMPLES = 512
+
+
 class _HistogramChild:
     __slots__ = ('_labels', '_bounds', '_counts', '_sum', '_count', '_min',
-                 '_max', '_lock')
+                 '_max', '_ring', '_lock')
 
     def __init__(self, labels, bounds):
         self._labels = labels
@@ -130,6 +136,7 @@ class _HistogramChild:
         self._count = 0
         self._min = math.inf
         self._max = -math.inf
+        self._ring = [0.0] * RECENT_SAMPLES      # bounded sample ring
         self._lock = threading.Lock()
 
     def observe(self, value):
@@ -140,6 +147,7 @@ class _HistogramChild:
             i += 1
         with self._lock:
             self._counts[i] += 1
+            self._ring[self._count % RECENT_SAMPLES] = value
             self._sum += value
             self._count += 1
             if value < self._min:
@@ -147,13 +155,34 @@ class _HistogramChild:
             if value > self._max:
                 self._max = value
 
+    def percentile(self, q):
+        """Exact q-th percentile (0..100) over the last RECENT_SAMPLES
+        observations (linear interpolation, numpy convention); None when
+        empty. Exact — unlike inferring from exponential bucket edges,
+        which is off by up to the 3× bucket width for long-tail decode
+        latencies."""
+        with self._lock:
+            n = min(self._count, RECENT_SAMPLES)
+            samples = sorted(self._ring[:n])
+        if not samples:
+            return None
+        if len(samples) == 1:
+            return samples[0]
+        pos = (len(samples) - 1) * (float(q) / 100.0)
+        lo = int(pos)
+        hi = min(lo + 1, len(samples) - 1)
+        frac = pos - lo
+        return samples[lo] * (1.0 - frac) + samples[hi] * frac
+
     def sample(self):
         with self._lock:
+            n = min(self._count, RECENT_SAMPLES)
             return {'labels': self._labels, 'buckets': list(self._counts),
                     'bounds': list(self._bounds), 'sum': self._sum,
                     'count': self._count,
                     'min': None if self._count == 0 else self._min,
-                    'max': None if self._count == 0 else self._max}
+                    'max': None if self._count == 0 else self._max,
+                    'recent': sorted(self._ring[:n])}
 
 
 class Histogram(_Metric):
@@ -169,6 +198,9 @@ class Histogram(_Metric):
 
     def observe(self, value):
         self.labels().observe(value)
+
+    def percentile(self, q):
+        return self.labels().percentile(q)
 
 
 class MetricsRegistry:

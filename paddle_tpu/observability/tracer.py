@@ -105,6 +105,23 @@ class StepTracer:
             else:
                 self._events.append(ev)
 
+    def complete(self, name, start_perf, end_perf, **args):
+        """Append one already-measured complete event (ph 'X') from
+        explicit perf_counter stamps — distributed trace spans are often
+        measured retroactively (queue wait is known only at admission),
+        so they can't ride the context-manager path."""
+        ev = {'name': name, 'ph': 'X',
+              'ts': (start_perf - self._epoch) * 1e6,
+              'dur': max(0.0, end_perf - start_perf) * 1e6,
+              'pid': os.getpid(), 'tid': threading.get_ident()}
+        if args:
+            ev['args'] = {k: _jsonable(v) for k, v in args.items()}
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+            else:
+                self._events.append(ev)
+
     def instant(self, name, **args):
         """Zero-duration marker (ph 'i') — e.g. a nonfinite detection."""
         ev = {'name': name, 'ph': 'i', 's': 't',

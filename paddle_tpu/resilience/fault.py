@@ -19,6 +19,11 @@ each clause is ``<action>@<key>=<value>``:
 - ``hang@step=N`` — the step-N boundary blocks (default: effectively
   forever; add ``hang@secs=S`` to bound it), simulating a wedged step so
   the watchdog's dump-and-abort path is subprocess-testable.
+- ``slow@step=N`` — every step boundary from N onward sleeps
+  ``slow@secs=S`` (default 0.25) — a *straggler*, not a wedge: the host
+  keeps making progress but its step time inflates, which the fleet
+  straggler monitor (docs/OBSERVABILITY.md) must flag within one window.
+  Unlike ``hang`` this fires every step — real stragglers stay slow.
 
 Unknown actions or keys raise ``ValueError`` listing the supported clauses
 — a typo like ``kil@step=3`` must fail the run at injector construction,
@@ -56,7 +61,8 @@ class FaultInjector:
     hooks cost one attribute read."""
 
     SUPPORTED = ('kill@step=N, io_fail@times=N, io_fail@prob=P, nan@step=N, '
-                 'spike@step=N, hang@step=N, hang@secs=S')
+                 'spike@step=N, hang@step=N, hang@secs=S, slow@step=N, '
+                 'slow@secs=S')
 
     def __init__(self, spec=None, seed=None):
         self._kill_step = None
@@ -66,6 +72,8 @@ class FaultInjector:
         self._spike_step = None
         self._hang_step = None
         self._hang_secs = None        # None = effectively forever
+        self._slow_step = None
+        self._slow_secs = 0.25
         self._fired = set()           # single-fire step clauses by action
         self._rng = random.Random(
             int(seed if seed is not None
@@ -98,6 +106,10 @@ class FaultInjector:
                 self._hang_step = int(value)
             elif action == 'hang' and key == 'secs':
                 self._hang_secs = float(value)
+            elif action == 'slow' and key == 'step':
+                self._slow_step = int(value)
+            elif action == 'slow' and key == 'secs':
+                self._slow_secs = float(value)
             else:
                 raise ValueError(
                     f"{ENV_SPEC}: unknown clause {clause!r} (supported: "
@@ -129,6 +141,13 @@ class FaultInjector:
             _logger.warning('fault injection: hanging %.1fs at step %d',
                             secs, step)
             time.sleep(secs)
+        if self._slow_step is not None and step >= self._slow_step:
+            # straggler: EVERY boundary from here on pays the sleep —
+            # on_step runs before end_of_step's record_step stamp, so the
+            # inflation lands in this step's recorded duration
+            _obs.inc('fault_injections', site='slow_step',
+                     help='injected faults by site (PADDLE_TPU_FAULT_INJECT)')
+            time.sleep(self._slow_secs)
 
     def wants_loss(self, step):
         """Whether :meth:`on_loss` would alter the loss at `step` — lets the

@@ -137,6 +137,10 @@ class CheckpointManager:
         # FLEET_EXIT_CODE instead of 0
         self.fleet_poisoned = None
         self._rank = None              # resolved lazily (post-bootstrap)
+        # fleet telemetry (docs/OBSERVABILITY.md "Training fleet"): host 0
+        # folds every host's published snapshot and runs the straggler
+        # monitor; built lazily the first boundary the KV is configured
+        self._straggler = None
 
     # ------------------------------------------------------------------
     # fleet plumbing (fleet_runtime/)
@@ -467,9 +471,12 @@ class CheckpointManager:
         now = time.perf_counter()
         # the first boundary has no prior timestamp: the step still COUNTS
         # (lost-work deltas are in steps), its duration is just unknown
-        self.goodput.record_step(
-            now - self._last_boundary if self._last_boundary is not None
-            else 0.0)
+        step_time = (now - self._last_boundary
+                     if self._last_boundary is not None else None)
+        self.goodput.record_step(step_time if step_time is not None else 0.0)
+        if step_time is not None:
+            from ..observability import distributed as _dobs
+            _dobs.series('step_time').observe(step_time)
         sentinel = self._sentinel()
         if sentinel is not None:
             # fleet poison poll (docs/RESILIENCE.md "Fleet propagation"):
@@ -524,6 +531,7 @@ class CheckpointManager:
             cap_meta['goodput'] = self.goodput.meta()
             cap_meta['preempted'] = bool(preempt)
             self.save(step, arrays, cap_meta, block=preempt)
+        self._publish_fleet_telemetry(step, step_time)
         self._write_progress(step)
         self.goodput.export_metrics()
         self._last_boundary = time.perf_counter()
@@ -533,6 +541,35 @@ class CheckpointManager:
                          'stopping', step)
             return True
         return False
+
+    # ------------------------------------------------------------------
+    # fleet telemetry (docs/OBSERVABILITY.md "Training fleet")
+    # ------------------------------------------------------------------
+    def _publish_fleet_telemetry(self, step, step_time_s):
+        """Per-host metric snapshot through the coordinator KV at each
+        step boundary; host 0 folds the fleet aggregate + straggler
+        verdict into ``fleet_metrics.json`` beside the checkpoints.
+        Gated on the KV being configured — one env read when it isn't —
+        and never allowed to fail a training step."""
+        from ..fleet_runtime.coordinator import ENV_FLEET_DIR
+        if not os.environ.get(ENV_FLEET_DIR):
+            return
+        from ..observability import distributed as _dobs
+        try:
+            rank = self._rank_index()
+            _dobs.publish_host_snapshot(rank, step,
+                                        step_time_s=step_time_s)
+            if rank == 0:
+                if self._straggler is None:
+                    self._straggler = _dobs.StragglerMonitor(
+                        out_dir=self.directory)
+                _dobs.aggregate_fleet_snapshots(
+                    straggler=self._straggler,
+                    out_path=os.path.join(self.directory,
+                                          'fleet_metrics.json'),
+                    step=step)
+        except Exception as e:   # noqa: broad — telemetry must not kill a step
+            _logger.warning('fleet telemetry publish failed: %s', e)
 
     # ------------------------------------------------------------------
     # heartbeat

@@ -31,6 +31,7 @@ import time
 import numpy as np
 
 from .. import metrics as _m
+from ...observability import distributed as _dobs
 from ..engine import bucket_ladder
 from ..errors import InvalidRequest
 from .kv_cache import (CacheContext, KVCachePool, DEFAULT_BLOCK_SIZE,
@@ -258,6 +259,9 @@ class DecodeEngine:
         active = sum(t is not None for t in tables)
         _m.decode_slots_active.set(active)
         _m.decode_slot_occupancy.observe(active / max(S, 1))
+        # sliding-window views for /healthz slo + fleet snapshots
+        _dobs.series('occupancy').observe(active / max(S, 1))
+        _dobs.series('decode_step').observe(dt)
         if return_rows:
             return out, rows
         return out
@@ -319,6 +323,8 @@ class DecodeEngine:
         active = sum(t is not None for t in tables)
         _m.decode_slots_active.set(active)
         _m.decode_slot_occupancy.observe(active / max(S, 1))
+        _dobs.series('occupancy').observe(active / max(S, 1))
+        _dobs.series('decode_step').observe(dt)
         return rows
 
     def inject_prefill(self, table, payload):

@@ -43,6 +43,7 @@ import time
 import uuid
 
 from .. import metrics as _m
+from ...observability import distributed as _dobs
 from ..breaker import CircuitBreaker
 from ..errors import (DeadlineExceeded, EngineClosed, EngineUnhealthy,
                       InvalidRequest, Overloaded, OutOfBlocks, ServingError)
@@ -75,11 +76,12 @@ class GenerationStream:
     replaying the same id + params reproduces the token stream bitwise."""
 
     def __init__(self, prompt_len, max_new_tokens, replica_id=None,
-                 request_id=None):
+                 request_id=None, trace_id=None):
         self.prompt_len = prompt_len
         self.max_new_tokens = max_new_tokens
         self.replica_id = replica_id
         self.request_id = request_id or uuid.uuid4().hex[:16]
+        self.trace_id = trace_id
         self._q = queue.Queue()
         self._tokens = []
         self._done = threading.Event()
@@ -88,9 +90,13 @@ class GenerationStream:
 
     @property
     def meta(self):
-        """Result metadata: {'request_id', 'replica_id'} — stable from
-        submission, valid before/after completion."""
-        return {'request_id': self.request_id, 'replica_id': self.replica_id}
+        """Result metadata: {'request_id', 'replica_id'} (+ 'trace_id' for
+        sampled-trace requests) — stable from submission, valid
+        before/after completion."""
+        meta = {'request_id': self.request_id, 'replica_id': self.replica_id}
+        if self.trace_id is not None:
+            meta['trace_id'] = self.trace_id
+        return meta
 
     # -- consumer side -----------------------------------------------------
     def __iter__(self):
@@ -149,18 +155,26 @@ class _Request:
     __slots__ = ('prompt', 'max_new_tokens', 'eos_id', 'stream', 'deadline',
                  'enqueued_at', 'table', 'next_token', 'generated',
                  'pending_prompt', 'prefilling', 'handoff_pending',
-                 'sampling', 'sampler', 'history')
+                 'sampling', 'sampler', 'history', 'trace', 'enqueued_perf',
+                 'handoff_t0')
 
     def __init__(self, prompt, max_new_tokens, eos_id, deadline,
-                 replica_id=None, sampling=None, request_id=None):
+                 replica_id=None, sampling=None, request_id=None,
+                 trace=None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
-        self.stream = GenerationStream(len(prompt), max_new_tokens,
-                                       replica_id=replica_id,
-                                       request_id=request_id)
+        # distributed trace carrier (observability.TraceContext | None):
+        # spans recorded here parent under the router's dispatch span
+        self.trace = trace if (trace is not None and trace.sampled) else None
+        self.stream = GenerationStream(
+            len(prompt), max_new_tokens, replica_id=replica_id,
+            request_id=request_id,
+            trace_id=self.trace.trace_id if self.trace else None)
         self.deadline = deadline
         self.enqueued_at = time.monotonic()
+        self.enqueued_perf = time.perf_counter()
+        self.handoff_t0 = None
         self.table = None
         self.next_token = None        # sampled but not yet cached/emitted?
         self.generated = 0
@@ -252,7 +266,7 @@ class DecodeScheduler:
 
     # -- client side -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=16, eos_id=None,
-               timeout_ms=None, sampling=None, request_id=None):
+               timeout_ms=None, sampling=None, request_id=None, trace=None):
         """Validate and enqueue one generation; returns its
         :class:`GenerationStream`. Raises InvalidRequest / Overloaded /
         EngineUnhealthy (breaker open) / EngineClosed (all pre-enqueue).
@@ -261,7 +275,10 @@ class DecodeScheduler:
         validation happens HERE, pre-enqueue, naming the bad field.
         ``request_id``: optional client-pinned id; for sampled requests it
         seeds the stream, so resubmitting the same id + params replays the
-        exact token sequence (after a restart, on another replica, ...)."""
+        exact token sequence (after a restart, on another replica, ...).
+        ``trace``: optional :class:`observability.TraceContext` carried in
+        from the HTTP front end — queue-wait/prefill/per-token spans of
+        this generation are recorded under it (docs/OBSERVABILITY.md)."""
         if not self.breaker.allow():
             raise EngineUnhealthy('decode engine',
                                   self.breaker.consecutive_failures)
@@ -286,7 +303,9 @@ class DecodeScheduler:
         req = _Request(prompt, max_new,
                        self.engine.eos_id if eos_id is None else eos_id,
                        deadline, replica_id=self.replica_id,
-                       sampling=params, request_id=request_id)
+                       sampling=params, request_id=request_id, trace=trace)
+        if req.trace is not None:
+            _m.trace_requests_sampled.inc()
         with self._cv:
             if self._closing:
                 raise EngineClosed('decode scheduler is shutting down')
@@ -296,6 +315,7 @@ class DecodeScheduler:
             self._waiting.append(req)
             _m.decode_requests_accepted.inc()
             _m.decode_queue_depth.set(len(self._waiting))
+            _dobs.series('queue_depth').observe(len(self._waiting))
             self._cv.notify()
         return req.stream
 
@@ -357,7 +377,19 @@ class DecodeScheduler:
         if getattr(self.engine, 'prefix_cache', None) is not None:
             self.engine.publish_prefix(req.prompt, req.table)
 
+    def _trace_span(self, req, name, start_perf, end_perf, **args):
+        """Record one replica-side span of a traced request (no-op when the
+        request carries no sampled trace — one None check)."""
+        if req.trace is None:
+            return
+        _m.trace_spans_recorded.inc()
+        _dobs.record_span(req.trace.child(), name, start_perf, end_perf,
+                          request_id=req.stream.request_id,
+                          replica_id=self.replica_id, **args)
+
     def _prefill(self, req):
+        self._trace_span(req, 'replica/queue_wait', req.enqueued_perf,
+                        time.perf_counter())
         cached = getattr(req.table, 'cached_len', 0)
         if cached:
             # prefix-cache hit: the front of the table is already-filled
@@ -378,8 +410,10 @@ class DecodeScheduler:
             # a greedy first token, not logits, so the draw must happen
             # here where the row is
             req.handoff_pending = True
+            req.handoff_t0 = time.perf_counter()
             self.disagg.submit(req, req.prompt, req.max_new_tokens)
             return
+        t0 = time.perf_counter()
         try:
             if req.sampler is None:     # kwarg-free call: duck-typed
                 first = self.engine.prefill(req.prompt, req.table)
@@ -391,6 +425,8 @@ class DecodeScheduler:
             self._fail_request(req, e)
             self._record_engine_failure()
             return
+        self._trace_span(req, 'replica/prefill', t0, time.perf_counter(),
+                         prompt_len=len(req.prompt))
         self.breaker.record_success()
         self._publish(req)
         self._emit_token(req, first)
@@ -416,6 +452,10 @@ class DecodeScheduler:
                 self._fail_request(req, e)
                 self._record_engine_failure()
                 continue
+            if req.handoff_t0 is not None:
+                self._trace_span(req, 'replica/handoff_wait',
+                                 req.handoff_t0, time.perf_counter(),
+                                 prompt_len=len(req.prompt))
             self.breaker.record_success()
             self._publish(req)
             self._emit_token(req, first)
@@ -455,6 +495,11 @@ class DecodeScheduler:
         req.history.append(int(token))
         req.stream._emit(token)
         _m.decode_tokens_generated.inc()
+        _dobs.series('tokens').observe(1.0)
+        if req.generated == 1:
+            ttft = time.perf_counter() - req.enqueued_perf
+            _m.decode_ttft_seconds.observe(ttft)
+            _dobs.series('ttft').observe(ttft)
         if req.eos_id is not None and int(token) == int(req.eos_id):
             self._retire(req, 'stop')
         elif req.generated >= req.max_new_tokens:
@@ -500,6 +545,8 @@ class DecodeScheduler:
         rows = None
         need_rows = any(r.sampler is not None and not r.prefilling
                         for r in active)
+        traced = [r for r in active if r.trace is not None]
+        t0 = time.perf_counter() if traced else 0.0
         try:
             if need_rows:
                 out, rows = self.engine.decode_step(tokens, tables,
@@ -511,6 +558,7 @@ class DecodeScheduler:
                 self._fail_request(req, e)
             self._record_engine_failure()
             return True
+        t1 = time.perf_counter() if traced else 0.0
         self.breaker.record_success()
         for i, req in enumerate(self._slots):
             if req is None or req.handoff_pending:
@@ -527,6 +575,9 @@ class DecodeScheduler:
                 self._emit_token(req, self._pick_token(req, rows[i]))
             else:
                 self._emit_token(req, int(out[i]))
+            if req.trace is not None:
+                self._trace_span(req, 'replica/token', t0, t1,
+                                 index=req.generated - 1)
         return True
 
     def _spec_step(self):
@@ -577,6 +628,8 @@ class DecodeScheduler:
                             req.history, n)][:n]
                 toks = [req.next_token] + drafts
             fed[i] = toks
+        traced = [r for r in active if r.trace is not None]
+        t0 = time.perf_counter() if traced else 0.0
         try:
             rows = self.engine.spec_step(fed, tables)
         except Exception as e:
@@ -584,6 +637,7 @@ class DecodeScheduler:
                 self._fail_request(req, e)
             self._record_engine_failure()
             return True
+        t1 = time.perf_counter() if traced else 0.0
         self.breaker.record_success()
         for i, req in enumerate(self._slots):
             if req is None or req.handoff_pending:
@@ -614,6 +668,8 @@ class DecodeScheduler:
             if req.table is not None:
                 # commit the accepted prefix, roll back the rejected tail
                 req.table.context_len = bases[i] + emitted
+            self._trace_span(req, 'replica/verify_round', t0, t1,
+                             fed=f, emitted=emitted)
             _m.decode_spec_accept_len.observe(emitted)
             if drafted:
                 self._spec_drafted += drafted

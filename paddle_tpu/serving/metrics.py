@@ -325,6 +325,29 @@ router_rolling_restarts = _LazyMetric(
     'counter', 'router_rolling_restarts',
     'replicas restarted behind a drain by rolling_restart()')
 
+# fleet-wide observability (PR 17, docs/OBSERVABILITY.md "Fleet-wide")
+decode_ttft_seconds = _LazyMetric(
+    'histogram', 'decode_ttft_seconds',
+    'submit -> first emitted token per generation (time-to-first-token)')
+router_scrape_failures = _LazyMetric(
+    'counter', 'router_scrape_failures',
+    'replica /metrics scrapes that failed or timed out during a '
+    '/metrics/fleet aggregation (label replica)')
+router_fleet_scrapes = _LazyMetric(
+    'counter', 'router_fleet_scrapes',
+    '/metrics/fleet aggregations served')
+trace_requests_sampled = _LazyMetric(
+    'counter', 'trace_requests_sampled',
+    'requests that carried (router) or received (replica) a sampled '
+    'trace context')
+trace_spans_recorded = _LazyMetric(
+    'counter', 'trace_spans_recorded',
+    'distributed-trace spans recorded by this process')
+trace_clock_offset_seconds = _LazyMetric(
+    'gauge', 'trace_clock_offset_seconds',
+    'estimated replica-minus-router wall-clock offset from the health '
+    'handshake (label replica) — the trace-merge alignment input')
+
 # disaggregated prefill/decode (tier/disagg.py)
 disagg_handoffs = _LazyMetric(
     'counter', 'disagg_handoffs',

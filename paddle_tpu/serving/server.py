@@ -44,6 +44,8 @@ from .engine import InferenceEngine
 from .errors import (DeadlineExceeded, EngineClosed, EngineUnhealthy,
                      InvalidRequest, Overloaded)
 from ..log_helper import get_logger
+from ..observability import TraceContext
+from ..observability import distributed as _dobs
 
 __all__ = ['ServingServer', 'create_server']
 
@@ -92,18 +94,24 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         srv = self.server.serving
         if self.path == '/healthz':
+            # unix_time rides every healthz reply: the router's poll uses
+            # it for the clock-offset handshake that aligns this process's
+            # trace spans onto the router's timeline (trace_merge.py)
             if srv.draining:
-                self._reply(503, {'status': 'draining'})
+                self._reply(503, {'status': 'draining',
+                                  'unix_time': time.time()})
             elif srv.breaker_states():
                 # a tripped (or probing) circuit breaker: this replica is
                 # alive but should not receive traffic — 503 'degraded'
                 # evicts it from the balancer until the probe closes the
                 # breaker again (docs/SERVING.md "Circuit breaker")
                 self._reply(503, {'status': 'degraded',
-                                  'breakers': srv.breaker_states()})
+                                  'breakers': srv.breaker_states(),
+                                  'unix_time': time.time()})
             else:
                 body = {'status': 'ok', 'replica': srv.replica_id,
-                        'warmup': srv.warmup_status()}
+                        'warmup': srv.warmup_status(),
+                        'unix_time': time.time()}
                 if srv.engine is not None:
                     body['buckets'] = srv.engine.buckets
                     body['compiled'] = srv.engine.compiled_buckets
@@ -117,6 +125,9 @@ class _Handler(BaseHTTPRequestHandler):
                         'cache_blocks_total': eng.pool.allocator.capacity,
                         'prompt_buckets': eng.prompt_buckets,
                     }
+                slo = srv.slo_status()
+                if slo is not None:
+                    body['slo'] = slo
                 self._reply(200, body)
         elif self.path == '/metrics':
             from ..observability import registry
@@ -251,6 +262,12 @@ class _Handler(BaseHTTPRequestHandler):
                 f'unknown request field(s): {", ".join(unknown)}; '
                 f'supported: {", ".join(sorted(_GENERATE_KEYS))}'))
         sampling = {k: payload[k] for k in _SAMPLING_KEYS if k in payload}
+        try:
+            # distributed trace carrier (docs/OBSERVABILITY.md): absent
+            # header = untraced (one dict get); malformed = client bug, 400
+            trace = TraceContext.from_headers(self.headers)
+        except ValueError as e:
+            return self._error(400, InvalidRequest(str(e)))
         t0 = time.perf_counter()
         try:
             stream = srv.generator.submit(
@@ -259,7 +276,8 @@ class _Handler(BaseHTTPRequestHandler):
                 eos_id=payload.get('eos_id'),
                 timeout_ms=payload.get('timeout_ms'),
                 sampling=sampling or None,
-                request_id=payload.get('request_id'))
+                request_id=payload.get('request_id'),
+                trace=trace)
         except tuple(e for e, _ in _STATUS_BY_ERROR) as e:
             for etype, code in _STATUS_BY_ERROR:
                 if isinstance(e, etype):
@@ -358,6 +376,10 @@ class ServingServer:
             _logger.info('warmed decode engine: %s',
                          {k: round(s, 3) for k, s in timings.items()})
         self.request_timeout = request_timeout
+        # PADDLE_TPU_SLO monitor (strict parse fails construction, not the
+        # first /healthz) + span-record process label for trace merging
+        self._slo = _dobs.SLOMonitor.from_env()
+        _dobs.set_process_label(self.replica_id)
         self.draining = False
         self._shutdown_started = False
         self._shutdown_lock = threading.Lock()
@@ -393,6 +415,14 @@ class ServingServer:
             status['decode'] = self.generator.engine.warmed
         status['done'] = all(status.values()) if status else False
         return status
+
+    def slo_status(self):
+        """Evaluate the PADDLE_TPU_SLO clauses against the live windowed
+        series (None when no SLO is configured). Each evaluation also
+        drives the slo_ok gauges + slo_breaches burn counters."""
+        if self._slo is None:
+            return None
+        return self._slo.evaluate()
 
     def breaker_states(self):
         """{component: breaker state} for every NON-closed circuit breaker

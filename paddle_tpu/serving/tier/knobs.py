@@ -18,6 +18,13 @@ environment must fail the component that reads it, not every
 | ``PADDLE_TPU_SPEC_DECODE``             | ``0`` / ``1``          | DecodeEngine (``0`` is the hard escape hatch — wins over the constructor arg) |
 | ``PADDLE_TPU_SPEC_K``                  | int >= 2               | DecodeEngine (verify-window width) |
 | ``PADDLE_TPU_SPEC_DRAFTER``            | ``ngram`` / ``draft_model`` / ``off`` | DecodeScheduler |
+| ``PADDLE_TPU_TRACE_SAMPLE``            | float in [0, 1]        | router edge sampling (observability/trace_context.py) |
+| ``PADDLE_TPU_TRACE_DIR``               | directory path         | span-record JSONL output (observability/distributed.py) |
+| ``PADDLE_TPU_SLO``                     | ``<series>.<agg><op><value>,...`` | ServingServer /healthz (observability/distributed.py SLOMonitor) |
+
+The trace/SLO knobs' parsers live beside their consumers in
+``observability/`` (this package imports observability, never the
+reverse) but follow the same strict-parse contract.
 """
 from __future__ import annotations
 

@@ -24,6 +24,12 @@ LocalPrefillWorker beside the decode engine), and the speculative-decoding
 set ``PADDLE_TPU_SPEC_DECODE`` / ``PADDLE_TPU_SPEC_K`` (via DecodeEngine) +
 ``PADDLE_TPU_SPEC_DRAFTER`` (via DecodeScheduler) — also exposed as
 ``--spec-decode`` / ``--spec-k`` / ``--drafter`` CLI flags.
+
+Observability flows through the environment the launcher hands this
+process: ``PADDLE_TPU_TRACE_DIR`` makes the replica stream span records
+(named by its replica_id via the ServingServer process label) and
+``PADDLE_TPU_SLO`` adds the /healthz slo block — the ready line echoes
+``trace_dir`` so drills can assert the wiring took.
 """
 from __future__ import annotations
 
@@ -146,9 +152,12 @@ def main(argv=None):
         import os
         # the launcher (router test / bench / operator script) parses this
         # single stdout line to learn the bound port and pid
+        from ...observability.trace_context import ENV_TRACE_DIR
         print(json.dumps({'ready': True, 'port': srv.port,  # lint: allow-print (launcher handshake)
                           'pid': os.getpid(),
-                          'replica_id': scheduler.replica_id}), flush=True)
+                          'replica_id': scheduler.replica_id,
+                          'trace_dir': os.environ.get(ENV_TRACE_DIR)}),
+              flush=True)
         try:
             srv.serve_forever()
         finally:

@@ -31,8 +31,18 @@ dying replica kills only its in-flight streams; everything queued or new
 reroutes with zero drops (subprocess kill -9 tested,
 tests/framework/test_router_failover.py).
 
+Observability (docs/OBSERVABILITY.md): the router is the trace EDGE —
+``maybe_sample()`` decides once per request, the context rides the
+``X-PaddleTPU-Trace`` header to the replica, and the router records the
+request root / per-attempt dispatch / retry spans around the replica's
+spans. ``GET /metrics/fleet`` serves the replicas' merged Prometheus
+export (counter-sum / gauge-label / bucket-merge), and the health poll
+doubles as the clock handshake trace_merge.py aligns timelines with.
+
 Strict-parse knobs (tier/knobs.py): ``PADDLE_TPU_ROUTER_REPLICAS``,
-``PADDLE_TPU_ROUTER_PORT``, ``PADDLE_TPU_ROUTER_HEALTH_POLL_S``.
+``PADDLE_TPU_ROUTER_PORT``, ``PADDLE_TPU_ROUTER_HEALTH_POLL_S``; plus
+``PADDLE_TPU_TRACE_SAMPLE`` / ``PADDLE_TPU_TRACE_DIR``
+(observability/trace_context.py).
 """
 from __future__ import annotations
 
@@ -48,6 +58,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .. import metrics as _m
 from ..errors import InvalidRequest, NoReplicaAvailable
 from ...log_helper import get_logger
+from ...observability import distributed as _dobs
+from ...observability.trace_context import maybe_sample
 from .knobs import (ENV_ROUTER_HEALTH_POLL_S, ENV_ROUTER_PORT,
                     ENV_ROUTER_REPLICAS, parse_float_env, parse_int_env,
                     parse_replicas_env)
@@ -87,6 +99,14 @@ _logger = get_logger(
     __name__, logging.INFO,
     fmt='%(asctime)s-%(levelname)s: [router] %(message)s')
 
+
+def _span(ctx, name, start_perf, end_perf, **args):
+    """Router-side span record; free (one None check) when untraced."""
+    if ctx is None:
+        return
+    _m.trace_spans_recorded.inc()
+    _dobs.record_span(ctx, name, start_perf, end_perf, **args)
+
 #: dispatch failures that are the REPLICA's fault → retry elsewhere.
 #: 4xx (bad request, overload backpressure, deadline) are the CLIENT's
 #: contract with the tier and propagate unchanged.
@@ -105,6 +125,11 @@ class Replica:
         self.reported_load = 0        # decode active + waiting at last poll
         self.inflight = 0             # router-side, updated at dispatch
         self.last_poll_ok = 0.0
+        # clock handshake (docs/OBSERVABILITY.md): estimated replica-unix
+        # minus router-unix, from the health poll's RTT midpoint — what
+        # trace_merge.py uses to align this replica's spans
+        self.clock_offset = None
+        self.replica_id = None        # reported by /healthz when available
         self._lock = threading.Lock()
 
     def load(self):
@@ -154,20 +179,30 @@ class RoutedGeneration:
         self.replica = None           # url actually streaming
         self.retries = 0              # reroutes before streaming began
         self.first_event_at = None
+        # sampling is decided ONCE here at the edge; the context travels
+        # with every dispatch so a trace is complete or absent
+        self.trace = maybe_sample()
+        if self.trace is not None:
+            _m.trace_requests_sampled.inc()
 
     def events(self):
         router, payload = self._router, self._payload
         deadline = time.monotonic() + self._timeout
         tried = set()
+        req_t0 = time.perf_counter()
         while True:
             rep = router._pick(tried, deadline)
             self.replica = rep.url
             rep.begin()
             t0 = time.perf_counter()
             emitted = False
+            # each dispatch attempt is its own span under the request
+            # root; its id is what the replica parents its spans under
+            attempt = self.trace.child() if self.trace is not None else None
             try:
                 try:
-                    resp = router._post(rep, payload, self._timeout)
+                    resp = router._post(rep, payload, self._timeout,
+                                        trace=attempt)
                 except urllib.error.HTTPError as e:
                     if e.code in _REROUTE_HTTP_CODES:
                         raise ConnectionError(f'replica replied {e.code}')
@@ -181,11 +216,27 @@ class RoutedGeneration:
                     if event.get('done'):
                         event['replica'] = rep.url
                         event['retries'] = self.retries
+                        if self.trace is not None:
+                            event.setdefault('trace_id',
+                                             self.trace.trace_id)
+                            # spans must land BEFORE the done yield: the
+                            # consumer may drop the generator right after
+                            now = time.perf_counter()
+                            _span(attempt, 'router/dispatch', t0, now,
+                                  replica=rep.url)
+                            _span(self.trace, 'router/request', req_t0,
+                                  now, retries=self.retries)
                         _m.router_requests_completed.inc()
                         yield event
                         return
                     if 'error' in event:      # replica-side typed failure
                         _m.router_requests_failed.inc()
+                        now = time.perf_counter()
+                        _span(attempt, 'router/dispatch', t0, now,
+                              replica=rep.url, error=event.get('error'))
+                        _span(self.trace, 'router/request', req_t0, now,
+                              retries=self.retries,
+                              error=event.get('error'))
                         yield event
                         return
                     yield event
@@ -202,6 +253,11 @@ class RoutedGeneration:
                     # tokens already forwarded: this stream dies with its
                     # replica (the only thing a replica death may kill)
                     _m.router_requests_failed.inc()
+                    now = time.perf_counter()
+                    _span(attempt, 'router/dispatch', t0, now,
+                          replica=rep.url, error='ReplicaDied')
+                    _span(self.trace, 'router/request', req_t0, now,
+                          retries=self.retries, error='ReplicaDied')
                     yield {'error': 'ReplicaDied',
                            'message': f'replica {rep.url} failed '
                                       f'mid-stream: {e}',
@@ -211,6 +267,10 @@ class RoutedGeneration:
                 tried.add(rep)
                 self.retries += 1
                 _m.router_requests_rerouted.inc()
+                # the failed attempt becomes a retry span — the failover
+                # drill asserts this sits between the two replicas' spans
+                _span(attempt, 'router/retry', t0, time.perf_counter(),
+                      replica=rep.url, error=str(e))
                 _logger.warning('rerouting (attempt %d) off %s: %s',
                                 self.retries + 1, rep.url, e)
             finally:
@@ -232,6 +292,7 @@ class Router:
                               else float(health_poll_s))
         self.request_timeout = float(request_timeout)
         self.connect_timeout = float(connect_timeout)
+        _dobs.set_process_label('router')
         self._closed = threading.Event()
         self.poll_once()              # constructor returns with fresh state
         self._poll_thread = threading.Thread(
@@ -244,9 +305,11 @@ class Router:
     def _poll_replica(self, rep):
         _m.router_health_polls.inc()
         try:
+            u0 = time.time()
             with urllib.request.urlopen(rep.url + '/healthz',
                                         timeout=self.connect_timeout) as r:
                 body = json.load(r)
+            u1 = time.time()
             rep.healthy = body.get('status') == 'ok'
             rep.half_open = False
             warm = body.get('warmup')
@@ -256,6 +319,16 @@ class Router:
             rep.reported_load = (int(decode.get('active', 0))
                                  + int(decode.get('waiting', 0)))
             rep.last_poll_ok = time.monotonic()
+            rep.replica_id = body.get('replica') or rep.replica_id
+            if 'unix_time' in body:
+                # handshake offset estimate: the replica stamped its clock
+                # somewhere inside [u0, u1]; the RTT midpoint is the
+                # minimum-bias guess (error bounded by RTT/2)
+                rep.clock_offset = float(body['unix_time']) - (u0 + u1) / 2.0
+                _m.trace_clock_offset_seconds.labels(
+                    replica=rep.replica_id or rep.url).set(rep.clock_offset)
+                _dobs.record_clock_offset(rep.replica_id or rep.url,
+                                          rep.clock_offset, rtt_s=u1 - u0)
         except urllib.error.HTTPError as e:
             try:
                 body = json.load(e)
@@ -307,11 +380,43 @@ class Router:
             time.sleep(min(0.2, self.health_poll_s))
             self.poll_once()
 
-    def _post(self, rep, payload, timeout):
+    def _post(self, rep, payload, timeout, trace=None):
+        headers = {'Content-Type': 'application/json'}
+        if trace is not None:
+            headers.update(trace.to_headers())
         req = urllib.request.Request(
             rep.url + '/generate', data=json.dumps(payload).encode(),
-            headers={'Content-Type': 'application/json'})
+            headers=headers)
         return urllib.request.urlopen(req, timeout=timeout)
+
+    # -- fleet metrics -----------------------------------------------------
+    def scrape_replica_metrics(self, timeout_s=2.0):
+        """Scrape every replica's ``/metrics``; → ``[(label, text), ...]``
+        for the scrapes that succeeded. A dead or wedged replica costs one
+        bounded timeout and a ``router_scrape_failures`` tick — never a
+        fleet-scrape failure (the kill -9 hardening contract)."""
+        scrapes = []
+        for rep in self.replicas:
+            label = rep.replica_id or rep.url
+            try:
+                with urllib.request.urlopen(rep.url + '/metrics',
+                                            timeout=timeout_s) as r:
+                    scrapes.append((label,
+                                    r.read().decode('utf-8', 'replace')))
+            except (OSError, ValueError) as e:
+                _m.router_scrape_failures.labels(replica=label).inc()
+                _logger.warning('fleet scrape of %s failed: %s',
+                                rep.url, e)
+        return scrapes
+
+    def fleet_metrics_text(self, timeout_s=2.0):
+        """Merged replica-labeled Prometheus text for ``/metrics/fleet``
+        (docs/OBSERVABILITY.md "Aggregation semantics"). Router-local
+        metrics stay on ``/metrics`` — this is the REPLICAS' merged
+        view, so the two exports never double-count."""
+        _m.router_fleet_scrapes.inc()
+        return _dobs.merge_fleet_metrics(
+            self.scrape_replica_metrics(timeout_s))
 
     # -- client API --------------------------------------------------------
     def stream_generate(self, prompt, max_new_tokens=16, eos_id=None,
@@ -376,13 +481,19 @@ class Router:
         deadline = time.monotonic() + timeout
         tried = set()
         retries = 0
+        trace = maybe_sample()        # edge decision, as in events()
+        if trace is not None:
+            _m.trace_requests_sampled.inc()
+        req_t0 = time.perf_counter()
         while True:
             rep = self._pick(tried, deadline)
             rep.begin()
             t0 = time.perf_counter()
+            attempt = trace.child() if trace is not None else None
             try:
                 try:
-                    with self._post(rep, payload, timeout) as resp:
+                    with self._post(rep, payload, timeout,
+                                    trace=attempt) as resp:
                         body = json.load(resp)
                 except urllib.error.HTTPError as e:
                     if e.code in _REROUTE_HTTP_CODES:
@@ -391,6 +502,13 @@ class Router:
                 _m.router_dispatch_seconds.observe(time.perf_counter() - t0)
                 body['replica'] = rep.url
                 body['retries'] = retries
+                if trace is not None:
+                    body.setdefault('trace_id', trace.trace_id)
+                    now = time.perf_counter()
+                    _span(attempt, 'router/dispatch', t0, now,
+                          replica=rep.url)
+                    _span(trace, 'router/request', req_t0, now,
+                          retries=retries)
                 _m.router_requests_completed.inc()
                 return body
             except urllib.error.HTTPError:
@@ -401,6 +519,8 @@ class Router:
                 tried.add(rep)
                 retries += 1
                 _m.router_requests_rerouted.inc()
+                _span(attempt, 'router/retry', t0, time.perf_counter(),
+                      replica=rep.url, error=str(e))
                 _logger.warning('retrying non-streamed request off %s: %s',
                                 rep.url, e)
             finally:
@@ -499,6 +619,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
             from ...observability import registry
             self._reply(200, registry.prometheus_text().encode(),
                         content_type='text/plain; version=0.0.4')
+        elif self.path == '/metrics/fleet':
+            self._reply(200, router.fleet_metrics_text().encode(),
+                        content_type='text/plain; version=0.0.4')
         else:
             self._reply(404, {'error': 'NotFound', 'message': self.path})
 
@@ -539,13 +662,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 final = events[-1] if events else {}
                 if 'error' in final and not final.get('done'):
                     return self._reply(502, final)
-                return self._reply(200, {
+                reply = {
                     'tokens': final.get('tokens', []),
                     'finish_reason': final.get('finish_reason'),
                     'replica': final.get('replica'),
                     'retries': final.get('retries', 0),
                     'request_id': final.get('request_id'),
-                    'replica_id': final.get('replica_id')})
+                    'replica_id': final.get('replica_id')}
+                if 'trace_id' in final:   # sampled: hand the id back
+                    reply['trace_id'] = final['trace_id']
+                return self._reply(200, reply)
             # prime the FIRST event before committing the 200: replica 4xx /
             # no-replica failures raise here, while an error reply is still
             # possible on the wire

@@ -1,0 +1,133 @@
+"""Training-fleet observability drill (ISSUE 17): a fault.py-injected
+slow host must be NAMED by the straggler monitor within one aggregation
+window of the fault firing, through the REAL spine — wall-timed steps →
+per-host snapshot publish over the coordinator KV (file mirror) →
+host-0 aggregation → ``straggler_*`` gauges + quarantine JSONL. Clean
+fleets stay quiet."""
+import json
+import os
+import time
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.fleet_runtime.coordinator import ENV_FLEET_DIR
+from paddle_tpu.observability import distributed as dobs
+from paddle_tpu.resilience.fault import FaultInjector
+
+_HOSTS = 4
+_SLOW_RANK = 1
+_SLOW_STEP = 2
+
+
+@pytest.fixture(autouse=True)
+def _fresh(tmp_path, monkeypatch):
+    monkeypatch.setenv(ENV_FLEET_DIR, str(tmp_path / 'fleet'))
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _run_fleet_steps(steps, fault_spec_for_rank, straggler, out_dir,
+                     base_step_s=0.002):
+    """Drive _HOSTS simulated ranks through `steps` lock-steps: each rank
+    runs its fault injector's on_step hook (wall-timed — exactly where
+    the resilience manager measures), publishes its snapshot, and rank 0
+    aggregates. Returns the last aggregate document."""
+    injectors = {rank: FaultInjector(fault_spec_for_rank(rank))
+                 for rank in range(_HOSTS)}
+    fleet = None
+    for step in range(1, steps + 1):
+        for rank in range(_HOSTS):
+            t0 = time.perf_counter()
+            injectors[rank].on_step(step)
+            step_time = base_step_s + (time.perf_counter() - t0)
+            dobs.publish_host_snapshot(rank, step, step_time_s=step_time)
+        fleet = dobs.aggregate_fleet_snapshots(
+            straggler=straggler,
+            out_path=os.path.join(out_dir, 'fleet_metrics.json'),
+            step=step)
+    return fleet
+
+
+def test_fault_injected_slow_host_is_named_within_one_window(tmp_path):
+    """``slow@step=N`` on one rank (every step ≥ N stays slow — a real
+    straggler, not a blip): the very next host-0 aggregation after the
+    fault fires must flag that host."""
+    out = str(tmp_path / 'run')
+    os.makedirs(out)
+    straggler = dobs.StragglerMonitor(out_dir=out)
+    spec = ('slow@step=%d,slow@secs=0.15' % _SLOW_STEP)
+
+    def fault_for(rank):
+        return spec if rank == _SLOW_RANK else ''
+
+    flagged_at = None
+    injectors = {rank: FaultInjector(fault_for(rank))
+                 for rank in range(_HOSTS)}
+    for step in range(1, _SLOW_STEP + 3):
+        for rank in range(_HOSTS):
+            t0 = time.perf_counter()
+            injectors[rank].on_step(step)
+            step_time = 0.002 + (time.perf_counter() - t0)
+            dobs.publish_host_snapshot(rank, step, step_time_s=step_time)
+        fleet = dobs.aggregate_fleet_snapshots(
+            straggler=straggler,
+            out_path=os.path.join(out, 'fleet_metrics.json'), step=step)
+        if fleet['straggler']['stragglers']:
+            flagged_at = step
+            break
+    # named within ONE aggregation window of the fault firing at step 2
+    assert flagged_at == _SLOW_STEP
+    assert fleet['straggler']['stragglers'] == [str(_SLOW_RANK)]
+    assert fleet['straggler']['zscores'][str(_SLOW_RANK)] > 3.5
+
+    # the quarantine-style JSONL names the host, with the z that flagged it
+    recs = [json.loads(line) for line in
+            open(os.path.join(out, 'straggler.jsonl'))]
+    assert recs[0]['host'] == str(_SLOW_RANK)
+    assert recs[0]['step'] == _SLOW_STEP
+    assert recs[0]['zscore'] > 3.5
+
+    # gauges for dashboards: straggler_count + per-host zscores
+    reg = obs.registry.to_dict()
+    assert reg['straggler_count']['samples'][0]['value'] == 1
+    z = {s['labels']['host']: s['value']
+         for s in reg['straggler_zscore']['samples']}
+    assert z[str(_SLOW_RANK)] > 3.5 > z['0']
+
+    # the exported fleet doc mirrors the aggregate (ops surface)
+    doc = json.load(open(os.path.join(out, 'fleet_metrics.json')))
+    assert doc['hosts'] == list(range(_HOSTS))
+    assert doc['straggler']['stragglers'] == [str(_SLOW_RANK)]
+    assert str(_SLOW_RANK) in doc['step_time_s']
+
+
+def test_clean_fleet_stays_quiet(tmp_path):
+    out = str(tmp_path / 'run')
+    os.makedirs(out)
+    straggler = dobs.StragglerMonitor(out_dir=out)
+    fleet = _run_fleet_steps(5, lambda rank: '', straggler, out)
+    assert fleet['straggler']['stragglers'] == []
+    assert not os.path.exists(os.path.join(out, 'straggler.jsonl'))
+    assert obs.registry.to_dict()[
+        'straggler_count']['samples'][0]['value'] == 0
+    # snapshots flowed: every host published and was folded in
+    assert fleet['hosts'] == list(range(_HOSTS))
+    assert len(fleet['step_time_s']) == _HOSTS
+
+
+def test_fleet_aggregate_counter_and_gauge_semantics():
+    """The KV aggregate mirrors merge_fleet_metrics semantics: counters
+    sum across hosts, gauges stay per-host facts."""
+    obs.registry.counter('fleet_drill_ticks', 'x').inc(3)
+    obs.registry.gauge('fleet_drill_level', 'x').set(0.5)
+    for rank in range(2):
+        dobs.publish_host_snapshot(rank, step=1, step_time_s=0.01)
+    fleet = dobs.aggregate_fleet_snapshots()
+    # the same registry published twice ⇒ the fleet counter is the sum
+    assert fleet['counters']['fleet_drill_ticks'] == 6.0
+    assert fleet['gauges']['fleet_drill_level'] == {
+        'host0': 0.5, 'host1': 0.5}
+    # windowed series ride along per host for fleet dashboards
+    assert set(fleet['series']) == {'host0', 'host1'}

@@ -256,6 +256,60 @@ def measure_failover(model, lock, work, refs):
             'rerouted': int(_counter('router_requests_rerouted') - r0)}
 
 
+def measure_trace_overhead(model, lock, work, refs):
+    """Tracing A/B (PERF.md §22): the SAME serial request sweep through
+    the router with ``PADDLE_TPU_TRACE_SAMPLE=0`` (production default)
+    vs ``=1`` plus span records on. The untraced path must do zero span
+    work — asserted structurally (``spans_off == 0``) — so the measured
+    off-vs-on p50 gap is the full cost of tracing a request, a hard
+    upper bound on what the disabled path can cost."""
+    import tempfile
+    from paddle_tpu.observability import distributed as _dobs
+    from paddle_tpu.observability.trace_context import (ENV_TRACE_DIR,
+                                                        ENV_TRACE_SAMPLE)
+    from paddle_tpu.serving.tier import Router
+    rep = _Replica(model, lock, 'trace-ab')
+    saved = {k: os.environ.get(k)
+             for k in (ENV_TRACE_SAMPLE, ENV_TRACE_DIR)}
+    p50, spans, ok = {}, {}, {}
+    try:
+        with tempfile.TemporaryDirectory() as td, \
+                Router([rep.url], health_poll_s=0.3) as router:
+            for mode, env in (('off', {ENV_TRACE_SAMPLE: '0'}),
+                              ('on', {ENV_TRACE_SAMPLE: '1',
+                                      ENV_TRACE_DIR: td})):
+                os.environ.update(env)
+                for prompt, max_new in work[:2]:     # warm the HTTP path
+                    router.generate(prompt, max_new_tokens=max_new,
+                                    timeout=120)
+                s0 = _counter('trace_spans_recorded')
+                lat, good = [], True
+                for i, (prompt, max_new) in enumerate(work):
+                    t0 = time.perf_counter()
+                    fin = router.generate(prompt, max_new_tokens=max_new,
+                                          timeout=120)
+                    lat.append(time.perf_counter() - t0)
+                    good = good and fin['tokens'] == refs[i]
+                p50[mode] = _percentile(lat, 50)
+                spans[mode] = int(_counter('trace_spans_recorded') - s0)
+                ok[mode] = good
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _dobs.reset_distributed()     # drop the recorder bound to td
+        rep.shutdown()
+    return {'bench': 'serving_tier_trace_overhead',
+            'requests': len(work),
+            'p50_off_ms': round(p50['off'] * 1e3, 2),
+            'p50_on_ms': round(p50['on'] * 1e3, 2),
+            'on_over_off': round(p50['on'] / max(p50['off'], 1e-9), 3),
+            'spans_off': spans['off'], 'spans_on': spans['on'],
+            'bitwise_equal': ok['off'] and ok['on']}
+
+
 def measure_all(smoke=False, seed=0):
     import threading as _t
     from paddle_tpu.dygraph import guard
@@ -284,7 +338,26 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--smoke', action='store_true',
                     help='CI sizes: fewer/shorter generations')
+    ap.add_argument('--trace-ab', action='store_true',
+                    help='also measure request p50 with trace sampling '
+                         'off vs on (PERF.md §22)')
     args = ap.parse_args()
+    if args.trace_ab:
+        import threading as _t
+        from paddle_tpu.dygraph import guard
+        from paddle_tpu.models.causal_lm import greedy_generate
+        from paddle_tpu.serving.tier.replica import build_tiny_lm
+        n = 8 if args.smoke else 24
+        with guard():
+            model = build_tiny_lm()
+            work = build_shared_prompt_work(n)
+            pad = -(-(16 + 16) // 4) * 4
+            refs = [greedy_generate(model, p, m, pad_len=pad)
+                    for p, m in work]
+            res = measure_trace_overhead(model, _t.RLock(), work, refs)
+        print(json.dumps(res), flush=True)
+        sys.exit(0 if (res['bitwise_equal'] and res['spans_off'] == 0
+                       and res['spans_on'] > 0) else 1)
     results = measure_all(smoke=args.smoke)
     for section in results.values():
         print(json.dumps(section), flush=True)

@@ -361,6 +361,64 @@ def summarize(metrics, trace, steps, top=10):
                 f"{int(hf)} failed")
         lines.append('')
 
+    # ---- fleet-wide tier observability (docs/OBSERVABILITY.md) ----
+    fleet_scrapes = _counter(metrics, 'router_fleet_scrapes')
+    sampled = _counter(metrics, 'trace_requests_sampled')
+    ttft = (metrics.get('decode_ttft_seconds') or {}).get('samples', [])
+    if fleet_scrapes or sampled or (ttft and ttft[0]['count']):
+        lines.append('## Tier (fleet-wide)')
+        if fleet_scrapes:
+            sfails = _counter(metrics, 'router_scrape_failures')
+            lines.append(f"/metrics/fleet:        {int(fleet_scrapes)} "
+                         f"aggregation(s), {int(sfails)} failed replica "
+                         f"scrape(s)")
+        offs = _gauge_by_label(metrics, 'trace_clock_offset_seconds',
+                               'replica')
+        if offs:
+            lines.append(
+                "clock offsets:         "
+                + ', '.join(f'{r}: {v * 1e3:+.1f}ms'
+                            for r, v in sorted(offs.items()))
+                + '  (health-handshake estimate, trace_merge.py input)')
+        if sampled:
+            lines.append(
+                f"tracing:               {int(sampled)} sampled "
+                f"request(s), "
+                f"{int(_counter(metrics, 'trace_spans_recorded'))} "
+                f"span(s) recorded")
+        if ttft and ttft[0]['count']:
+            s = ttft[0]
+            lines.append(f"TTFT:                  {s['count']} "
+                         f"request(s), mean {_ms(s['sum'] / s['count'])}, "
+                         f"max {_ms(s['max'] or 0)}")
+        lines.append('')
+
+    # ---- straggler / SLO monitors (docs/OBSERVABILITY.md) ----
+    zscores = _gauge_by_label(metrics, 'straggler_zscore', 'host')
+    slo_ok = _gauge_by_label(metrics, 'slo_ok', 'slo')
+    if zscores or slo_ok:
+        lines.append('## Straggler / SLO')
+        if zscores:
+            flagged = _counter(metrics, 'straggler_flags')
+            count = (metrics.get('straggler_count')
+                     or {}).get('samples', [])
+            lines.append(
+                f"straggler monitor:     "
+                f"{int(count[0]['value']) if count else 0} host(s) "
+                f"currently flagged, {int(flagged)} cumulative detection(s)")
+            lines.append(
+                "host z-scores:         "
+                + ', '.join(f'{h}: {z:+.2f}'
+                            for h, z in sorted(zscores.items())))
+        if slo_ok:
+            burns = _gauge_by_label(metrics, 'slo_breaches', 'slo')
+            for clause, ok in sorted(slo_ok.items()):
+                state = 'OK' if ok else 'BREACHED'
+                lines.append(
+                    f"slo {clause:<18} {state} "
+                    f"({int(burns.get(clause, 0))} breach evaluation(s))")
+        lines.append('')
+
     # ---- memory plan (analysis/plan.py, docs/ANALYSIS.md) ----
     def _gauge(name):
         s = (metrics.get(name) or {}).get('samples', [])

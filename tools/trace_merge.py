@@ -1,0 +1,246 @@
+"""Merge per-process distributed span records into ONE chrome timeline.
+
+Each traced process streams ``spans-<pid>.jsonl`` into
+``PADDLE_TPU_TRACE_DIR`` (observability/distributed.py): a first-line
+``clock`` record, one ``span`` record per completed span, and — on the
+router — ``offset`` records carrying the health-handshake estimate of
+each replica's (replica_unix − router_unix) clock offset. This tool
+folds N such files into one chrome-trace JSON:
+
+- every process's spans are shifted onto the OFFSET RECORDER's clock
+  (``aligned_start = start_unix − offset[process]``), so a replica whose
+  wall clock runs 5s fast still nests correctly inside the router's
+  dispatch span;
+- parent links are validated: every ``parent_span_id`` must resolve to
+  a recorded span — the e2e failover drill asserts zero dangling
+  parents across a router + two replicas + a kill -9;
+- each process becomes one chrome "process" lane (``process_name``
+  metadata), spans become ``X`` events tagged trace_id/span_id.
+
+Usage::
+
+    python tools/trace_merge.py <trace_dir | spans-*.jsonl ...> \
+        [--out merged.json] [--trace-id ID]
+    python tools/trace_merge.py --smoke      # self-check, prints JSON
+
+``--smoke`` synthesizes two processes with a KNOWN injected clock skew
+and verifies the merge re-aligns them (tier-1 gate).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_span_file(path):
+    """→ ``{'clock': ..., 'spans': [...], 'offsets': [...]}`` from one
+    spans JSONL file; torn tails (a kill -9 mid-line) are skipped."""
+    out = {'clock': None, 'spans': [], 'offsets': []}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue              # torn final line of a killed process
+            if 'clock' in rec and out['clock'] is None:
+                out['clock'] = rec['clock']
+            elif 'span' in rec:
+                out['spans'].append(rec['span'])
+            elif 'offset' in rec:
+                out['offsets'].append(rec['offset'])
+    return out
+
+
+def merge_span_files(paths, trace_id=None):
+    """Merge N span files → ``(chrome_doc, summary)``.
+
+    `summary` carries the validation verdict: span/process/trace counts,
+    the offset table applied, and ``unresolved_parents`` — span ids whose
+    parent was never recorded (0 on a correct propagation chain)."""
+    docs = [load_span_file(p) for p in paths]
+
+    # offset table: the recording process (router / host 0) measured
+    # everyone else's clock against its own; it is itself the reference.
+    offsets = {}
+    for doc in docs:
+        for off in doc['offsets']:
+            # last write wins: offsets re-estimate every health poll
+            offsets[str(off['process'])] = float(off['offset_s'])
+        if doc['offsets'] and doc['clock']:
+            offsets.setdefault(str(doc['clock']['process']), 0.0)
+
+    spans = []
+    processes = []                    # label order = chrome pid order
+    for doc in docs:
+        label = str(doc['clock']['process']) if doc['clock'] else '?'
+        if label not in processes:
+            processes.append(label)
+        for span in doc['spans']:
+            span = dict(span)
+            span.setdefault('process', label)
+            if trace_id is not None and span.get('trace_id') != trace_id:
+                continue
+            span['aligned_start'] = (span['start_unix']
+                                     - offsets.get(span['process'], 0.0))
+            spans.append(span)
+    spans.sort(key=lambda s: s['aligned_start'])
+
+    by_id = {s['span_id']: s for s in spans}
+    unresolved = sorted({s['span_id'] for s in spans
+                         if s.get('parent_span_id')
+                         and s['parent_span_id'] not in by_id})
+
+    pid_of = {label: i for i, label in enumerate(processes)}
+    t0 = spans[0]['aligned_start'] if spans else 0.0
+    events = [{'name': 'process_name', 'ph': 'M', 'pid': pid,
+               'tid': 0, 'args': {'name': label}}
+              for label, pid in sorted(pid_of.items(),
+                                       key=lambda kv: kv[1])]
+    for s in spans:
+        args = dict(s.get('args') or {})
+        args['trace_id'] = s.get('trace_id')
+        args['span_id'] = s['span_id']
+        if s.get('parent_span_id'):
+            args['parent_span_id'] = s['parent_span_id']
+        events.append({
+            'name': s['name'], 'ph': 'X',
+            'ts': (s['aligned_start'] - t0) * 1e6,
+            'dur': max(0.0, s['dur_s']) * 1e6,
+            'pid': pid_of.get(s.get('process', '?'), 0), 'tid': 0,
+            'args': args})
+
+    chrome = {'traceEvents': events,
+              'otherData': {'aligned_by': 'paddle_tpu trace_merge',
+                            'offsets_s': offsets,
+                            'epoch_unix': t0}}
+    summary = {'files': len(paths), 'processes': processes,
+               'spans': len(spans),
+               'traces': len({s.get('trace_id') for s in spans}),
+               'offsets_s': offsets,
+               'unresolved_parents': unresolved}
+    return chrome, summary
+
+
+def spans_for_trace(chrome, trace_id):
+    """Convenience for drills: the merged X events of one trace,
+    time-ordered."""
+    return sorted((e for e in chrome['traceEvents']
+                   if e['ph'] == 'X'
+                   and e['args'].get('trace_id') == trace_id),
+                  key=lambda e: e['ts'])
+
+
+# ---------------------------------------------------------------------------
+# --smoke: synthesize two skewed processes, verify re-alignment
+# ---------------------------------------------------------------------------
+
+_SMOKE_SKEW_S = 5.0                   # replica clock runs 5s fast
+
+
+def _smoke(tmpdir):
+    """Two synthetic processes: a 'router' whose dispatch span covers a
+    'replica' span, with the replica's wall clock skewed +5s. Without
+    offset correction the replica span lands 5s OUTSIDE its parent;
+    the merge must pull it back inside."""
+    base = 1700000000.0
+    tid, root, disp, rspan = 'a' * 16, 'b' * 16, 'c' * 16, 'd' * 16
+    router = [
+        {'clock': {'pid': 1, 'process': 'router', 'unix_time': base,
+                   'perf_counter': 0.0}},
+        {'offset': {'process': 'replica-a', 'offset_s': _SMOKE_SKEW_S,
+                    'rtt_s': 0.001, 'unix_time': base}},
+        {'span': {'name': 'router/request', 'trace_id': tid,
+                  'span_id': root, 'parent_span_id': None,
+                  'start_unix': base, 'dur_s': 1.0, 'process': 'router'}},
+        {'span': {'name': 'router/dispatch', 'trace_id': tid,
+                  'span_id': disp, 'parent_span_id': root,
+                  'start_unix': base + 0.1, 'dur_s': 0.8,
+                  'process': 'router'}},
+    ]
+    replica = [
+        {'clock': {'pid': 2, 'process': 'replica-a',
+                   'unix_time': base + _SMOKE_SKEW_S,
+                   'perf_counter': 0.0}},
+        # the replica's stamps are on ITS (fast) clock: truly at
+        # base+0.3 but recorded as base+skew+0.3
+        {'span': {'name': 'replica/prefill', 'trace_id': tid,
+                  'span_id': rspan, 'parent_span_id': disp,
+                  'start_unix': base + _SMOKE_SKEW_S + 0.3, 'dur_s': 0.2,
+                  'process': 'replica-a'}},
+    ]
+    paths = []
+    for name, records in (('spans-1.jsonl', router),
+                          ('spans-2.jsonl', replica)):
+        p = os.path.join(tmpdir, name)
+        with open(p, 'w') as f:
+            for rec in records:
+                f.write(json.dumps(rec) + '\n')
+        paths.append(p)
+
+    chrome, summary = merge_span_files(paths)
+    ordered = spans_for_trace(chrome, tid)
+    by_name = {e['name']: e for e in ordered}
+    disp_ev, rep_ev = by_name['router/dispatch'], by_name['replica/prefill']
+    checks = {
+        'all_spans_merged': summary['spans'] == 3,
+        'parents_resolve': summary['unresolved_parents'] == [],
+        'offset_applied': summary['offsets_s'].get('replica-a')
+        == _SMOKE_SKEW_S,
+        # the realigned replica span must nest INSIDE its parent dispatch
+        'replica_nested_in_dispatch':
+            disp_ev['ts'] <= rep_ev['ts']
+            and rep_ev['ts'] + rep_ev['dur']
+            <= disp_ev['ts'] + disp_ev['dur'] + 1,   # 1 µs float slack
+        'time_ordered': [e['name'] for e in ordered]
+        == ['router/request', 'router/dispatch', 'replica/prefill'],
+    }
+    return {'ok': all(checks.values()), 'checks': checks,
+            'summary': summary}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('paths', nargs='*',
+                    help='a trace dir (globs spans-*.jsonl) or explicit '
+                         'span files')
+    ap.add_argument('--out', help='write the merged chrome trace here')
+    ap.add_argument('--trace-id', help='keep only this trace')
+    ap.add_argument('--smoke', action='store_true',
+                    help='self-check on synthetic skewed input')
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            verdict = _smoke(td)
+        print(json.dumps(verdict, indent=1))
+        return 0 if verdict['ok'] else 1
+
+    paths = []
+    for p in args.paths:
+        if os.path.isdir(p):
+            paths.extend(sorted(glob.glob(os.path.join(p,
+                                                       'spans-*.jsonl'))))
+        else:
+            paths.append(p)
+    if not paths:
+        print('trace_merge: no span files (pass a PADDLE_TPU_TRACE_DIR '
+              'or spans-*.jsonl paths)', file=sys.stderr)
+        return 2
+    chrome, summary = merge_span_files(paths, trace_id=args.trace_id)
+    if args.out:
+        with open(args.out, 'w') as f:
+            json.dump(chrome, f)
+        summary['out'] = args.out
+    print(json.dumps(summary, indent=1))
+    return 0 if not summary['unresolved_parents'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())
