@@ -691,7 +691,8 @@ def main():
         emit({"metric": "decode_engine",
               "uncached": de['uncached'], "continuous": de['continuous'],
               "drain": de['drain'], "sampled": de['sampled'],
-              "speculative": de['speculative']})
+              "speculative": de['speculative'],
+              "kv_quant": de['kv_quant']})
         summary.update(
             decode_continuous_vs_drain=de['continuous']['speedup_vs_drain'],
             decode_tokens_per_s=de['continuous']['tokens_per_s'],
@@ -701,6 +702,13 @@ def main():
             spec_decode_acceptance=de['speculative']['acceptance'],
             spec_decode_bitwise=de['speculative']['bitwise_equal'],
             decode_sampled_replayable=de['sampled']['replayable'])
+        kv = de['kv_quant']
+        summary.update(
+            kv_quant_hbm_bytes_f32_over_int8=kv['hbm_bytes_f32_over_int8'],
+            kv_quant_int8_match_rate=(
+                kv['per_dtype']['int8']['match_rate_vs_f32']),
+            kv_quant_f32_bitwise=kv['per_dtype']['f32']['bitwise_equal'],
+            kv_quant_int8_slots_per_chip=kv['slots_per_chip']['int8'])
 
     st = run("serving_tier", lambda: bench_serving_tier(on_tpu))
     if st is not None:

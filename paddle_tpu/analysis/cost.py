@@ -464,6 +464,43 @@ def _c_allreduce_bucket(ctx):
 
 
 # ---------------------------------------------------------------------------
+# rules: paged attention — the decode-pool read path. Bytes stay generic
+# (Σ VarInfo nbytes), which is exactly the quantization story: an int8 pool
+# prices its pages at 1 B/elem and its f32 row scales at 4 B/row with no
+# op-specific bytes code here. FLOPs walk the padded context.
+# ---------------------------------------------------------------------------
+
+def _pdim(info, i, assume):
+    """Dim `i` of a VarInfo, with unknown rank/dim priced at `assume`."""
+    if info is None or info.shape is None or i >= len(info.shape):
+        return int(assume)
+    return int(info.shape[i]) if known(info.shape[i]) else int(assume)
+
+
+@cost_rule('paged_attention', 'paged_prefill_attention')
+def _c_paged_attention(ctx):
+    # per query row against T_pad = num_blocks_per_seq × block_size keys:
+    # QK^T (2D) + softmax (~TRANS+2) + PV (2D) — the padded extent is the
+    # honest decode cost; masked positions still burn the lanes
+    kp = ctx.input('k_pages')
+    bt = ctx.input('block_tables')
+    a = ctx.assume_dim
+    heads = _pdim(kp, 0, a)
+    block_size = _pdim(kp, 2, a)
+    head_dim = _pdim(kp, 3, a)
+    seqs = _pdim(bt, 0, a)
+    t_pad = _pdim(bt, 1, a) * block_size
+    queries = max(1, ctx.out_elems() // max(1, head_dim))
+    flops = queries * t_pad * (4 * head_dim + TRANSCENDENTAL_FLOPS + 2)
+    if ctx.input('k_scales') is not None:
+        # int8 pool: one dequant multiply per gathered K and V element
+        # (the gather materializes every sequence's padded window once,
+        # shared across that sequence's query rows)
+        flops += 2 * seqs * heads * t_pad * head_dim
+    return flops
+
+
+# ---------------------------------------------------------------------------
 # fallback coverage: every remaining op type with an INFER rule gets a
 # bytes-only cost rule so the registries stay coverage-aligned (the tier-1
 # coverage test asserts infer rules ⊆ cost rules); genuinely-unknown op

@@ -1354,6 +1354,34 @@ def _allreduce_bucket(ctx):
 # rules: paged KV-cache attention (serving/decode)
 # ---------------------------------------------------------------------------
 
+def _check_kv_scales(ctx):
+    """The optional int8-pool dequant scales: one f32 per (head, block,
+    position) row — rank 3, matching the pages' leading dims when both are
+    known. Typed here so the generic byte model prices a quantized pool as
+    1 B/elem payload + 4 B/row scales with no op-specific bytes rule."""
+    pages = ctx.input('k_pages')
+    for slot in ('k_scales', 'v_scales'):
+        sc = ctx.input(slot)
+        if sc is None:
+            continue
+        if sc.dtype is not None and sc.dtype != 'float32':
+            raise InferError(
+                f'{slot} must be float32 row scales, got {sc.dtype}',
+                kind='dtype-mismatch')
+        if sc.shape is not None:
+            if len(sc.shape) != 3:
+                raise InferError(
+                    f'{slot} expects rank 3 (H, num_blocks, block_size), '
+                    f'got rank {len(sc.shape)}')
+            if (pages is not None and pages.shape is not None
+                    and len(pages.shape) == 4
+                    and tuple(sc.shape) != tuple(pages.shape[:3])):
+                raise InferError(
+                    f'{slot} shape {tuple(sc.shape)} does not match the '
+                    f'pages\' (H, num_blocks, block_size) '
+                    f'{tuple(pages.shape[:3])}')
+
+
 @infer_rule('paged_attention')
 def _paged_attention(ctx):
     # decode read: q (S, H, D) -> (S, H, D); multi-query speculative
@@ -1363,6 +1391,7 @@ def _paged_attention(ctx):
         raise InferError(
             f'paged_attention expects q of rank 3 (decode) or 4 '
             f'(multi-query verify), got rank {len(q.shape)}')
+    _check_kv_scales(ctx)
     return {'Out': VarInfo(q.shape, q.dtype)}
 
 
@@ -1373,6 +1402,7 @@ def _paged_prefill_attention(ctx):
         raise InferError(
             f'paged_prefill_attention expects q of rank 4 (1, H, L, D), '
             f'got rank {len(q.shape)}')
+    _check_kv_scales(ctx)
     return {'Out': VarInfo(q.shape, q.dtype)}
 
 

@@ -50,7 +50,8 @@ from .cost import (OpCost, dtype_nbytes, has_cost_rule, info_nbytes,
 from .infer import UNKNOWN, VarInfo, declared_info, infer_op, seed_env
 
 __all__ = ['MemoryPlan', 'plan_program', 'select_checkpoints',
-           'gradient_bytes']
+           'gradient_bytes', 'solve_decode_pool_blocks',
+           'decode_pool_report']
 
 
 class Resident:
@@ -493,6 +494,96 @@ def gradient_bytes(program, assume_dim=1):
         if blk.has_var(p):
             total += info_nbytes(declared_info(blk.var(p)), assume_dim)
     return total
+
+
+# ---------------------------------------------------------------------------
+# decode-pool sizing (PADDLE_TPU_DECODE_HBM_MB → KV blocks)
+# ---------------------------------------------------------------------------
+
+def _model_state_bytes(model):
+    """Σ parameter bytes of a dygraph model (runtime widths — the same 1×
+    resident-state term plan_program charges for persistables)."""
+    total = 0
+    for p in model.parameters():
+        v = getattr(p, 'value', p)
+        total += int(getattr(v, 'nbytes', 0))
+    return total
+
+
+def _decode_kv_geometry(model):
+    """(n_layers, n_heads, head_dim) of the model's KV cache, from the
+    causal_lm config contract (``model.cfg.{num_hidden_layers,
+    num_attention_heads, hidden_size}``). Raises a ValueError naming what
+    is missing — a budget solve over unknown geometry would silently size
+    the pool wrong."""
+    cfg = getattr(model, 'cfg', None)
+    try:
+        n_layers = int(cfg.num_hidden_layers)
+        n_heads = int(cfg.num_attention_heads)
+        head_dim = int(cfg.hidden_size) // n_heads
+    except (TypeError, AttributeError):
+        raise ValueError(
+            'decode-pool budget solve needs model.cfg with '
+            'num_hidden_layers / num_attention_heads / hidden_size '
+            '(the models/causal_lm.py config contract); pass an explicit '
+            'max_blocks / PADDLE_TPU_DECODE_MAX_BLOCKS for models '
+            'without it')
+    return n_layers, n_heads, head_dim
+
+
+def decode_pool_block_bytes(model, block_size, kv_dtype='f32'):
+    """HBM bytes ONE KV-cache block costs across every layer: K and V,
+    ``n_heads × block_size`` rows per layer, each row priced by
+    kv_cache.kv_row_bytes at the storage dtype (int8 rows carry their f32
+    scale)."""
+    from ..serving.decode.kv_cache import kv_row_bytes
+    n_layers, n_heads, head_dim = _decode_kv_geometry(model)
+    return (n_layers * 2 * n_heads * int(block_size)
+            * kv_row_bytes(head_dim, kv_dtype))
+
+
+def solve_decode_pool_blocks(model, hbm_mb, block_size, kv_dtype='f32',
+                             min_blocks=2):
+    """The ``PADDLE_TPU_DECODE_HBM_MB`` budget solve: blocks =
+    (budget − model state) // per-block KV bytes, floored at
+    ``min_blocks`` (the engine passes max_blocks_per_seq + 1 so an empty
+    pool always covers one maximal request). Raises when the budget does
+    not even cover the model's resident state — a silent floor there
+    would hide that the budget is fiction."""
+    budget = int(hbm_mb) << 20
+    state = _model_state_bytes(model)
+    block_bytes = decode_pool_block_bytes(model, block_size, kv_dtype)
+    if budget <= state:
+        raise ValueError(
+            f'PADDLE_TPU_DECODE_HBM_MB={hbm_mb} ({budget} bytes) does not '
+            f'cover the model state ({state} bytes); nothing left for the '
+            f'KV pool')
+    return max(int(min_blocks), (budget - state) // block_bytes)
+
+
+def decode_pool_report(model, hbm_mb, block_size, kv_dtype='f32',
+                       min_blocks=2):
+    """The solve, itemized for tools/plan_program.py — every term of the
+    closed form inspectable next to the resulting block count."""
+    n_layers, n_heads, head_dim = _decode_kv_geometry(model)
+    from ..serving.decode.kv_cache import kv_row_bytes
+    state = _model_state_bytes(model)
+    block_bytes = decode_pool_block_bytes(model, block_size, kv_dtype)
+    blocks = solve_decode_pool_blocks(model, hbm_mb, block_size, kv_dtype,
+                                      min_blocks)
+    return {
+        'budget_mb': int(hbm_mb),
+        'kv_dtype': kv_dtype,
+        'block_size': int(block_size),
+        'model_state_bytes': state,
+        'kv_layers': n_layers,
+        'kv_heads': n_heads,
+        'head_dim': head_dim,
+        'row_bytes': kv_row_bytes(head_dim, kv_dtype),
+        'block_bytes': block_bytes,
+        'num_blocks': int(blocks),
+        'pool_bytes': int(blocks) * block_bytes,
+    }
 
 
 # ---------------------------------------------------------------------------

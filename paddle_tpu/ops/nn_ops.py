@@ -727,7 +727,8 @@ def fused_attention(q, k, v, bias=None, *, sm_scale=1.0, causal=False):
 
 
 @register_op('paged_attention')
-def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                    k_scales=None, v_scales=None, *,
                     sm_scale=1.0, pages_per_compute_block=4):
     """Single-token decode attention over a paged KV cache (the decode half
     of the serving decode engine — docs/SERVING.md "Stateful decode";
@@ -741,6 +742,13 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
     - ``block_tables``: (S, max_blocks_per_seq) int32 — each slot's cache
       blocks in sequence order; tail entries beyond the context are
       arbitrary valid block ids (masked by ``context_lens``).
+    - ``k_scales`` / ``v_scales``: optional (H, num_blocks, block_size)
+      f32 — per-row dequant scales for int8 pools (PADDLE_TPU_KV_DTYPE=
+      int8). Dequantization happens AFTER the per-slot gather, so only the
+      slots' working set is ever materialized at f32; bf16 pools pass no
+      scales and simply cast after the gather. Scale-zero rows (unwritten,
+      incl. the scratch block) dequantize to exact zeros, preserving the
+      masking contract below at every dtype.
     - ``context_lens``: (S,) int32 — tokens to attend per slot, INCLUDING
       the token written at position context_len-1 this step. In the
       multi-query form this is the extent of fed-token ROW 0; row j
@@ -765,10 +773,14 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
     v_pages = jnp.asarray(v_pages)
     block_tables = jnp.asarray(block_tables, jnp.int32)
     context_lens = jnp.asarray(context_lens, jnp.int32)
-    if _jax.default_backend() == 'tpu' and q.ndim == 3:
-        # the stock pallas kernel is single-query; the multi-query (S,H,K,D)
-        # verify read uses the XLA formulation on every backend until a
-        # ragged kernel lands (Ragged Paged Attention is the blueprint)
+    if (_jax.default_backend() == 'tpu' and q.ndim == 3
+            and k_pages.dtype == jnp.float32):
+        # the stock pallas kernel is single-query over f32 pools; the
+        # multi-query (S,H,K,D) verify read AND the quantized pools
+        # (bf16/int8 payload needs the dequant-after-gather below) use the
+        # XLA formulation on every backend until a ragged quantized kernel
+        # lands (Ragged Paged Attention is the blueprint) — deliberate
+        # dispatch, not counted as a pallas fallback
         try:
             from jax.experimental.pallas.ops.tpu.paged_attention import (
                 paged_attention as _tpu_paged_attention)
@@ -786,8 +798,8 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
         # (S, 1) step that would have read the same K/V at extent
         # context_lens + j (the tests prove it across ragged extents).
         s, h, kq, d = q.shape
-        k = _gather_pages(k_pages, block_tables, s, h, d)
-        v = _gather_pages(v_pages, block_tables, s, h, d)
+        k = _gather_pages(k_pages, block_tables, s, h, d, k_scales)
+        v = _gather_pages(v_pages, block_tables, s, h, d, v_scales)
         t_pad = k.shape[2]
         scores = jnp.matmul(q, jnp.swapaxes(k, -1, -2))    # (S, H, K, T)
         if sm_scale != 1.0:
@@ -799,8 +811,8 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
         probs = jax.nn.softmax(scores, axis=-1)
         return jnp.matmul(probs, v)                        # (S, H, K, D)
     s, h, d = q.shape
-    k = _gather_pages(k_pages, block_tables, s, h, d)
-    v = _gather_pages(v_pages, block_tables, s, h, d)
+    k = _gather_pages(k_pages, block_tables, s, h, d, k_scales)
+    v = _gather_pages(v_pages, block_tables, s, h, d, v_scales)
     t_pad = k.shape[2]
     # same op sequence as the unfused MHA path (matmul·α → mask → softmax
     # → matmul), q extent 1: bitwise-equal to the whole-sequence rows
@@ -815,18 +827,33 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
     return out.reshape(s, h, d)
 
 
-def _gather_pages(pages, block_tables, s, h, d):
+def _gather_pages(pages, block_tables, s, h, d, scales=None):
     """(H, NB, BS, D) cache pool + (S, nbs) tables → dense (S, H, nbs·BS, D)
-    per-slot key/value view (the XLA stand-in for the kernel's block walk)."""
+    per-slot key/value view (the XLA stand-in for the kernel's block walk).
+
+    f32 pools pass through untouched (the bitwise-contract path). Quantized
+    pools dequantize AFTER the gather — int8 payload × its per-row f32
+    ``scales`` (gathered with the identical take/reshape/transpose, shape
+    (S, H, nbs·BS)), bf16 payload a plain f32 cast — so the dense working
+    set is f32 but the resident pool never is."""
     nb = block_tables.shape[1]
     bs = pages.shape[2]
     g = jnp.take(pages, block_tables.reshape(-1), axis=1)
     g = g.reshape(h, s, nb, bs, d).transpose(1, 0, 2, 3, 4)
-    return g.reshape(s, h, nb * bs, d)
+    g = g.reshape(s, h, nb * bs, d)
+    if scales is not None:
+        sc = jnp.take(jnp.asarray(scales, jnp.float32),
+                      block_tables.reshape(-1), axis=1)
+        sc = sc.reshape(h, s, nb, bs).transpose(1, 0, 2, 3)
+        return g.astype(jnp.float32) * sc.reshape(s, h, nb * bs)[..., None]
+    if g.dtype != jnp.float32:
+        return g.astype(jnp.float32)
+    return g
 
 
 @register_op('paged_prefill_attention')
-def paged_prefill_attention(q, k, v, k_pages, v_pages, block_tables, *,
+def paged_prefill_attention(q, k, v, k_pages, v_pages, block_tables,
+                            k_scales=None, v_scales=None, *,
                             sm_scale=1.0):
     """Prefill-phase attention for the decode engine: causal whole-prompt
     attention whose KEY EXTENT is the paged-cache view, so prefill rows are
@@ -843,10 +870,18 @@ def paged_prefill_attention(q, k, v, k_pages, v_pages, block_tables, *,
 
     Row r attends keys 0..r (causal). Rows past the real prompt length are
     garbage-in-garbage-out: finite, never read, and overwritten by decode
-    steps before any masked read could see them."""
+    steps before any masked read could see them.
+
+    ``k_scales``/``v_scales``: per-row dequant scales for int8 pools, as in
+    :func:`paged_attention`. Quantized pools take the XLA gather+dequant
+    path on every backend (the raw-k/v TPU kernel would attend the
+    UN-quantized projections — bitwise-different from the decode steps that
+    later read the quantized cache, breaking the prefill/decode parity the
+    engine is built on)."""
     import jax as _jax
     q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
-    if _jax.default_backend() == 'tpu':
+    if (_jax.default_backend() == 'tpu'
+            and jnp.asarray(k_pages).dtype == jnp.float32):
         try:
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 flash_attention)
@@ -856,9 +891,11 @@ def paged_prefill_attention(q, k, v, k_pages, v_pages, block_tables, *,
             _pallas_fallback('paged_prefill_attention', e, q.shape)
     b, h, lq, d = q.shape
     kd = _gather_pages(jnp.asarray(k_pages),
-                       jnp.asarray(block_tables, jnp.int32), b, h, d)
+                       jnp.asarray(block_tables, jnp.int32), b, h, d,
+                       k_scales)
     vd = _gather_pages(jnp.asarray(v_pages),
-                       jnp.asarray(block_tables, jnp.int32), b, h, d)
+                       jnp.asarray(block_tables, jnp.int32), b, h, d,
+                       v_scales)
     t_pad = kd.shape[2]
     scores = jnp.matmul(q, jnp.swapaxes(kd, -1, -2))
     if sm_scale != 1.0:

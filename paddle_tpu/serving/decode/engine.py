@@ -65,7 +65,8 @@ class DecodeEngine:
     def __init__(self, model, slots=None, block_size=None, max_blocks=None,
                  max_prompt_len=64, max_new_tokens_cap=64,
                  prompt_buckets=None, eos_id=None, prefix_cache=None,
-                 model_lock=None, spec_decode=None, spec_k=None):
+                 model_lock=None, spec_decode=None, spec_k=None,
+                 kv_dtype=None):
         self.model = model
         if hasattr(model, 'eval'):
             model.eval()           # generation is inference: no dropout
@@ -83,9 +84,19 @@ class DecodeEngine:
         block_size = int(block_size or DEFAULT_BLOCK_SIZE)
         max_total = self.max_prompt_len + self.max_new_tokens_cap
         max_bps = -(-max_total // block_size)
+        # KV storage dtype: arg wins, else the strict-parsed
+        # PADDLE_TPU_KV_DTYPE knob (default f32 — the bitwise-exact path)
+        from ..tier.knobs import (ENV_KV_DTYPE, KV_DTYPE_CHOICES,
+                                  parse_choice_env)
+        if kv_dtype is None:
+            kv_dtype = parse_choice_env(ENV_KV_DTYPE, KV_DTYPE_CHOICES,
+                                        'f32')
+        num_blocks = self._resolve_num_blocks(model, max_blocks, block_size,
+                                              max_bps, kv_dtype)
         self.pool = KVCachePool(block_size=block_size,
-                                num_blocks=max_blocks or DEFAULT_MAX_BLOCKS,
-                                max_blocks_per_seq=max_bps)
+                                num_blocks=num_blocks,
+                                max_blocks_per_seq=max_bps,
+                                kv_dtype=kv_dtype)
         if self.pool.allocator.capacity < max_bps:
             # an empty pool must always cover one maximal request, or the
             # scheduler's FIFO head could wait forever
@@ -95,6 +106,8 @@ class DecodeEngine:
                 f'{max_total} tokens at block_size={block_size})')
         _m.decode_slots_total.set(self.slots)
         _m.decode_cache_blocks_total.set(self.pool.allocator.capacity)
+        from .kv_cache import KV_DTYPE_CODES
+        _m.kv_cache_dtype.set(KV_DTYPE_CODES[self.pool.kv_dtype])
         self._prefill_compiled = set()
         self._step_compiled = False
         self._spec_compiled = False
@@ -127,6 +140,31 @@ class DecodeEngine:
             self.prefix_cache = PrefixCache(self.pool)
         else:
             self.prefix_cache = prefix_cache
+
+    @staticmethod
+    def _resolve_num_blocks(model, max_blocks, block_size, max_bps,
+                            kv_dtype):
+        """Pool-size precedence (docs/SERVING.md "Tiered KV cache"): an
+        explicit ``max_blocks=`` arg wins, then an explicitly-SET
+        ``PADDLE_TPU_DECODE_MAX_BLOCKS`` env (checked live, not the
+        import-time default — an operator pinning the block count must
+        beat any budget), then the ``PADDLE_TPU_DECODE_HBM_MB`` budget
+        solve (analysis/plan.py prices model state + per-block KV bytes at
+        ``kv_dtype``), else the module default."""
+        if max_blocks:
+            return int(max_blocks)
+        import os as _os
+        raw = _os.environ.get('PADDLE_TPU_DECODE_MAX_BLOCKS', '').strip()
+        if raw:
+            return int(raw)
+        from ..tier.knobs import ENV_DECODE_HBM_MB, parse_int_env
+        hbm_mb = parse_int_env(ENV_DECODE_HBM_MB, 0, minimum=1)
+        if hbm_mb:
+            from ...analysis.plan import solve_decode_pool_blocks
+            return solve_decode_pool_blocks(
+                model, hbm_mb, block_size=block_size, kv_dtype=kv_dtype,
+                min_blocks=max_bps + 1)
+        return DEFAULT_MAX_BLOCKS
 
     # -- geometry ----------------------------------------------------------
     @property
@@ -210,6 +248,7 @@ class DecodeEngine:
             self._prefill_compiled.add(bucket)
             _m.decode_prefill_compiles.inc()
         _m.decode_cache_blocks_used.set(self.pool.allocator.used)
+        _m.kv_cache_bytes_in_hbm.set(self.pool.bytes_in_hbm())
         if sampler is not None:
             return int(sampler(row))
         return int(row.argmax())
@@ -345,13 +384,20 @@ class DecodeEngine:
                 f'handoff carries {nb} blocks but the table reserves only '
                 f'{len(table.blocks)}')
         for layer, (k, v) in enumerate(payload.layers):
+            ks = vs = None
+            if payload.scales is not None:
+                ks, vs = payload.scales[layer]
             if skip:
                 k, v = k[:, skip:], v[:, skip:]
+                if ks is not None:
+                    ks, vs = ks[:, skip:], vs[:, skip:]
             if k.shape[1]:
                 self.pool.write_whole_blocks(
-                    layer, table.blocks[skip:nb], k, v)
+                    layer, table.blocks[skip:nb], k, v,
+                    k_scale=ks, v_scale=vs)
         table.context_len = payload.context_len
         _m.decode_cache_blocks_used.set(self.pool.allocator.used)
+        _m.kv_cache_bytes_in_hbm.set(self.pool.bytes_in_hbm())
         return int(payload.first_token)
 
     # -- warmup ------------------------------------------------------------

@@ -23,6 +23,21 @@ tests/ops/test_paged_attention.py proves reuse-after-free is clean).
 Functional updates: jax arrays are immutable, so writes go through jitted
 scatters with the pool array DONATED — XLA updates in place instead of
 copying the pool per token (the same donation lever as PR 1's executor).
+
+Quantized storage (``kv_dtype``, docs/SERVING.md "Tiered KV cache"): the
+pools hold payload at ``f32`` (exact, the default — this path is
+bitwise-unchanged), ``bf16`` (half the bytes; decode reads cast back to
+f32 — an exact roundtrip for every representable value), or ``int8``
+(quarter the bytes: one symmetric int8 row + one f32 scale per
+(head, position) row via quant_collectives.rowwise_quantize — the PR 9/15
+sparse-push codec; KV rows and embedding rows are the same shape problem).
+Quantization happens AT THE WRITE (prefill block scatter, decode token
+scatter, speculative window, whole-block handoff injection) and
+dequantization AT THE READ inside `paged_attention` /
+`paged_prefill_attention`, after the per-slot gather — so the resident
+pool never exists at f32. The scratch-block masking contract survives
+every dtype: scales init to 0.0, so an unwritten int8 row dequantizes to
+exact zeros, and masked probabilities are exactly zero regardless.
 """
 from __future__ import annotations
 
@@ -37,7 +52,8 @@ from ..errors import InvalidRequest, OutOfBlocks
 
 __all__ = ['BlockAllocator', 'BlockTable', 'KVCachePool', 'CacheContext',
            'DEFAULT_SLOTS', 'DEFAULT_BLOCK_SIZE', 'DEFAULT_MAX_BLOCKS',
-           'SCRATCH_BLOCK']
+           'SCRATCH_BLOCK', 'KV_PAYLOAD_DTYPES', 'KV_DTYPE_CODES',
+           'kv_row_bytes']
 
 DEFAULT_SLOTS = int(os.environ.get('PADDLE_TPU_DECODE_SLOTS', '8'))
 DEFAULT_BLOCK_SIZE = int(os.environ.get('PADDLE_TPU_DECODE_BLOCK_SIZE', '16'))
@@ -45,6 +61,26 @@ DEFAULT_MAX_BLOCKS = int(os.environ.get('PADDLE_TPU_DECODE_MAX_BLOCKS',
                                         '256'))
 
 SCRATCH_BLOCK = 0
+
+# storage payload width per element, by kv_dtype; int8 additionally carries
+# one f32 scale per (head, position) row — kv_row_bytes() is the closed
+# form the pool-sizing solve and the analysis bytes model both price
+KV_PAYLOAD_DTYPES = {'f32': 'float32', 'bf16': 'bfloat16', 'int8': 'int8'}
+_KV_PAYLOAD_BYTES = {'f32': 4, 'bf16': 2, 'int8': 1}
+# stable small-int codes: the kv_cache_dtype gauge and the disagg KVPayload
+# wire meta both speak these (0 is also what a legacy 3-int meta implies)
+KV_DTYPE_CODES = {'f32': 0, 'bf16': 1, 'int8': 2}
+
+
+def kv_row_bytes(head_dim, kv_dtype):
+    """Bytes of ONE cached K or V row (one head × one token position) at
+    ``kv_dtype``: payload + (int8 only) its f32 row scale."""
+    if kv_dtype not in _KV_PAYLOAD_BYTES:
+        raise ValueError(
+            f'kv_dtype={kv_dtype!r} is not supported; supported values: '
+            + ', '.join(repr(c) for c in KV_PAYLOAD_DTYPES))
+    return (int(head_dim) * _KV_PAYLOAD_BYTES[kv_dtype]
+            + (4 if kv_dtype == 'int8' else 0))
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -57,6 +93,18 @@ def _scatter_blocks(pages, block_ids, vals):
 def _scatter_tokens(pages, block_ids, offsets, vals):
     """pages (H, NB, BS, D) ← vals (H, S, D) at (block_ids, offsets) (S,)."""
     return pages.at[:, block_ids, offsets].set(vals)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_block_scales(scales, block_ids, vals):
+    """scales (H, NB, BS) ← vals (H, nb, BS) at block_ids (nb,)."""
+    return scales.at[:, block_ids].set(vals)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_token_scales(scales, block_ids, offsets, vals):
+    """scales (H, NB, BS) ← vals (H, S) at (block_ids, offsets) (S,)."""
+    return scales.at[:, block_ids, offsets].set(vals)
 
 
 class BlockAllocator:
@@ -188,13 +236,23 @@ class KVCachePool:
     """
 
     def __init__(self, block_size=None, num_blocks=None,
-                 max_blocks_per_seq=None, dtype='float32'):
+                 max_blocks_per_seq=None, dtype='float32', kv_dtype=None):
         self.block_size = int(block_size or DEFAULT_BLOCK_SIZE)
         self.num_blocks = int(num_blocks or DEFAULT_MAX_BLOCKS)
         self.max_blocks_per_seq = int(max_blocks_per_seq or 8)
-        self.dtype = dtype
+        kv_dtype = kv_dtype or 'f32'
+        if kv_dtype not in KV_PAYLOAD_DTYPES:
+            raise ValueError(
+                f'kv_dtype={kv_dtype!r} is not supported; supported values: '
+                + ', '.join(repr(c) for c in KV_PAYLOAD_DTYPES))
+        self.kv_dtype = kv_dtype
+        # 'f32' keeps honoring the legacy ``dtype`` arg so the default path
+        # allocates EXACTLY the arrays it always did (bitwise contract)
+        self.dtype = dtype if kv_dtype == 'f32' else KV_PAYLOAD_DTYPES[
+            kv_dtype]
         self.allocator = BlockAllocator(self.num_blocks)
         self._layers = {}          # layer idx -> [k_pages, v_pages]
+        self._scales = {}          # int8 only: layer -> [k_scales, v_scales]
 
     @property
     def padded_context(self):
@@ -225,10 +283,43 @@ class KVCachePool:
             shape = (n_heads, self.num_blocks, self.block_size, head_dim)
             self._layers[layer] = [jnp.zeros(shape, self.dtype),
                                    jnp.zeros(shape, self.dtype)]
+            if self.kv_dtype == 'int8':
+                # one f32 scale per (head, position) row; zero-init means
+                # unwritten rows (incl. the scratch block) dequantize to
+                # exact zeros — the masking contract at a new dtype
+                self._scales[layer] = [jnp.zeros(shape[:3], 'float32'),
+                                       jnp.zeros(shape[:3], 'float32')]
         return self._layers[layer]
 
     def pages(self, layer):
         return self._layers[layer]
+
+    def scales(self, layer):
+        """int8 pools: [k_scales, v_scales] each (H, NB, BS) f32; ``None``
+        for f32/bf16 pools (payload is self-describing)."""
+        return self._scales.get(layer)
+
+    def _encode_rows(self, vals):
+        """f32 rows (H, ..., D) → (payload at the storage dtype, row scales
+        (H, ...) f32 or ``None``). The f32 branch returns its input object
+        untouched — the default path must stay bitwise-identical."""
+        if self.kv_dtype == 'f32':
+            return vals, None
+        import jax.numpy as jnp
+        if self.kv_dtype == 'bf16':
+            return jnp.asarray(vals).astype(jnp.bfloat16), None
+        from ...parallel.quant_collectives import rowwise_quantize
+        return rowwise_quantize(vals)
+
+    def bytes_in_hbm(self):
+        """Resident pool bytes across all allocated layers: payload arrays
+        plus (int8) their scale arrays — the kv_cache_bytes_in_hbm gauge."""
+        total = 0
+        for arrs in self._layers.values():
+            total += sum(int(a.nbytes) for a in arrs)
+        for arrs in self._scales.values():
+            total += sum(int(a.nbytes) for a in arrs)
+        return total
 
     def write_prefill(self, layer, table, k, v):
         """Write the prompt's K/V rows. ``k``/``v``: (H, L, D) — the bucket-
@@ -248,8 +339,14 @@ class KVCachePool:
         ids = np.asarray(table.blocks[:nb_w], np.int32)
         kb = k[:, :target].reshape(h, nb_w, self.block_size, d)
         vb = v[:, :target].reshape(h, nb_w, self.block_size, d)
+        kb, ks = self._encode_rows(kb)
+        vb, vs = self._encode_rows(vb)
         pages[0] = _scatter_blocks(pages[0], ids, kb)
         pages[1] = _scatter_blocks(pages[1], ids, vb)
+        if ks is not None:
+            sc = self._scales[layer]
+            sc[0] = _scatter_block_scales(sc[0], ids, ks)
+            sc[1] = _scatter_block_scales(sc[1], ids, vs)
 
     def write_tokens(self, layer, block_ids, offsets, k, v):
         """One decode step's K/V: ``k``/``v`` (H, S, D) written at
@@ -259,8 +356,14 @@ class KVCachePool:
         pages = self.ensure_layer(layer, h, d)
         ids = np.asarray(block_ids, np.int32)
         offs = np.asarray(offsets, np.int32)
+        k, ks = self._encode_rows(k)
+        v, vs = self._encode_rows(v)
         pages[0] = _scatter_tokens(pages[0], ids, offs, k)
         pages[1] = _scatter_tokens(pages[1], ids, offs, v)
+        if ks is not None:
+            sc = self._scales[layer]
+            sc[0] = _scatter_token_scales(sc[0], ids, offs, ks)
+            sc[1] = _scatter_token_scales(sc[1], ids, offs, vs)
 
     # -- whole-block transfer (serving/tier/disagg.py handoff) -------------
     def read_blocks(self, layer, block_ids):
@@ -272,9 +375,29 @@ class KVCachePool:
         k_pages, v_pages = self._layers[layer]
         return (np.asarray(k_pages[:, ids]), np.asarray(v_pages[:, ids]))
 
-    def write_whole_blocks(self, layer, block_ids, k, v):
+    def read_block_scales(self, layer, block_ids):
+        """int8 pools: gather the blocks' row scales as host arrays
+        ``(k_scales, v_scales)`` each (H, nb, block_size) f32 — shipped
+        beside :meth:`read_blocks` payloads so a same-dtype receiver can
+        scatter them back byte-exact. ``None`` for f32/bf16 pools."""
+        if layer not in self._scales:
+            return None
+        ids = np.asarray(block_ids, np.int32)
+        ks, vs = self._scales[layer]
+        return (np.asarray(ks[:, ids]), np.asarray(vs[:, ids]))
+
+    def write_whole_blocks(self, layer, block_ids, k, v,
+                           k_scale=None, v_scale=None):
         """Scatter whole blocks (the :meth:`read_blocks` shapes) into this
-        pool at ``block_ids`` — the receiving half of a KV handoff."""
+        pool at ``block_ids`` — the receiving half of a KV handoff or a
+        host-tier reinjection.
+
+        Dtype conversion matrix: payload already at this pool's storage
+        dtype (int8 arriving WITH its scales) scatters directly —
+        byte-exact, which is what makes same-dtype disagg handoff and
+        spill→reinject bitwise; otherwise the incoming rows are decoded to
+        f32 (using ``k_scale``/``v_scale`` when the sender was int8) and
+        re-encoded at this pool's dtype."""
         h, nb, bs, d = k.shape
         if bs != self.block_size:
             raise InvalidRequest(
@@ -283,8 +406,25 @@ class KVCachePool:
         pages = self.ensure_layer(layer, h, d)
         ids = np.asarray(block_ids, np.int32)
         import jax.numpy as jnp
-        pages[0] = _scatter_blocks(pages[0], ids, jnp.asarray(k))
-        pages[1] = _scatter_blocks(pages[1], ids, jnp.asarray(v))
+        k = jnp.asarray(k)
+        v = jnp.asarray(v)
+        same = (k.dtype == jnp.dtype(self.dtype)
+                and (self.kv_dtype != 'int8' or k_scale is not None))
+        if same:
+            ks, vs = k_scale, v_scale
+        else:
+            if k_scale is not None:      # sender was int8: decode first
+                from ...parallel.quant_collectives import rowwise_dequantize
+                k = rowwise_dequantize(k, k_scale)
+                v = rowwise_dequantize(v, v_scale)
+            k, ks = self._encode_rows(k.astype(jnp.float32))
+            v, vs = self._encode_rows(v.astype(jnp.float32))
+        pages[0] = _scatter_blocks(pages[0], ids, k)
+        pages[1] = _scatter_blocks(pages[1], ids, v)
+        if self.kv_dtype == 'int8':
+            sc = self._scales[layer]
+            sc[0] = _scatter_block_scales(sc[0], ids, jnp.asarray(ks))
+            sc[1] = _scatter_block_scales(sc[1], ids, jnp.asarray(vs))
 
     # -- observability -----------------------------------------------------
     def utilization(self):
@@ -347,6 +487,14 @@ class CacheContext:
             self._ctx = np.asarray(
                 [max(int(c), 1) for c in context_lens], np.int32)
 
+    def _scale_inputs(self, layer):
+        """Extra dispatch inputs for int8 pools ({} otherwise — the f32/bf16
+        dispatch must stay slot-for-slot what it was before quantization)."""
+        sc = self.pool.scales(layer)
+        if sc is None:
+            return {}
+        return {'k_scales': sc[0], 'v_scales': sc[1]}
+
     def attend(self, q, k, v, sm_scale=1.0):
         from ...dygraph.tape import Tensor, dispatch_op
         layer = self._layer
@@ -360,11 +508,11 @@ class CacheContext:
             k_pages, v_pages = self.pool.pages(layer)
             bt = np.asarray([table.padded(self.pool.max_blocks_per_seq)],
                             np.int32)
-            return dispatch_op(
-                'paged_prefill_attention',
-                {'q': q, 'k': k, 'v': v, 'k_pages': k_pages,
-                 'v_pages': v_pages, 'block_tables': bt},
-                {'sm_scale': float(sm_scale)})
+            inputs = {'q': q, 'k': k, 'v': v, 'k_pages': k_pages,
+                      'v_pages': v_pages, 'block_tables': bt}
+            inputs.update(self._scale_inputs(layer))
+            return dispatch_op('paged_prefill_attention', inputs,
+                               {'sm_scale': float(sm_scale)})
         if self.window > 1:
             # multi-token decode (speculative verify): (S, H, K, D) ->
             # (H, S·K, D) rows, slot-major, matching the flattened write
@@ -376,12 +524,12 @@ class CacheContext:
                 kv.transpose(1, 0, 2, 3).reshape(h, s * k_w, d),
                 vv.transpose(1, 0, 2, 3).reshape(h, s * k_w, d))
             k_pages, v_pages = self.pool.pages(layer)
-            return dispatch_op(
-                'paged_attention',
-                {'q': q, 'k_pages': k_pages, 'v_pages': v_pages,
-                 'block_tables': self._batched_tables,
-                 'context_lens': self._ctx},
-                {'sm_scale': float(sm_scale)})
+            inputs = {'q': q, 'k_pages': k_pages, 'v_pages': v_pages,
+                      'block_tables': self._batched_tables,
+                      'context_lens': self._ctx}
+            inputs.update(self._scale_inputs(layer))
+            return dispatch_op('paged_attention', inputs,
+                               {'sm_scale': float(sm_scale)})
         # decode: (S, H, 1, D) -> (H, S, D) token rows
         self.pool.write_tokens(layer, self._write_ids, self._write_offs,
                                kv[:, :, 0].transpose(1, 0, 2),
@@ -389,12 +537,12 @@ class CacheContext:
         k_pages, v_pages = self.pool.pages(layer)
         q3 = dispatch_op('reshape', {'x': q},
                          {'shape': [q.shape[0], q.shape[1], q.shape[3]]})
-        out = dispatch_op(
-            'paged_attention',
-            {'q': q3, 'k_pages': k_pages, 'v_pages': v_pages,
-             'block_tables': self._batched_tables,
-             'context_lens': self._ctx},
-            {'sm_scale': float(sm_scale)})
+        inputs = {'q': q3, 'k_pages': k_pages, 'v_pages': v_pages,
+                  'block_tables': self._batched_tables,
+                  'context_lens': self._ctx}
+        inputs.update(self._scale_inputs(layer))
+        out = dispatch_op('paged_attention', inputs,
+                          {'sm_scale': float(sm_scale)})
         return dispatch_op('reshape', {'x': out},
                            {'shape': [q.shape[0], q.shape[1], 1,
                                       q.shape[3]]})

@@ -287,6 +287,35 @@ prefix_cache_evicted_blocks = _LazyMetric(
     'counter', 'prefix_cache_evicted_blocks',
     'cached blocks evicted (LRU over refcount-idle leaves) under pool or '
     'cap pressure')
+prefix_cache_evictions = _LazyMetric(
+    'counter', 'prefix_cache_evictions',
+    'blocks leaving HBM residency (spilled or dropped), labeled by cause: '
+    'pressure = allocation ran dry, cap = publish hit '
+    'PADDLE_TPU_PREFIX_CACHE_MAX_BLOCKS')
+
+# quantized + tiered KV cache (PADDLE_TPU_KV_DTYPE storage dtype + the
+# PADDLE_TPU_PREFIX_CACHE_HOST_MB host spill tier — docs/SERVING.md
+# "Tiered KV cache")
+kv_cache_dtype = _LazyMetric(
+    'gauge', 'kv_cache_dtype',
+    'KV pool storage dtype code (0 = f32, 1 = bf16, 2 = int8)')
+kv_cache_bytes_in_hbm = _LazyMetric(
+    'gauge', 'kv_cache_bytes_in_hbm',
+    'resident KV pool bytes across allocated layers (payload arrays plus '
+    'int8 row-scale arrays), sampled after pool writes')
+kv_cache_bytes_spilled = _LazyMetric(
+    'counter', 'kv_cache_bytes_spilled',
+    'serialized KV payload bytes moved from HBM to the host spill tier')
+kv_cache_spill_count = _LazyMetric(
+    'counter', 'kv_cache_spill_count',
+    'prefix-cache blocks spilled to host RAM instead of being dropped')
+kv_cache_reinject_count = _LazyMetric(
+    'counter', 'kv_cache_reinject_count',
+    'spilled blocks re-scattered into HBM on a later radix hit')
+kv_cache_reinject_seconds = _LazyMetric(
+    'histogram', 'kv_cache_reinject_seconds',
+    'wall seconds per host->HBM reinjection (deserialize + one block '
+    'scatter per layer for the whole reinjected run)')
 
 # multi-replica router (tier/router.py)
 router_requests = _LazyMetric(

@@ -42,18 +42,30 @@ __all__ = ['KVPayload', 'PrefillReplica', 'LocalPrefillWorker']
 
 
 class KVPayload:
-    """One finished prefill: whole KV blocks for every layer + the first
-    greedy token. ``layers[i]`` is ``(k, v)`` with shape
-    (H, num_blocks, block_size, D) — the :meth:`KVCachePool.read_blocks`
-    layout, scatter-ready on the decode side."""
+    """One finished prefill (or one spilled prefix-cache block): whole KV
+    blocks for every layer + the first greedy token. ``layers[i]`` is
+    ``(k, v)`` with shape (H, num_blocks, block_size, D) — the
+    :meth:`KVCachePool.read_blocks` layout, scatter-ready on the decode
+    side.
 
-    __slots__ = ('layers', 'context_len', 'first_token', 'block_size')
+    ``kv_dtype`` records the sender pool's storage dtype
+    (``PADDLE_TPU_KV_DTYPE``); for int8 pools ``scales[i]`` is the
+    ``(k_scales, v_scales)`` pair of (H, num_blocks, block_size) f32
+    row scales (``read_block_scales``) — shipping the quantized payload +
+    scales keeps a same-dtype handoff byte-exact AND ~4× smaller on the
+    wire than the f32 bytes it replaces."""
 
-    def __init__(self, layers, context_len, first_token, block_size):
+    __slots__ = ('layers', 'context_len', 'first_token', 'block_size',
+                 'kv_dtype', 'scales')
+
+    def __init__(self, layers, context_len, first_token, block_size,
+                 kv_dtype='f32', scales=None):
         self.layers = layers
         self.context_len = int(context_len)
         self.first_token = int(first_token)
         self.block_size = int(block_size)
+        self.kv_dtype = kv_dtype
+        self.scales = scales          # per-layer (k_scales, v_scales) | None
 
     @property
     def num_blocks(self):
@@ -61,15 +73,29 @@ class KVPayload:
 
     @property
     def nbytes(self):
-        return sum(k.nbytes + v.nbytes for k, v in self.layers)
+        total = sum(k.nbytes + v.nbytes for k, v in self.layers)
+        if self.scales is not None:
+            total += sum(ks.nbytes + vs.nbytes
+                         for ks, vs in self.scales if ks is not None)
+        return total
 
     # -- wire format (the cross-host seam) ---------------------------------
     def to_bytes(self):
-        arrays = {'meta': np.asarray([self.context_len, self.first_token,
-                                      self.block_size], np.int64)}
+        from ..decode.kv_cache import KV_DTYPE_CODES
+        arrays = {'meta': np.asarray(
+            [self.context_len, self.first_token, self.block_size,
+             KV_DTYPE_CODES[self.kv_dtype]], np.int64)}
         for i, (k, v) in enumerate(self.layers):
+            k, v = np.asarray(k), np.asarray(v)
+            if k.dtype.name == 'bfloat16':
+                # npz has no portable bf16; ship as f32 (a lossless widen —
+                # the receiving pool re-narrows to identical bf16 bytes)
+                k, v = k.astype(np.float32), v.astype(np.float32)
             arrays[f'k{i}'] = k
             arrays[f'v{i}'] = v
+            if self.scales is not None and self.scales[i] is not None:
+                arrays[f'ks{i}'] = np.asarray(self.scales[i][0])
+                arrays[f'vs{i}'] = np.asarray(self.scales[i][1])
         buf = io.BytesIO()
         # wire serialization into memory — no file, torn-write-proof
         # commit does not apply
@@ -78,14 +104,25 @@ class KVPayload:
 
     @classmethod
     def from_bytes(cls, data):
+        from ..decode.kv_cache import KV_DTYPE_CODES
+        codes = {v: k for k, v in KV_DTYPE_CODES.items()}
         with np.load(io.BytesIO(data)) as z:
-            ctx, first, bs = (int(x) for x in z['meta'])
-            layers = []
+            meta = [int(x) for x in z['meta']]
+            ctx, first, bs = meta[:3]
+            # pre-quantization senders wrote a 3-int meta: f32 payload
+            kv_dtype = codes[meta[3]] if len(meta) > 3 else 'f32'
+            layers, scales, any_scales = [], [], False
             i = 0
             while f'k{i}' in z:
                 layers.append((z[f'k{i}'], z[f'v{i}']))
+                if f'ks{i}' in z:
+                    scales.append((z[f'ks{i}'], z[f'vs{i}']))
+                    any_scales = True
+                else:
+                    scales.append(None)
                 i += 1
-        return cls(layers, ctx, first, bs)
+        return cls(layers, ctx, first, bs, kv_dtype=kv_dtype,
+                   scales=scales if any_scales else None)
 
 
 class PrefillReplica:
@@ -105,11 +142,17 @@ class PrefillReplica:
         try:
             first = eng.prefill(prompt, table)
             nb = -(-len(prompt) // bs)
-            layers = [eng.pool.read_blocks(layer, table.blocks[:nb])
-                      for layer in range(eng.pool.num_layers)]
+            layers, scales, any_scales = [], [], False
+            for layer in range(eng.pool.num_layers):
+                layers.append(eng.pool.read_blocks(layer, table.blocks[:nb]))
+                sc = eng.pool.read_block_scales(layer, table.blocks[:nb])
+                scales.append(sc)
+                any_scales = any_scales or sc is not None
         finally:
             eng.release_table(table)
-        return KVPayload(layers, len(prompt), first, bs)
+        return KVPayload(layers, len(prompt), first, bs,
+                         kv_dtype=eng.pool.kv_dtype,
+                         scales=scales if any_scales else None)
 
 
 class LocalPrefillWorker:

@@ -18,6 +18,9 @@ environment must fail the component that reads it, not every
 | ``PADDLE_TPU_SPEC_DECODE``             | ``0`` / ``1``          | DecodeEngine (``0`` is the hard escape hatch — wins over the constructor arg) |
 | ``PADDLE_TPU_SPEC_K``                  | int >= 2               | DecodeEngine (verify-window width) |
 | ``PADDLE_TPU_SPEC_DRAFTER``            | ``ngram`` / ``draft_model`` / ``off`` | DecodeScheduler |
+| ``PADDLE_TPU_KV_DTYPE``                | ``f32`` / ``bf16`` / ``int8`` | KVCachePool storage dtype (docs/SERVING.md "Tiered KV cache") |
+| ``PADDLE_TPU_DECODE_HBM_MB``           | int > 0                | DecodeEngine pool sizing (budget solve; explicit ``PADDLE_TPU_DECODE_MAX_BLOCKS`` / ``max_blocks=`` wins) |
+| ``PADDLE_TPU_PREFIX_CACHE_HOST_MB``    | int >= 0 (0 = no spill tier) | PrefixCache host spill tier byte cap |
 | ``PADDLE_TPU_TRACE_SAMPLE``            | float in [0, 1]        | router edge sampling (observability/trace_context.py) |
 | ``PADDLE_TPU_TRACE_DIR``               | directory path         | span-record JSONL output (observability/distributed.py) |
 | ``PADDLE_TPU_SLO``                     | ``<series>.<agg><op><value>,...`` | ServingServer /healthz (observability/distributed.py SLOMonitor) |
@@ -34,10 +37,21 @@ __all__ = ['parse_flag_env', 'parse_int_env', 'parse_float_env',
            'parse_replicas_env', 'parse_choice_env', 'ENV_PREFIX_CACHE',
            'ENV_PREFIX_CACHE_MAX_BLOCKS', 'ENV_DISAGG', 'ENV_ROUTER_REPLICAS',
            'ENV_ROUTER_PORT', 'ENV_ROUTER_HEALTH_POLL_S', 'ENV_SPEC_DECODE',
-           'ENV_SPEC_K', 'ENV_SPEC_DRAFTER']
+           'ENV_SPEC_K', 'ENV_SPEC_DRAFTER', 'ENV_KV_DTYPE',
+           'ENV_DECODE_HBM_MB', 'ENV_PREFIX_CACHE_HOST_MB',
+           'KV_DTYPE_CHOICES']
 
 ENV_PREFIX_CACHE = 'PADDLE_TPU_PREFIX_CACHE'
 ENV_PREFIX_CACHE_MAX_BLOCKS = 'PADDLE_TPU_PREFIX_CACHE_MAX_BLOCKS'
+ENV_KV_DTYPE = 'PADDLE_TPU_KV_DTYPE'
+ENV_DECODE_HBM_MB = 'PADDLE_TPU_DECODE_HBM_MB'
+ENV_PREFIX_CACHE_HOST_MB = 'PADDLE_TPU_PREFIX_CACHE_HOST_MB'
+
+# the KV-cache storage dtypes kv_cache.KVCachePool accepts, in quality
+# order: f32 is the bitwise-exact default, bf16 halves payload bytes with
+# exact-roundtrip-through-f32 semantics, int8 quarters them behind one f32
+# scale per (head, position) row (quant_collectives.rowwise_quantize)
+KV_DTYPE_CHOICES = ('f32', 'bf16', 'int8')
 ENV_DISAGG = 'PADDLE_TPU_DISAGG'
 ENV_ROUTER_REPLICAS = 'PADDLE_TPU_ROUTER_REPLICAS'
 ENV_ROUTER_PORT = 'PADDLE_TPU_ROUTER_PORT'

@@ -1,0 +1,54 @@
+"""tier-1 guard for the KV-quantization bench section: the
+``decode_kv_quant`` A/B from tools/bench_decode.py must run on CPU and
+hold the quality contract — f32 storage bitwise, int8 greedy match-rate
+≥ 0.99 — plus the geometry acceptance: int8 pools ≥ 3.5× smaller in HBM
+than f32 at head_dim 32 (measured pool bytes, not arithmetic), more
+budget-solved slots per chip, and a host tier that extends the effective
+cache beyond HBM. Run standalone here (the full bench_decode smoke is
+tests/framework/test_bench_decode.py's job) so a kv-quant regression
+points at this file."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+_RUNNER = (
+    "import json, sys; sys.path.insert(0, %r); "
+    "from bench_decode import measure_kv_quant; "
+    "print(json.dumps(measure_kv_quant(smoke=True)))"
+    % os.path.join(REPO, 'tools'))
+
+
+def test_bench_kv_quant_smoke_runs_on_cpu():
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    r = subprocess.run([sys.executable, '-c', _RUNNER], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    kv = json.loads(r.stdout.strip().splitlines()[-1])
+    assert kv['bench'] == 'decode_kv_quant'
+    assert set(kv['per_dtype']) == {'f32', 'bf16', 'int8'}
+    for d in kv['per_dtype'].values():
+        assert d['tokens_per_s'] > 0
+        assert d['kv_bytes_in_hbm'] > 0
+        assert 0.0 <= d['match_rate_vs_f32'] <= 1.0
+
+    # quality contract (docs/SERVING.md): f32 is the pre-quantization path
+    # bit for bit; int8 may drift but must track the greedy trajectory
+    assert kv['per_dtype']['f32']['bitwise_equal'] is True
+    assert kv['per_dtype']['f32']['match_rate_vs_f32'] == 1.0
+    assert kv['per_dtype']['int8']['match_rate_vs_f32'] >= 0.99
+
+    # geometry acceptance at head_dim 32: f32 rows 128 B, int8 rows 36 B
+    assert kv['head_dim'] == 32
+    assert kv['hbm_bytes_f32_over_int8'] >= 3.5, kv
+    assert kv['per_dtype']['bf16']['kv_bytes_in_hbm'] * 2 == \
+        kv['per_dtype']['f32']['kv_bytes_in_hbm']
+
+    # what the bytes buy: more solved slots per chip at the same budget,
+    # and the host tier extends every dtype's effective cache
+    assert kv['slots_per_chip']['int8'] > kv['slots_per_chip']['bf16'] \
+        > kv['slots_per_chip']['f32'] > 0
+    for d, eff in kv['effective_cache_blocks'].items():
+        assert eff['with_host_tier'] > eff['hbm_only'], (d, eff)

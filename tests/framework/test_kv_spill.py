@@ -1,0 +1,300 @@
+"""Tiered HBM→host prefix cache (PADDLE_TPU_PREFIX_CACHE_HOST_MB):
+spill→reinject bitwise parity, the publish-time MAX_BLOCKS cap (cause-
+labeled eviction metrics), host-LRU byte bounding, the walked-path
+exclusion regression (an insert must never orphan the subtree it stands
+on), and a kill -9 subprocess drill — the spill tier is process-local, so
+dying mid-spill can never corrupt anything a fresh process sees."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.dygraph import guard
+from paddle_tpu.models.causal_lm import greedy_generate
+from paddle_tpu.serving import DecodeEngine, DecodeScheduler, PrefixCache
+from paddle_tpu.serving.decode.kv_cache import BlockTable
+from paddle_tpu.serving.tier.replica import build_tiny_lm
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(scope='module')
+def lm():
+    with guard():
+        yield build_tiny_lm()
+
+
+def make_engine(model, **kw):
+    kw.setdefault('slots', 2)
+    kw.setdefault('block_size', 4)
+    kw.setdefault('max_blocks', 64)
+    kw.setdefault('max_prompt_len', 16)
+    kw.setdefault('max_new_tokens_cap', 8)
+    kw.setdefault('prefix_cache', True)
+    return DecodeEngine(model, **kw)
+
+
+def _counter(name, **labels):
+    from paddle_tpu.observability import registry
+    d = registry.to_dict().get(name)
+    if not d or not d['samples']:
+        return 0.0
+    return sum(s['value'] for s in d['samples']
+               if not labels or s.get('labels') == labels)
+
+
+PROMPT = [7, 3, 11, 5, 9, 2, 44, 8, 13]           # two whole 4-token blocks
+
+
+# -- spill → reinject parity (the load-bearing contract) -------------------
+
+@pytest.mark.parametrize('dtype', ['f32', 'int8'])
+def test_spill_reinject_bitwise_equals_resident_hit(lm, monkeypatch, dtype):
+    """Cold generation, spill EVERY cached block to host RAM, run the same
+    prompt again: the hit reinjects from the host tier and must produce
+    the cold generation's exact bytes — at f32 (byte-identical payload
+    roundtrip) and at int8 (quantized payload + scales roundtrip)."""
+    monkeypatch.setenv('PADDLE_TPU_PREFIX_CACHE_HOST_MB', '8')
+    eng = make_engine(lm, kv_dtype=dtype)
+    pc = eng.prefix_cache
+    s0 = _counter('kv_cache_spill_count')
+    b0 = _counter('kv_cache_bytes_spilled')
+    r0 = _counter('kv_cache_reinject_count')
+    with DecodeScheduler(eng) as sched:
+        cold = sched.submit(PROMPT, max_new_tokens=6).result(240)
+        resident = pc.resident_blocks
+        assert resident == 2
+        while pc._spill_or_evict_one():
+            pass
+        assert pc.resident_blocks == 0
+        assert pc.spilled_blocks == resident
+        assert pc.host_bytes > 0
+        assert _counter('kv_cache_spill_count') - s0 == resident
+        assert _counter('kv_cache_bytes_spilled') - b0 == pc.host_bytes
+        hit = sched.submit(PROMPT, max_new_tokens=6).result(240)
+    assert hit == cold
+    if dtype == 'f32':
+        assert cold == greedy_generate(lm, PROMPT, 6,
+                                       pad_len=eng.padded_context)
+    assert _counter('kv_cache_reinject_count') - r0 == resident
+    assert pc.spilled_blocks == 0                 # promoted back to HBM
+    assert pc.resident_blocks == resident
+
+
+def test_evict_idle_drops_host_tier_too(lm, monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_PREFIX_CACHE_HOST_MB', '8')
+    eng = make_engine(lm)
+    pc = eng.prefix_cache
+    with DecodeScheduler(eng) as sched:
+        sched.submit(PROMPT, max_new_tokens=4).result(240)
+    while pc._spill_or_evict_one():
+        pass
+    assert pc.spilled_blocks > 0
+    # a cold re-publish over the spilled path promotes the nodes in place
+    with DecodeScheduler(eng) as sched:
+        sched.submit(PROMPT, max_new_tokens=4).result(240)
+    assert pc.spilled_blocks == 0 and pc.resident_blocks == 2
+    pc.evict_idle()
+    assert pc.resident_blocks == 0 and pc.spilled_blocks == 0
+    assert pc.host_bytes == 0
+    assert eng.pool.allocator.used == 0
+
+
+# -- publish-time cap (the satellite bugfix) -------------------------------
+
+def test_max_blocks_cap_enforced_on_publish(lm, monkeypatch):
+    """PADDLE_TPU_PREFIX_CACHE_MAX_BLOCKS must bound residency at PUBLISH
+    time too (pre-fix it only triggered on allocation pressure): three
+    disjoint 2-block prompts through a cap of 2 keep residency ≤ 2 and
+    count prefix_cache_evictions{cause=cap}."""
+    monkeypatch.setenv('PADDLE_TPU_PREFIX_CACHE_MAX_BLOCKS', '2')
+    monkeypatch.delenv('PADDLE_TPU_PREFIX_CACHE_HOST_MB', raising=False)
+    eng = make_engine(lm)
+    c0 = _counter('prefix_cache_evictions', cause='cap')
+    prompts = [[t] * 9 for t in (5, 6, 7)]
+    with DecodeScheduler(eng) as sched:
+        for p in prompts:
+            sched.submit(p, max_new_tokens=4).result(240)
+    pc = eng.prefix_cache
+    assert pc.resident_blocks <= 2
+    assert pc.resident_blocks == len(pc.resident_block_ids())
+    assert _counter('prefix_cache_evictions', cause='cap') - c0 > 0
+
+
+def test_cap_spills_instead_of_dropping_when_host_configured(lm,
+                                                             monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_PREFIX_CACHE_MAX_BLOCKS', '2')
+    monkeypatch.setenv('PADDLE_TPU_PREFIX_CACHE_HOST_MB', '8')
+    eng = make_engine(lm)
+    pc = eng.prefix_cache
+    prompts = [[t] * 9 for t in (5, 6, 7)]
+    with DecodeScheduler(eng) as sched:
+        colds = [sched.submit(p, max_new_tokens=4).result(240)
+                 for p in prompts]
+        assert pc.resident_blocks <= 2
+        assert pc.spilled_blocks > 0              # cap moved blocks to host
+        # the capped-out prompt still hits — served back via reinjection
+        r0 = _counter('kv_cache_reinject_count')
+        h0 = _counter('prefix_cache_hits')
+        again = sched.submit(prompts[0], max_new_tokens=4).result(240)
+    assert again == colds[0]
+    assert _counter('prefix_cache_hits') - h0 == 1
+    assert _counter('kv_cache_reinject_count') - r0 > 0
+
+
+# -- host LRU bounding -----------------------------------------------------
+
+def test_host_tier_lru_cap_drops_oldest():
+    from paddle_tpu.serving.tier.prefix_cache import _HostTier, _Node
+    t = _HostTier(100)
+    n1, n2, n3 = _Node(None), _Node(None), _Node(None)
+    assert t.put(n1, b'x' * 40) == []
+    assert t.put(n2, b'y' * 40) == []
+    t.touch(n1)                                   # n2 becomes the LRU entry
+    assert t.put(n3, b'z' * 40) == [n2]
+    assert t.bytes <= 100
+    assert n1 in t and n3 in t and n2 not in t
+    assert t.pop(n1) == b'x' * 40
+    assert t.bytes == 40
+
+
+def test_host_overflow_drops_trie_path_for_real(lm, monkeypatch):
+    """When the LRU lets a payload go, its spilled trie node must go too —
+    the prompt becomes an honest MISS (re-prefilled bitwise) instead of a
+    dangling path match would try to reinject."""
+    monkeypatch.setenv('PADDLE_TPU_PREFIX_CACHE_HOST_MB', '8')
+    eng = make_engine(lm)
+    pc = eng.prefix_cache
+    with DecodeScheduler(eng) as sched:
+        cold = sched.submit(PROMPT, max_new_tokens=4).result(240)
+        while pc._spill_or_evict_one():
+            pass
+        assert pc.spilled_blocks == 2
+        pc._host.cap = 1                          # force total overflow
+        other = [9] * 9
+        sched.submit(other, max_new_tokens=4).result(240)
+        while pc._spill_or_evict_one():
+            pass
+        # every spill overflowed the 1-byte cap: all host entries dropped,
+        # nothing dangles
+        assert pc.spilled_blocks == 0 and pc.host_bytes == 0
+        assert pc.match(PROMPT) == []             # honest miss, no crash
+        m0 = _counter('prefix_cache_misses')
+        again = sched.submit(PROMPT, max_new_tokens=4).result(240)
+    assert again == cold
+    assert _counter('prefix_cache_misses') - m0 >= 1
+
+
+# -- walked-path exclusion regression --------------------------------------
+
+def test_insert_never_orphans_the_walked_path(lm):
+    """Regression: a publish that hits the cap while standing on an IDLE
+    cached node (refcount 1 — its request already finished) must not evict
+    that node: unlinking it would attach the new child to a detached
+    subtree and leak its block. With the fix, the walk's own path is
+    excluded from victim selection and the publish simply stops."""
+    eng = make_engine(lm, prefix_cache=False)
+    pool = eng.pool
+    pc = PrefixCache(pool, max_blocks=1, host_mb=0)
+    bs = pool.block_size
+    prefix = [5, 6, 7, 8]
+    # request Q publishes the one-block prefix, then finishes
+    q_blocks = pool.allocator.allocate(1)
+    pc.insert(prefix, BlockTable(q_blocks, bs))
+    pool.allocator.release(q_blocks)
+    assert pc.resident_blocks == 1
+    node_a = pc._root.children[tuple(prefix)]
+    assert pool.allocator.refcount(node_a.block) == 1   # idle, evictable
+    # request R (cold admission, private copies) publishes prefix + suffix:
+    # chunk 2 needs a block, the cap is reached, and the only idle victim
+    # is the node R's walk is standing on
+    r_blocks = pool.allocator.allocate(2)
+    pc.insert(prefix + [9, 10, 11, 12], BlockTable(r_blocks, bs))
+    pool.allocator.release(r_blocks)
+    # the walked node survived; nothing was orphaned or leaked
+    assert pc._root.children[tuple(prefix)] is node_a
+    assert node_a.block is not None
+    assert pc.resident_blocks == len(pc.resident_block_ids()) == 1
+    assert pool.allocator.used == pc.resident_blocks
+    assert pc.match(prefix + [0]) == [node_a.block]
+    pool.allocator.release([node_a.block])
+
+
+# -- kill -9 drill ---------------------------------------------------------
+
+_DRILL = r"""
+import os, sys
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+os.environ['PADDLE_TPU_PREFIX_CACHE_HOST_MB'] = '8'
+os.environ['PADDLE_TPU_KV_DTYPE'] = 'int8'
+sys.path.insert(0, sys.argv[1])
+from paddle_tpu.dygraph import guard
+from paddle_tpu.serving import DecodeEngine, DecodeScheduler
+from paddle_tpu.serving.tier.replica import build_tiny_lm
+rounds = int(sys.argv[2])
+with guard():
+    lm = build_tiny_lm()
+    eng = DecodeEngine(lm, slots=2, block_size=4, max_blocks=64,
+                       max_prompt_len=16, max_new_tokens_cap=8,
+                       prefix_cache=True)
+    pc = eng.prefix_cache
+    for rnd in range(rounds):
+        prompt = [3 + rnd % 50] * 8 + [1 + rnd % 7]
+        with DecodeScheduler(eng) as sched:
+            cold = sched.submit(prompt, max_new_tokens=6).result(120)
+            while pc._spill_or_evict_one():
+                pass
+            assert pc.resident_blocks == 0
+            hit = sched.submit(prompt, max_new_tokens=6).result(120)
+        assert hit == cold, (rnd, hit, cold)
+        assert eng.pool.allocator.used == pc.resident_blocks
+        print('ROUND-OK %d' % rnd, flush=True)
+"""
+
+
+def _spawn_drill(tmp_path, rounds):
+    script = tmp_path / 'drill.py'
+    script.write_text(_DRILL)
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    return subprocess.Popen(
+        [sys.executable, str(script), _REPO, str(rounds)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def test_kill9_mid_spill_leaves_nothing_corrupt(tmp_path):
+    """The drill: a subprocess loops cold→spill-everything→hit rounds,
+    printing ROUND-OK only after verifying parity and pool accounting.
+    SIGKILL lands mid-round; every round that completed before it had
+    already verified, and a FRESH process (the only thing that exists
+    after kill -9 — the spill tier is process RAM) runs the same round
+    clean. There is no persistent state to corrupt, and this drill is the
+    executable proof."""
+    proc = _spawn_drill(tmp_path, rounds=1000)
+    try:
+        seen = []
+        deadline = time.monotonic() + 300
+        while len(seen) < 2 and time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            assert line.startswith('ROUND-OK'), line
+            seen.append(line.strip())
+        assert seen == ['ROUND-OK 0', 'ROUND-OK 1'], (
+            seen, proc.stderr.read() if proc.poll() is not None else '')
+        proc.send_signal(signal.SIGKILL)          # mid-round, no cleanup
+        proc.wait(timeout=60)
+        assert proc.returncode == -signal.SIGKILL
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    fresh = _spawn_drill(tmp_path, rounds=1)
+    out, err = fresh.communicate(timeout=300)
+    assert fresh.returncode == 0, err[-3000:]
+    assert 'ROUND-OK 0' in out

@@ -27,6 +27,12 @@ drain policy's idle-slot waste), one JSON line each:
    rounds take ≥ 1.5× fewer decode steps than lockstep (greedy tiny-LM
    streams are repetition-heavy — the n-gram drafter's cache-friendly
    case). ``speedup_vs_lockstep`` reports the wall-clock ratio.
+6. ``decode_kv_quant`` — KV storage dtype A/B (f32 / bf16 / int8 pools on
+   a head_dim-32 model): measured pool bytes-in-HBM (int8 acceptance:
+   ≥ 3.5× smaller than f32), token-level greedy match-rate vs f32 (f32
+   bitwise, int8 ≥ 0.99), and the capacity the bytes buy — slots-per-chip
+   at a fixed HBM budget and effective cache blocks with the host spill
+   tier (PERF.md §23).
 
 Runs on any backend; CPU is the honest configuration (the quantity under
 test is scheduling + shape discipline, not FLOPs):
@@ -184,6 +190,81 @@ def measure_spec(engine, work, refs):
     return res
 
 
+def measure_kv_quant(smoke=False, seed=0):
+    """KV storage dtype A/B (PERF.md §23): the same greedy workload through
+    engines at PADDLE_TPU_KV_DTYPE = f32 / bf16 / int8 on a head_dim-32
+    model — f32 rows are 128 B, int8 rows 32+4 B (payload + one f32 scale),
+    so the pool ratio under test is 3.56×; tiny's head_dim 16 would
+    understate it (3.2×). Reports per-dtype tokens/s, measured pool
+    bytes-in-HBM, token-level greedy match-rate against the f32 reference
+    (the quality contract: f32 bitwise, int8 ≥ 0.99), and what the bytes
+    buy: slots-per-chip at a fixed HBM budget (planner solve ÷ worst-case
+    blocks per request) and effective cache blocks with the host spill
+    tier on top of HBM."""
+    from paddle_tpu.analysis.plan import (decode_pool_block_bytes,
+                                          solve_decode_pool_blocks)
+    from paddle_tpu.dygraph import guard
+    from paddle_tpu.models.causal_lm import (CausalLMConfig, TransformerLM,
+                                             greedy_generate)
+    from paddle_tpu.serving.decode import DecodeEngine, DecodeScheduler
+    requests = 8 if smoke else 16
+    budget_mb, host_mb = 1024, 512
+    with guard():
+        cfg = CausalLMConfig(vocab_size=128, hidden_size=64,
+                             num_hidden_layers=2, num_attention_heads=2,
+                             intermediate_size=64,
+                             max_position_embeddings=128)
+        model = TransformerLM(cfg)
+        model.eval()
+        work = build_workload(requests, 12, 24 if smoke else 32, seed)
+        per, refs, max_bps = {}, None, None
+        for dtype in ('f32', 'bf16', 'int8'):
+            engine = DecodeEngine(model, slots=4, block_size=8,
+                                  max_blocks=256, max_prompt_len=16,
+                                  max_new_tokens_cap=48, kv_dtype=dtype)
+            max_bps = engine.pool.max_blocks_per_seq
+            if refs is None:
+                refs = [greedy_generate(model, p, m,
+                                        pad_len=engine.padded_context)
+                        for p, m in work]
+            engine.warmup()
+            with DecodeScheduler(engine, queue_depth=len(work) + 1) as sched:
+                t0 = time.perf_counter()
+                streams = [sched.submit(p, max_new_tokens=m)
+                           for p, m in work]
+                outs = [s.result(600) for s in streams]
+                wall = time.perf_counter() - t0
+            matched = sum(sum(a == b for a, b in zip(o, r))
+                          for o, r in zip(outs, refs))
+            total = sum(len(r) for r in refs)
+            per[dtype] = {
+                'tokens_per_s': round(sum(len(o) for o in outs) / wall, 1),
+                'kv_bytes_in_hbm': int(engine.pool.bytes_in_hbm()),
+                'match_rate_vs_f32': round(matched / max(total, 1), 4),
+                'bitwise_equal': outs == refs,
+            }
+        slots_per_chip, eff = {}, {}
+        for dtype in per:
+            blocks = solve_decode_pool_blocks(model, budget_mb,
+                                              block_size=8, kv_dtype=dtype)
+            slots_per_chip[dtype] = blocks // max_bps
+            block_bytes = decode_pool_block_bytes(model, 8, dtype)
+            eff[dtype] = {
+                'hbm_only': blocks,
+                'with_host_tier': blocks + (host_mb << 20) // block_bytes,
+            }
+    return {
+        'bench': 'decode_kv_quant',
+        'requests': len(work), 'head_dim': 32, 'budget_mb': budget_mb,
+        'host_mb': host_mb, 'per_dtype': per,
+        'hbm_bytes_f32_over_int8': round(
+            per['f32']['kv_bytes_in_hbm']
+            / per['int8']['kv_bytes_in_hbm'], 2),
+        'slots_per_chip': slots_per_chip,
+        'effective_cache_blocks': eff,
+    }
+
+
 def measure_all(smoke=False, seed=0):
     from paddle_tpu.dygraph import guard
     from paddle_tpu.models.causal_lm import CausalLMConfig, TransformerLM
@@ -216,8 +297,9 @@ def measure_all(smoke=False, seed=0):
         cont['tokens_per_s'] / drain['tokens_per_s'], 2)
     spec['speedup_vs_lockstep'] = round(
         spec['tokens_per_s'] / cont['tokens_per_s'], 2)
+    kv_quant = measure_kv_quant(smoke=smoke, seed=seed)
     return {'uncached': baseline, 'continuous': cont, 'drain': drain,
-            'sampled': sampled, 'speculative': spec}
+            'sampled': sampled, 'speculative': spec, 'kv_quant': kv_quant}
 
 
 def main():
@@ -231,13 +313,20 @@ def main():
     # gate on correctness and STRUCTURE (step counts are deterministic for
     # the seeded workload); wall-clock ratios live in PERF.md §13 and stay
     # out of the exit code so a loaded CI box cannot flake the bench
+    kv = results['kv_quant']
     ok = (results['continuous']['bitwise_equal']
           and results['drain']['bitwise_equal']
           and results['continuous']['steps'] < results['drain']['steps']
           and results['sampled']['replayable']
           and results['speculative']['bitwise_equal']
           and results['speculative']['steps'] * 1.5
-          <= results['continuous']['steps'])
+          <= results['continuous']['steps']
+          # kv-quant quality contract (docs/SERVING.md): f32 storage is
+          # bitwise; int8 greedy match-rate ≥ 0.99. The byte ratio is pool
+          # geometry, not wall-clock — deterministic, so gated too.
+          and kv['per_dtype']['f32']['bitwise_equal']
+          and kv['per_dtype']['int8']['match_rate_vs_f32'] >= 0.99
+          and kv['hbm_bytes_f32_over_int8'] >= 3.5)
     sys.exit(0 if ok else 1)
 
 

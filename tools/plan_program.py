@@ -11,6 +11,14 @@ Milliseconds, zero tracing — nothing is compiled or executed.
         --batch-size 64 --passes
     JAX_PLATFORMS=cpu python tools/plan_program.py --model-dir /m \
         --budget 2048
+    JAX_PLATFORMS=cpu python tools/plan_program.py --decode-pool-mb 2048 \
+        --kv-dtype int8
+
+``--decode-pool-mb MB`` prints the decode KV pool sizing solve
+(``analysis.plan.decode_pool_report``): the same arithmetic the engine
+runs for ``PADDLE_TPU_DECODE_HBM_MB`` — model state subtracted from the
+budget, the remainder divided by per-block KV bytes at ``--kv-dtype`` —
+so the pool a budget buys is inspectable before serving starts.
 
 ``--budget MB`` gates the exit code: 1 when the predicted peak exceeds
 it (CI memory regression guard), 0 otherwise. ``--passes`` plans the
@@ -35,11 +43,37 @@ if _TOOLS not in sys.path:
     sys.path.insert(0, _TOOLS)
 
 
+def _decode_pool_doc(args):
+    """The itemized PADDLE_TPU_DECODE_HBM_MB solve, as a plain dict."""
+    from paddle_tpu.analysis.plan import decode_pool_report
+    from paddle_tpu.models.causal_lm import CausalLMConfig, TransformerLM
+    cfg = (CausalLMConfig.tiny() if args.decode_model == 'tiny'
+           else CausalLMConfig())
+    report = decode_pool_report(TransformerLM(cfg), args.decode_pool_mb,
+                                block_size=args.kv_block_size,
+                                kv_dtype=args.kv_dtype)
+    report['model'] = args.decode_model
+    return report
+
+
+def _format_decode_pool(doc):
+    mib = 1 << 20
+    yield (f"decode pool: {doc['num_blocks']} blocks of "
+           f"{doc['block_size']} tokens at kv_dtype={doc['kv_dtype']} "
+           f"({doc['model']} model)")
+    yield (f"  budget {doc['budget_mb']} MiB - model state "
+           f"{doc['model_state_bytes'] / mib:.1f} MiB -> "
+           f"{doc['pool_bytes'] / mib:.1f} MiB of KV pages")
+    yield (f"  block = {doc['kv_layers']} layers x 2 (K,V) x "
+           f"{doc['kv_heads']} heads x {doc['block_size']} tokens x "
+           f"{doc['row_bytes']} B/row = {doc['block_bytes']} B")
+
+
 def main(argv=None):
     from lint_program import RECIPES, _build_recipe, _load_model
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    src = ap.add_mutually_exclusive_group(required=True)
+    src = ap.add_mutually_exclusive_group()
     src.add_argument('--model-dir',
                      help='saved inference model '
                           '(fluid.io.save_inference_model layout)')
@@ -62,12 +96,43 @@ def main(argv=None):
                     help='rows in the residents / op-cost tables')
     ap.add_argument('--json', action='store_true',
                     help='emit the machine-readable plan')
+    ap.add_argument('--decode-pool-mb', type=int, default=None,
+                    help='print the decode KV pool sizing solve for this '
+                         'HBM budget (MiB) — the PADDLE_TPU_DECODE_HBM_MB '
+                         'arithmetic, itemized')
+    ap.add_argument('--kv-dtype', choices=('f32', 'bf16', 'int8'),
+                    default='f32',
+                    help='KV pool storage dtype for the sizing solve '
+                         '(PADDLE_TPU_KV_DTYPE; default f32)')
+    ap.add_argument('--kv-block-size', type=int, default=16,
+                    help='KV pool block size for the sizing solve '
+                         '(default 16)')
+    ap.add_argument('--decode-model', choices=('tiny', 'base'),
+                    default='base',
+                    help='CausalLM preset whose state/geometry the sizing '
+                         'solve uses (default base)')
     args = ap.parse_args(argv)
     if args.batch_size <= 0:
         ap.error('--batch-size must be > 0')
+    if not (args.model_dir or args.recipe or args.decode_pool_mb):
+        ap.error('one of --model-dir, --recipe or --decode-pool-mb '
+                 'is required')
+    if args.decode_pool_mb is not None and args.decode_pool_mb <= 0:
+        ap.error('--decode-pool-mb must be > 0')
+    if args.kv_block_size <= 0:
+        ap.error('--kv-block-size must be > 0')
 
     os.environ.setdefault('PADDLE_TPU_VERIFY', 'full')
     from paddle_tpu.analysis.plan import plan_program
+
+    pool_doc = _decode_pool_doc(args) if args.decode_pool_mb else None
+    if not (args.model_dir or args.recipe):
+        # decode-pool-only mode: no program to plan
+        if args.json:
+            print(json.dumps({'decode_pool': pool_doc}, indent=1))
+        else:
+            print('\n'.join(_format_decode_pool(pool_doc)))
+        return 0
 
     if args.model_dir:
         program, fetches, feeds = _load_model(args.model_dir)
@@ -99,6 +164,8 @@ def main(argv=None):
         if budget_bytes:
             doc['budget_bytes'] = budget_bytes
             doc['fits_budget'] = plan.peak_bytes <= budget_bytes
+        if pool_doc:
+            doc['decode_pool'] = pool_doc
         print(json.dumps(doc, indent=1))
     else:
         print(f'target: {label}  (batch dims assumed {args.batch_size}, '
@@ -106,6 +173,8 @@ def main(argv=None):
               f'{plan.plan_seconds * 1e3:.1f}ms)')
         print('\n'.join(plan.format_report(top=args.top,
                                            budget_bytes=budget_bytes)))
+        if pool_doc:
+            print('\n'.join(_format_decode_pool(pool_doc)))
     return 1 if budget_bytes and plan.peak_bytes > budget_bytes else 0
 
 

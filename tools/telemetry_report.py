@@ -361,6 +361,48 @@ def summarize(metrics, trace, steps, top=10):
                 f"{int(hf)} failed")
         lines.append('')
 
+    # ---- KV cache (quantized pools + host spill tier, docs/SERVING.md) --
+    kv_dtype = (metrics.get('kv_cache_dtype') or {}).get('samples', [])
+    kv_hbm = (metrics.get('kv_cache_bytes_in_hbm') or {}).get('samples', [])
+    spills = _counter(metrics, 'kv_cache_spill_count')
+    reinjects = _counter(metrics, 'kv_cache_reinject_count')
+    if kv_dtype or kv_hbm or spills or reinjects:
+        lines.append('## KV cache')
+        if kv_dtype:
+            names = {0: 'f32', 1: 'bf16', 2: 'int8'}
+            code = int(kv_dtype[0]['value'])
+            lines.append(f"storage dtype:         "
+                         f"{names.get(code, f'?({code})')} "
+                         f"(PADDLE_TPU_KV_DTYPE)")
+        if kv_hbm:
+            lines.append(f"bytes in HBM:          "
+                         f"{kv_hbm[0]['value'] / 2**20:.3f} MiB "
+                         f"(pool pages + row scales)")
+        if spills or reinjects:
+            sb = _counter(metrics, 'kv_cache_bytes_spilled')
+            lines.append(
+                f"host spill tier:       {int(spills)} block(s) spilled "
+                f"({sb / 2**20:.3f} MiB serialized), "
+                f"{int(reinjects)} reinjected on radix hits")
+            rs = (metrics.get('kv_cache_reinject_seconds')
+                  or {}).get('samples', [])
+            if rs and rs[0]['count']:
+                s = rs[0]
+                lines.append(f"reinject latency:      mean "
+                             f"{_ms(s['sum'] / s['count'])}, "
+                             f"max {_ms(s['max'] or 0)} per hit path")
+        ev = (metrics.get('prefix_cache_evictions') or {}).get('samples', [])
+        if ev:
+            by_cause = {}
+            for s in ev:
+                c = s['labels'].get('cause', '?')
+                by_cause[c] = by_cause.get(c, 0) + s['value']
+            lines.append(
+                "evictions by cause:    "
+                + ', '.join(f'{c}: {int(v)}'
+                            for c, v in sorted(by_cause.items())))
+        lines.append('')
+
     # ---- fleet-wide tier observability (docs/OBSERVABILITY.md) ----
     fleet_scrapes = _counter(metrics, 'router_fleet_scrapes')
     sampled = _counter(metrics, 'trace_requests_sampled')
