@@ -226,34 +226,61 @@ class PrefixCache:
         from .disagg import KVPayload
         t0 = time.perf_counter()
         exclude = set(map(id, path))
-        pending = []                       # (node, new block id, payload)
-        for idx, n in enumerate(path):
-            if n.block is not None:
-                continue
-            try:
-                bid = self._allocate_evicting(1, exclude=exclude)[0]
-            except OutOfBlocks:
-                path = path[:idx]
-                break
-            pending.append((n, bid, KVPayload.from_bytes(self._host.pop(n))))
-        if not pending:
-            return path
-        import numpy as np
-        ids = [bid for _, bid, _ in pending]
-        n_layers = max(len(p.layers) for _, _, p in pending)
-        for layer in range(n_layers):
-            k = np.concatenate([p.layers[layer][0] for _, _, p in pending],
-                               axis=1)
-            v = np.concatenate([p.layers[layer][1] for _, _, p in pending],
-                               axis=1)
-            ks = vs = None
-            if pending[0][2].scales is not None:
-                ks = np.concatenate(
-                    [p.scales[layer][0] for _, _, p in pending], axis=1)
-                vs = np.concatenate(
-                    [p.scales[layer][1] for _, _, p in pending], axis=1)
-            self.pool.write_whole_blocks(layer, ids, k, v,
-                                         k_scale=ks, v_scale=vs)
+        pending = []                       # [node, new block id, payload]
+        try:
+            for idx, n in enumerate(path):
+                if n.block is not None:
+                    continue
+                # ``exclude`` shields path nodes from VICTIM selection
+                # only: a pressure spill below can still overflow the
+                # host LRU and drop a later path node (this one
+                # included) — so check membership before allocating and
+                # after.
+                bid = None
+                if n in self._host:
+                    try:
+                        bid = self._allocate_evicting(1, exclude=exclude)[0]
+                    except OutOfBlocks:
+                        bid = None
+                    if bid is not None and n not in self._host:
+                        self.pool.allocator.release([bid])
+                        bid = None
+                if bid is None:
+                    # truncate here; the still-spilled tail was just
+                    # matched (hot), so refresh its host-LRU recency
+                    for m in path[idx:]:
+                        if m.block is None and m in self._host:
+                            self._host.touch(m)
+                    path = path[:idx]
+                    break
+                pending.append([n, bid, None])
+                pending[-1][2] = KVPayload.from_bytes(self._host.pop(n))
+            if not pending:
+                return path
+            import numpy as np
+            ids = [bid for _, bid, _ in pending]
+            n_layers = max(len(p.layers) for _, _, p in pending)
+            for layer in range(n_layers):
+                k = np.concatenate([p.layers[layer][0]
+                                    for _, _, p in pending], axis=1)
+                v = np.concatenate([p.layers[layer][1]
+                                    for _, _, p in pending], axis=1)
+                ks = vs = None
+                if pending[0][2].scales is not None:
+                    ks = np.concatenate(
+                        [p.scales[layer][0] for _, _, p in pending], axis=1)
+                    vs = np.concatenate(
+                        [p.scales[layer][1] for _, _, p in pending], axis=1)
+                self.pool.write_whole_blocks(layer, ids, k, v,
+                                             k_scale=ks, v_scale=vs)
+        except BaseException:
+            # the pending payloads are already popped from the host
+            # tier: return their blocks to the pool and drop the now-
+            # irrecoverable nodes so a later match cannot dangle on them
+            self.pool.allocator.release([bid for _, bid, _ in pending])
+            for m, _, _ in pending:
+                self._drop_spilled(m)
+            raise
         for n, bid, _ in pending:
             # the fresh allocation's refcount 1 becomes the cache's own
             # residency reference (mirror of insert's retain)
@@ -433,4 +460,15 @@ class PrefixCache:
             n = 0
             while self._spill_or_evict_one(allow_spill=False):
                 n += 1
+            # fully-spilled subtrees have no resident node for the loop to
+            # unlink through — drop them outright so host RAM drains too
+            if self._host is not None:
+                stack = [self._root]
+                while stack:
+                    node = stack.pop()
+                    for child in list(node.children.values()):
+                        if child.block is None:
+                            self._drop_spilled(child)
+                        else:
+                            stack.append(child)
             return n

@@ -226,6 +226,103 @@ def test_insert_never_orphans_the_walked_path(lm):
     pool.allocator.release([node_a.block])
 
 
+def test_reinject_survives_host_lru_dropping_a_path_node(lm):
+    """Regression: during reinjection, the pressure spill of a NON-path
+    victim can overflow the host LRU, which drops entries front-first —
+    possibly a LATER still-spilled node of the very path being
+    reinjected (``exclude`` shields path nodes from victim selection,
+    not from the byte-cap drop). match must truncate into an honest
+    shorter hit, not raise KeyError, and must not leak pool blocks."""
+    eng = make_engine(lm, prefix_cache=False)
+    pool = eng.pool
+    pc = PrefixCache(pool, max_blocks=0, host_mb=8)
+    bs = pool.block_size
+    pa = list(range(5, 5 + 2 * bs))               # two whole blocks
+    blocks = pool.allocator.allocate(2)
+    pc.insert(pa, BlockTable(blocks, bs))
+    pool.allocator.release(blocks)
+    # spill both: the leaf goes first (victims need no resident children),
+    # so the host LRU front is pa's DEEPER node — path[1] of a future hit
+    while pc._spill_or_evict_one():
+        pass
+    assert pc.spilled_blocks == 2
+    blobs = list(pc._host._entries.values())
+    assert len(set(map(len, blobs))) == 1         # one-block blobs, equal
+    # two idle resident non-path nodes: pressure victims for BOTH path
+    # allocations, so pre-fix the loop reaches the dropped leaf with a
+    # block in hand and dies on _host.pop
+    for t in (21, 22):
+        b2 = pool.allocator.allocate(1)
+        pc.insert([t] * bs, BlockTable(b2, bs))
+        pool.allocator.release(b2)
+    # cap fits exactly the two path blobs: spilling the first victim will
+    # overflow and drop the LRU front (pa's leaf)
+    pc._host.cap = pc.host_bytes + 1
+    held = pool.allocator.allocate(pool.allocator.available)
+    hit = pc.match(pa + [0])
+    parent = pc._root.children[tuple(pa[:bs])]
+    assert hit == [parent.block]                  # truncated, reinjected
+    assert parent.block is not None
+    assert tuple(pa[bs:2 * bs]) not in parent.children   # dropped for real
+    assert pc.resident_blocks == 2                # parent + untouched [22]*bs
+    assert pc.spilled_blocks == 1                 # the first victim's payload
+    pool.allocator.release(hit)
+    assert pool.allocator.used == len(held) + 2   # held + cache refs: no leak
+    pool.allocator.release(held)
+    pc.evict_idle()
+    assert pool.allocator.used == 0
+    assert pc.spilled_blocks == 0 and pc.host_bytes == 0
+
+
+def test_evict_idle_drains_fully_spilled_subtrees(lm, monkeypatch):
+    """Regression: evict_idle (shutdown path) only walked RESIDENT
+    victims, so a fully-spilled subtree hanging off the root kept its
+    payloads in host RAM forever. It must drain the host tier too."""
+    monkeypatch.setenv('PADDLE_TPU_PREFIX_CACHE_HOST_MB', '8')
+    eng = make_engine(lm)
+    pc = eng.prefix_cache
+    with DecodeScheduler(eng) as sched:
+        sched.submit(PROMPT, max_new_tokens=4).result(240)
+    while pc._spill_or_evict_one():
+        pass
+    assert pc.spilled_blocks > 0 and pc.host_bytes > 0
+    pc.evict_idle()
+    assert pc.resident_blocks == 0 and pc.spilled_blocks == 0
+    assert pc.host_bytes == 0
+    assert not pc._root.children                  # nothing dangles
+    assert eng.pool.allocator.used == 0
+
+
+def test_truncated_reinject_refreshes_host_lru_recency(lm):
+    """A matched-but-unreinjectable (OutOfBlocks) spilled path is HOT:
+    truncation must refresh its host-LRU recency so a later overflow
+    drops cold entries first, not the path that just hit."""
+    eng = make_engine(lm, prefix_cache=False)
+    pool = eng.pool
+    pc = PrefixCache(pool, max_blocks=0, host_mb=8)
+    bs = pool.block_size
+    pa = list(range(5, 5 + 2 * bs))
+    blocks = pool.allocator.allocate(2)
+    pc.insert(pa, BlockTable(blocks, bs))
+    pool.allocator.release(blocks)
+    b2 = pool.allocator.allocate(1)
+    pc.insert([31] * bs, BlockTable(b2, bs))
+    pool.allocator.release(b2)
+    while pc._spill_or_evict_one():
+        pass
+    assert pc.spilled_blocks == 3
+    pz_node = pc._root.children[tuple([31] * bs)]
+    # pool exhausted with nothing evictable: the reinject truncates at
+    # path[0] with OutOfBlocks and must touch pa's two spilled nodes
+    held = pool.allocator.allocate(pool.allocator.available)
+    m0 = _counter('prefix_cache_misses')
+    assert pc.match(pa + [0]) == []               # honest miss, no crash
+    assert _counter('prefix_cache_misses') - m0 == 1
+    assert pc.spilled_blocks == 3                 # nothing dropped
+    assert next(iter(pc._host._entries)) is pz_node   # cold entry is LRU
+    pool.allocator.release(held)
+
+
 # -- kill -9 drill ---------------------------------------------------------
 
 _DRILL = r"""
