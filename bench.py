@@ -504,6 +504,18 @@ def bench_resilience(on_tpu):
     return measure_all(smoke=not on_tpu)
 
 
+def bench_elastic(on_tpu):
+    """Elastic runtime (ISSUE 19): the autoscaler's Poisson ramp drill
+    (replica count follows load, zero drops through scale-up/drain, every
+    decision recorded with its trigger) and the goodput resize-vs-crash
+    bucket separation. Valid on CPU: control-loop and accounting
+    behaviour are the quantities under test."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools'))
+    from bench_elastic import measure_all
+    return measure_all(smoke=not on_tpu)
+
+
 def bench_collectives_section(on_tpu):
     """Quantized + bucketed gradient collectives (PERF.md §16). Runs in a
     SUBPROCESS: the 8-device virtual CPU mesh needs XLA_FLAGS set before
@@ -750,6 +762,21 @@ def main():
             supervisor_bitwise=rz['resilience_supervised']
             ['bitwise_identical'],
             nan_recovery_ok=rz['resilience_nan_recovery']['recovered'])
+
+    el = run("elastic", lambda: bench_elastic(on_tpu))
+    if el is not None:
+        emit({"metric": "elastic",
+              "autoscale_ramp": el['elastic_autoscale_ramp'],
+              "resize_accounting": el['elastic_resize_accounting']})
+        summary.update(
+            elastic_autoscale_dropped=el['elastic_autoscale_ramp']
+            ['dropped'],
+            elastic_autoscale_bitwise=el['elastic_autoscale_ramp']
+            ['bitwise_equal'],
+            elastic_max_replicas_seen=el['elastic_autoscale_ramp']
+            ['max_replicas_seen'],
+            elastic_resize_buckets_separate=el['elastic_resize_accounting']
+            ['buckets_separate'])
 
     co = run("collectives", lambda: bench_collectives_section(on_tpu))
     if co is not None:

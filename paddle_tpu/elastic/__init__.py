@@ -1,0 +1,48 @@
+"""Elastic runtime (ROADMAP item: elastic fleet + autoscaling tier).
+
+Two halves, both riding existing machinery instead of new control planes:
+
+- **Training — resize-on-restore** (:mod:`reshard`, :mod:`schedule`): a
+  sharded checkpoint written at nproc=4 restores onto nproc=2/8 — full
+  values reassemble on read (PR 12), the partitioner re-lays the tiles,
+  and :func:`check_reshard` validates the saved mesh/specs against the
+  restoring fleet UP FRONT (typed :class:`ReshardError` instead of a
+  ``device_put`` shape error). Scheduled grow/shrink
+  (``PADDLE_TPU_ELASTIC_RESIZE``) checkpoints synchronously at the
+  boundary and exits through the exit-for-resume ladder; goodput books
+  the downtime in its own resize bucket.
+- **Serving — autoscaler** (:mod:`autoscaler`, :mod:`launcher`): a
+  control loop beside the router consumes the always-on windowed series
+  and spawns/retires replicas through the :class:`ReplicaLauncher` seam,
+  gated behind the existing drain + cold-replica warmup machinery
+  (``PADDLE_TPU_AUTOSCALE_*`` knobs).
+
+Docs: docs/RESILIENCE.md "Elasticity", docs/SERVING.md "Autoscaler".
+"""
+from .reshard import ReshardError, check_reshard, current_mesh_axes
+from .schedule import (ENV_ELASTIC_RESIZE, RESIZE_FILE, ResizePlan,
+                       clear_resize_request, parse_resize_env,
+                       parse_resize_spec, read_resize_request,
+                       write_resize_request)
+
+__all__ = [
+    'ReshardError', 'check_reshard', 'current_mesh_axes',
+    'ResizePlan', 'parse_resize_env', 'parse_resize_spec',
+    'write_resize_request', 'read_resize_request', 'clear_resize_request',
+    'ENV_ELASTIC_RESIZE', 'RESIZE_FILE',
+    'AutoscaleConfig', 'Autoscaler', 'ReplicaLauncher',
+    'ProcessReplicaLauncher', 'CallableReplicaLauncher',
+]
+
+
+def __getattr__(name):
+    # the serving-side half imports the serving package; keep it lazy so
+    # training-only processes never pay (or break on) that import
+    if name in ('AutoscaleConfig', 'Autoscaler'):
+        from . import autoscaler as _a
+        return getattr(_a, name)
+    if name in ('ReplicaLauncher', 'ProcessReplicaLauncher',
+                'CallableReplicaLauncher'):
+        from . import launcher as _l
+        return getattr(_l, name)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

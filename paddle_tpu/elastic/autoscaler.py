@@ -1,0 +1,254 @@
+"""Serving-tier autoscaler: a control loop beside the router that grows
+and shrinks the replica set from the always-on windowed load series.
+
+Signals (PR 17 series, shipped in every replica's ``/healthz`` and cached
+on the router's :class:`~paddle_tpu.serving.tier.router.Replica` view):
+``queue_depth`` (scheduler backlog), ``occupancy`` (decode slot
+utilization), ``ttft`` p99 (time-to-first-token — the user-visible SLO).
+Policy, evaluated once per ``interval_s`` tick (docs/SERVING.md
+"Autoscaler"):
+
+- **scale UP** when mean queue depth per routable replica exceeds
+  ``up_queue`` or p99 TTFT exceeds ``up_ttft_s``, capped at
+  ``max_replicas``;
+- **scale DOWN** when mean occupancy stays below ``down_occupancy`` AND
+  the queue is empty for ``down_delay_s`` straight, floored at
+  ``min_replicas``;
+- both directions respect ``cooldown_s`` between decisions (hysteresis:
+  one decision per cooldown window, sustained-low for down).
+
+Safety rides the EXISTING tier machinery, never around it: a launched
+replica enters the router cold and unroutable — the warmup gate plus the
+fast initial health poll (PR 19 router fix) decide time-to-routable; a
+retiring replica is DRAINED first (router stops routing, in-flight
+streams run to completion, replica-side queue observed empty) and only
+then retired through the :class:`~paddle_tpu.elastic.launcher
+.ReplicaLauncher` seam — scale-down drops zero requests by construction.
+
+Every decision is recorded: ``autoscale_decisions{action,trigger}``,
+``autoscale_replicas``, ``autoscale_time_to_routable_seconds``, and the
+in-memory ``Autoscaler.decisions`` journal the drills assert on.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ..log_helper import get_logger
+from ..serving import metrics as _m
+from ..serving.tier.knobs import (
+    ENV_AUTOSCALE, ENV_AUTOSCALE_COOLDOWN_S, ENV_AUTOSCALE_DOWN_DELAY_S,
+    ENV_AUTOSCALE_DOWN_OCC, ENV_AUTOSCALE_INTERVAL_S, ENV_AUTOSCALE_MAX,
+    ENV_AUTOSCALE_MIN, ENV_AUTOSCALE_UP_QUEUE, ENV_AUTOSCALE_UP_TTFT_S,
+    parse_flag_env, parse_float_env, parse_int_env)
+
+__all__ = ['AutoscaleConfig', 'Autoscaler']
+
+_logger = get_logger(
+    __name__, logging.INFO,
+    fmt='%(asctime)s-%(levelname)s: [elastic] %(message)s')
+
+
+class AutoscaleConfig:
+    """Hysteresis policy knobs; :meth:`from_env` strict-parses the
+    ``PADDLE_TPU_AUTOSCALE_*`` set (tier/knobs.py table)."""
+
+    def __init__(self, min_replicas=1, max_replicas=4, interval_s=1.0,
+                 up_queue=4.0, up_ttft_s=2.0, down_occupancy=0.25,
+                 cooldown_s=10.0, down_delay_s=30.0):
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.interval_s = float(interval_s)
+        self.up_queue = float(up_queue)
+        self.up_ttft_s = float(up_ttft_s)
+        self.down_occupancy = float(down_occupancy)
+        self.cooldown_s = float(cooldown_s)
+        self.down_delay_s = float(down_delay_s)
+        if self.min_replicas > self.max_replicas:
+            raise ValueError(
+                f'{ENV_AUTOSCALE_MIN}={self.min_replicas} must be <= '
+                f'{ENV_AUTOSCALE_MAX}={self.max_replicas}')
+
+    @classmethod
+    def from_env(cls):
+        return cls(
+            min_replicas=parse_int_env(ENV_AUTOSCALE_MIN, 1, minimum=1),
+            max_replicas=parse_int_env(ENV_AUTOSCALE_MAX, 4, minimum=1),
+            interval_s=parse_float_env(ENV_AUTOSCALE_INTERVAL_S, 1.0),
+            up_queue=parse_float_env(ENV_AUTOSCALE_UP_QUEUE, 4.0),
+            up_ttft_s=parse_float_env(ENV_AUTOSCALE_UP_TTFT_S, 2.0),
+            down_occupancy=parse_float_env(ENV_AUTOSCALE_DOWN_OCC, 0.25),
+            cooldown_s=parse_float_env(ENV_AUTOSCALE_COOLDOWN_S, 10.0),
+            down_delay_s=parse_float_env(ENV_AUTOSCALE_DOWN_DELAY_S, 30.0))
+
+    @staticmethod
+    def enabled_from_env():
+        return parse_flag_env(ENV_AUTOSCALE, default=False)
+
+
+class Autoscaler:
+    """The control loop. ``start=True`` runs :meth:`tick` every
+    ``config.interval_s`` on a daemon thread; tests drive :meth:`tick`
+    directly with ``start=False``."""
+
+    def __init__(self, router, launcher, config=None, start=True):
+        self.router = router
+        self.launcher = launcher
+        self.config = config if config is not None \
+            else AutoscaleConfig.from_env()
+        self.decisions = []            # [{'action','trigger','replicas',..}]
+        self._lock = threading.Lock()
+        self._last_action_t = -float('inf')
+        self._low_since = None
+        self._pending_up = {}          # url -> launch monotonic (cold gate)
+        self._retiring = {}            # url -> drain-start monotonic
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name='paddle-tpu-autoscaler', daemon=True)
+        if start:
+            self._thread.start()
+
+    # -- signal collection -------------------------------------------------
+    def signals(self):
+        """Fold the routable replicas' cached /healthz series into the
+        tick's decision inputs. Replicas predating the series block fall
+        back to their reported live load."""
+        reps = [r for r in list(self.router.replicas)
+                if r.url not in self._retiring]
+        routable = [r for r in reps if r.routable()]
+        queue = occ = ttft = 0.0
+        if routable:
+            queues, occs, ttfts = [], [], []
+            for r in routable:
+                s = getattr(r, 'series', None) or {}
+                q = (s.get('queue_depth') or {}).get('mean')
+                queues.append(float(q) if q is not None
+                              else float(r.reported_load))
+                o = (s.get('occupancy') or {}).get('mean')
+                if o is not None:
+                    occs.append(float(o))
+                t = (s.get('ttft') or {}).get('p99')
+                if t is not None:
+                    ttfts.append(float(t))
+            queue = sum(queues) / len(queues)
+            occ = sum(occs) / len(occs) if occs else 0.0
+            ttft = max(ttfts) if ttfts else 0.0
+        return {'replicas': len(reps), 'routable': len(routable),
+                'queue_depth': queue, 'occupancy': occ, 'ttft_p99': ttft}
+
+    # -- the decision ------------------------------------------------------
+    def tick(self, now=None):
+        """One control-loop evaluation; returns the decision record made
+        this tick (or None). Also advances pending scale-ups (cold →
+        routable bookkeeping) and pending drains (drained → retired)."""
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            self._advance_pending(now)
+            sig = self.signals()
+            _m.autoscale_replicas.set(sig['replicas'])
+            _m.autoscale_replicas_routable.set(sig['routable'])
+            cooled = now - self._last_action_t >= self.config.cooldown_s
+            low = (sig['routable'] > 0
+                   and sig['occupancy'] < self.config.down_occupancy
+                   and sig['queue_depth'] < 1.0)
+            if low:
+                if self._low_since is None:
+                    self._low_since = now
+            else:
+                self._low_since = None
+            decision = None
+            if sig['replicas'] < self.config.min_replicas:
+                decision = self._scale_up(sig, 'min_replicas', now)
+            elif cooled and sig['replicas'] < self.config.max_replicas \
+                    and sig['routable'] > 0 \
+                    and sig['queue_depth'] > self.config.up_queue:
+                decision = self._scale_up(sig, 'queue_depth', now)
+            elif cooled and sig['replicas'] < self.config.max_replicas \
+                    and sig['routable'] > 0 \
+                    and sig['ttft_p99'] > self.config.up_ttft_s:
+                decision = self._scale_up(sig, 'ttft_p99', now)
+            elif cooled and low and not self._pending_up \
+                    and sig['replicas'] > self.config.min_replicas \
+                    and sig['routable'] > 1 \
+                    and now - self._low_since >= self.config.down_delay_s:
+                decision = self._scale_down(sig, 'occupancy', now)
+            return decision
+
+    def _record(self, action, trigger, sig, extra=None):
+        record = {'action': action, 'trigger': trigger,
+                  'replicas': sig['replicas'], 'signals': dict(sig),
+                  'unix_time': time.time()}
+        record.update(extra or {})
+        self.decisions.append(record)
+        _m.autoscale_decisions.labels(action=action, trigger=trigger).inc()
+        _logger.info('autoscale %s (trigger=%s): %s', action, trigger, sig)
+        return record
+
+    def _scale_up(self, sig, trigger, now):
+        url = self.launcher.launch()
+        self.router.add_replica(url)
+        self._pending_up[url.rstrip('/')] = now
+        self._last_action_t = now
+        return self._record('up', trigger, sig, {'url': url})
+
+    def _scale_down(self, sig, trigger, now):
+        # drain the least-loaded routable replica; never the last one
+        candidates = [r for r in list(self.router.replicas)
+                      if r.routable() and r.url not in self._retiring]
+        victim = min(candidates, key=lambda r: r.load())
+        self.router.drain(victim.url)
+        self._retiring[victim.url] = now
+        self._low_since = None
+        self._last_action_t = now
+        return self._record('down', trigger, sig, {'url': victim.url})
+
+    def _advance_pending(self, now):
+        # cold scale-ups: book time-to-routable once the warmup gate opens
+        for url, t0 in list(self._pending_up.items()):
+            try:
+                rep = self.router._replica_by_url(url)
+            except KeyError:
+                self._pending_up.pop(url)
+                continue
+            if rep.routable():
+                self._pending_up.pop(url)
+                _m.autoscale_time_to_routable_seconds.observe(now - t0)
+        # drains: retire once the router-side in-flight AND the replica's
+        # own queue are empty — the zero-drop contract
+        for url, t0 in list(self._retiring.items()):
+            try:
+                rep = self.router._replica_by_url(url)
+            except KeyError:
+                self._retiring.pop(url)
+                continue
+            if rep.inflight == 0 and rep.reported_load == 0:
+                self.router.remove_replica(url)
+                self._retiring.pop(url)
+                _m.autoscale_drain_seconds.observe(now - t0)
+                try:
+                    self.launcher.retire(url)
+                except Exception as e:   # noqa: BLE001 — replica already gone
+                    _logger.warning('retire(%s) failed: %s', url, e)
+
+    # -- lifecycle ---------------------------------------------------------
+    def _loop(self):
+        while not self._closed.wait(self.config.interval_s):
+            try:
+                self.tick()
+            except Exception as e:   # noqa: BLE001 — loop must survive
+                _logger.warning('autoscaler tick failed: %s', e)
+
+    def draining(self):
+        return sorted(self._retiring)
+
+    def close(self):
+        self._closed.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

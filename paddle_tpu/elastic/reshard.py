@@ -1,0 +1,124 @@
+"""Reshard manifest validation: may a checkpoint saved under one mesh be
+re-laid onto the mesh the RESTORING fleet configured?
+
+PR 12's sharded checkpoints reassemble every variable to its FULL global
+value on read, so reshard-on-restore needs no data movement beyond the
+normal re-placement — *when the new mesh can actually tile the state*. The
+failure mode this module closes is the other case: a checkpoint whose spec
+manifest shards ``scope/fc_0.w_0`` dim 0 over ``fsdp`` restored onto a
+fleet whose ``fsdp`` axis no longer divides that dim (or no longer exists)
+used to die as an opaque shape error deep inside ``device_put``, after
+minutes of bring-up. :func:`check_reshard` validates the saved manifest
+against the restoring partitioner UP FRONT and raises a typed
+:class:`ReshardError` naming the saved vs. current mesh axes and the first
+offending variable/dimension.
+
+The saved manifest is the partitioner's
+:meth:`~paddle_tpu.partition.partitioner.Partitioner.state_manifest`
+(``{'mesh_axes', 'axis_rules', 'specs'}``) recorded in every checkpoint's
+``meta['partition']``; shapes come from the reassembled arrays themselves.
+"""
+from __future__ import annotations
+
+__all__ = ['ReshardError', 'check_reshard', 'current_mesh_axes']
+
+_SCOPE_PREFIX = 'scope/'
+
+
+class ReshardError(ValueError):
+    """A checkpoint's saved partition layout cannot be re-laid onto the
+    restoring fleet's mesh. Carries ``saved_axes`` / ``current_axes``
+    (mesh-axis-name → size dicts) and, when per-variable, ``name``/``dim``
+    of the first offending tile layout."""
+
+    def __init__(self, message, saved_axes=None, current_axes=None,
+                 name=None, dim=None):
+        super().__init__(message)
+        self.saved_axes = dict(saved_axes or {})
+        self.current_axes = dict(current_axes or {})
+        self.name = name
+        self.dim = dim
+
+
+def current_mesh_axes(partitioner=None):
+    """The restoring process's mesh axes (``{name: size}``), or ``{}``
+    when no mesh is configured (single-device / replicated semantics —
+    every full value is placeable, nothing to validate)."""
+    if partitioner is None:
+        from ..partition import get_partitioner
+        partitioner = get_partitioner()
+    if partitioner.mesh is None:
+        return {}
+    return dict(partitioner.axis_sizes())
+
+
+def _spec_axes(entry):
+    """One spec entry (None | axis name | list of axis names) → tuple of
+    mesh axis names the dim is sharded over."""
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def _shape_for(name, shapes):
+    if shapes is None:
+        return None
+    return shapes.get(name) or shapes.get(_SCOPE_PREFIX + name)
+
+
+def check_reshard(saved, partitioner=None, shapes=None, step=None):
+    """Validate `saved` (a checkpoint's ``meta['partition']`` manifest)
+    against the restoring process's mesh. Returns a summary dict
+    ``{'saved_axes', 'current_axes', 'resharded'}`` — ``resharded`` is
+    True when the mesh topology changed and tiles will be re-laid.
+
+    Raises :class:`ReshardError` up front when a saved spec names a mesh
+    axis the current mesh does not have, or when the product of the
+    current axis sizes for a sharded dim no longer divides that dim
+    (`shapes`: ``{name_or_scope_key: global shape}`` from the reassembled
+    arrays; dims with no shape available are skipped).
+
+    A process with NO configured mesh restores every value replicated —
+    always legal, never an error."""
+    saved = saved or {}
+    saved_axes = dict(saved.get('mesh_axes') or {})
+    current_axes = current_mesh_axes(partitioner)
+    where = f' (checkpoint step {step})' if step is not None else ''
+    summary = {'saved_axes': saved_axes, 'current_axes': current_axes,
+               'resharded': bool(saved_axes) and saved_axes != current_axes}
+    if not current_axes:
+        return summary
+    for name, entries in (saved.get('specs') or {}).items():
+        shape = _shape_for(name, shapes)
+        for dim, entry in enumerate(entries):
+            axes = _spec_axes(entry)
+            if not axes:
+                continue
+            missing = [a for a in axes if a not in current_axes]
+            if missing:
+                raise ReshardError(
+                    f'cannot reshard {name!r} dim {dim}{where}: saved '
+                    f'layout shards it over mesh axis '
+                    f'{"/".join(missing)!s} which the restoring mesh '
+                    f'does not have (saved mesh {saved_axes}, current '
+                    f'mesh {current_axes})',
+                    saved_axes=saved_axes, current_axes=current_axes,
+                    name=name, dim=dim)
+            if shape is None or dim >= len(shape):
+                continue
+            size = 1
+            for a in axes:
+                size *= int(current_axes[a])
+            if size > 0 and int(shape[dim]) % size != 0:
+                raise ReshardError(
+                    f'cannot reshard {name!r}{where}: dim {dim} of '
+                    f'global shape {tuple(shape)} is sharded over '
+                    f'{"x".join(axes)} but is not divisible by the '
+                    f'restoring mesh\'s {"x".join(axes)} size {size} '
+                    f'(saved mesh {saved_axes}, current mesh '
+                    f'{current_axes})',
+                    saved_axes=saved_axes, current_axes=current_axes,
+                    name=name, dim=dim)
+    return summary

@@ -1,0 +1,121 @@
+"""Scheduled fleet grow/shrink at step boundaries.
+
+The resize ladder (docs/RESILIENCE.md "Elasticity") rides the PR 12
+exit-for-resume machinery end to end — no new process-control plane:
+
+1. ``PADDLE_TPU_ELASTIC_RESIZE=at_step=N:nproc=M`` (strict parse) arms
+   the :class:`~paddle_tpu.resilience.manager.CheckpointManager` with a
+   :class:`ResizePlan`;
+2. at the first boundary ``step >= N`` the manager commits a SYNCHRONOUS
+   checkpoint at that exact step (durable before any exit — a scheduled
+   resize must lose zero steps, unlike a crash), rank 0 writes the
+   ``resize.json`` request beside the checkpoints, and the heartbeat is
+   stamped ``resize_exit`` so the next incarnation's goodput books the
+   downtime into the *resize* bucket, not the crash bucket;
+3. every host returns ``True`` from ``end_of_step`` with
+   ``manager.resize_requested`` set; the train loop exits through
+   :func:`~paddle_tpu.fleet_runtime.coordinator.exit_for_resume`
+   (exit 75 — the restarter's existing resume signal);
+4. the restarter reads :func:`read_resize_request` and relaunches the
+   fleet at ``target_nproc``; restore re-lays the tiles onto the new mesh
+   (validated by :mod:`~paddle_tpu.elastic.reshard`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from ..resilience.snapshot import atomic_write_bytes
+
+__all__ = ['ResizePlan', 'parse_resize_env', 'parse_resize_spec',
+           'write_resize_request', 'read_resize_request',
+           'clear_resize_request', 'ENV_ELASTIC_RESIZE', 'RESIZE_FILE']
+
+ENV_ELASTIC_RESIZE = 'PADDLE_TPU_ELASTIC_RESIZE'
+RESIZE_FILE = 'resize.json'
+
+_FORM = "'at_step=<N>:nproc=<M>' with N >= 1 and M >= 1"
+
+
+class ResizePlan:
+    """One scheduled resize: exit for resume at the first boundary
+    ``>= step``, to be relaunched at ``nproc`` processes."""
+
+    __slots__ = ('step', 'nproc')
+
+    def __init__(self, step, nproc):
+        self.step = int(step)
+        self.nproc = int(nproc)
+
+    def due(self, step):
+        return int(step) >= self.step
+
+    def __repr__(self):
+        return f'ResizePlan(step={self.step}, nproc={self.nproc})'
+
+    def __eq__(self, other):
+        return (isinstance(other, ResizePlan)
+                and (self.step, self.nproc) == (other.step, other.nproc))
+
+    def __hash__(self):
+        return hash((self.step, self.nproc))
+
+
+def parse_resize_spec(raw, name=ENV_ELASTIC_RESIZE):
+    """``at_step=N:nproc=M`` → :class:`ResizePlan`; anything else raises
+    naming the knob and the supported form (house strict-parse rule)."""
+    fields = {}
+    for part in str(raw).split(':'):
+        key, sep, val = part.partition('=')
+        if not sep or key.strip() not in ('at_step', 'nproc'):
+            raise ValueError(
+                f'{name}={raw!r} is not supported; supported form: {_FORM}')
+        try:
+            fields[key.strip()] = int(val)
+        except ValueError:
+            raise ValueError(
+                f'{name}={raw!r} is not supported; supported form: {_FORM}')
+    if set(fields) != {'at_step', 'nproc'} or fields['at_step'] < 1 \
+            or fields['nproc'] < 1:
+        raise ValueError(
+            f'{name}={raw!r} is not supported; supported form: {_FORM}')
+    return ResizePlan(fields['at_step'], fields['nproc'])
+
+
+def parse_resize_env(environ=None):
+    """The armed :class:`ResizePlan` from ``PADDLE_TPU_ELASTIC_RESIZE``,
+    or None when the knob is unset."""
+    raw = (environ if environ is not None
+           else os.environ).get(ENV_ELASTIC_RESIZE, '').strip()
+    if not raw:
+        return None
+    return parse_resize_spec(raw)
+
+
+def write_resize_request(directory, step, target_nproc, from_nproc=None):
+    """Atomic ``resize.json`` beside the checkpoints: the restarter's
+    instruction to relaunch at ``target_nproc``. Returns the record."""
+    record = {'step': int(step), 'target_nproc': int(target_nproc),
+              'from_nproc': None if from_nproc is None else int(from_nproc),
+              'unix_time': time.time()}
+    atomic_write_bytes(os.path.join(directory, RESIZE_FILE),
+                       json.dumps(record, indent=1).encode())
+    return record
+
+
+def read_resize_request(directory):
+    """The pending resize request, or None."""
+    try:
+        with open(os.path.join(directory, RESIZE_FILE)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def clear_resize_request(directory):
+    """Consume the request (the restarter, after relaunching)."""
+    try:
+        os.unlink(os.path.join(directory, RESIZE_FILE))
+    except OSError:
+        pass

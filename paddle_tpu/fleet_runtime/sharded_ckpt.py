@@ -282,8 +282,12 @@ def read_sharded_checkpoint(ckpt):
     files (validated against the fleet manifest by discovery already).
     ``meta['host_meta']`` maps rank → that host's local meta (RNG,
     loader cursor); the restoring manager overlays its own rank's entry.
-    Because full values come back, restoring onto a DIFFERENT mesh shape
-    (reshard-on-restore) needs nothing extra: the new placement happens
+    Because full values come back, the read itself is mesh-agnostic —
+    inspection tooling can read any checkpoint from any process.
+    Restoring onto a DIFFERENT mesh shape (reshard-on-restore) is a
+    property of the RESTORE path: ``CheckpointManager.restore`` runs the
+    reshard-manifest legality check (``elastic/reshard.py``) against the
+    restoring fleet's mesh up front, and the new placement then happens
     wherever the state is next consumed."""
     directory = ckpt.directory
     manifest = ckpt.manifest

@@ -44,6 +44,12 @@ class GoodputTracker:
         self.restarts = 0
         self.lost_steps = 0
         self.lost_s = 0.0
+        # elastic-resize accounting (docs/RESILIENCE.md "Elasticity"): a
+        # SCHEDULED grow/shrink exit checkpoints synchronously at the
+        # boundary, so its cost is pure downtime — booked here, in its
+        # own bucket, never conflated with crash-restart loss
+        self.resizes = 0
+        self.resize_lost_s = 0.0
 
     # -- recording ------------------------------------------------------
     def record_step(self, seconds):
@@ -64,6 +70,10 @@ class GoodputTracker:
         self.restarts += int(restored.get('restarts', 0))
         self.lost_steps += int(restored.get('lost_steps', 0))
         self.lost_s += float(restored.get('lost_s', 0.0))
+        self.resizes += int(restored.get('resizes', 0))
+        self.resize_lost_s += float(restored.get('resize_lost_s', 0.0))
+        resize_exit = bool(progress.get('resize_exit')) if progress \
+            else False
         if progress:
             lost_steps = max(0, int(progress.get('steps', 0))
                              - self.prior_steps)
@@ -80,12 +90,24 @@ class GoodputTracker:
             self.prior_wall_s = max(
                 self.prior_wall_s,
                 float(progress.get('wall_s', 0.0))) + downtime
+            if resize_exit:
+                # scheduled resize: the exit checkpointed synchronously
+                # at the boundary (lost_steps should be 0 — any nonzero
+                # delta still books as crash loss above); the downtime
+                # between exit and relaunch is the resize's whole cost
+                self.resizes += 1
+                self.resize_lost_s += downtime
             if _obs._ENABLED:
                 _obs.inc('restart_lost_steps', lost_steps,
                          help='steps of work lost to restarts (executed '
                               'after the restored checkpoint, replayed)')
                 _obs.inc('restart_lost_seconds', lost_s,
                          help='productive seconds lost to restarts')
+                if resize_exit:
+                    _obs.inc('elastic_resizes_total',
+                             help='scheduled fleet resizes completed '
+                                  '(exit-for-resume at a step boundary, '
+                                  'relaunched at the new size)')
         if _obs._ENABLED:
             _obs.inc('restarts_total',
                      help='training restarts that restored a checkpoint')
@@ -114,6 +136,12 @@ class GoodputTracker:
                            help='cumulative productive step seconds')
             _obs.set_gauge('goodput_wall_seconds', self.wall_seconds(),
                            help='cumulative wall seconds since job start')
+            _obs.set_gauge('goodput_resize_lost_seconds',
+                           self.resize_lost_s,
+                           help='cumulative downtime from SCHEDULED fleet '
+                                'resizes (grow/shrink exit -> relaunch) — '
+                                'a separate bucket from crash-restart '
+                                'loss')
 
     def meta(self):
         """Cumulative counters for the checkpoint manifest / heartbeat."""
@@ -124,4 +152,6 @@ class GoodputTracker:
             'restarts': self.restarts,
             'lost_steps': self.lost_steps,
             'lost_s': round(self.lost_s, 6),
+            'resizes': self.resizes,
+            'resize_lost_s': round(self.resize_lost_s, 6),
         }

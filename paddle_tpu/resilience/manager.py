@@ -141,6 +141,16 @@ class CheckpointManager:
         # folds every host's published snapshot and runs the straggler
         # monitor; built lazily the first boundary the KV is configured
         self._straggler = None
+        # elastic scheduled resize (elastic/schedule.py): armed from
+        # PADDLE_TPU_ELASTIC_RESIZE. At the first due boundary
+        # end_of_step commits a SYNCHRONOUS checkpoint, rank 0 writes
+        # resize.json, and the call returns True with `resize_requested`
+        # set — the loop exits through the exit-for-resume ladder and
+        # the restarter relaunches at the new size
+        from ..elastic.schedule import parse_resize_env
+        self._resize_plan = parse_resize_env()
+        self.resize_requested = None
+        self._resize_exit = False
 
     # ------------------------------------------------------------------
     # fleet plumbing (fleet_runtime/)
@@ -191,20 +201,50 @@ class CheckpointManager:
         fleet = self._fleet_world() > 1
         ckpt = ckpt if ckpt is not None else self.latest()
         if fleet:
+            from ..elastic.reshard import current_mesh_axes
             from ..fleet_runtime.bootstrap import (all_hosts_agree,
                                                    fleet_barrier)
             step = -1 if ckpt is None else int(ckpt.step)
-            if not all_hosts_agree({'restore_step': step},
+            # the resize restore barrier: a (possibly resized) fleet must
+            # agree on BOTH the step and the mesh it restores onto before
+            # any host starts re-laying tiles — a half-updated launch
+            # config (one host still at the old world size) fails here,
+            # typed, instead of diverging inside the first collective
+            if not all_hosts_agree({'restore_step': step,
+                                    'mesh_axes': current_mesh_axes()},
                                    tag='ckpt_restore'):
                 raise RuntimeError(
-                    f'fleet restore: hosts disagree on the checkpoint to '
-                    f'restore (this host found step {step}); checkpoint '
+                    f'fleet restore: hosts disagree on the checkpoint '
+                    f'step or the restoring mesh (this host found step '
+                    f'{step}, mesh {current_mesh_axes()}); checkpoint '
                     f'directory {self.directory} is not consistently '
-                    f'visible across the fleet')
+                    f'visible, or the fleet was relaunched with '
+                    f'mismatched sizes')
             fleet_barrier(f'ckpt_restore_{step}')
         if ckpt is None:
             return None
         arrays, meta = _snap.read_checkpoint(ckpt)
+        saved_part = meta.get('partition')
+        if saved_part:
+            # reshard-manifest check (elastic/reshard.py): the saved
+            # mesh/specs must be re-layable onto THIS fleet's mesh —
+            # divisibility validated up front, ReshardError instead of a
+            # device_put shape error after minutes of bring-up
+            from ..elastic.reshard import check_reshard
+            info = check_reshard(
+                saved_part,
+                shapes={k: np.shape(v) for k, v in arrays.items()},
+                step=ckpt.step)
+            if info['resharded']:
+                _logger.info(
+                    'reshard-on-restore: checkpoint step %d saved on '
+                    'mesh %s, re-laying onto %s', ckpt.step,
+                    info['saved_axes'], info['current_axes'])
+                if _obs._ENABLED:
+                    _obs.inc('elastic_reshard_restores',
+                             help='restores that re-laid checkpoint '
+                                  'tiles onto a different mesh than '
+                                  'they were saved under')
         host_meta = meta.get('host_meta')
         if host_meta:
             # this host's own RNG / loader cursor (falls back to host 0's
@@ -516,12 +556,19 @@ class CheckpointManager:
                 self._last_boundary = time.perf_counter()
                 return False
         preempt = self._preemption.requested
+        # scheduled elastic resize (elastic/schedule.py): at the first
+        # boundary >= the planned step, checkpoint SYNCHRONOUSLY and exit
+        # for relaunch at the new size — exactly the preemption shape,
+        # plus the resize.json handoff for the restarter
+        resize = (self._resize_plan is not None
+                  and self.resize_requested is None
+                  and self._resize_plan.due(step))
         due = (self.every_n_steps is not None
                and step % self.every_n_steps == 0)
         if self.last_verdict is not None and \
                 self.last_verdict.action == 'skip':
             due = False                # never checkpoint a dropped update
-        if due or preempt:
+        if due or preempt or resize:
             got = state_fn()
             arrays, cap_meta = got if isinstance(got, tuple) else (got, {})
             cap_meta = dict(cap_meta)
@@ -530,7 +577,9 @@ class CheckpointManager:
             cap_meta['step'] = int(step)
             cap_meta['goodput'] = self.goodput.meta()
             cap_meta['preempted'] = bool(preempt)
-            self.save(step, arrays, cap_meta, block=preempt)
+            self.save(step, arrays, cap_meta, block=preempt or resize)
+        if resize:
+            self._begin_resize(step)
         self._publish_fleet_telemetry(step, step_time)
         self._write_progress(step)
         self.goodput.export_metrics()
@@ -540,7 +589,32 @@ class CheckpointManager:
             _logger.info('preemption checkpoint committed at step %d; '
                          'stopping', step)
             return True
+        if resize:
+            return True
         return False
+
+    def _begin_resize(self, step):
+        """The resize checkpoint is committed (save was synchronous);
+        record the handoff. Rank 0 writes ``resize.json`` beside the
+        checkpoints so the restarter knows the target size; every rank
+        stamps ``resize_exit`` into its heartbeat so the NEXT incarnation
+        books the downtime into the resize bucket, not crash loss."""
+        plan = self._resize_plan
+        self.wait()                    # surface a failed resize save HERE
+        from ..elastic import schedule as _sched
+        if self._rank_index() == 0:
+            _sched.write_resize_request(self.directory, step, plan.nproc,
+                                        from_nproc=self._fleet_world())
+        self._resize_exit = True
+        self.resize_requested = {'step': int(step),
+                                 'target_nproc': int(plan.nproc)}
+        if _obs._ENABLED:
+            _obs.inc('elastic_resize_exits',
+                     help='scheduled resize exits taken at a step '
+                          'boundary (checkpoint committed, relaunch '
+                          'pending)')
+        _logger.info('scheduled resize at step %d: checkpoint committed, '
+                     'exiting for relaunch at nproc=%d', step, plan.nproc)
 
     # ------------------------------------------------------------------
     # fleet telemetry (docs/OBSERVABILITY.md "Training fleet")
@@ -592,6 +666,10 @@ class CheckpointManager:
                'last_checkpoint_step': self._last_saved_step,
                'unix_time': time.time()}
         doc.update(self.goodput.meta())
+        if self._resize_exit:
+            # next incarnation's record_restart routes the downtime into
+            # the resize bucket instead of crash loss
+            doc['resize_exit'] = True
         try:
             _snap.atomic_write_bytes(self._progress_path(),
                                      json.dumps(doc).encode())

@@ -354,6 +354,27 @@ router_rolling_restarts = _LazyMetric(
     'counter', 'router_rolling_restarts',
     'replicas restarted behind a drain by rolling_restart()')
 
+# elastic autoscaler (elastic/autoscaler.py; docs/SERVING.md "Autoscaler")
+autoscale_decisions = _LazyMetric(
+    'counter', 'autoscale_decisions',
+    'autoscaler decisions taken (labels action=up|down, trigger='
+    'queue_depth|ttft_p99|occupancy|min_replicas)')
+autoscale_replicas = _LazyMetric(
+    'gauge', 'autoscale_replicas',
+    'replicas under autoscaler management (including cold pending ones, '
+    'excluding draining-for-retirement ones)')
+autoscale_replicas_routable = _LazyMetric(
+    'gauge', 'autoscale_replicas_routable',
+    'managed replicas currently healthy + warm + not draining')
+autoscale_time_to_routable_seconds = _LazyMetric(
+    'histogram', 'autoscale_time_to_routable_seconds',
+    'scale-up launch -> replica routable (spawn + warmup gate + fast '
+    'initial health poll)')
+autoscale_drain_seconds = _LazyMetric(
+    'histogram', 'autoscale_drain_seconds',
+    'scale-down drain start -> replica idle (router in-flight 0 and '
+    'replica queue empty) and retired')
+
 # fleet-wide observability (PR 17, docs/OBSERVABILITY.md "Fleet-wide")
 decode_ttft_seconds = _LazyMetric(
     'histogram', 'decode_ttft_seconds',

@@ -116,6 +116,14 @@ class _Handler(BaseHTTPRequestHandler):
                     body['buckets'] = srv.engine.buckets
                     body['compiled'] = srv.engine.compiled_buckets
                 if srv.generator is not None:
+                    # the always-on windowed load series ride every
+                    # healthz reply: the router caches them per replica
+                    # and the elastic autoscaler reads queue_depth /
+                    # occupancy / ttft p99 off that cache — no second
+                    # scrape channel (docs/SERVING.md "Autoscaler")
+                    body['series'] = {
+                        name: _dobs.series(name).snapshot()
+                        for name in ('queue_depth', 'occupancy', 'ttft')}
                     eng = srv.generator.engine
                     body['decode'] = {
                         'slots': eng.slots,

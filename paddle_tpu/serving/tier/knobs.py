@@ -21,6 +21,15 @@ environment must fail the component that reads it, not every
 | ``PADDLE_TPU_KV_DTYPE``                | ``f32`` / ``bf16`` / ``int8`` | KVCachePool storage dtype (docs/SERVING.md "Tiered KV cache") |
 | ``PADDLE_TPU_DECODE_HBM_MB``           | int > 0                | DecodeEngine pool sizing (budget solve; explicit ``PADDLE_TPU_DECODE_MAX_BLOCKS`` / ``max_blocks=`` wins) |
 | ``PADDLE_TPU_PREFIX_CACHE_HOST_MB``    | int >= 0 (0 = no spill tier) | PrefixCache host spill tier byte cap |
+| ``PADDLE_TPU_AUTOSCALE``               | ``0`` / ``1``          | router CLI: run an elastic Autoscaler beside the router |
+| ``PADDLE_TPU_AUTOSCALE_MIN``           | int >= 1               | Autoscaler floor (default 1) |
+| ``PADDLE_TPU_AUTOSCALE_MAX``           | int >= 1               | Autoscaler ceiling (default 4) |
+| ``PADDLE_TPU_AUTOSCALE_INTERVAL_S``    | float > 0              | control-loop tick (default 1.0) |
+| ``PADDLE_TPU_AUTOSCALE_UP_QUEUE``      | float > 0              | scale-up: mean queue depth per routable replica (default 4.0) |
+| ``PADDLE_TPU_AUTOSCALE_UP_TTFT_S``     | float > 0              | scale-up: p99 time-to-first-token seconds (default 2.0) |
+| ``PADDLE_TPU_AUTOSCALE_DOWN_OCC``      | float > 0              | scale-down: mean slot occupancy below this (default 0.25) |
+| ``PADDLE_TPU_AUTOSCALE_COOLDOWN_S``    | float > 0              | min seconds between decisions (default 10) |
+| ``PADDLE_TPU_AUTOSCALE_DOWN_DELAY_S``  | float > 0              | sustained-low seconds before a scale-down (default 30) |
 | ``PADDLE_TPU_TRACE_SAMPLE``            | float in [0, 1]        | router edge sampling (observability/trace_context.py) |
 | ``PADDLE_TPU_TRACE_DIR``               | directory path         | span-record JSONL output (observability/distributed.py) |
 | ``PADDLE_TPU_SLO``                     | ``<series>.<agg><op><value>,...`` | ServingServer /healthz (observability/distributed.py SLOMonitor) |
@@ -39,7 +48,11 @@ __all__ = ['parse_flag_env', 'parse_int_env', 'parse_float_env',
            'ENV_ROUTER_PORT', 'ENV_ROUTER_HEALTH_POLL_S', 'ENV_SPEC_DECODE',
            'ENV_SPEC_K', 'ENV_SPEC_DRAFTER', 'ENV_KV_DTYPE',
            'ENV_DECODE_HBM_MB', 'ENV_PREFIX_CACHE_HOST_MB',
-           'KV_DTYPE_CHOICES']
+           'KV_DTYPE_CHOICES', 'ENV_AUTOSCALE', 'ENV_AUTOSCALE_MIN',
+           'ENV_AUTOSCALE_MAX', 'ENV_AUTOSCALE_INTERVAL_S',
+           'ENV_AUTOSCALE_UP_QUEUE', 'ENV_AUTOSCALE_UP_TTFT_S',
+           'ENV_AUTOSCALE_DOWN_OCC', 'ENV_AUTOSCALE_COOLDOWN_S',
+           'ENV_AUTOSCALE_DOWN_DELAY_S']
 
 ENV_PREFIX_CACHE = 'PADDLE_TPU_PREFIX_CACHE'
 ENV_PREFIX_CACHE_MAX_BLOCKS = 'PADDLE_TPU_PREFIX_CACHE_MAX_BLOCKS'
@@ -59,6 +72,17 @@ ENV_ROUTER_HEALTH_POLL_S = 'PADDLE_TPU_ROUTER_HEALTH_POLL_S'
 ENV_SPEC_DECODE = 'PADDLE_TPU_SPEC_DECODE'
 ENV_SPEC_K = 'PADDLE_TPU_SPEC_K'
 ENV_SPEC_DRAFTER = 'PADDLE_TPU_SPEC_DRAFTER'
+
+# elastic autoscaler (elastic/autoscaler.py; docs/SERVING.md "Autoscaler")
+ENV_AUTOSCALE = 'PADDLE_TPU_AUTOSCALE'
+ENV_AUTOSCALE_MIN = 'PADDLE_TPU_AUTOSCALE_MIN'
+ENV_AUTOSCALE_MAX = 'PADDLE_TPU_AUTOSCALE_MAX'
+ENV_AUTOSCALE_INTERVAL_S = 'PADDLE_TPU_AUTOSCALE_INTERVAL_S'
+ENV_AUTOSCALE_UP_QUEUE = 'PADDLE_TPU_AUTOSCALE_UP_QUEUE'
+ENV_AUTOSCALE_UP_TTFT_S = 'PADDLE_TPU_AUTOSCALE_UP_TTFT_S'
+ENV_AUTOSCALE_DOWN_OCC = 'PADDLE_TPU_AUTOSCALE_DOWN_OCC'
+ENV_AUTOSCALE_COOLDOWN_S = 'PADDLE_TPU_AUTOSCALE_COOLDOWN_S'
+ENV_AUTOSCALE_DOWN_DELAY_S = 'PADDLE_TPU_AUTOSCALE_DOWN_DELAY_S'
 
 
 def parse_flag_env(name, default=False, environ=None):

@@ -130,6 +130,9 @@ class Replica:
         # trace_merge.py uses to align this replica's spans
         self.clock_offset = None
         self.replica_id = None        # reported by /healthz when available
+        # cached /healthz windowed-series snapshots (queue_depth /
+        # occupancy / ttft) — the autoscaler's decision inputs
+        self.series = {}
         self._lock = threading.Lock()
 
     def load(self):
@@ -287,6 +290,7 @@ class Router:
         if not replica_urls:
             raise ValueError('need at least one replica URL')
         self.replicas = [Replica(u) for u in replica_urls]
+        self._replicas_lock = threading.Lock()   # guards membership only
         self.health_poll_s = (parse_float_env(ENV_ROUTER_HEALTH_POLL_S, 1.0)
                               if health_poll_s is None
                               else float(health_poll_s))
@@ -320,6 +324,7 @@ class Router:
                                  + int(decode.get('waiting', 0)))
             rep.last_poll_ok = time.monotonic()
             rep.replica_id = body.get('replica') or rep.replica_id
+            rep.series = body.get('series') or rep.series
             if 'unix_time' in body:
                 # handshake offset estimate: the replica stamped its clock
                 # somewhere inside [u0, u1]; the RTT midpoint is the
@@ -349,12 +354,65 @@ class Router:
                 for r in self.replicas))
 
     def poll_once(self):
-        for rep in self.replicas:
+        for rep in list(self.replicas):
             self._poll_replica(rep)
 
     def _poll_loop(self):
         while not self._closed.wait(self.health_poll_s):
             self.poll_once()
+
+    def _fast_poll(self, rep):
+        """Admission poll for a freshly added replica: short initial
+        backoff (50 ms, doubling up to the regular ``health_poll_s``)
+        until the first moment it is routable — so scale-up
+        time-to-routable tracks the replica's actual warmup, instead of
+        being quantized to a full health-poll period."""
+        delay = 0.05
+        while not self._closed.wait(delay):
+            if rep not in self.replicas:
+                return                 # removed before it came up
+            self._poll_replica(rep)
+            if rep.routable():
+                _logger.info('replica %s admitted: routable after fast '
+                             'poll', rep.url)
+                return
+            delay = min(delay * 2, self.health_poll_s)
+
+    # -- elastic membership (elastic/autoscaler.py) ------------------------
+    def add_replica(self, url, fast_poll=True):
+        """Register a replica at runtime (scale-up). It starts unpolled —
+        NOT routable — and is admitted by the fast initial poll the
+        moment ``/healthz`` reports healthy + warm (the cold-replica gate
+        applies to elastic replicas exactly as to static ones). Returns
+        the :class:`Replica` (the existing one if already registered)."""
+        url = url.rstrip('/')
+        with self._replicas_lock:
+            for r in self.replicas:
+                if r.url == url:
+                    return r
+            rep = Replica(url)
+            # copy-on-write: readers iterate a stable list snapshot
+            self.replicas = self.replicas + [rep]
+        if fast_poll:
+            threading.Thread(target=self._fast_poll, args=(rep,),
+                             name='paddle-tpu-router-admit',
+                             daemon=True).start()
+        return rep
+
+    def remove_replica(self, url):
+        """Deregister a replica (scale-down, after drain). In-flight
+        streams keep their handle to it; it just stops being a dispatch
+        candidate. Raises KeyError when unknown."""
+        url = url.rstrip('/')
+        with self._replicas_lock:
+            rep = next((r for r in self.replicas if r.url == url), None)
+            if rep is None:
+                raise KeyError(f'unknown replica {url}')
+            self.replicas = [r for r in self.replicas if r is not rep]
+        _m.router_replicas_routable.set(
+            sum(r.healthy and r.warmed and not r.draining
+                for r in self.replicas))
+        return rep
 
     # -- dispatch ----------------------------------------------------------
     def _pick(self, exclude, deadline):
@@ -781,7 +839,18 @@ def main(argv=None):
     if not urls:
         ap.error(f'no replicas: pass --replica or set {ENV_ROUTER_REPLICAS}')
     router = Router(urls, health_poll_s=args.health_poll_s)
-    RouterServer(router, host=args.host, port=args.port).serve_forever()
+    scaler = None
+    from ...elastic.autoscaler import AutoscaleConfig, Autoscaler
+    if AutoscaleConfig.enabled_from_env():      # PADDLE_TPU_AUTOSCALE=1
+        from ...elastic.launcher import ProcessReplicaLauncher
+        scaler = Autoscaler(router, ProcessReplicaLauncher(),
+                            AutoscaleConfig.from_env())
+    try:
+        RouterServer(router, host=args.host, port=args.port).serve_forever()
+    finally:
+        if scaler is not None:
+            scaler.close()
+            scaler.launcher.close()
 
 
 if __name__ == '__main__':

@@ -1,0 +1,45 @@
+"""tier-1 guard for the elastic bench: tools/bench_elastic.py --smoke must
+run end-to-end on CPU and hold the subsystem's hard guarantees — the
+autoscaler ramp completes every Poisson arrival with the reference bytes
+(zero drops through scale-up AND drain-backed scale-down), the replica
+count follows the load within [min, max], every decision carries its
+trigger, and the goodput resize bucket stays separate from crash loss.
+Timings (time-to-routable, drain seconds) are reported but not asserted so
+a loaded CI box cannot flake them."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+def test_bench_elastic_smoke_runs_on_cpu():
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    r = subprocess.run(
+        [sys.executable, os.path.join('tools', 'bench_elastic.py'),
+         '--smoke'],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
+    benches = {d['bench']: d for d in lines if 'bench' in d}
+    assert {'elastic_autoscale_ramp',
+            'elastic_resize_accounting'} <= set(benches)
+
+    ramp = benches['elastic_autoscale_ramp']
+    assert ramp['dropped'] == 0 and not ramp['errors'], ramp
+    assert ramp['completed'] == ramp['requests']
+    assert ramp['bitwise_equal'] is True
+    # the tier followed the load: grew under pressure, within the cap,
+    # and drained back down when it fell off
+    assert 1 < ramp['max_replicas_seen'] <= ramp['max_replicas_cap']
+    assert ramp['scaled_up'] and ramp['scaled_down'], ramp
+    assert ramp['final_replicas'] == 1, ramp
+    assert all(d['trigger'] for d in ramp['decisions'])
+    assert ramp['time_to_routable_s']['count'] >= 1
+
+    acct = benches['elastic_resize_accounting']
+    assert acct['buckets_separate'] is True, acct
+    assert acct['crash']['lost_steps'] == acct['predicted_lost_steps']
+    assert acct['resize']['lost_steps'] == 0
+    assert acct['resize']['resizes'] == 1

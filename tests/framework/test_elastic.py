@@ -1,0 +1,218 @@
+"""Elastic runtime units (ISSUE 19): reshard-manifest legality, the
+strict-parse resize schedule, the resize.json handoff, and the goodput
+resize bucket — everything that doesn't need a live fleet (those drills
+live in test_elastic_resize.py / test_autoscaler.py)."""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.elastic import (ReshardError, ResizePlan, check_reshard,
+                                clear_resize_request, current_mesh_axes,
+                                parse_resize_env, parse_resize_spec,
+                                read_resize_request, write_resize_request)
+from paddle_tpu.elastic.schedule import ENV_ELASTIC_RESIZE
+from paddle_tpu.resilience.goodput import GoodputTracker
+
+
+class _Part:
+    """Stand-in partitioner: just the mesh/axis_sizes surface
+    check_reshard consumes."""
+
+    def __init__(self, axes):
+        self._axes = dict(axes)
+        self.mesh = object() if axes else None
+
+    def axis_sizes(self):
+        return dict(self._axes)
+
+
+SAVED_4 = {
+    'mesh_axes': {'fsdp': 4},
+    'axis_rules': {},
+    'specs': {'fc_0.w_0': ['fsdp', None], 'fc_0.b_0': [None]},
+}
+
+
+# ---------------------------------------------------------------------------
+# reshard manifest check
+# ---------------------------------------------------------------------------
+def test_check_reshard_same_mesh_is_not_a_reshard():
+    info = check_reshard(SAVED_4, partitioner=_Part({'fsdp': 4}),
+                         shapes={'fc_0.w_0': (16, 8)})
+    assert info['resharded'] is False
+    assert info['saved_axes'] == {'fsdp': 4}
+    assert info['current_axes'] == {'fsdp': 4}
+
+
+def test_check_reshard_shrink_and_grow_are_legal():
+    for size in (1, 2, 8):
+        info = check_reshard(SAVED_4, partitioner=_Part({'fsdp': size}),
+                             shapes={'fc_0.w_0': (16, 8)})
+        assert info['resharded'] is True, size
+        assert info['current_axes'] == {'fsdp': size}
+
+
+def test_check_reshard_no_mesh_means_replicated_restore():
+    # a single-process restore (no mesh) reassembles full values and
+    # places them replicated: always legal
+    info = check_reshard(SAVED_4, partitioner=_Part({}), shapes=None)
+    assert info['current_axes'] == {}
+    assert info['resharded'] is True
+
+
+def test_check_reshard_divisibility_error_is_typed_and_named():
+    with pytest.raises(ReshardError) as ei:
+        check_reshard(SAVED_4, partitioner=_Part({'fsdp': 3}),
+                      shapes={'fc_0.w_0': (16, 8)})
+    e = ei.value
+    # the error NAMES the variable, the dim, and both meshes — the whole
+    # point vs. a device_put shape error minutes later
+    assert e.name == 'fc_0.w_0' and e.dim == 0
+    assert e.saved_axes == {'fsdp': 4}
+    assert e.current_axes == {'fsdp': 3}
+    msg = str(e)
+    assert 'fc_0.w_0' in msg and 'fsdp' in msg and '3' in msg
+    assert isinstance(e, ValueError)       # callers catching ValueError work
+
+
+def test_check_reshard_missing_axis_error():
+    with pytest.raises(ReshardError) as ei:
+        check_reshard(SAVED_4, partitioner=_Part({'mp': 2}),
+                      shapes={'fc_0.w_0': (16, 8)})
+    assert ei.value.name == 'fc_0.w_0'
+    assert 'fsdp' in str(ei.value) and 'mp' in str(ei.value)
+
+
+def test_check_reshard_scoped_shape_lookup():
+    # manager shapes are often scope-qualified; the check must find them
+    info = check_reshard(SAVED_4, partitioner=_Part({'fsdp': 2}),
+                         shapes={'scope/fc_0.w_0': (16, 8)})
+    assert info['resharded'] is True
+
+
+def test_current_mesh_axes_without_mesh_is_empty():
+    assert current_mesh_axes(_Part({})) == {}
+
+
+def test_sharded_read_mesh_agnostic_then_restore_check_raises(tmp_path):
+    """The read itself is mesh-agnostic (inspection tooling must be able
+    to read any checkpoint from any process); the manifest a REAL sharded
+    write commits then drives the restore-path check: a compatible mesh
+    passes (resharded flagged), an incompatible one raises the typed,
+    named ReshardError up front — not a shape error downstream."""
+    from paddle_tpu.fleet_runtime import sharded_ckpt as sc
+    from paddle_tpu.resilience import snapshot as snap
+    import paddle_tpu.elastic.reshard as rs
+    sc.write_host_shard(
+        str(tmp_path), step=3,
+        arrays={'w': np.arange(32, dtype=np.float32).reshape(16, 2)},
+        rank=0, world=1)
+    sc.commit_fleet_manifest(
+        str(tmp_path), step=3, world=1,
+        meta={'partition': {'mesh_axes': {'fsdp': 4},
+                            'specs': {'w': ['fsdp', None]}}})
+    ck = snap.latest_checkpoint(str(tmp_path))
+    assert ck is not None and ck.sharded
+    orig = rs.current_mesh_axes
+    try:
+        # the read never consults the process mesh — even one the saved
+        # layout could not be laid onto
+        rs.current_mesh_axes = lambda partitioner=None: {'fsdp': 3}
+        arrays, meta = snap.read_checkpoint(ck)
+        assert arrays['w'].shape == (16, 2)
+        shapes = {k: v.shape for k, v in arrays.items()}
+        # the restore-path check on the SAME manifest: compatible mesh
+        # passes and flags the reshard ...
+        rs.current_mesh_axes = lambda partitioner=None: {'fsdp': 2}
+        info = rs.check_reshard(meta['partition'], shapes=shapes, step=3)
+        assert info['resharded'] is True
+        # ... incompatible (16 % 3 != 0) raises typed and named
+        rs.current_mesh_axes = lambda partitioner=None: {'fsdp': 3}
+        with pytest.raises(ReshardError) as ei:
+            rs.check_reshard(meta['partition'], shapes=shapes, step=3)
+        assert ei.value.name == 'w'
+    finally:
+        rs.current_mesh_axes = orig
+
+
+# ---------------------------------------------------------------------------
+# resize schedule: strict parse + handoff file
+# ---------------------------------------------------------------------------
+def test_parse_resize_spec():
+    plan = parse_resize_spec('at_step=20:nproc=8')
+    assert plan == ResizePlan(step=20, nproc=8)
+    assert not plan.due(19) and plan.due(20) and plan.due(21)
+    # order-insensitive
+    assert parse_resize_spec('nproc=2:at_step=5') == ResizePlan(5, 2)
+
+
+@pytest.mark.parametrize('raw', [
+    'at_step=5',                # missing nproc
+    'nproc=4',                  # missing at_step
+    'at_step=0:nproc=4',        # step must be >= 1
+    'at_step=5:nproc=0',        # nproc must be >= 1
+    'at_step=x:nproc=4',        # not an int
+    'at_step=5:nproc=4:bogus=1',  # unknown key
+    'whatever',
+])
+def test_parse_resize_spec_rejects_malformed(raw):
+    with pytest.raises(ValueError) as ei:
+        parse_resize_spec(raw)
+    assert ENV_ELASTIC_RESIZE in str(ei.value)   # error names the knob
+
+
+def test_parse_resize_env(monkeypatch):
+    monkeypatch.delenv(ENV_ELASTIC_RESIZE, raising=False)
+    assert parse_resize_env() is None
+    monkeypatch.setenv(ENV_ELASTIC_RESIZE, 'at_step=7:nproc=2')
+    assert parse_resize_env() == ResizePlan(7, 2)
+    monkeypatch.setenv(ENV_ELASTIC_RESIZE, 'nonsense')
+    with pytest.raises(ValueError):
+        parse_resize_env()
+
+
+def test_resize_request_roundtrip(tmp_path):
+    d = str(tmp_path)
+    assert read_resize_request(d) is None
+    write_resize_request(d, step=9, target_nproc=2, from_nproc=4)
+    req = read_resize_request(d)
+    assert req['step'] == 9
+    assert req['target_nproc'] == 2 and req['from_nproc'] == 4
+    assert req['unix_time'] > 0
+    clear_resize_request(d)
+    assert read_resize_request(d) is None
+    clear_resize_request(d)                      # idempotent
+
+
+# ---------------------------------------------------------------------------
+# goodput: the resize bucket is distinct from crash loss
+# ---------------------------------------------------------------------------
+def test_goodput_resize_bucket_separate_from_crash_loss():
+    g = GoodputTracker()
+    hb = time.time() - 4.0
+    g.record_restart(
+        {'steps': 6, 'productive_s': 3.0, 'wall_s': 10.0,
+         'resizes': 1, 'resize_lost_s': 2.0},
+        {'steps': 6, 'productive_s': 3.0, 'wall_s': 10.5,
+         'unix_time': hb, 'resize_exit': True})
+    # scheduled resize: checkpoint was synchronous at the boundary →
+    # zero crash loss; downtime books in the resize bucket and prior
+    # resize counters carry forward
+    assert g.lost_steps == 0 and g.lost_s == 0.0
+    assert g.resizes == 2
+    assert g.resize_lost_s >= 2.0 + 3.5
+    meta = g.meta()
+    assert meta['resizes'] == 2
+    assert meta['resize_lost_s'] == pytest.approx(g.resize_lost_s, abs=1e-3)
+
+
+def test_goodput_crash_loss_still_books_normally():
+    g = GoodputTracker()
+    g.record_restart(
+        {'steps': 6, 'productive_s': 3.0, 'wall_s': 10.0},
+        {'steps': 8, 'productive_s': 4.0, 'wall_s': 11.0,
+         'unix_time': time.time() - 2.0})     # no resize_exit: a crash
+    assert g.lost_steps == 2
+    assert g.lost_s == pytest.approx(1.0)
+    assert g.resizes == 0 and g.resize_lost_s == 0.0

@@ -1,0 +1,281 @@
+"""Elastic resize drills (ISSUE 19): real multi-process fleets resized
+across restore.
+
+1. **Shrink 4→2 under a crash:** a 4-worker fleet is SIGKILLed mid-run;
+   the fleet restarts at 2 workers from the 4-wide sharded checkpoint
+   (reshard-on-restore: full values reassembled, re-laid onto the 2-wide
+   mesh). Acceptance is bitwise: two independent 2-worker resumes from
+   byte-identical copies of the same checkpoint directory produce
+   identical loss trajectories — resharding is deterministic, and the
+   crash loss books in the CRASH bucket (resizes stays 0).
+2. **Scheduled grow 2→4:** ``PADDLE_TPU_ELASTIC_RESIZE=at_step=N:nproc=4``
+   makes every worker commit a synchronous checkpoint at the boundary,
+   write ``resize.json``, and exit FLEET_EXIT_CODE (75) — the PR 12
+   resume ladder. The relaunched 4-worker fleet resumes at N+1 with
+   goodput booking the resize exactly once: ``resizes == 1``,
+   ``lost_steps == 0`` (scheduled ≠ crash), ``resize_lost_s > 0``.
+"""
+import json
+import os
+import shutil
+import signal
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+# Same deterministic fleet program as test_fleet_crash_resume.py, plus the
+# resize exit: when end_of_step returns True with `resize_requested` set,
+# the loop leaves through exit_for_resume (75) after flushing the manager.
+TRAIN_SCRIPT = r'''
+import json, os, sys
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import layers as L
+from paddle_tpu import resilience
+from paddle_tpu.fleet_runtime import (bootstrap, check_poisoned,
+                                      exit_for_resume, FLEET_EXIT_CODE)
+
+ckpt_dir, log_path, total_steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+bootstrap()
+import jax
+rank = jax.process_index()
+
+fluid.seed(1234)
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = L.data('cx', [8], dtype='float32')
+    y = L.data('cy', [1], dtype='float32')
+    h = L.fc(x, size=16, act='relu')
+    h = L.dropout(h, dropout_prob=0.3)
+    pred = L.fc(h, size=1)
+    loss = L.reduce_mean(L.square_error_cost(pred, y))
+    from paddle_tpu.parallel import DistributedStrategy, fleet
+    fleet.init(mesh_shape={'fsdp': jax.device_count()})
+    strat = DistributedStrategy()
+    strat.sharding = True
+    fleet.distributed_optimizer(
+        fluid.optimizer.Adam(learning_rate=1e-2), strategy=strat,
+    ).minimize(loss)
+
+exe = fluid.Executor()
+exe.run(startup)
+
+blk = main.global_block()
+loader = fluid.DataLoader.from_generator(
+    feed_list=[blk.var('cx'), blk.var('cy')], capacity=4)
+loader.shard_for_fleet()
+
+def epoch_batches(epoch, n=5):
+    rng = np.random.RandomState(100 + epoch)
+    return [(rng.randn(8, 8).astype(np.float32),
+             rng.randn(8, 1).astype(np.float32)) for _ in range(n)]
+
+loader.set_batch_generator(lambda: iter(epoch_batches(loader.epoch)))
+
+mgr = resilience.CheckpointManager(ckpt_dir, every_n_steps=3, keep=3)
+step = 0
+got = mgr.restore()
+if got is not None:
+    arrays, meta = got
+    resilience.restore_training_state(arrays, meta, executor=exe,
+                                      program=main, loader=loader)
+    step = meta['step']
+    if rank == 0:
+        with open(log_path + '.goodput', 'w') as f:
+            json.dump(mgr.goodput.meta(), f)
+
+log = open(log_path, 'a') if rank == 0 else None
+stopped = False
+while step < total_steps and not stopped:
+    for batch in loader():
+        try:
+            lv = exe.run(main, feed=batch, fetch_list=[loss])[0]
+        except Exception:
+            rec = check_poisoned()
+            if rec is not None:
+                mgr.close()
+                exit_for_resume(rec)
+            raise
+        step += 1
+        if log:
+            log.write(json.dumps({'step': step,
+                                  'loss': np.asarray(lv).tobytes().hex()})
+                      + '\n')
+            log.flush()
+        stopped = mgr.end_of_step(
+            step, lambda: resilience.capture_training_state(
+                executor=exe, program=main, loader=loader))
+        if stopped or step >= total_steps:
+            break
+mgr.wait()
+mgr.close()
+if log:
+    log.close()
+if mgr.resize_requested is not None:
+    # the elastic ladder: checkpoint committed + resize.json written by
+    # end_of_step; leave through the fleet resume exit (75)
+    exit_for_resume()
+if mgr.fleet_poisoned is not None:
+    exit_for_resume(mgr.fleet_poisoned)
+'''
+
+
+def _write_script(tmp_path):
+    script = tmp_path / 'elastic_train.py'
+    if not script.exists():
+        script.write_text(TRAIN_SCRIPT)
+    return script
+
+
+def _run_fleet(tmp_path, name, nproc, ckpt_dir, total_steps, env=None,
+               rank_env=None, timeout=240):
+    """Launch an `nproc`-worker fleet; returns (rcs, {step: loss_hex})."""
+    sys.path.insert(0, REPO)
+    from paddle_tpu.fleet_runtime.bootstrap import local_fleet
+    script = _write_script(tmp_path)
+    log = tmp_path / f'{name}.jsonl'
+    base = {
+        'PYTHONPATH': REPO,
+        'PADDLE_TPU_METRICS_DIR': str(tmp_path / f'{name}_metrics'),
+        'PADDLE_TPU_WATCHDOG': '1',
+        'PADDLE_TPU_WATCHDOG_FLOOR_S': '6',
+        'PADDLE_TPU_WATCHDOG_COLD_S': '90',
+        'PADDLE_TPU_VERIFY': 'off',
+    }
+    base.update(env or {})
+    outs = []
+
+    def stdout(rank):
+        f = open(tmp_path / f'{name}.r{rank}.out', 'w')
+        outs.append(f)
+        return f
+
+    fl = local_fleet(nproc, script, args=[ckpt_dir, log, total_steps],
+                     env=base, rank_env=rank_env, stdout=stdout, cwd=REPO)
+    rcs = fl.wait(timeout=timeout)
+    for f in outs:
+        f.close()
+    losses = {}
+    if log.exists():
+        for line in log.read_text().splitlines():
+            if line.strip():
+                rec = json.loads(line)
+                losses[rec['step']] = rec['loss']
+    return rcs, losses
+
+
+def _rank_out(tmp_path, name, rank):
+    p = tmp_path / f'{name}.r{rank}.out'
+    return p.read_text()[-3000:] if p.exists() else '<no output>'
+
+
+def test_shrink_4_to_2_bitwise_vs_same_size_reference(tmp_path):
+    """Kill a 4-worker fleet, resume TWICE at 2 workers from byte-equal
+    checkpoint copies: both resumes restore the 4-wide sharded state onto
+    the 2-wide mesh and must agree bitwise step for step."""
+    total = 10
+    ck = tmp_path / 'ck4'
+    rcs, crash = _run_fleet(
+        tmp_path, 'crash4', 4, ck, total,
+        rank_env={2: {'PADDLE_TPU_FAULT_INJECT': 'kill@step=8'}})
+    assert rcs[2] == -signal.SIGKILL, (rcs, _rank_out(tmp_path, 'crash4', 2))
+    assert 0 not in rcs, (rcs, _rank_out(tmp_path, 'crash4', 0))
+    assert max(crash) >= 7          # the step-6 checkpoint committed
+    from paddle_tpu.resilience import snapshot as snap
+    ck0 = snap.latest_checkpoint(str(ck))
+    assert ck0 is not None and ck0.step == 6 and ck0.manifest['world'] == 4
+
+    # byte-identical second copy BEFORE any resume touches the directory
+    ck_copy = tmp_path / 'ck4_copy'
+    shutil.copytree(ck, ck_copy)
+
+    rcs, resumed = _run_fleet(tmp_path, 'shrink', 2, ck, total)
+    assert rcs == [0, 0], (rcs, _rank_out(tmp_path, 'shrink', 0),
+                           _rank_out(tmp_path, 'shrink', 1))
+    rcs, reference = _run_fleet(tmp_path, 'shrinkref', 2, ck_copy, total)
+    assert rcs == [0, 0], (rcs, _rank_out(tmp_path, 'shrinkref', 0),
+                           _rank_out(tmp_path, 'shrinkref', 1))
+
+    # both played exactly steps 7..total after restoring step 6
+    assert sorted(resumed) == list(range(7, total + 1))
+    assert sorted(reference) == sorted(resumed)
+    mismatches = {s: (resumed[s], reference[s]) for s in resumed
+                  if resumed[s] != reference[s]}
+    assert not mismatches, \
+        f'reshard-on-restore is not deterministic: {mismatches}'
+
+    # the crash loss books as CRASH loss — the resize bucket stays empty
+    gp = json.loads((tmp_path / 'shrink.jsonl.goodput').read_text())
+    assert gp['restarts'] == 1, gp
+    assert gp['lost_steps'] == max(crash) - 6, gp
+    assert gp['resizes'] == 0 and gp['resize_lost_s'] == 0.0, gp
+
+
+@pytest.mark.slow
+def test_grow_4_to_8_bitwise_vs_same_size_reference(tmp_path):
+    """The wide leg of the acceptance drill (slow: an 8-process fleet on
+    one host): the SAME 4-wide crashed checkpoint restores onto nproc=8
+    with bitwise-deterministic resharding, proven the same way as the
+    shrink leg — two independent 8-worker resumes from byte-identical
+    checkpoint copies must agree step for step."""
+    total = 10
+    ck = tmp_path / 'ck4'
+    rcs, crash = _run_fleet(
+        tmp_path, 'crash4w', 4, ck, total,
+        rank_env={2: {'PADDLE_TPU_FAULT_INJECT': 'kill@step=8'}})
+    assert rcs[2] == -signal.SIGKILL, rcs
+    assert 0 not in rcs, (rcs, _rank_out(tmp_path, 'crash4w', 0))
+    from paddle_tpu.resilience import snapshot as snap
+    ck0 = snap.latest_checkpoint(str(ck))
+    assert ck0 is not None and ck0.step == 6 and ck0.manifest['world'] == 4
+
+    ck_copy = tmp_path / 'ck4w_copy'
+    shutil.copytree(ck, ck_copy)
+
+    rcs, resumed = _run_fleet(tmp_path, 'grow8', 8, ck, total, timeout=480)
+    assert rcs == [0] * 8, (rcs, _rank_out(tmp_path, 'grow8', 0))
+    rcs, reference = _run_fleet(tmp_path, 'grow8ref', 8, ck_copy, total,
+                                timeout=480)
+    assert rcs == [0] * 8, (rcs, _rank_out(tmp_path, 'grow8ref', 0))
+
+    assert sorted(resumed) == list(range(7, total + 1))
+    assert sorted(reference) == sorted(resumed)
+    mismatches = {s: (resumed[s], reference[s]) for s in resumed
+                  if resumed[s] != reference[s]}
+    assert not mismatches, \
+        f'reshard-on-restore is not deterministic at 8 wide: {mismatches}'
+    gp = json.loads((tmp_path / 'grow8.jsonl.goodput').read_text())
+    assert gp['restarts'] == 1 and gp['resizes'] == 0, gp
+
+
+def test_scheduled_grow_2_to_4_books_resize_not_crash(tmp_path):
+    from paddle_tpu.elastic.schedule import read_resize_request
+    from paddle_tpu.fleet_runtime import FLEET_EXIT_CODE
+    ck = tmp_path / 'ck2'
+    rcs, losses = _run_fleet(
+        tmp_path, 'grow', 2, ck, 12,
+        env={'PADDLE_TPU_ELASTIC_RESIZE': 'at_step=5:nproc=4'})
+    # every worker leaves through the resume ladder at the SAME boundary
+    assert rcs == [FLEET_EXIT_CODE] * 2, \
+        (rcs, _rank_out(tmp_path, 'grow', 0), _rank_out(tmp_path, 'grow', 1))
+    assert max(losses) == 5
+    req = read_resize_request(str(ck))
+    assert req is not None, os.listdir(ck)
+    assert req['step'] == 5 and req['target_nproc'] == 4 \
+        and req['from_nproc'] == 2, req
+    # the resize checkpoint is synchronous AT the boundary: durable step 5
+    from paddle_tpu.resilience import snapshot as snap
+    ck0 = snap.latest_checkpoint(str(ck))
+    assert ck0 is not None and ck0.step == 5, ck0
+
+    # the restarter's move: relaunch at target_nproc
+    rcs, resumed = _run_fleet(tmp_path, 'grown', req['target_nproc'], ck, 8)
+    assert rcs == [0, 0, 0, 0], (rcs, _rank_out(tmp_path, 'grown', 0))
+    assert sorted(resumed) == list(range(6, 9))   # resumed at 6, no replay
+    gp = json.loads((tmp_path / 'grown.jsonl.goodput').read_text())
+    assert gp['restarts'] == 1, gp
+    assert gp['resizes'] == 1, gp
+    assert gp['lost_steps'] == 0 and gp['lost_s'] == 0.0, gp
+    assert gp['resize_lost_s'] > 0.0, gp

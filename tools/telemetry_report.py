@@ -263,6 +263,16 @@ def summarize(metrics, trace, steps, top=10):
                 f"{int(_counter(metrics, 'restart_lost_steps'))} step(s) / "
                 f"{_counter(metrics, 'restart_lost_seconds'):.2f}s of "
                 f"replayed work")
+        resizes = _counter(metrics, 'elastic_resizes_total')
+        resize_lost = (metrics.get('goodput_resize_lost_seconds')
+                       or {}).get('samples', [])
+        if resizes or (resize_lost and resize_lost[0]['value']):
+            lost_s = resize_lost[0]['value'] if resize_lost else 0.0
+            reshards = _counter(metrics, 'elastic_reshard_restores')
+            lines.append(
+                f"elastic resizes:       {int(resizes)} scheduled "
+                f"resize(s), {lost_s:.2f}s resize downtime (separate from "
+                f"crash loss), {int(reshards)} reshard-on-restore(s)")
         preempt = _counter(metrics, 'preemption_requests')
         faults = _counter(metrics, 'fault_injections')
         if preempt or faults:
@@ -322,7 +332,8 @@ def summarize(metrics, trace, steps, top=10):
     tier_misses = _counter(metrics, 'prefix_cache_misses')
     routed = _counter(metrics, 'router_requests')
     handoffs = _counter(metrics, 'disagg_handoffs')
-    if tier_hits or tier_misses or routed or handoffs:
+    autoscale = _counter(metrics, 'autoscale_decisions')
+    if tier_hits or tier_misses or routed or handoffs or autoscale:
         lines.append('## Serving tier')
         if tier_hits or tier_misses:
             saved = _counter(metrics, 'prefix_cache_tokens_saved')
@@ -352,6 +363,43 @@ def summarize(metrics, trace, steps, top=10):
                 load = ', '.join(f'{u}: {int(v)}'
                                  for u, v in sorted(per_replica.items()))
                 lines.append(f"per-replica in-flight: {load}")
+        if autoscale:
+            by_act = {}
+            for s in (metrics.get('autoscale_decisions')
+                      or {}).get('samples', []):
+                key = (f"{s['labels'].get('action', '?')}/"
+                       f"{s['labels'].get('trigger', '?')}")
+                by_act[key] = by_act.get(key, 0) + int(s['value'])
+            detail = ', '.join(f'{k}: {v}'
+                               for k, v in sorted(by_act.items()))
+            lines.append(f"autoscaler:            {int(autoscale)} "
+                         f"decision(s) ({detail})")
+            reps = (metrics.get('autoscale_replicas')
+                    or {}).get('samples', [])
+            routable = (metrics.get('autoscale_replicas_routable')
+                        or {}).get('samples', [])
+            if reps:
+                lines.append(
+                    f"tier size:             {int(reps[0]['value'])} "
+                    f"replica(s), "
+                    f"{int(routable[0]['value']) if routable else 0} "
+                    f"routable")
+            ttr = (metrics.get('autoscale_time_to_routable_seconds')
+                   or {}).get('samples', [])
+            if ttr and ttr[0]['count']:
+                s = ttr[0]
+                lines.append(f"cold-start admission:  mean "
+                             f"{s['sum'] / s['count']:.2f}s to routable, "
+                             f"max {s['max'] or 0:.2f}s "
+                             f"({int(s['count'])} replica(s))")
+            dr = (metrics.get('autoscale_drain_seconds')
+                  or {}).get('samples', [])
+            if dr and dr[0]['count']:
+                s = dr[0]
+                lines.append(f"drain-then-retire:     mean "
+                             f"{s['sum'] / s['count']:.2f}s, "
+                             f"max {s['max'] or 0:.2f}s "
+                             f"({int(s['count'])} replica(s))")
         if handoffs:
             hb = _counter(metrics, 'disagg_kv_bytes')
             hf = _counter(metrics, 'disagg_handoff_failures')
