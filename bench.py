@@ -493,6 +493,19 @@ def bench_async_pipeline(on_tpu):
     return measure_all(smoke=not on_tpu)
 
 
+def bench_pipeline_parallel(on_tpu):
+    """Pipeline-parallel schedules (PERF.md "Pipeline parallelism"):
+    GPipe vs 1F1B at the same auto-cut — bitwise loss parity, predicted
+    (staged planner) AND measured (XLA memory_analysis) peak residency,
+    and auto-cut quality vs every manual cut on bert_layer. Valid on
+    CPU: parity, planner-vs-XLA agreement and cut quality are
+    host-independent; steps/s is trend-only."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools'))
+    from bench_pp import measure_all
+    return measure_all(smoke=not on_tpu)
+
+
 def bench_resilience(on_tpu):
     """Checkpoint stall + restart lost-work (PERF.md §14) and self-healing
     (PERF.md §15): async checkpointing must add < 1 step of stall, the
@@ -746,6 +759,18 @@ def main():
             async_pipeline_speedup=pl['async_pipeline']['speedup'],
             async_pipeline_bitwise=pl['async_pipeline']
             ['bitwise_identical'])
+
+    pp = run("pipeline_parallel", lambda: bench_pipeline_parallel(on_tpu))
+    if pp is not None:
+        emit({"metric": "pipeline_parallel",
+              "schedules": pp['schedules'], "autocut": pp['autocut']})
+        summary.update(
+            pp_bitwise=pp['schedules']['bitwise_identical'],
+            pp_1f1b_peak_le_gpipe=(
+                pp['schedules']['predicted_1f1b_le_gpipe']
+                and pp['schedules']['measured_1f1b_le_gpipe']),
+            pp_autocut_within_tolerance=pp['autocut']
+            ['within_tolerance'])
 
     rz = run("resilience", lambda: bench_resilience(on_tpu))
     if rz is not None:

@@ -403,19 +403,44 @@ def _op_read_names(op):
 
 
 def _pipeline_plan(program, fwd_ops, marker, feed_names, state_names,
-                   fetch_names=()):
+                   fetch_names=(), feed_shapes=None):
     """Static analysis for PipelineOptimizer lowering (ref optimizer.py:3405):
     split the forward at the cut vars into stages + a loss tail. If the
-    stages are isomorphic (same op/attr sequence, same param shapes, single
-    chained activation) and the default mesh has a matching 'pp' axis, the
-    step runs the real SPMD GPipe schedule (parallel/pipeline.gpipe);
-    otherwise it falls back to a microbatched lax.scan with gradient
-    accumulation — same numerics, per-microbatch activation memory."""
+    schedule is 'gpipe' and the stages are isomorphic (same op/attr
+    sequence, same param shapes, single chained activation) and the default
+    mesh has a matching 'pp' axis, the step runs the real SPMD GPipe
+    schedule (partition/pipeline.gpipe); otherwise it lowers to a
+    microbatched lax.scan whose gradient structure follows the schedule —
+    gpipe numerics via scan-transpose, 1F1B/interleaved via per-microbatch
+    (per-wave) backward inside the scan (sched_fwd_grad)."""
     pipe = marker.attrs.get('pipeline')
     if not pipe or not pipe.get('cut_vars'):
         return None
     cut_vars = list(pipe['cut_vars'])
-    m = int(pipe['num_microbatches'])
+    n_stages = len(cut_vars) + 1
+    # knob resolution: env wins over the marker attr (which carries the
+    # PipelineOptimizer/DistributedStrategy value) — strict-parse both
+    from .partition.pipeline import pp_microbatches, pp_schedule
+    schedule = pp_schedule(pipe.get('schedule')) or 'gpipe'
+    m_attr = int(pipe.get('num_microbatches') or 0)
+    m = pp_microbatches(m_attr if m_attr > 0 else None)
+    if m is None:
+        # auto (0-sentinel): smallest count whose predicted staged peak
+        # fits PADDLE_TPU_HBM_BUDGET_MB — the auto_remat consumption
+        # pattern; no budget (or an unplannable cut — the lowering falls
+        # back regardless) → one microbatch per stage
+        from .ir.auto_remat import hbm_budget_bytes
+        budget = hbm_budget_bytes()
+        m = n_stages
+        if budget is not None:
+            from .analysis.stage import solve_microbatches
+            try:
+                m, _peak, _fits = solve_microbatches(
+                    program, cut_vars, schedule, budget,
+                    fetch_names=fetch_names, feed_names=feed_names,
+                    feed_shapes=feed_shapes)
+            except Exception:
+                pass
     # microbatch-combine rule for the loss: mean-reduced losses average
     # across microbatches, sum-reduced losses add — anything else cannot be
     # reassembled exactly from per-microbatch values (scan_fwd raises)
@@ -424,7 +449,12 @@ def _pipeline_plan(program, fwd_ops, marker, feed_names, state_names,
     combine = ('mean' if loss_producer in ('mean', 'reduce_mean')
                else 'sum' if loss_producer in ('reduce_sum', 'sum')
                else None)
-    fallback = {'mode': 'scan', 'm': m, 'combine': combine}
+    fallback = {'mode': 'scan', 'm': m, 'combine': combine,
+                'schedule': schedule, 'n_stages': n_stages}
+    if schedule != 'gpipe':
+        # 1F1B/interleaved restructure the backward — they always lower
+        # through the schedule-structured scan, never the SPMD gpipe mode
+        return fallback
     producer = {}
     for i, op in enumerate(fwd_ops):
         for n in op.output_names():
@@ -509,7 +539,8 @@ def _pipeline_plan(program, fwd_ops, marker, feed_names, state_names,
         return fallback
     return {'mode': 'gpipe', 'm': m, 'stages': stages, 'tail': tail,
             'spn': spn, 'x_name': ext[0][0], 'out_name': cut_vars[0],
-            'cut_out': cut_vars[-1], 'mesh': mesh}
+            'cut_out': cut_vars[-1], 'mesh': mesh,
+            'schedule': 'gpipe', 'n_stages': len(stages)}
 
 
 def _remat_segments(fwd_ops, checkpoints):
@@ -529,7 +560,8 @@ def _remat_segments(fwd_ops, checkpoints):
     return segs
 
 
-def _lower(program: Program, feed_names, fetch_names, state_names):
+def _lower(program: Program, feed_names, fetch_names, state_names,
+           feed_shapes=None):
     """Build the pure step function for `program`.
 
     The step takes the training state SPLIT in two dicts so the caller can
@@ -563,12 +595,18 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
         sparse_vals_names = dict(zip(sparse_params,
                                      marker.outputs.get('SparseVals', [])))
         pplan = _pipeline_plan(program, fwd_ops, marker, feed_names,
-                               state_names, fetch_names)
-        if pplan is not None and sparse_params:
+                               state_names, fetch_names, feed_shapes)
+        if pplan is not None and sparse_params \
+                and pplan['mode'] == 'gpipe':
+            # the scan lowerings split the per-site surrogates per
+            # microbatch (docs/SPARSE.md); only the SPMD gpipe mode —
+            # whose stages live inside a shard_map the surrogate context
+            # cannot cross — still rejects the composition
             raise NotImplementedError(
-                'sparse embedding gradients + pipeline microbatching are '
-                'not composable; set PADDLE_TPU_SPARSE_GRAD=0 or drop the '
-                'pipeline cut_list')
+                'sparse embedding gradients are not composable with the '
+                'SPMD gpipe pipeline mode; use the scan lowering '
+                '(non-isomorphic stages or PADDLE_TPU_PP_SCHEDULE=1f1b) '
+                'or set PADDLE_TPU_SPARSE_GRAD=0')
         loss_var_shape = None
         blk0 = program.global_block()
         if blk0.has_var(loss_name):
@@ -709,9 +747,9 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
 
             def gpipe_fwd(pvals):
                 """Real SPMD GPipe: stage params stacked over 'pp', scan +
-                ppermute schedule (parallel/pipeline.gpipe), loss tail on
+                ppermute schedule (partition/pipeline.gpipe), loss tail on
                 the reassembled full batch."""
-                from .parallel.pipeline import gpipe
+                from .partition.pipeline import gpipe
                 e = {k: pvals.get(k, v) for k, v in feeds.items()}
                 spn = pplan['spn']
 
@@ -754,10 +792,12 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                         e.__setitem__)
                 return jnp.sum(e[loss_name]), e
 
-            def scan_fwd(pvals):
-                """GPipe-numerics fallback: microbatched lax.scan with loss
-                (and grad, via autodiff of the scan) accumulation; state
-                writes thread through the carry in microbatch order."""
+            def micro_split(pvals):
+                """Shared scan-mode prologue: batch-major feeds and the
+                per-site sparse surrogates split (m, batch/m, ...);
+                scalars pass through. Microbatch i's lookup occurrences
+                are the contiguous surrogate row block i (ids are
+                batch-major, so flatten order is block-contiguous)."""
                 mm = pplan['m']
                 if pplan['combine'] is None:
                     raise ValueError(
@@ -784,18 +824,54 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
                         split[kf] = v.reshape((mm, mb) + v.shape[1:])
                     else:
                         rest[kf] = v
-                sw0 = {n: state[n] for n in written_state}
+                site_split = {}
+                for k in site_keys:
+                    v = pvals[k]
+                    if v.shape[0] % mm != 0:
+                        raise ValueError(
+                            f"pipeline+sparse: lookup site {k!r} has "
+                            f"{v.shape[0]} id occurrences, not divisible "
+                            f"by num_microbatches {mm}")
+                    site_split[k] = v.reshape(
+                        (mm, v.shape[0] // mm) + v.shape[1:])
+                return fv, split, rest, site_split, mb, mm
+
+            def micro_fetch_names():
                 # fetches of forward intermediates: collected per microbatch
                 # and reassembled after the scan (grad fetches are bound
                 # after fwd by the marker, so only fwd-produced names count)
-                fwd_produced = {n for o in fwd_ops for n in o.output_names()}
-                micro_fetch = [n for n in fetch_names
-                               if n in fwd_produced and n not in state_set
-                               and n != loss_name]
+                fwd_produced = {n for o in fwd_ops
+                                for n in o.output_names()}
+                return [n for n in fetch_names
+                        if n in fwd_produced and n not in state_set
+                        and n != loss_name]
+
+            def micro_stitch(e, micro_fetch, ys, mm, mb):
+                for n, v in zip(micro_fetch, ys):
+                    if v.ndim >= 2 and v.shape[1] == mb:
+                        # batch-major intermediate: stitch microbatches back
+                        e[n] = v.reshape((mm * mb,) + v.shape[2:])
+                    else:
+                        # per-microbatch scalar/metric: average (exact for
+                        # mean-type metrics over equal microbatches)
+                        e[n] = jnp.mean(v, axis=0)
+
+            def scan_fwd(pvals):
+                """GPipe-numerics fallback: microbatched lax.scan with loss
+                (and grad, via autodiff of the scan) accumulation; state
+                writes thread through the carry in microbatch order."""
+                fv, split, rest, site_split, mb, mm = micro_split(pvals)
+                sw0 = {n: state[n] for n in written_state}
+                micro_fetch = micro_fetch_names()
 
                 def body(carry, xs):
                     loss_acc, sw = carry
-                    mb_idx, xslices = xs
+                    mb_idx, xslices, ssl = xs
+                    if site_keys:
+                        # rebind the site surrogates to this trace's
+                        # per-microbatch slices (grads flow back through
+                        # the scan transpose into pvals[site])
+                        site_vals.update(ssl)
                     e = dict(rest)
                     e.update(xslices)
                     e.update(sw)
@@ -808,33 +884,144 @@ def _lower(program: Program, feed_names, fetch_names, state_names):
 
                 (loss_tot, sw_fin), ys = jax.lax.scan(
                     body, (jnp.zeros((), jnp.float32), sw0),
-                    (jnp.arange(mm), split))
+                    (jnp.arange(mm), split, site_split))
                 loss = loss_tot / mm if pplan['combine'] == 'mean' \
                     else loss_tot
                 e = dict(fv)          # all feeds stay fetchable
                 e.update(sw_fin)
                 e[loss_name] = (jnp.reshape(loss, loss_var_shape)
                                 if loss_var_shape is not None else loss)
-                for n, v in zip(micro_fetch, ys):
-                    if v.ndim >= 2 and v.shape[1] == mb:
-                        # batch-major intermediate: stitch microbatches back
-                        e[n] = v.reshape((mm * mb,) + v.shape[2:])
-                    else:
-                        # per-microbatch scalar/metric: average (exact for
-                        # mean-type metrics over equal microbatches)
-                        e[n] = jnp.mean(v, axis=0)
+                micro_stitch(e, micro_fetch, ys, mm, mb)
                 return jnp.reshape(loss, ()), e
 
-            if pplan is None:
-                fwd = plain_fwd
-            elif pplan['mode'] == 'gpipe':
-                fwd = gpipe_fwd
-            else:
-                fwd = scan_fwd
+            def sched_fwd_grad(pvals):
+                """Schedule-structured gradients for 1F1B/interleaved: the
+                backward runs per microbatch (1F1B) or per wave
+                (interleaved) INSIDE the scan, so only one wave of
+                residuals is ever live — the staged planner's
+                ``host_peak_bytes`` prediction, visible to XLA as a
+                smaller temp arena than the gpipe scan-transpose.
+
+                1F1B runs its scan in reverse: jax's scan transpose
+                accumulates constant-operand cotangents from the last
+                microbatch down, so reverse per-microbatch accumulation
+                reproduces the gpipe schedule's float association exactly
+                — bitwise grad parity on the same cut. The per-microbatch
+                cotangent seed is ``loss_sum / m`` (the same literal
+                division the transpose injects). With forward-written
+                state (BN stats) the scan must run forward; parity then
+                holds to tolerance, not bitwise. Returns ``(env, grads)``
+                — the backward is internal, no outer value_and_grad."""
+                fv, split, rest, site_split, mb, mm = micro_split(pvals)
+                sw0 = {n: state[n] for n in written_state}
+                micro_fetch = micro_fetch_names()
+                dense = {n: pvals[n] for n in param_names}
+                combine = pplan['combine']
+
+                def mb_fwd(pv, sv, xslices, sw, mb_idx):
+                    if site_keys:
+                        site_vals.update(sv)
+                    e = dict(rest)
+                    e.update(xslices)
+                    e.update(sw)
+                    run_seq(fwd_ops, 0, make_read(e, pv, state),
+                            e.__setitem__,
+                            key=jax.random.fold_in(base_key, 7919 + mb_idx))
+                    lsum = jnp.sum(e[loss_name])
+                    seed = lsum / mm if combine == 'mean' else lsum
+                    new_sw = {n: e[n] for n in written_state}
+                    outs = tuple(jnp.asarray(e[n]) for n in micro_fetch)
+                    return seed, (lsum, new_sw, outs)
+
+                gacc0 = {n: jnp.zeros_like(v) for n, v in dense.items()}
+                if pplan['schedule'] == '1f1b':
+                    def body(carry, xs):
+                        gacc, sw = carry
+                        mb_idx, xslices, ssl = xs
+                        (_, (lsum, new_sw, outs)), (gd, gs) = \
+                            jax.value_and_grad(
+                                mb_fwd, argnums=(0, 1), has_aux=True)(
+                                dense, ssl, xslices, sw, mb_idx)
+                        gacc = {n: gacc[n] + gd[n] for n in gacc}
+                        return (gacc, new_sw), (lsum, outs, gs)
+
+                    (gacc, sw_fin), (lsums, ys, gsite) = jax.lax.scan(
+                        body, (gacc0, sw0),
+                        (jnp.arange(mm), split, site_split),
+                        reverse=not written_state)
+                else:                                       # interleaved
+                    from .analysis.stage import wave_size
+                    w = wave_size('interleaved', pplan['n_stages'], mm)
+                    nw = mm // w
+                    wsplit = {k: v.reshape((nw, w) + v.shape[1:])
+                              for k, v in split.items()}
+                    wsite = {k: v.reshape((nw, w) + v.shape[1:])
+                             for k, v in site_split.items()}
+                    widx = jnp.arange(mm).reshape(nw, w)
+
+                    def wave_fwd(pv, sv, wslices, sw, idxs):
+                        def inner(c, ixs):
+                            sacc, sw_i = c
+                            mb_idx, xsl, ssl = ixs
+                            seed, (lsum, new_sw, outs) = mb_fwd(
+                                pv, ssl, xsl, sw_i, mb_idx)
+                            return (sacc + seed, new_sw), (lsum, outs)
+
+                        (seed_tot, sw_out), (lsums, outs) = jax.lax.scan(
+                            inner, (jnp.zeros((), jnp.float32), sw),
+                            (idxs, wslices, sv))
+                        return seed_tot, (lsums, sw_out, outs)
+
+                    def body(carry, xs):
+                        gacc, sw = carry
+                        idxs, wslices, wsl = xs
+                        (_, (lsums, sw_out, outs)), (gd, gs) = \
+                            jax.value_and_grad(
+                                wave_fwd, argnums=(0, 1), has_aux=True)(
+                                dense, wsl, wslices, sw, idxs)
+                        gacc = {n: gacc[n] + gd[n] for n in gacc}
+                        return (gacc, sw_out), (lsums, outs, gs)
+
+                    (gacc, sw_fin), (lsums, ys, gsite) = jax.lax.scan(
+                        body, (gacc0, sw0), (widx, wsplit, wsite))
+                    lsums = lsums.reshape((mm,))
+                    ys = tuple(v.reshape((mm,) + v.shape[2:]) for v in ys)
+                    gsite = {k: v.reshape((mm,) + v.shape[2:])
+                             for k, v in gsite.items()}
+                # loss assembled in FORWARD microbatch order — the same
+                # float association as scan_fwd's carry accumulation
+                loss_acc = jnp.zeros((), jnp.float32)
+                for i in range(mm):
+                    loss_acc = loss_acc + lsums[i]
+                loss = loss_acc / mm if combine == 'mean' else loss_acc
+                e = dict(fv)
+                e.update(sw_fin)
+                e[loss_name] = (jnp.reshape(loss, loss_var_shape)
+                                if loss_var_shape is not None else loss)
+                micro_stitch(e, micro_fetch, ys, mm, mb)
+                grads = dict(gacc)
+                for k in site_keys:
+                    g = gsite[k]
+                    grads[k] = g.reshape((-1,) + g.shape[2:])
+                return e, grads
+
             from .ops import sparse_ops as _sp
-            with _sp.site_context(site_vals):
-                (_, env), grads = jax.value_and_grad(fwd, has_aux=True)(
-                    params)
+            if pplan is not None and pplan['mode'] == 'scan' \
+                    and pplan['schedule'] != 'gpipe':
+                # 1F1B/interleaved own their backward (per-microbatch /
+                # per-wave value_and_grad inside the scan)
+                with _sp.site_context(site_vals):
+                    env, grads = sched_fwd_grad(params)
+            else:
+                if pplan is None:
+                    fwd = plain_fwd
+                elif pplan['mode'] == 'gpipe':
+                    fwd = gpipe_fwd
+                else:
+                    fwd = scan_fwd
+                with _sp.site_context(site_vals):
+                    (_, env), grads = jax.value_and_grad(
+                        fwd, has_aux=True)(params)
             for n, gname in zip(param_names, marker.outputs['Grads']):
                 env[gname] = grads[n]
             if sparse_sites:
@@ -1190,9 +1377,13 @@ class Executor:
         from . import ir
         feed_sig = tuple(sorted((n, v.shape, str(v.dtype))
                                 for n, v in feed_vals.items()))
+        # the pp knobs restructure the lowering (schedule/microbatch
+        # count), so a knob flip must re-lower, not hit the cache
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
                tuple(state_names), donate,
-               ir.pipeline_signature(build_strategy))
+               ir.pipeline_signature(build_strategy),
+               os.environ.get('PADDLE_TPU_PP_SCHEDULE', ''),
+               os.environ.get('PADDLE_TPU_PP_MICROBATCHES', ''))
         fn = self._cache.get(key)
         compiled_now = fn is None
         record_program_cache(hit=not compiled_now)
@@ -1225,7 +1416,10 @@ class Executor:
                 self._plan_telemetry(opt_program, fetch_names, feed_vals,
                                      donate)
                 step = _lower(opt_program, list(feed_vals), fetch_names,
-                              state_names)
+                              state_names,
+                              feed_shapes={n: tuple(v.shape)
+                                           for n, v in feed_vals.items()
+                                           if hasattr(v, 'shape')})
                 fn = jax.jit(step, donate_argnums=(0,))
             self._cache[key] = fn
 
@@ -1512,7 +1706,9 @@ class Executor:
             if val is None:
                 raise RuntimeError(f"persistable var '{n}' is uninitialized")
             state[n] = jnp.asarray(val)
-        step = _lower(program, feed_names, fetch_names, state_names)
+        step = _lower(program, feed_names, fetch_names, state_names,
+                      feed_shapes={n: tuple(np.asarray(feed[n]).shape)
+                                   for n in feed_names})
         base_key = default_generator.base_key()
 
         def fn(*feed_arrays):

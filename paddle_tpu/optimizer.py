@@ -792,24 +792,75 @@ class GradientMergeOptimizer(Optimizer):
         return []
 
 
+def _stamp_pipeline(program, cut_vars, num_microbatches, schedule,
+                    num_stages=None, loss_name=None):
+    """Stamp the pipeline plan onto the backward marker. With no explicit
+    cut and a stage count, the cut is COMPUTED: ``solve_stage_cuts``
+    (analysis/stage.py) balances predicted per-stage FLOPs+bytes from the
+    cost model. ``num_microbatches`` 0 is the auto sentinel — the executor
+    solves the count against ``PADDLE_TPU_HBM_BUDGET_MB`` at lowering
+    time, when feed shapes are known."""
+    block = program.global_block()
+    marker = next(op for op in reversed(block.ops)
+                  if op.type == BACKWARD_OP_TYPE)
+    cut_vars = [v.name if hasattr(v, 'name') else v
+                for v in (cut_vars or [])]
+    if not cut_vars and num_stages:
+        from .analysis.stage import solve_stage_cuts
+        cut_vars, _report = solve_stage_cuts(
+            program, num_stages,
+            fetch_names=(loss_name,) if loss_name else ())
+    marker._set_attr('pipeline', {
+        'cut_vars': cut_vars,
+        'num_microbatches': int(num_microbatches),
+        'schedule': schedule})
+
+
 class PipelineOptimizer:
     """ref: optimizer.py:3405 PipelineOptimizer — the reference splits the
     Program at `cut_list` points and streams batches through per-device
     section workers. The TPU lowering (executor.py `_lower`): the Program is
-    split at the cut vars into stages; isomorphic stages stack their
-    parameters over the 'pp' mesh axis and run the SPMD GPipe schedule
-    (paddle_tpu.parallel.pipeline: lax.scan + ppermute over ICI);
-    non-uniform stages fall back to a microbatched lax.scan with gradient
-    accumulation — the same GPipe numerics (mean-of-microbatch grads) and
-    per-microbatch activation memory, without cross-device placement."""
+    split at the cut vars into stages; with ``schedule='gpipe'`` (default),
+    isomorphic stages stack their parameters over the 'pp' mesh axis and
+    run the SPMD GPipe schedule (paddle_tpu.partition.pipeline: lax.scan +
+    ppermute over ICI), non-uniform stages fall back to a microbatched
+    lax.scan with gradient accumulation — the same GPipe numerics
+    (mean-of-microbatch grads) and per-microbatch activation memory.
+    ``schedule='1f1b'``/'interleaved' run the backward per microbatch/wave
+    inside the scan (executor sched_fwd_grad): bitwise-identical gradients
+    at one wave of resident activations instead of all m.
+
+    New vs the reference signature: ``schedule`` (∈ partition.pipeline
+    .PP_SCHEDULES; PADDLE_TPU_PP_SCHEDULE overrides), ``num_stages``
+    (auto-cut via the cost model when cut_list is omitted), and
+    ``num_microbatches='auto'`` (count solved to fit
+    PADDLE_TPU_HBM_BUDGET_MB; PADDLE_TPU_PP_MICROBATCHES overrides)."""
 
     def __init__(self, optimizer, cut_list=None, place_list=None,
                  concurrency_list=None, queue_size=30, sync_steps=1,
-                 start_cpu_core_id=0, num_microbatches=None):
+                 start_cpu_core_id=0, num_microbatches=None,
+                 schedule=None, num_stages=None):
         self._inner = optimizer
         self.cut_list = cut_list
-        self.num_microbatches = num_microbatches or max(
-            len(place_list or []) or 1, 1)
+        if schedule is not None:
+            from .partition.pipeline import PP_SCHEDULES
+            if schedule not in PP_SCHEDULES:
+                raise ValueError(
+                    f'PipelineOptimizer: unknown schedule {schedule!r} '
+                    f"(supported: {', '.join(PP_SCHEDULES)})")
+        self.schedule = schedule
+        self.num_stages = int(num_stages) if num_stages else None
+        if self.num_stages is not None and self.num_stages < 2:
+            raise ValueError(
+                f'PipelineOptimizer: num_stages must be >= 2, '
+                f'got {num_stages}')
+        if num_microbatches == 'auto' or (
+                num_microbatches is None
+                and (schedule is not None or num_stages is not None)):
+            self.num_microbatches = 0      # executor solves vs HBM budget
+        else:
+            self.num_microbatches = num_microbatches or max(
+                len(place_list or []) or 1, 1)
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
@@ -818,16 +869,12 @@ class PipelineOptimizer:
                  no_grad_set=None):
         if in_dygraph_mode():
             raise RuntimeError("PipelineOptimizer is a static-graph "
-                               "construct (use parallel.pipeline.gpipe for "
+                               "construct (use partition.pipeline for "
                                "the functional path)")
         params_grads = self._inner.backward(loss, startup_program,
                                             parameter_list, no_grad_set)
-        block = loss.block.program.global_block()
-        marker = next(op for op in reversed(block.ops)
-                      if op.type == BACKWARD_OP_TYPE)
-        marker._set_attr('pipeline', {
-            'cut_vars': [v.name if hasattr(v, 'name') else v
-                         for v in (self.cut_list or [])],
-            'num_microbatches': int(self.num_microbatches)})
+        _stamp_pipeline(loss.block.program, self.cut_list,
+                        self.num_microbatches, self.schedule,
+                        num_stages=self.num_stages, loss_name=loss.name)
         self._inner.apply_gradients(params_grads)
         return None, params_grads

@@ -198,6 +198,13 @@ class DistributedStrategy:
         self._mesh_shape = None
         self._dcn_mesh_shape = None
         self._axis_rules = None
+        # pipeline parallelism (docs/DISTRIBUTED.md): pipeline_stages
+        # turns on the cost-model auto-cut; pp_schedule/pp_microbatches
+        # pick the schedule and microbatch count (strict-parse; the
+        # PADDLE_TPU_PP_* env knobs win at lowering time)
+        self._pipeline_stages = None
+        self._pp_schedule = None
+        self._pp_microbatches = None
 
     @property
     def mesh_shape(self):
@@ -251,6 +258,65 @@ class DistributedStrategy:
                 f"{', '.join(SUPPORTED_COMM_DTYPES)})")
         self._comm_dtype = value
 
+    @property
+    def pipeline_stages(self):
+        """Pipeline stage count (>= 2 enables pp): the cut is computed
+        by the cost-model solver (analysis/stage.solve_stage_cuts)."""
+        return self._pipeline_stages
+
+    @pipeline_stages.setter
+    def pipeline_stages(self, value):
+        if value is not None:
+            try:
+                value = int(value)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f'DistributedStrategy.pipeline_stages: expected an '
+                    f'integer stage count >= 2, got {value!r}')
+            if value < 2:
+                raise ValueError(
+                    f'DistributedStrategy.pipeline_stages: must be >= 2 '
+                    f'to pipeline, got {value!r}')
+        self._pipeline_stages = value
+
+    @property
+    def pp_schedule(self):
+        """Pipeline schedule ∈ {gpipe, 1f1b, interleaved}; the
+        ``PADDLE_TPU_PP_SCHEDULE`` env var overrides at lowering time."""
+        return self._pp_schedule
+
+    @pp_schedule.setter
+    def pp_schedule(self, value):
+        if value is not None:
+            from ..partition.pipeline import PP_SCHEDULES
+            if value not in PP_SCHEDULES:
+                raise ValueError(
+                    f'DistributedStrategy.pp_schedule: unknown schedule '
+                    f"{value!r} (supported: {', '.join(PP_SCHEDULES)})")
+        self._pp_schedule = value
+
+    @property
+    def pp_microbatches(self):
+        """Microbatch count: a positive int, or 'auto' (default) to solve
+        the smallest count fitting ``PADDLE_TPU_HBM_BUDGET_MB``;
+        ``PADDLE_TPU_PP_MICROBATCHES`` overrides at lowering time."""
+        return self._pp_microbatches
+
+    @pp_microbatches.setter
+    def pp_microbatches(self, value):
+        if value is not None and value != 'auto':
+            try:
+                value = int(value)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"DistributedStrategy.pp_microbatches: expected a "
+                    f"positive integer or 'auto', got {value!r}")
+            if value <= 0:
+                raise ValueError(
+                    f'DistributedStrategy.pp_microbatches: must be > 0, '
+                    f'got {value!r}')
+        self._pp_microbatches = value
+
 
 class DistributedOptimizer:
     """Wraps an optimizer; minimize() behaves like the inner one, but the
@@ -294,6 +360,22 @@ class DistributedOptimizer:
         result = inner.minimize(loss, startup_program, parameter_list,
                                 no_grad_set)
         program = loss.block.program
+        if strat.pipeline_stages or strat.pp_schedule \
+                or strat.pp_microbatches:
+            # one dist_strategy drives pp like every other axis: auto-cut
+            # from the cost model, schedule + microbatch count stamped on
+            # the backward marker (executor resolves env overrides and
+            # the HBM-budget microbatch solve at lowering time)
+            if not strat.pipeline_stages:
+                raise ValueError(
+                    'DistributedStrategy: pp_schedule/pp_microbatches '
+                    'need pipeline_stages >= 2 to enable pipelining')
+            mm = strat.pp_microbatches
+            from ..optimizer import _stamp_pipeline
+            _stamp_pipeline(
+                program, [], 0 if mm in (None, 'auto') else int(mm),
+                strat.pp_schedule, num_stages=strat.pipeline_stages,
+                loss_name=loss.name)
         from ..partition import configure, get_partitioner
         if strat.mesh_shape or strat.axis_rules:
             # strategy-declared topology: build the partitioner's owned

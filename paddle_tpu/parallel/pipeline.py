@@ -1,80 +1,30 @@
-"""Pipeline parallelism: GPipe microbatch schedule over a mesh axis ('pp').
+"""DEPRECATED shim: pipeline parallelism moved onto the partitioner.
 
-The reference's PipelineOptimizer splits the Program across devices and
-streams batches through section workers
-(ref: python/paddle/fluid/optimizer.py:PipelineOptimizer +
-paddle/fluid/framework/pipeline_trainer.cc). The TPU formulation keeps ONE
-SPMD program: every device holds its own stage's parameters (stacked pytree,
-leading dim = n_stages, sharded over 'pp'), and a lax.scan steps the GPipe
-schedule — each tick computes the local stage and ppermutes activations to
-the neighbor over ICI. Autodiff through the scan+ppermute gives the 1F1B-
-equivalent backward without a separate scheduler.
+The GPipe schedule this module owned lives in
+:mod:`paddle_tpu.partition.pipeline` now — on the partitioner's owned
+mesh, next to the 1F1B and interleaved schedules, the ``('stage','pp')``
+logical-axis rule, and the strict-parse ``PADDLE_TPU_PP_SCHEDULE`` /
+``PADDLE_TPU_PP_MICROBATCHES`` knobs. Everything here delegates
+(bitwise-identical — same code, new home) behind a one-per-process
+deprecation warning, the ``parallel.mesh.set_default_mesh`` pattern.
 """
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
-from jax import lax
-from jax.sharding import PartitionSpec as P
-
-from ..core import compat
-from .mesh import get_default_mesh
+from ..partition.pipeline import gpipe as _gpipe
+from ..partition.pipeline import stack_stage_params  # noqa: F401  (re-export)
 
 __all__ = ['gpipe', 'stack_stage_params']
 
 
-def stack_stage_params(per_stage_params):
-    """[{name: arr} per stage] → {name: arr[n_stages, ...]} for sharding
-    over 'pp' (all stages must be isomorphic — the transformer-block case)."""
-    keys = per_stage_params[0].keys()
-    return {k: jnp.stack([p[k] for p in per_stage_params]) for k in keys}
-
-
 def gpipe(stage_fn, stacked_params, x_micro, mesh=None, axis='pp'):
-    """Run `stage_fn(params, x) -> y` as a pipeline.
-
-    stacked_params: pytree with leading dim n_stages (sharded over `axis`).
-    x_micro: (n_micro, mb, ...) microbatched input (replicated).
-    Returns (n_micro, mb, ...) outputs of the LAST stage (replicated).
-    Stage input/output shapes must match (uniform stages)."""
-    mesh = mesh or get_default_mesh()
-    n_micro = x_micro.shape[0]
-    p = mesh.shape[axis]                                # static stage count
-    n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
-    if n_stages != p:
-        raise ValueError(
-            f"gpipe: {n_stages} stacked stages but mesh axis {axis!r} has "
-            f"{p} devices — one stage per device is required")
-
-    def body(params_s, xm):
-        # params_s leaves: (1, ...) local stage slice → squeeze
-        params = jax.tree_util.tree_map(lambda a: a[0], params_s)
-        idx = lax.axis_index(axis)
-        T = n_micro + p - 1
-        fwd_perm = [(i, i + 1) for i in range(p - 1)]
-        # activations are device-varying (each stage computes differently):
-        # mark the zero init for shard_map's vma typing
-        zero = compat.pcast(jnp.zeros_like(xm[0]), axis, to='varying')
-
-        def step(carry, t):
-            prev_y = carry
-            recv = lax.ppermute(prev_y, axis, fwd_perm)
-            mb = jnp.clip(t, 0, n_micro - 1)
-            x_in = jnp.where(idx == 0, xm[mb], recv)
-            active = (t >= idx) & (t - idx < n_micro)
-            y = stage_fn(params, x_in)
-            y = jnp.where(active, y, zero)
-            return y, y
-
-        _, ys = lax.scan(step, zero, jnp.arange(T))     # (T, mb, ...)
-        # device p-1 finishes microbatch i at tick i + p - 1
-        outs = ys[p - 1:p - 1 + n_micro] if p > 1 else ys[:n_micro]
-        # only the last stage's values are real; broadcast them to all
-        outs = jnp.where(idx == p - 1, outs, jnp.zeros_like(outs))
-        return lax.psum(outs, axis)
-
-    param_specs = jax.tree_util.tree_map(
-        lambda _: P(axis), stacked_params)
-    fn = compat.shard_map(body, mesh=mesh,
-                       in_specs=(param_specs, P()), out_specs=P())
-    return fn(stacked_params, x_micro)
+    """DEPRECATED: use ``partition.pipeline.gpipe`` (or the schedule-aware
+    executor lowering / ``SpmdTrainStep(pipeline=...)``)."""
+    from ..partition.partitioner import warn_once
+    warn_once(
+        'parallel.pipeline.gpipe',
+        'parallel.pipeline.gpipe is deprecated: pipeline schedules are '
+        'owned by the partitioner (paddle_tpu.partition.pipeline). Import '
+        'gpipe from there, or drive schedules through '
+        'PipelineOptimizer(schedule=...) / DistributedStrategy.pp_schedule '
+        '/ PADDLE_TPU_PP_SCHEDULE.')
+    return _gpipe(stage_fn, stacked_params, x_micro, mesh=mesh, axis=axis)

@@ -1,0 +1,197 @@
+"""Pipeline-parallel schedules on the partitioner mesh ('pp' axis).
+
+The reference's PipelineOptimizer splits the Program across devices and
+streams batches through section workers
+(ref: python/paddle/fluid/optimizer.py:PipelineOptimizer +
+paddle/fluid/framework/pipeline_trainer.cc). The TPU formulation keeps
+ONE SPMD program on the partitioner's owned mesh: every device holds its
+own stage's parameters (stacked pytree, leading dim = n_stages, sharded
+over ``'pp'`` via the ``('stage', 'pp')`` logical-axis rule), and a
+lax.scan steps the schedule — each tick computes the local stage and
+ppermutes activations to the neighbor over ICI.
+
+Three schedules (``PP_SCHEDULES``):
+
+- ``gpipe``     — all m microbatch forwards, then the backward;
+  residuals for every microbatch are in flight at the peak.
+- ``1f1b``      — one backward immediately after each forward wave;
+  at most one wave of residuals is live. Same arithmetic, lower peak.
+- ``interleaved`` — v virtual stage chunks per device in circular
+  placement (device i holds stages i, p+i, 2p+i, …): v chained pipeline
+  passes per microbatch, finer cut granularity at the same device count.
+
+The schedule/microbatch knobs are strict-parse
+(``PADDLE_TPU_PP_SCHEDULE`` ∈ PP_SCHEDULES,
+``PADDLE_TPU_PP_MICROBATCHES`` a positive int; unknown values raise
+listing the contract) and the env always wins over
+``DistributedStrategy`` — the PR 8/9 knob-hygiene contract.
+
+``paddle_tpu.parallel.pipeline`` is the retired predecessor: it now
+delegates here behind a warn-once deprecation shim (the
+``set_default_mesh`` pattern).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core import compat
+
+__all__ = ['PP_SCHEDULES', 'ENV_PP_SCHEDULE', 'ENV_PP_MICROBATCHES',
+           'pp_schedule', 'pp_microbatches', 'gpipe', 'interleaved',
+           'stack_stage_params', 'pipeline_stage_scan']
+
+PP_SCHEDULES = ('gpipe', '1f1b', 'interleaved')
+ENV_PP_SCHEDULE = 'PADDLE_TPU_PP_SCHEDULE'
+ENV_PP_MICROBATCHES = 'PADDLE_TPU_PP_MICROBATCHES'
+
+
+def pp_schedule(default=None):
+    """The pipeline schedule, env-first: ``PADDLE_TPU_PP_SCHEDULE`` when
+    set (strict parse — unknown names raise listing PP_SCHEDULES), else
+    `default` (a ``DistributedStrategy``/marker value, may be None)."""
+    raw = os.environ.get(ENV_PP_SCHEDULE)
+    if raw is None or raw == '':
+        if default is not None and default not in PP_SCHEDULES:
+            raise ValueError(
+                f'pipeline schedule: unknown schedule {default!r} '
+                f"(supported: {', '.join(PP_SCHEDULES)})")
+        return default
+    if raw not in PP_SCHEDULES:
+        raise ValueError(
+            f'{ENV_PP_SCHEDULE}: unknown schedule {raw!r} '
+            f"(supported: {', '.join(PP_SCHEDULES)})")
+    return raw
+
+
+def pp_microbatches(default=None):
+    """Microbatch-count override: ``PADDLE_TPU_PP_MICROBATCHES`` when set
+    (strict parse — a positive integer), else `default`."""
+    raw = os.environ.get(ENV_PP_MICROBATCHES)
+    if raw is None or raw == '':
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f'{ENV_PP_MICROBATCHES}: expected a positive integer '
+            f'microbatch count, got {raw!r}')
+    if v <= 0:
+        raise ValueError(
+            f'{ENV_PP_MICROBATCHES}: must be > 0, got {raw!r}')
+    return v
+
+
+def _default_mesh():
+    from .partitioner import get_partitioner
+    return get_partitioner().mesh
+
+
+def stack_stage_params(per_stage_params):
+    """[{name: arr} per stage] → {name: arr[n_stages, ...]} for sharding
+    over 'pp' (all stages must be isomorphic — the transformer-block case)."""
+    keys = per_stage_params[0].keys()
+    return {k: jnp.stack([p[k] for p in per_stage_params]) for k in keys}
+
+
+def pipeline_stage_scan(stage_fn, params, xm, n_micro, axis='pp', p=None):
+    """One pipeline pass INSIDE an existing shard_map over `axis`:
+    `params` is the local device's (already unstacked) stage parameters,
+    `xm` the (n_micro, mb, ...) microbatched input replicated across the
+    axis. Each tick computes the local stage and ppermutes the activation
+    to the neighbor; returns the LAST stage's (n_micro, mb, ...) outputs
+    psum-broadcast to every device. This is the schedule kernel both the
+    legacy `gpipe` wrapper and SpmdTrainStep's pp composition run."""
+    p = p if p is not None else lax.psum(1, axis)
+    idx = lax.axis_index(axis)
+    T = n_micro + p - 1
+    fwd_perm = [(i, i + 1) for i in range(p - 1)]
+    # activations are device-varying (each stage computes differently):
+    # mark the zero init for shard_map's vma typing
+    zero = compat.pcast(jnp.zeros_like(xm[0]), axis, to='varying')
+
+    def step(carry, t):
+        prev_y = carry
+        recv = lax.ppermute(prev_y, axis, fwd_perm)
+        mb = jnp.clip(t, 0, n_micro - 1)
+        x_in = jnp.where(idx == 0, xm[mb], recv)
+        active = (t >= idx) & (t - idx < n_micro)
+        y = stage_fn(params, x_in)
+        y = jnp.where(active, y, zero)
+        return y, y
+
+    _, ys = lax.scan(step, zero, jnp.arange(T))     # (T, mb, ...)
+    # device p-1 finishes microbatch i at tick i + p - 1
+    outs = ys[p - 1:p - 1 + n_micro] if p > 1 else ys[:n_micro]
+    # only the last stage's values are real; broadcast them to all
+    outs = jnp.where(idx == p - 1, outs, jnp.zeros_like(outs))
+    return lax.psum(outs, axis)
+
+
+def gpipe(stage_fn, stacked_params, x_micro, mesh=None, axis='pp'):
+    """Run `stage_fn(params, x) -> y` as a pipeline over the mesh.
+
+    stacked_params: pytree with leading dim n_stages (sharded over `axis`).
+    x_micro: (n_micro, mb, ...) microbatched input (replicated).
+    Returns (n_micro, mb, ...) outputs of the LAST stage (replicated).
+    Stage input/output shapes must match (uniform stages)."""
+    mesh = mesh or _default_mesh()
+    n_micro = x_micro.shape[0]
+    p = mesh.shape[axis]                                # static stage count
+    n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_stages != p:
+        raise ValueError(
+            f"gpipe: {n_stages} stacked stages but mesh axis {axis!r} has "
+            f"{p} devices — one stage per device is required")
+
+    def body(params_s, xm):
+        # params_s leaves: (1, ...) local stage slice → squeeze
+        params = jax.tree_util.tree_map(lambda a: a[0], params_s)
+        return pipeline_stage_scan(stage_fn, params, xm, n_micro,
+                                   axis=axis, p=p)
+
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(axis), stacked_params)
+    fn = compat.shard_map(body, mesh=mesh,
+                          in_specs=(param_specs, P()), out_specs=P())
+    return fn(stacked_params, x_micro)
+
+
+def interleaved(stage_fn, stacked_params, x_micro, mesh=None, axis='pp'):
+    """Interleaved (circular) placement: v virtual stage chunks per
+    device. `stacked_params` leaves have leading dims ``(v, p, ...)`` —
+    chunk ``[j, i]`` is the parameters of virtual stage ``j*p + i``, so
+    device i holds stages i, p+i, …, (v−1)p+i. Each microbatch flows
+    through v chained pipeline passes; the output of pass j re-enters the
+    ring as the input of pass j+1. Stage input/output shapes must match
+    across ALL v·p virtual stages."""
+    mesh = mesh or _default_mesh()
+    n_micro = x_micro.shape[0]
+    p = mesh.shape[axis]
+    leaf = jax.tree_util.tree_leaves(stacked_params)[0]
+    if leaf.ndim < 2 or leaf.shape[1] != p:
+        raise ValueError(
+            f'interleaved: stacked params must have leading dims '
+            f'(v, p={p}, ...); got {tuple(leaf.shape)} — reshape '
+            f'(v*p, ...) stage stacks to (v, p, ...)')
+    v = leaf.shape[0]
+
+    def body(params_s, xm):
+        # params_s leaves: (v, 1, ...) local chunk column → squeeze dim 1
+        params_v = jax.tree_util.tree_map(lambda a: a[:, 0], params_s)
+        y = xm
+        for j in range(v):
+            params_j = jax.tree_util.tree_map(lambda a: a[j], params_v)
+            y = pipeline_stage_scan(stage_fn, params_j, y, n_micro,
+                                    axis=axis, p=p)
+        return y
+
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(None, axis), stacked_params)
+    fn = compat.shard_map(body, mesh=mesh,
+                          in_specs=(param_specs, P()), out_specs=P())
+    return fn(stacked_params, x_micro)

@@ -57,7 +57,7 @@ class SpmdTrainStep:
     """
 
     def __init__(self, loss_fn, params, partitioner=None, lr=0.1,
-                 comm_dtype=None, bucket_mb=None):
+                 comm_dtype=None, bucket_mb=None, pipeline=None):
         p = partitioner or get_partitioner()
         mesh = p.mesh
         if mesh is None:
@@ -72,13 +72,71 @@ class SpmdTrainStep:
         self._n_data = max(1, p.axis_size(data_axes))
         self._data_axes = data_axes
 
+        # pipeline composition (docs/DISTRIBUTED.md): stage-stacked
+        # params shard their leading dim over the 'stage' logical rule's
+        # mesh axis ('pp'); the body runs the schedule over that axis and
+        # the stage grads ride the existing per-tile dp sync
+        stage_names = ()
+        pp_ax = pp_size = pp_m = pp_sched = None
+        stage_fn = tail_fn = x_fn = None
+        if pipeline is not None:
+            from .pipeline import (pipeline_stage_scan, pp_microbatches,
+                                   pp_schedule)
+            cfg = dict(pipeline)
+            stage_fn = cfg['stage_fn']
+            tail_fn = cfg['tail_fn']
+            stage_names = tuple(cfg['stage_params'])
+            x_fn = cfg.get('x_fn') or (
+                lambda b: jax.tree_util.tree_leaves(b)[0])
+            pp_axes = p.mesh_axes_for('stage') or ()
+            pp_ax = pp_axes[0] if pp_axes else None
+            if pp_ax is None or pp_ax not in mesh.shape:
+                raise ValueError(
+                    "SpmdTrainStep(pipeline=...): the 'stage' logical "
+                    "rule resolves to no mesh axis — configure a mesh "
+                    "with a 'pp' axis (e.g. mesh_shape={'dp':2,'pp':2})")
+            pp_size = mesh.shape[pp_ax]
+            pp_sched = pp_schedule(cfg.get('schedule')) or 'gpipe'
+            if pp_sched == 'interleaved':
+                raise NotImplementedError(
+                    'SpmdTrainStep pipeline: interleaved placement is '
+                    'the functional partition.pipeline.interleaved path '
+                    '(v-chunk stacked params); use gpipe or 1f1b here')
+            pp_m = pp_microbatches(cfg.get('num_microbatches')) or pp_size
+            if pp_m % min(pp_m, pp_size):
+                raise ValueError(
+                    f'SpmdTrainStep pipeline: num_microbatches {pp_m} '
+                    f'must be a multiple of the wave size '
+                    f'{min(pp_m, pp_size)} (the pp axis span)')
+        self._pp_schedule = pp_sched
+        self._pp_microbatches = pp_m
+
         entries: Dict[str, tuple] = {}
         fsdp_dim: Dict[str, Optional[int]] = {}
         kinds: Dict[str, str] = {}
         arrays = {n: jnp.asarray(v) for n, v in params.items()}
         for n, v in arrays.items():
-            e = spec_entries(p.param_spec(n, v.shape))
-            e = e + (None,) * (v.ndim - len(e))
+            if n in stage_names:
+                if v.shape[0] != pp_size:
+                    raise ValueError(
+                        f'SpmdTrainStep pipeline: stage param {n!r} has '
+                        f'{v.shape[0]} stacked stages but mesh axis '
+                        f'{pp_ax!r} has {pp_size} devices')
+                # stacked dim rides the 'stage' rule; the PER-STAGE dims
+                # still resolve through param_spec, so Megatron-marked
+                # stage weights tile over tp too (pp×tp composition) —
+                # fsdp entries are dropped (stage_fn sees its stage's
+                # full value; there is no gather inside the schedule)
+                tail_e = spec_entries(p.param_spec(n, v.shape[1:]))
+                tail_e = tail_e + (None,) * (v.ndim - 1 - len(tail_e))
+                tail_e = tuple(
+                    x if x is not None and fsdp_ax not in (
+                        (x,) if isinstance(x, str) else tuple(x))
+                    else None for x in tail_e)
+                e = (pp_ax,) + tail_e
+            else:
+                e = spec_entries(p.param_spec(n, v.shape))
+                e = e + (None,) * (v.ndim - len(e))
             axes = _flat_axes(e)
             if fsdp_ax is not None and fsdp_ax in axes:
                 kinds[n] = 'fsdp'
@@ -153,6 +211,51 @@ class SpmdTrainStep:
                     g = qc.qallreduce_sum(g, ax, comm_dtype=comm)
             return g
 
+        def pp_value_and_grad(full, batch):
+            """Schedule-structured (loss, grads) over the pp axis: gpipe
+            runs all pp_m microbatches through one pipeline pass and one
+            backward; 1f1b runs one backward per wave of pp_size
+            microbatches, so only a wave of residuals is resident."""
+            def pipe_loss(pf, bslice, n_mb):
+                sp = {k: pf[k][0] for k in stage_names}
+                x = x_fn(bslice)
+                if x.shape[0] % n_mb:
+                    raise ValueError(
+                        f'SpmdTrainStep pipeline: local batch '
+                        f'{x.shape[0]} not divisible by microbatch '
+                        f'count {n_mb}')
+                xm = x.reshape((n_mb, x.shape[0] // n_mb) + x.shape[1:])
+                ym = pipeline_stage_scan(stage_fn, sp, xm, n_mb,
+                                         axis=pp_ax, p=pp_size)
+                # the tail loss is seeded on every pp device, so the
+                # cotangent crossing the psum-broadcast back into the
+                # schedule arrives pp_size-fold; rescale the backward
+                # (forward value untouched) so stage grads are exact
+                s = 1.0 / pp_size
+                ym = ym * s + lax.stop_gradient(ym * (1.0 - s))
+                y = ym.reshape((ym.shape[0] * ym.shape[1],)
+                               + ym.shape[2:])
+                return tail_fn(pf, y, bslice)
+
+            if pp_sched == 'gpipe':
+                return jax.value_and_grad(
+                    lambda pf: pipe_loss(pf, batch, pp_m))(full)
+            wsz = min(pp_m, pp_size)                        # 1f1b
+            nw = pp_m // wsz
+            gacc = jax.tree_util.tree_map(jnp.zeros_like, full)
+            lacc = jnp.zeros((), jnp.float32)
+            for i in range(nw):
+                bi = jax.tree_util.tree_map(
+                    lambda a: a.reshape((nw, a.shape[0] // nw)
+                                        + a.shape[1:])[i], batch)
+                li, gi = jax.value_and_grad(
+                    lambda pf: pipe_loss(pf, bi, wsz))(full)
+                gacc = jax.tree_util.tree_map(jnp.add, gacc, gi)
+                lacc = lacc + li
+            scale = 1.0 / nw                # mean of equal wave means
+            return lacc * scale, jax.tree_util.tree_map(
+                lambda a: a * scale, gacc)
+
         def body(ptiles, batch):
             full = {}
             for n, v in ptiles.items():
@@ -161,7 +264,10 @@ class SpmdTrainStep:
                                              axis=fsdp_dim[n], tiled=True)
                 else:
                     full[n] = v
-            loss, grads = jax.value_and_grad(loss_fn)(full, batch)
+            if pp_sched is not None:
+                loss, grads = pp_value_and_grad(full, batch)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(full, batch)
             new = {}
             for n in fsdp_names:
                 d = fsdp_dim[n]
@@ -206,10 +312,14 @@ class SpmdTrainStep:
 
     # ------------------------------------------------------------------
     def __call__(self, batch):
-        batch = jnp.asarray(batch)
-        if self._n_data > 1 and batch.shape[0] % self._n_data:
+        # batch may be one array or a pytree of batch-major arrays (the
+        # pipeline tail reads labels from its slice); the single bspec
+        # applies to every leaf via shard_map's spec-prefix semantics
+        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        b0 = jax.tree_util.tree_leaves(batch)[0]
+        if self._n_data > 1 and b0.shape[0] % self._n_data:
             raise ValueError(
-                f'SpmdTrainStep: global batch {batch.shape[0]} is not '
+                f'SpmdTrainStep: global batch {b0.shape[0]} is not '
                 f'divisible by the data-axis span {self._n_data} '
                 f'({self._data_axes})')
         for elems, axis_size, phases in self._sync_records:

@@ -1,0 +1,225 @@
+"""Pipeline-parallel schedule bench (PERF.md "Pipeline parallelism").
+
+Two measurements, both on the executor's real lowering path (the same
+`PipelineOptimizer` stamp → scan/1F1B lowering a training script hits):
+
+1. ``measure_schedules`` — an activation-heavy deep MLP cut into 2
+   stages, GPipe vs 1F1B at the same cut and microbatch count:
+
+   - bitwise loss parity across the schedules (they are the same
+     arithmetic — 1F1B only reorders the backward);
+   - PREDICTED host peak from the staged planner
+     (`analysis.stage.plan_staged_program`) — GPipe keeps all m
+     microbatches of residuals in flight, 1F1B one wave;
+   - MEASURED XLA temp bytes of the compiled step
+     (`jit(...).lower(...).compile().memory_analysis()`), so the
+     planner's prediction is checked against the compiler, not assumed;
+   - steps/s for both schedules.
+
+2. ``measure_autocut`` — the bert_layer recipe: every manual single-cut
+   candidate (`analysis.stage.stage_cut_candidates`) is scored through
+   the staged planner and the cost-model auto-cut
+   (`solve_stage_cuts`) must land within 5% of the best manual cut.
+
+Valid on CPU — parity, planner-vs-XLA agreement, and cut quality are
+host-independent claims; steps/s is reported for trend only (a CPU host
+pipelines nothing, so 1F1B ≈ GPipe throughput here — the schedule's win
+is the peak-residency column).
+
+  JAX_PLATFORMS=cpu python tools/bench_pp.py [--smoke] [--steps N]
+
+Acceptance (tier-1, tests/framework/test_bench_pp.py): bitwise parity,
+1F1B predicted AND measured peak <= GPipe, auto-cut within 5%.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable as `python tools/bench_pp.py` from the repo root
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+_TOOLS = os.path.join(_REPO, 'tools')
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+
+def build_pp_mlp(smoke=False):
+    """Activation-heavy deep MLP under a 2-stage PipelineOptimizer
+    (auto-cut, schedule stamped gpipe — the env knob flips it without a
+    rebuild). Returns (main, startup, bs, loss)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers as L
+    width, depth, bs = (128, 8, 32) if smoke else (512, 12, 128)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data('pp_x', [width], dtype='float32')
+        y = L.data('pp_y', [1], dtype='float32')
+        h = x
+        for _ in range(depth):
+            h = L.fc(h, size=width, act='relu')
+        pred = L.fc(h, size=1)
+        loss = L.reduce_mean(L.square_error_cost(pred, y))
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(learning_rate=1e-3),
+            num_stages=2, num_microbatches=4, schedule='gpipe')
+        opt.minimize(loss)
+    return main, startup, bs, loss
+
+
+def _pipeline_stamp(program):
+    """The marker's stamped pipeline plan (cut_vars/m/schedule)."""
+    for op in reversed(program.global_block().ops):
+        pipe = op.attrs.get('pipeline')
+        if pipe:
+            return pipe
+    raise ValueError('no pipeline stamp on the program')
+
+
+def _measured_temp_bytes(exe, program, feed, fetch_names, scope):
+    """XLA's temp-buffer bytes for the step the executor just compiled:
+    re-lower the same (program, feeds, fetches) through the executor's
+    own `_lower` and ask the compiled artifact, donation included."""
+    import jax
+    import numpy as np
+    from paddle_tpu import ir
+    from paddle_tpu.core.random import default_generator
+    from paddle_tpu.executor import _lower
+
+    feed_vals = {n: np.asarray(v) for n, v in feed.items()}
+    state_names = sorted(v.name for v in program.list_vars()
+                         if v.persistable
+                         and scope.find(v.name) is not None)
+    opt_program, _ = ir.apply_pipeline(
+        program, fetch_names=fetch_names, feed_names=list(feed_vals))
+    step = _lower(opt_program, list(feed_vals), fetch_names, state_names,
+                  feed_shapes={n: v.shape for n, v in feed_vals.items()})
+    dstate = {n: scope.find(n) for n in state_names}
+    key = default_generator.base_key()
+    compiled = jax.jit(step, donate_argnums=(0,)).lower(
+        dstate, {}, feed_vals, key).compile()
+    return int(compiled.memory_analysis().temp_size_in_bytes)
+
+
+def measure_schedules(smoke=False, steps=None):
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.analysis.stage import plan_staged_program
+    from paddle_tpu.core.random import default_generator
+
+    main, startup, bs, loss = build_pp_mlp(smoke)
+    steps = steps or (4 if smoke else 8)
+    stamp = _pipeline_stamp(main)
+    cuts, m = list(stamp['cut_vars']), int(stamp['num_microbatches'])
+    rng = np.random.RandomState(0)
+    feeds = [{'pp_x': rng.randn(bs, main.global_block().var('pp_x')
+                                .shape[-1]).astype(np.float32),
+              'pp_y': rng.randn(bs, 1).astype(np.float32)}
+             for _ in range(steps)]
+    fetch = [loss.name]
+
+    old_env = os.environ.get('PADDLE_TPU_PP_SCHEDULE')
+    out = {'bench': 'pipeline_schedules', 'steps': steps, 'batch': bs,
+           'microbatches': m, 'cut_vars': cuts, 'schedules': {}}
+    losses = {}
+    try:
+        for sched in ('gpipe', '1f1b'):
+            os.environ['PADDLE_TPU_PP_SCHEDULE'] = sched
+            splan = plan_staged_program(
+                main, cuts, m, schedule=sched, fetch_names=fetch,
+                feed_names=['pp_x', 'pp_y'],
+                feed_shapes={'pp_x': feeds[0]['pp_x'].shape,
+                             'pp_y': feeds[0]['pp_y'].shape})
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                default_generator.seed(42)
+                exe = fluid.Executor()
+                exe.run(startup)
+                exe.run(main, feed=feeds[0], fetch_list=fetch)  # compile
+                measured = _measured_temp_bytes(exe, main, feeds[0],
+                                                fetch, scope)
+                # re-seed state so both schedules see identical params
+                exe.run(startup)
+                default_generator.seed(42)
+                got, t0 = [], time.perf_counter()
+                for feed in feeds:
+                    got.append(np.asarray(
+                        exe.run(main, feed=feed, fetch_list=fetch)[0]))
+                dt = time.perf_counter() - t0
+            losses[sched] = [g.tobytes() for g in got]
+            out['schedules'][sched] = {
+                'predicted_host_peak_bytes': int(splan.host_peak_bytes),
+                'measured_temp_bytes': measured,
+                'steps_per_s': round(steps / dt, 3),
+            }
+    finally:
+        if old_env is None:
+            os.environ.pop('PADDLE_TPU_PP_SCHEDULE', None)
+        else:
+            os.environ['PADDLE_TPU_PP_SCHEDULE'] = old_env
+
+    g, f = out['schedules']['gpipe'], out['schedules']['1f1b']
+    out['bitwise_identical'] = losses['gpipe'] == losses['1f1b']
+    out['predicted_1f1b_le_gpipe'] = (f['predicted_host_peak_bytes']
+                                      <= g['predicted_host_peak_bytes'])
+    out['measured_1f1b_le_gpipe'] = (f['measured_temp_bytes']
+                                     <= g['measured_temp_bytes'])
+    return out
+
+
+def measure_autocut(smoke=False, tolerance=0.05):
+    """Auto-cut vs every manual single cut on the bert_layer recipe,
+    scored by the staged planner's max per-stage cost (flops+bytes)."""
+    from lint_program import _build_recipe
+    from paddle_tpu.analysis.stage import (plan_staged_program,
+                                           solve_stage_cuts,
+                                           stage_cut_candidates)
+
+    program, fetches, feeds = _build_recipe('bert_layer')
+    bs = 8 if smoke else 16
+
+    def cut_cost(cuts):
+        splan = plan_staged_program(program, cuts, 2, schedule='1f1b',
+                                    fetch_names=fetches, feed_names=feeds,
+                                    assume_dim=bs)
+        return max(r.flops + r.bytes for r in splan.stages)
+
+    cands = stage_cut_candidates(program, fetch_names=fetches,
+                                 feed_names=feeds, assume_dim=bs)
+    manual = {c: cut_cost([c]) for c in cands}
+    best_var = min(manual, key=manual.get)
+    auto_cuts, report = solve_stage_cuts(program, 2, fetch_names=fetches,
+                                         feed_names=feeds, assume_dim=bs)
+    auto_cost = cut_cost(auto_cuts)
+    return {'bench': 'pipeline_autocut', 'recipe': 'bert_layer',
+            'candidates': len(cands),
+            'auto_cut': auto_cuts, 'auto_cost': int(auto_cost),
+            'best_manual_cut': best_var,
+            'best_manual_cost': int(manual[best_var]),
+            'balance': round(report['balance'], 4),
+            'within_tolerance': bool(
+                auto_cost <= manual[best_var] * (1 + tolerance))}
+
+
+def measure_all(smoke=False, steps=None):
+    return {'schedules': measure_schedules(smoke=smoke, steps=steps),
+            'autocut': measure_autocut(smoke=smoke)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--smoke', action='store_true',
+                    help='tiny shapes / CI smoke sizes')
+    ap.add_argument('--steps', type=int, default=None,
+                    help='timed steps per schedule')
+    args = ap.parse_args()
+    for res in measure_all(smoke=args.smoke, steps=args.steps).values():
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -89,6 +89,21 @@ def main(argv=None):
                     help='plan the post-IR-pipeline program (fuse knobs '
                          'on; includes auto_remat when '
                          'PADDLE_TPU_HBM_BUDGET_MB is set)')
+    ap.add_argument('--stages', type=int, default=None,
+                    help='plan the program cut into N pipeline stages '
+                         '(cost-model auto-cut, analysis.stage.'
+                         'solve_stage_cuts) and print the per-stage '
+                         'report; --budget then gates on the staged peak')
+    ap.add_argument('--pp-schedule', choices=('gpipe', '1f1b',
+                                              'interleaved'),
+                    default='gpipe',
+                    help='pipeline schedule the staged plan models '
+                         '(default gpipe)')
+    ap.add_argument('--pp-microbatches', type=int, default=None,
+                    help='microbatch count for the staged plan; default '
+                         'solves the smallest count that fits --budget '
+                         '(analysis.stage.solve_microbatches), or the '
+                         'stage count without a budget')
     ap.add_argument('--no-donate', action='store_true',
                     help='plan with buffer donation off '
                          '(PADDLE_TPU_DONATE=0 semantics)')
@@ -114,6 +129,12 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.batch_size <= 0:
         ap.error('--batch-size must be > 0')
+    if args.stages is not None and args.stages < 2:
+        ap.error('--stages must be >= 2')
+    if args.pp_microbatches is not None and args.pp_microbatches <= 0:
+        ap.error('--pp-microbatches must be > 0')
+    if args.pp_microbatches is not None and args.stages is None:
+        ap.error('--pp-microbatches requires --stages')
     if not (args.model_dir or args.recipe or args.decode_pool_mb):
         ap.error('one of --model-dir, --recipe or --decode-pool-mb '
                  'is required')
@@ -157,6 +178,28 @@ def main(argv=None):
                         assume_dim=args.batch_size)
     budget_bytes = int(args.budget * (1 << 20)) if args.budget else None
 
+    splan = None
+    if args.stages is not None:
+        from paddle_tpu.analysis.stage import (plan_staged_program,
+                                               solve_microbatches,
+                                               solve_stage_cuts)
+        cuts, _cut_report = solve_stage_cuts(
+            program, args.stages, fetch_names=fetches, feed_names=feeds,
+            assume_dim=args.batch_size)
+        m = args.pp_microbatches
+        if m is None:
+            if budget_bytes:
+                m, _peak, _fits = solve_microbatches(
+                    program, cuts, args.pp_schedule, budget_bytes,
+                    fetch_names=fetches, feed_names=feeds,
+                    assume_dim=args.batch_size)
+            else:
+                m = args.stages
+        splan = plan_staged_program(
+            program, cuts, m, schedule=args.pp_schedule,
+            fetch_names=fetches, feed_names=feeds,
+            donate=not args.no_donate, assume_dim=args.batch_size)
+
     if args.json:
         doc = plan.to_dict(top=args.top)
         doc['target'] = label
@@ -164,6 +207,11 @@ def main(argv=None):
         if budget_bytes:
             doc['budget_bytes'] = budget_bytes
             doc['fits_budget'] = plan.peak_bytes <= budget_bytes
+        if splan is not None:
+            doc['staged'] = splan.to_dict()
+            if budget_bytes:
+                doc['staged']['fits_budget'] = \
+                    splan.host_peak_bytes <= budget_bytes
         if pool_doc:
             doc['decode_pool'] = pool_doc
         print(json.dumps(doc, indent=1))
@@ -173,9 +221,12 @@ def main(argv=None):
               f'{plan.plan_seconds * 1e3:.1f}ms)')
         print('\n'.join(plan.format_report(top=args.top,
                                            budget_bytes=budget_bytes)))
+        if splan is not None:
+            print('\n'.join(splan.format_report(budget_bytes=budget_bytes)))
         if pool_doc:
             print('\n'.join(_format_decode_pool(pool_doc)))
-    return 1 if budget_bytes and plan.peak_bytes > budget_bytes else 0
+    peak = splan.host_peak_bytes if splan is not None else plan.peak_bytes
+    return 1 if budget_bytes and peak > budget_bytes else 0
 
 
 if __name__ == '__main__':
